@@ -1,0 +1,76 @@
+"""metaflow_tpu: a TPU-native workflow framework with Metaflow's capabilities.
+
+Public API (mirrors the reference's `from metaflow import ...` surface):
+
+    from metaflow_tpu import FlowSpec, step, Parameter, JSONType, current
+    from metaflow_tpu import retry, catch, timeout, resources, environment
+    from metaflow_tpu import tpu, checkpoint, parallel
+    from metaflow_tpu import Flow, Run, Step, Task, DataArtifact, namespace
+    from metaflow_tpu import Runner
+"""
+
+from .flowspec import FlowSpec, step
+from .parameters import Parameter, JSONType
+from .current import current
+from .exception import TpuFlowException, MetaflowException
+from .unbounded_foreach import UnboundedForeachInput
+from .decorators import make_step_decorator
+from .plugins import STEP_DECORATORS
+
+# generate user-facing decorator callables from the registry
+retry = make_step_decorator(STEP_DECORATORS["retry"])
+catch = make_step_decorator(STEP_DECORATORS["catch"])
+timeout = make_step_decorator(STEP_DECORATORS["timeout"])
+environment = make_step_decorator(STEP_DECORATORS["environment"])
+resources = make_step_decorator(STEP_DECORATORS["resources"])
+parallel = make_step_decorator(STEP_DECORATORS["parallel"])
+tpu = make_step_decorator(STEP_DECORATORS["tpu"])
+tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
+checkpoint = make_step_decorator(STEP_DECORATORS["checkpoint"])
+
+# client API (lazy-ish: import is cheap, no jax involved)
+from .client import (  # noqa: E402
+    Metaflow,
+    Flow,
+    Run,
+    Step,
+    Task,
+    DataArtifact,
+    namespace,
+    get_namespace,
+    default_namespace,
+)
+
+from .runner import Runner  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FlowSpec",
+    "step",
+    "Parameter",
+    "JSONType",
+    "current",
+    "TpuFlowException",
+    "MetaflowException",
+    "UnboundedForeachInput",
+    "retry",
+    "catch",
+    "timeout",
+    "environment",
+    "resources",
+    "parallel",
+    "tpu",
+    "tpu_parallel",
+    "checkpoint",
+    "Metaflow",
+    "Flow",
+    "Run",
+    "Step",
+    "Task",
+    "DataArtifact",
+    "namespace",
+    "get_namespace",
+    "default_namespace",
+    "Runner",
+]

@@ -1,0 +1,345 @@
+"""Click CLI: `python flow.py run|resume|step|check|show|dump|logs|output-dot`.
+
+Reference behavior: metaflow/cli.py (start group:235-333) +
+cli_components/{run_cmds,step_cmd,dump_cmd}.py. The `step` command is the
+hidden per-task entrypoint the runtime launches as a subprocess; `run` drives
+the NativeRuntime scheduler.
+"""
+
+import json
+import os
+import sys
+import threading
+import traceback
+
+import click
+
+from .datastore import STORAGE_BACKENDS, FlowDataStore
+from .decorators import (
+    _attach_decorators,
+    _init_step_decorators,
+    _init_flow_decorators,
+)
+from .exception import TpuFlowException
+from .graph import FlowGraph
+from .lint import lint
+from .metadata import METADATA_PROVIDERS
+from .plugins.parallel_decorator import ParallelDecorator
+from .plugins.tpu.tpu_parallel import TpuParallelDecorator
+from .runtime import NativeRuntime
+from .task import MetaflowTask
+from .unbounded_foreach import UBF_CONTROL
+from .util import decompress_list, read_latest_run_id, resolve_identity
+
+# the step command records its argv here so gang control tasks can replay it
+# for worker ranks (plugins/parallel_decorator.py)
+STEP_ARGV_ENV = "TPUFLOW_STEP_ARGV"
+
+
+def echo(line):
+    print(line, flush=True)
+
+
+def echo_quiet(line):
+    pass
+
+
+class CliState(object):
+    def __init__(self, flow):
+        self.flow = flow
+        self.graph = None
+        self.flow_datastore = None
+        self.metadata = None
+        self.echo = echo
+        self.quiet = False
+        self.decospecs = []
+
+
+def _prepare(state, decospecs):
+    """Lint, attach --with decorators, auto-attach the TPU gang decorator."""
+    flow = state.flow
+    state.graph = flow._graph
+    lint(state.graph)
+    if decospecs:
+        _attach_decorators(flow, decospecs)
+        state.decospecs = list(decospecs)
+    # TPU-first default: gang steps get jax.distributed wiring automatically
+    for node in state.graph:
+        if node.parallel_step:
+            step_func = getattr(flow, node.name)
+            if not any(
+                isinstance(d, ParallelDecorator) for d in step_func.decorators
+            ):
+                step_func.decorators.append(
+                    TpuParallelDecorator(statically_defined=False)
+                )
+    _init_step_decorators(flow, state.graph, None, state.flow_datastore, state.echo)
+
+
+def _param_options(flow):
+    opts = []
+    for name, param in flow._get_parameters():
+        kwargs = {"default": None, "required": False}
+        if param.help:
+            kwargs["help"] = param.help
+        opts.append(click.Option(["--" + name.replace("_", "-"), name], **kwargs))
+    return opts
+
+
+def _collect_params(flow, kwargs):
+    params = {}
+    for name, _param in flow._get_parameters():
+        val = kwargs.pop(name, None)
+        if val is not None:
+            params[name] = val
+    return params, kwargs
+
+
+def main(flow, args=None):
+    state = CliState(flow)
+
+    @click.group(name=flow.name, invoke_without_command=False)
+    @click.option("--datastore", default="local",
+                  type=click.Choice(list(STORAGE_BACKENDS)),
+                  help="Artifact storage backend.")
+    @click.option("--datastore-root", default=None,
+                  help="Root path for the datastore.")
+    @click.option("--metadata", default="local",
+                  type=click.Choice(list(METADATA_PROVIDERS)),
+                  help="Metadata provider.")
+    @click.option("--quiet/--no-quiet", default=False)
+    @click.option("--with", "decospecs", multiple=True,
+                  help="Attach a decorator to all steps (name:attr=val,...)")
+    @click.pass_context
+    def start(ctx, datastore, datastore_root, metadata, quiet, decospecs):
+        storage_impl = STORAGE_BACKENDS[datastore]
+        state.flow_datastore = FlowDataStore(
+            flow.name, storage_impl, ds_root=datastore_root
+        )
+        state.metadata = METADATA_PROVIDERS[metadata](flow=flow)
+        state.quiet = quiet
+        if quiet:
+            state.echo = echo_quiet
+        _prepare(state, decospecs)
+        ctx.obj = state
+
+    @start.command(help="Run the workflow locally.")
+    @click.option("--max-workers", default=16, show_default=True)
+    @click.option("--max-num-splits", default=100, show_default=True)
+    @click.option("--tag", "tags", multiple=True)
+    @click.option("--run-id-file", default=None)
+    @click.option("--namespace", "user_namespace", default=None)
+    @click.pass_obj
+    def run(state, max_workers, max_num_splits, tags, run_id_file,
+            user_namespace, **kwargs):
+        params, _ = _collect_params(state.flow, kwargs)
+        state.metadata.add_sticky_tags(tags=tags)
+        runtime = NativeRuntime(
+            state.flow,
+            state.graph,
+            state.flow_datastore,
+            state.metadata,
+            params=params,
+            namespace=user_namespace or resolve_identity(),
+            max_workers=max_workers,
+            max_num_splits=max_num_splits,
+            echo=echo,
+            decospecs=state.decospecs,
+        )
+        if run_id_file:
+            with open(run_id_file, "w") as f:
+                f.write(str(runtime.run_id))
+        runtime.execute()
+
+    run.params.extend(_param_options(flow))
+
+    @start.command(help="Resume a past run from where it failed.")
+    @click.argument("step-to-rerun", required=False)
+    @click.option("--origin-run-id", default=None,
+                  help="Run to resume (default: latest run).")
+    @click.option("--max-workers", default=16)
+    @click.option("--max-num-splits", default=100)
+    @click.option("--run-id-file", default=None)
+    @click.pass_obj
+    def resume(state, step_to_rerun, origin_run_id, max_workers,
+               max_num_splits, run_id_file):
+        origin = origin_run_id or read_latest_run_id(flow.name)
+        if origin is None:
+            raise TpuFlowException(
+                "No previous run found for flow %s: nothing to resume."
+                % flow.name
+            )
+        if step_to_rerun and step_to_rerun not in state.graph:
+            raise TpuFlowException(
+                "Step *%s* does not exist in flow %s." % (step_to_rerun, flow.name)
+            )
+        # reuse the origin run's parameters
+        params = {}
+        try:
+            origin_start = state.flow_datastore.get_task_datastores(
+                run_id=origin, steps=["start"]
+            )
+            if origin_start:
+                ds = origin_start[0]
+                for name in ds.get("_parameter_names") or []:
+                    params[name] = ds[name]
+        except Exception:
+            pass
+        runtime = NativeRuntime(
+            state.flow,
+            state.graph,
+            state.flow_datastore,
+            state.metadata,
+            params=params,
+            namespace=resolve_identity(),
+            max_workers=max_workers,
+            max_num_splits=max_num_splits,
+            origin_run_id=origin,
+            clone_run_id=origin,
+            resume_step=step_to_rerun,
+            echo=echo,
+            decospecs=state.decospecs,
+        )
+        if run_id_file:
+            with open(run_id_file, "w") as f:
+                f.write(str(runtime.run_id))
+        runtime.execute()
+
+    @start.command(hidden=True, help="Run a single task (internal).")
+    @click.argument("step-name")
+    @click.option("--run-id", required=True)
+    @click.option("--task-id", required=True)
+    @click.option("--input-paths", default=None)
+    @click.option("--split-index", default=None)
+    @click.option("--retry-count", default=0)
+    @click.option("--max-user-code-retries", default=0)
+    @click.option("--namespace", "user_namespace", default=None)
+    @click.option("--ubf-context", default=None)
+    @click.option("--origin-run-id", default=None)
+    @click.option("--params-json", default=None)
+    @click.pass_obj
+    def step(state, step_name, run_id, task_id, input_paths, split_index,
+             retry_count, max_user_code_retries, user_namespace, ubf_context,
+             origin_run_id, params_json):
+        os.environ[STEP_ARGV_ENV] = json.dumps(sys.argv)
+        if ubf_context not in (None, "", "none"):
+            ubf = ubf_context
+        else:
+            ubf = None
+        paths = decompress_list(input_paths) if input_paths else []
+
+        # task heartbeat: mtime-based liveness, 10s cadence
+        state.metadata.start_task_heartbeat(flow.name, run_id, step_name, task_id)
+        beat_stop = threading.Event()
+
+        def beats():
+            while not beat_stop.wait(10):
+                state.metadata.heartbeat()
+
+        beat_thread = threading.Thread(target=beats, daemon=True)
+        beat_thread.start()
+
+        task = MetaflowTask(
+            state.flow,
+            state.flow_datastore,
+            state.metadata,
+            console_logger=echo,
+            ubf_context=ubf,
+        )
+        try:
+            task.run_step(
+                step_name,
+                run_id,
+                task_id,
+                origin_run_id=origin_run_id,
+                input_paths=paths,
+                split_index=int(split_index) if split_index not in (None, "") else None,
+                retry_count=int(retry_count),
+                max_user_code_retries=int(max_user_code_retries),
+                namespace=user_namespace,
+                parameters_json=params_json,
+                num_parallel=0,
+            )
+        finally:
+            beat_stop.set()
+
+    @start.command(help="Validate the flow graph.")
+    @click.pass_obj
+    def check(state):
+        # lint already ran in _prepare; reaching here means the graph is valid
+        echo("Validating your flow...")
+        echo("    The graph looks good!")
+
+    @start.command(help="Show the structure of the flow.")
+    @click.pass_obj
+    def show(state):
+        echo("\n%s\n" % (state.graph.doc or flow.name))
+        for name in state.graph.sorted_nodes():
+            node = state.graph[name]
+            echo("Step *%s* (%s)" % (name, node.type))
+            if node.doc:
+                echo("    %s" % node.doc)
+            if node.type == "end":
+                echo("    => done")
+            else:
+                extra = ""
+                if node.type == "foreach":
+                    extra = " (foreach over '%s')" % node.foreach_param
+                elif node.type == "split-parallel":
+                    extra = " (gang)"
+                elif node.type == "split-switch":
+                    extra = " (switch on '%s')" % node.condition
+                echo("    => %s%s" % (", ".join(node.out_funcs), extra))
+
+    @start.command(name="output-dot", help="Print the DAG in DOT format.")
+    @click.pass_obj
+    def output_dot(state):
+        print(state.graph.output_dot())
+
+    @start.command(help="Dump artifacts of a task: dump RUN/STEP/TASK")
+    @click.argument("pathspec")
+    @click.option("--private/--no-private", default=False,
+                  help="Include internal (underscore) artifacts.")
+    @click.option("--max-value-size", default=1000)
+    @click.pass_obj
+    def dump(state, pathspec, private, max_value_size):
+        parts = pathspec.split("/")
+        if len(parts) == 3:
+            run_id, step_name, task_id = parts
+        else:
+            raise TpuFlowException(
+                "Specify a task as RUN_ID/STEP/TASK_ID; got %r" % pathspec
+            )
+        ds = state.flow_datastore.get_task_datastore(run_id, step_name, task_id)
+        for name, value in sorted(ds.to_dict(show_private=private).items()):
+            rep = repr(value)
+            if len(rep) > max_value_size:
+                rep = rep[:max_value_size] + "..."
+            print("%s = %s" % (name, rep))
+
+    @start.command(help="Show logs of a task: logs RUN/STEP/TASK")
+    @click.argument("pathspec")
+    @click.option("--stderr/--stdout", default=False)
+    @click.pass_obj
+    def logs(state, pathspec, stderr):
+        parts = pathspec.split("/")
+        run_id, step_name, task_id = parts[-3], parts[-2], parts[-1]
+        ds = state.flow_datastore.get_task_datastore(
+            run_id, step_name, task_id, allow_not_done=True
+        )
+        name = "stderr" if stderr else "stdout"
+        data = ds.load_log_legacy("runtime", name)
+        sys.stdout.write(data.decode("utf-8", errors="replace"))
+
+    try:
+        start(args=args, standalone_mode=False, obj=state)
+    except click.exceptions.ClickException as ex:
+        ex.show()
+        sys.exit(ex.exit_code)
+    except TpuFlowException as ex:
+        sys.stderr.write("%s: %s\n" % (ex.headline, str(ex)))
+        if os.environ.get("TPUFLOW_DEBUG"):
+            traceback.print_exc()
+        sys.exit(1)
+    except click.exceptions.Abort:
+        sys.exit(1)

@@ -1,0 +1,15 @@
+from .storage import DataStoreStorage, LocalStorage, GCSStorage, STORAGE_BACKENDS
+from .cas import ContentAddressedStore
+from .task_datastore import TaskDataStore, MAX_ATTEMPTS
+from .flow_datastore import FlowDataStore
+
+__all__ = [
+    "DataStoreStorage",
+    "LocalStorage",
+    "GCSStorage",
+    "STORAGE_BACKENDS",
+    "ContentAddressedStore",
+    "TaskDataStore",
+    "FlowDataStore",
+    "MAX_ATTEMPTS",
+]

@@ -1,0 +1,114 @@
+"""Per-flow datastore: creates/finds TaskDataStores + raw data (code pkgs).
+
+Reference behavior: metaflow/datastore/flow_datastore.py (FlowDataStore:13,
+get_task_datastores:79 latest-attempt resolution, save_data:348).
+"""
+
+import hashlib
+
+from .cas import ContentAddressedStore
+from .task_datastore import TaskDataStore
+
+
+class FlowDataStore(object):
+    def __init__(self, flow_name, storage_impl, ds_root=None):
+        """storage_impl: a DataStoreStorage subclass; ds_root overrides its
+        configured root."""
+        root = ds_root or storage_impl.get_datastore_root_from_config()
+        self.flow_name = flow_name
+        self.storage = storage_impl(root)
+        self.ca_store = ContentAddressedStore(
+            self.storage.path_join(flow_name, "data"), self.storage
+        )
+
+    @property
+    def ds_type(self):
+        return self.storage.TYPE
+
+    @property
+    def ds_root(self):
+        return self.storage.datastore_root
+
+    def get_task_datastore(
+        self,
+        run_id,
+        step_name,
+        task_id,
+        attempt=None,
+        mode="r",
+        allow_not_done=False,
+    ):
+        return TaskDataStore(
+            self,
+            run_id,
+            step_name,
+            task_id,
+            attempt=attempt,
+            mode=mode,
+            allow_not_done=allow_not_done,
+        )
+
+    def get_task_datastores(
+        self, run_id=None, steps=None, pathspecs=None, allow_not_done=False
+    ):
+        """Return read-mode TaskDataStores for many tasks at once.
+
+        Either (run_id, steps) — all tasks of those steps — or explicit
+        pathspecs 'run/step/task'.
+        """
+        task_specs = []
+        if pathspecs is not None:
+            for ps in pathspecs:
+                parts = ps.split("/")
+                if len(parts) == 4:  # flow/run/step/task
+                    parts = parts[1:]
+                run, step, task = parts
+                task_specs.append((run, step, task))
+        else:
+            steps = steps or self.list_steps(run_id)
+            for step in steps:
+                for task in self.list_tasks(run_id, step):
+                    task_specs.append((run_id, step, task))
+        out = []
+        for run, step, task in task_specs:
+            ds = self.get_task_datastore(
+                run, step, task, mode="r", allow_not_done=allow_not_done
+            )
+            if ds.has_attempt():
+                out.append(ds)
+        return out
+
+    # ---------- run/step/task listing (powers the local client) ----------
+
+    def list_runs(self):
+        out = []
+        for path, is_file in self.storage.list_content([self.flow_name]):
+            name = self.storage.basename(path)
+            if not is_file and name not in ("data",):
+                out.append(name)
+        return out
+
+    def list_steps(self, run_id):
+        prefix = self.storage.path_join(self.flow_name, str(run_id))
+        return [
+            self.storage.basename(p)
+            for p, is_file in self.storage.list_content([prefix])
+            if not is_file and not self.storage.basename(p).startswith("_")
+        ]
+
+    def list_tasks(self, run_id, step_name):
+        prefix = self.storage.path_join(self.flow_name, str(run_id), step_name)
+        return [
+            self.storage.basename(p)
+            for p, is_file in self.storage.list_content([prefix])
+            if not is_file
+        ]
+
+    # ---------- raw data (code packages, include files) ----------
+
+    def save_data(self, data_iter):
+        """Save raw byte blobs; returns [(uri, key)] in order."""
+        return self.ca_store.save_blobs(data_iter, raw=True)
+
+    def load_data(self, keys):
+        return {k: blob for k, blob in self.ca_store.load_blobs(keys, force_raw=True)}

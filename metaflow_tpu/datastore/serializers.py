@@ -1,0 +1,160 @@
+"""Artifact serializer registry — JAX/numpy arrays are first-class.
+
+Reference shape: metaflow/datastore/artifacts/serializer.py (priority-ordered
+registry, pickle as the 9999 fallback). TPU-first choices:
+
+  - `jax.Array` / `np.ndarray` serialize as .npy bytes after a single
+    device→host transfer (`jax.device_get`), never through pickle's memo
+    machinery — multi-GB arrays stream at memcpy speed.
+  - pytrees of arrays (dicts/lists/tuples/flax state) go through a
+    treedef + packed-arrays format for the same reason.
+  - everything else falls back to pickle (highest protocol).
+
+Each serializer returns (payload_bytes, type_tag); deserialization dispatches
+on the stored tag, so formats can evolve independently.
+"""
+
+import io
+import pickle
+
+import numpy as np
+
+TYPE_NPY = "npy"
+TYPE_PYTREE = "pytree"
+TYPE_PICKLE = "pickle"
+
+
+def _is_jax_array(obj):
+    try:
+        import jax
+
+        return isinstance(obj, jax.Array)
+    except ImportError:
+        return False
+
+
+def _tree_only_arrays(obj, depth=0):
+    """True if obj is a (nested) dict/list/tuple whose leaves are all
+    arrays/scalars — eligible for the fast pytree format."""
+    if depth > 16:
+        return False
+    if isinstance(obj, (np.ndarray,)) or _is_jax_array(obj):
+        return True
+    if isinstance(obj, (int, float, bool)) or obj is None:
+        return True
+    if isinstance(obj, dict):
+        return all(isinstance(k, str) for k in obj) and all(
+            _tree_only_arrays(v, depth + 1) for v in obj.values()
+        )
+    if isinstance(obj, (list, tuple)):
+        return bool(obj) and all(_tree_only_arrays(v, depth + 1) for v in obj)
+    return False
+
+
+def _to_host(arr):
+    if _is_jax_array(arr):
+        import jax
+
+        return np.asarray(jax.device_get(arr))
+    return arr
+
+
+def _npy_bytes(arr):
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def _npy_load(data):
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+def serialize(obj):
+    """Return (payload_bytes, type_tag)."""
+    if isinstance(obj, np.ndarray) and obj.dtype != object:
+        return _npy_bytes(obj), TYPE_NPY
+    if _is_jax_array(obj):
+        return _npy_bytes(_to_host(obj)), TYPE_NPY
+    if isinstance(obj, (dict, list, tuple)) and _tree_only_arrays(obj):
+        return _pytree_bytes(obj), TYPE_PYTREE
+    return pickle.dumps(_pickle_safe(obj), protocol=pickle.HIGHEST_PROTOCOL), TYPE_PICKLE
+
+
+def deserialize(payload, type_tag):
+    if type_tag == TYPE_NPY:
+        return _npy_load(payload)
+    if type_tag == TYPE_PYTREE:
+        return _pytree_load(payload)
+    return pickle.loads(payload)
+
+
+def _pickle_safe(obj):
+    """Move any device-resident arrays in an arbitrary object graph to host
+    before pickling (a jax.Array inside a random user object would otherwise
+    force pickle through a slow fallback or fail on non-addressable shards)."""
+    if _is_jax_array(obj):
+        return _to_host(obj)
+    if isinstance(obj, dict):
+        return {k: _pickle_safe(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_pickle_safe(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_pickle_safe(v) for v in obj)
+    return obj
+
+
+# ---- pytree format: json header (structure) + concatenated npy blocks ----
+
+import json
+
+
+def _pytree_bytes(tree):
+    leaves = []
+
+    def encode(node):
+        if isinstance(node, dict):
+            return {"t": "d", "v": {k: encode(v) for k, v in node.items()}}
+        if isinstance(node, list):
+            return {"t": "l", "v": [encode(v) for v in node]}
+        if isinstance(node, tuple):
+            return {"t": "t", "v": [encode(v) for v in node]}
+        if isinstance(node, (np.ndarray,)) or _is_jax_array(node):
+            leaves.append(_npy_bytes(_to_host(node)))
+            return {"t": "a", "i": len(leaves) - 1}
+        # scalar leaf
+        return {"t": "s", "v": node}
+
+    structure = encode(tree)
+    header = json.dumps(
+        {"structure": structure, "sizes": [len(b) for b in leaves]}
+    ).encode("utf-8")
+    out = io.BytesIO()
+    out.write(len(header).to_bytes(8, "little"))
+    out.write(header)
+    for b in leaves:
+        out.write(b)
+    return out.getvalue()
+
+
+def _pytree_load(data):
+    hlen = int.from_bytes(data[:8], "little")
+    header = json.loads(data[8 : 8 + hlen].decode("utf-8"))
+    offset = 8 + hlen
+    leaves = []
+    for size in header["sizes"]:
+        leaves.append(_npy_load(data[offset : offset + size]))
+        offset += size
+
+    def decode(node):
+        t = node["t"]
+        if t == "d":
+            return {k: decode(v) for k, v in node["v"].items()}
+        if t == "l":
+            return [decode(v) for v in node["v"]]
+        if t == "t":
+            return tuple(decode(v) for v in node["v"])
+        if t == "a":
+            return leaves[node["i"]]
+        return node["v"]
+
+    return decode(header["structure"])

@@ -1,0 +1,309 @@
+"""Static graph checks run before every execution.
+
+Reference behavior: metaflow/lint.py (22 checks, lint.py:50-505). Checks are
+registered on a FlowLinter and run in order; each raises LintWarn with the
+user's source line when violated.
+"""
+
+from .exception import TpuFlowException
+
+
+class LintWarn(TpuFlowException):
+    headline = "Validity checker found an issue"
+
+    def __init__(self, msg, lineno=None, source_file=None):
+        if source_file and lineno:
+            msg = "%s:%d: %s" % (source_file, lineno, msg)
+        super().__init__(msg=msg, lineno=None)
+
+
+class FlowLinter(object):
+    def __init__(self):
+        self._checks = []
+
+    def check(self, f):
+        self._checks.append(f)
+        f.attrs = []
+        return f
+
+    def ensure_static_graph(self, f):
+        f.attrs.append("check_static_transitions")
+        return f
+
+    def run_checks(self, graph, **kwargs):
+        for check in self._checks:
+            check(graph)
+
+
+linter = FlowLinter()
+
+
+def _err(msg, node=None):
+    if node is not None:
+        raise LintWarn(msg, node.func_lineno, node.source_file)
+    raise LintWarn(msg)
+
+
+@linter.check
+def check_reserved_words(graph):
+    RESERVED = {"name", "next", "input", "index", "cmd"}
+    for node in graph:
+        if node.name in RESERVED:
+            _err("Step name *%s* is a reserved word." % node.name, node)
+
+
+@linter.check
+def check_basic_steps(graph):
+    for prefix in ("start", "end"):
+        if prefix not in graph:
+            raise LintWarn(
+                "Add %s step in your flow: a flow must have a step named "
+                "*%s* decorated with @step." % (prefix, prefix)
+            )
+
+
+@linter.check
+def check_that_end_is_end(graph):
+    node = graph["end"]
+    if node.has_tail_next or node.invalid_tail_next:
+        _err("The *end* step must not have a self.next() transition.", node)
+    if node.num_args > 2:
+        _err("The *end* step takes no extra arguments.", node)
+
+
+@linter.check
+def check_step_names(graph):
+    for node in graph:
+        if node.name.startswith("_") or not node.name.replace("_", "").isalnum():
+            _err(
+                "Step name *%s* is invalid: use alphanumeric characters and "
+                "underscores only, and don't start with an underscore." % node.name,
+                node,
+            )
+
+
+@linter.check
+def check_num_args(graph):
+    for node in graph:
+        if node.num_args > 2:
+            _err(
+                "Step *%s* takes too many arguments: a step takes either "
+                "(self) or (self, inputs) for a join." % node.name,
+                node,
+            )
+        if node.num_args == 2 and node.type != "join":
+            _err(
+                "Step *%s* is defined with two arguments (self, inputs) but "
+                "it is not preceded by a split: only join steps take the "
+                "extra *inputs* argument." % node.name,
+                node,
+            )
+        if node.num_args < 2 and node.type == "join":
+            _err(
+                "Step *%s* joins results of multiple parent steps so it must "
+                "be defined as def %s(self, inputs)." % (node.name, node.name),
+                node,
+            )
+
+
+@linter.check
+def check_static_transitions(graph):
+    for node in graph:
+        if node.type != "end" and not node.has_tail_next:
+            _err(
+                "Step *%s* is missing a self.next() transition as its last "
+                "statement." % node.name,
+                node,
+            )
+
+
+@linter.check
+def check_valid_transitions(graph):
+    for node in graph:
+        if node.type != "end" and node.has_tail_next and node.invalid_tail_next:
+            _err(
+                "Step *%s* has an invalid self.next() transition. Valid forms: "
+                "self.next(self.one_step), self.next(self.a, self.b), "
+                "self.next(self.body, foreach='attr'), "
+                "self.next(self.gang, num_parallel=N), "
+                "self.next({'case': self.a, ...}, condition='attr')." % node.name,
+                node,
+            )
+
+
+@linter.check
+def check_unknown_transitions(graph):
+    for node in graph:
+        unknown = [n for n in node.out_funcs if n not in graph]
+        if unknown:
+            _err(
+                "Step *%s* transitions to unknown step(s): %s. Make sure all "
+                "steps referenced in self.next() are decorated with @step."
+                % (node.name, ", ".join(unknown)),
+                node,
+            )
+
+
+@linter.check
+def check_for_orphans(graph):
+    seen = {"start"}
+    frontier = ["start"] if "start" in graph else []
+    while frontier:
+        new = []
+        for name in frontier:
+            for out in graph[name].out_funcs:
+                if out in graph and out not in seen:
+                    seen.add(out)
+                    new.append(out)
+        frontier = new
+    orphans = [n.name for n in graph if n.name not in seen]
+    if orphans:
+        raise LintWarn(
+            "Step(s) %s are not reachable from the *start* step. Add "
+            "transitions to them or remove them." % ", ".join(orphans)
+        )
+
+
+@linter.check
+def check_for_acyclicity(graph):
+    # Cycles are only allowed through a split-switch (recursive switch).
+    def visit(name, path):
+        node = graph[name]
+        for out in node.out_funcs:
+            if out not in graph:
+                continue
+            if out in path:
+                # a back-edge is legal iff some node in the cycle is a switch
+                cycle = path[path.index(out):] + [out]
+                if not any(graph[c].type == "split-switch" for c in cycle[:-1]):
+                    _err(
+                        "There is a loop in your flow: %s. A flow must be a "
+                        "directed acyclic graph (recursion is only allowed "
+                        "via a switch transition)." % "->".join(cycle),
+                        node,
+                    )
+            else:
+                visit(out, path + [out])
+
+    if "start" in graph:
+        visit("start", ["start"])
+
+
+@linter.check
+def check_split_join_balance(graph):
+    """Every join must line up with its nearest split; the end step must be
+    reached with an empty split stack. (Reference: lint.py
+    check_split_join_balance:294 — the subtlest invariant in the graph.)"""
+
+    def traverse(node, split_stack, seen):
+        if node.name in seen:
+            return
+        seen.add(node.name)
+        if node.type in ("split", "split-switch"):
+            split_stack = split_stack + ["split:%s" % node.name]
+        elif node.type == "foreach":
+            split_stack = split_stack + ["foreach:%s" % node.name]
+        elif node.type == "split-parallel":
+            split_stack = split_stack + ["parallel:%s" % node.name]
+        elif node.type == "join":
+            if not split_stack:
+                _err(
+                    "Step *%s* is a join (it takes an extra *inputs* "
+                    "argument) but there is no split or foreach to join."
+                    % node.name,
+                    node,
+                )
+            split_stack = split_stack[:-1]
+        elif node.type == "end":
+            if split_stack:
+                kind, split_name = split_stack[-1].split(":", 1)
+                _err(
+                    "Step *end* reached before the %s started at step "
+                    "*%s* was joined. Add a join step (def step(self, "
+                    "inputs)) before *end*." % (kind, split_name),
+                    node,
+                )
+        for out in node.out_funcs:
+            if out in graph:
+                traverse(graph[out], split_stack, seen)
+
+    if "start" in graph:
+        traverse(graph["start"], [], set())
+
+    # a join must join the steps of exactly one split level: all of its
+    # in_funcs must share the same innermost split parent
+    for node in graph:
+        if node.type != "join":
+            continue
+        parents = set()
+        for in_func in node.in_funcs:
+            if in_func in graph:
+                p = graph[in_func].split_parents
+                parents.add(p[-1] if p else None)
+        if len(parents) > 1:
+            _err(
+                "Step *%s* joins steps from different splits (%s). A join "
+                "can only join steps of the same split."
+                % (node.name, ", ".join(sorted(node.in_funcs))),
+                node,
+            )
+
+
+@linter.check
+def check_parallel_rules(graph):
+    for node in graph:
+        if node.type == "split-parallel":
+            if len(node.out_funcs) != 1:
+                _err(
+                    "Step *%s* uses num_parallel so it must transition to "
+                    "exactly one (gang) step." % node.name,
+                    node,
+                )
+        if node.parallel_step:
+            # gang step must be immediately followed by a join
+            for out in node.out_funcs:
+                if out in graph and graph[out].type != "join":
+                    _err(
+                        "Step *%s* is a gang (@parallel) step so it must be "
+                        "followed by a join step." % node.name,
+                        node,
+                    )
+            if node.type == "join":
+                _err(
+                    "Step *%s* cannot be both a join and a gang (@parallel) "
+                    "step." % node.name,
+                    node,
+                )
+
+
+@linter.check
+def check_switch_rules(graph):
+    for node in graph:
+        if node.type == "split-switch":
+            if not node.switch_cases:
+                _err(
+                    "Step *%s* has a switch transition with no cases."
+                    % node.name,
+                    node,
+                )
+            if not node.condition:
+                _err(
+                    "Step *%s* has a switch transition without a condition."
+                    % node.name,
+                    node,
+                )
+
+
+@linter.check
+def check_empty_foreaches(graph):
+    for node in graph:
+        if node.type == "foreach" and not node.foreach_param:
+            _err(
+                "Step *%s* has a foreach transition without an iterator "
+                "attribute name." % node.name,
+                node,
+            )
+
+
+def lint(graph):
+    linter.run_checks(graph)

@@ -1,0 +1,6 @@
+from .metadata import MetaDatum, MetadataProvider
+from .local import LocalMetadataProvider
+
+METADATA_PROVIDERS = {"local": LocalMetadataProvider}
+
+__all__ = ["MetaDatum", "MetadataProvider", "LocalMetadataProvider", "METADATA_PROVIDERS"]

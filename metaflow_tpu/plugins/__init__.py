@@ -1,0 +1,42 @@
+"""Plugin registries (reference shape: metaflow/plugins/__init__.py *_DESC
+lists). Decorator classes register here; `--with name:attr=val` resolves
+through STEP_DECORATORS."""
+
+from .core_decorators import (
+    RetryDecorator,
+    CatchDecorator,
+    TimeoutDecorator,
+    EnvironmentDecorator,
+    ResourcesDecorator,
+)
+from .parallel_decorator import ParallelDecorator
+from .tpu.tpu_decorator import TpuDecorator
+from .tpu.tpu_parallel import TpuParallelDecorator
+from .tpu.checkpoint_decorator import CheckpointDecorator
+
+STEP_DECORATORS = {
+    cls.name: cls
+    for cls in (
+        RetryDecorator,
+        CatchDecorator,
+        TimeoutDecorator,
+        EnvironmentDecorator,
+        ResourcesDecorator,
+        ParallelDecorator,
+        TpuDecorator,
+        TpuParallelDecorator,
+        CheckpointDecorator,
+    )
+}
+
+FLOW_DECORATORS = {}
+
+
+def register_step_decorator(cls):
+    STEP_DECORATORS[cls.name] = cls
+    return cls
+
+
+def register_flow_decorator(cls):
+    FLOW_DECORATORS[cls.name] = cls
+    return cls

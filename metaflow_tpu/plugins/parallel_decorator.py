@@ -1,0 +1,209 @@
+"""@parallel: gang-scheduled steps (`self.next(step, num_parallel=N)`).
+
+Reference behavior: metaflow/plugins/parallel_decorator.py — the scheduler
+queues ONE control task (UBF_CONTROL); locally the control task forks N-1
+worker `step` subprocesses (task ids `{control}_node_i`), runs rank 0 itself,
+then waits; `current.parallel` is wired from MF_PARALLEL_* env vars; framework
+subclasses override `setup_distributed_env`.
+
+TPU-first: the TpuParallelDecorator subclass (plugins/tpu) initializes
+`jax.distributed` so each gang member becomes one process of a JAX multi-host
+program over a pod slice — XLA collectives over ICI/DCN replace the
+reference's torchrun/NCCL rendezvous (SURVEY.md §2.9).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from ..current import current, Parallel
+from ..decorators import StepDecorator
+from ..exception import TpuFlowException
+from ..metadata.metadata import MetaDatum
+from ..unbounded_foreach import UBF_CONTROL, UBF_TASK
+
+
+class ParallelDecorator(StepDecorator):
+    name = "parallel"
+    defaults = {}
+    # framework subclasses can require a coordinator port
+    COORDINATOR_PORT = 9379
+
+    def runtime_step_cli(self, cli_args, retry_count, max_user_code_retries,
+                         ubf_context):
+        if ubf_context == UBF_CONTROL:
+            cli_args.command_options["ubf-context"] = UBF_CONTROL
+
+    def task_pre_step(self, step_name, task_datastore, metadata, run_id,
+                      task_id, flow, graph, retry_count, max_user_code_retries,
+                      ubf_context, inputs):
+        self._metadata = metadata
+        self._run_id = run_id
+        self._step_name = step_name
+        self._task_id = task_id
+        num_nodes = int(os.environ.get("MF_PARALLEL_NUM_NODES", "1"))
+        node_index = int(os.environ.get("MF_PARALLEL_NODE_INDEX", "0"))
+        main_ip = os.environ.get("MF_PARALLEL_MAIN_IP", "127.0.0.1")
+        control_task_id = os.environ.get("MF_PARALLEL_CONTROL_TASK_ID", task_id)
+        port = int(
+            os.environ.get("MF_PARALLEL_COORDINATOR_PORT", self.COORDINATOR_PORT)
+        )
+        current._update_env(
+            {
+                "parallel": Parallel(
+                    main_ip=main_ip,
+                    num_nodes=num_nodes,
+                    node_index=node_index,
+                    control_task_id=control_task_id,
+                    coordinator_port=port,
+                )
+            }
+        )
+
+    def setup_distributed_env(self, flow):
+        """Hook for framework subclasses (e.g. jax.distributed init)."""
+        pass
+
+    def teardown_distributed_env(self, flow):
+        pass
+
+    def task_decorate(self, step_func, flow, graph, retry_count,
+                      max_user_code_retries, ubf_context):
+        if (
+            ubf_context == UBF_CONTROL
+            and os.environ.get("MF_PARALLEL_REMOTE", "0") != "1"
+        ):
+            # local gang: the control task is responsible for forking the
+            # workers, running rank 0 itself, and reaping the children
+            return lambda: self._local_multinode_control_task_step_func(
+                flow, graph, step_func, retry_count
+            )
+
+        def wrapped():
+            self.setup_distributed_env(flow)
+            try:
+                step_func()
+            finally:
+                self.teardown_distributed_env(flow)
+
+        wrapped.__name__ = step_func.__name__
+        return wrapped
+
+    def _local_multinode_control_task_step_func(self, flow, graph, step_func,
+                                                retry_count):
+        """Fork N-1 local `step` subprocesses, run rank 0 in-process, wait.
+
+        Reference: parallel_decorator.py:_local_multinode_control_task_step_func
+        :175-246. The TPU analogue of a pod slice on one host: each rank is an
+        OS process; rank 0 doubles as the jax.distributed coordinator.
+        """
+        from ..cli import STEP_ARGV_ENV
+
+        num_parallel = int(flow._foreach_num_splits or 1)
+        run_id = current.run_id
+        step_name = current.step_name
+        control_task_id = current.task_id
+
+        os.environ["MF_PARALLEL_MAIN_IP"] = "127.0.0.1"
+        os.environ["MF_PARALLEL_NUM_NODES"] = str(num_parallel)
+        os.environ["MF_PARALLEL_CONTROL_TASK_ID"] = str(control_task_id)
+        os.environ.setdefault(
+            "MF_PARALLEL_COORDINATOR_PORT", str(self._free_port())
+        )
+
+        # worker argv: replay this process's own step command with a new
+        # task-id and ubf context (recorded by the CLI in the environment);
+        # sys.argv[0] is the flow .py file, so prepend the interpreter
+        base_argv = json.loads(os.environ[STEP_ARGV_ENV])
+        if base_argv and base_argv[0].endswith(".py"):
+            base_argv = [sys.executable] + base_argv
+
+        mapper_task_ids = [str(control_task_id)]
+        procs = []
+        for node_index in range(1, num_parallel):
+            task_id = "%s-node-%d" % (control_task_id, node_index)
+            mapper_task_ids.append(task_id)
+            argv = list(base_argv)
+            argv = self._replace_opt(argv, "--task-id", task_id)
+            argv = self._replace_opt(argv, "--split-index", str(node_index))
+            argv = self._replace_opt(argv, "--ubf-context", UBF_TASK)
+            env = dict(os.environ)
+            env["MF_PARALLEL_NODE_INDEX"] = str(node_index)
+            procs.append(
+                subprocess.Popen(
+                    argv,
+                    env=env,
+                    stdout=sys.stdout,
+                    stderr=sys.stderr,
+                )
+            )
+
+        # record the gang membership so the join sees all N tasks
+        flow._control_mapper_tasks = [
+            "/".join((run_id, step_name, task_id)) for task_id in mapper_task_ids
+        ]
+        self._metadata.register_metadata(
+            run_id,
+            step_name,
+            control_task_id,
+            [
+                MetaDatum(
+                    "control-mapper-tasks",
+                    json.dumps(flow._control_mapper_tasks),
+                    "control-mapper-tasks",
+                    [],
+                )
+            ],
+        )
+
+        # rank 0 runs in-process
+        os.environ["MF_PARALLEL_NODE_INDEX"] = "0"
+        current._update_env(
+            {
+                "parallel": Parallel(
+                    main_ip="127.0.0.1",
+                    num_nodes=num_parallel,
+                    node_index=0,
+                    control_task_id=str(control_task_id),
+                    coordinator_port=int(
+                        os.environ["MF_PARALLEL_COORDINATOR_PORT"]
+                    ),
+                )
+            }
+        )
+        self.setup_distributed_env(flow)
+        try:
+            step_func()
+        finally:
+            self.teardown_distributed_env(flow)
+
+        failed = []
+        for proc, task_id in zip(procs, mapper_task_ids[1:]):
+            if proc.wait() != 0:
+                failed.append(task_id)
+        if failed:
+            raise TpuFlowException(
+                "Gang worker task(s) failed: %s" % ", ".join(failed)
+            )
+
+    @staticmethod
+    def _free_port():
+        import socket
+
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    @staticmethod
+    def _replace_opt(argv, opt, value):
+        argv = list(argv)
+        for i, a in enumerate(argv):
+            if a == opt and i + 1 < len(argv):
+                argv[i + 1] = value
+                return argv
+            if a.startswith(opt + "="):
+                argv[i] = "%s=%s" % (opt, value)
+                return argv
+        argv.extend([opt, value])
+        return argv

@@ -1,0 +1,5 @@
+from .tpu_decorator import TpuDecorator
+from .tpu_parallel import TpuParallelDecorator
+from .checkpoint_decorator import CheckpointDecorator
+
+__all__ = ["TpuDecorator", "TpuParallelDecorator", "CheckpointDecorator"]

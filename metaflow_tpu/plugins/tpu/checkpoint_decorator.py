@@ -1,0 +1,124 @@
+"""@checkpoint: first-class within-step model checkpointing via orbax.
+
+The reference keeps @checkpoint in an external extension (SURVEY.md §5.4 —
+only hook points exist in-repo); here it is first-class: `current.checkpoint`
+saves/loads pytrees (model + optimizer state) through orbax into the run's
+datastore tree, scoped so that
+
+  - a task retry (same run/step/task, higher attempt) sees prior checkpoints;
+  - `resume` of a failed run can load the origin run's checkpoints
+    (load_origin=True, the default).
+
+On multi-host gangs every process must call save() (orbax multihost
+async barrier); on GCS roots orbax streams from TPU-VM host DRAM directly.
+"""
+
+import os
+
+from ...current import current
+from ...decorators import StepDecorator
+
+
+class Checkpointer(object):
+    """Exposed as `current.checkpoint`."""
+
+    def __init__(self, root, origin_root=None):
+        self._root = root
+        self._origin_root = origin_root
+        self._ckpt = None
+
+    def _checkpointer(self):
+        if self._ckpt is None:
+            import orbax.checkpoint as ocp
+
+            self._ckpt = ocp.PyTreeCheckpointer()
+        return self._ckpt
+
+    def directory(self, step=None):
+        return os.path.join(self._root, "step_%d" % step if step is not None else "")
+
+    def list(self, root=None):
+        root = root or self._root
+        if root.startswith("gs://"):
+            from ...datastore.storage import GCSStorage
+
+            st = GCSStorage(root)
+            names = [st.basename(p) for p, _ in st.list_content([""])]
+        else:
+            if not os.path.isdir(root):
+                return []
+            names = os.listdir(root)
+        steps = []
+        for name in names:
+            if name.startswith("step_") and name[5:].isdigit():
+                steps.append(int(name[5:]))
+        return sorted(steps)
+
+    def save(self, state, step=0):
+        """Save a pytree checkpoint for logical step `step`."""
+        path = os.path.join(self._root, "step_%d" % step)
+        self._checkpointer().save(path, state, force=True)
+        return path
+
+    def load(self, step=None, like=None):
+        """Load a checkpoint: `step` or the latest. Falls back to the origin
+        run's checkpoints under `resume`. Returns None when none exist."""
+        for root in (self._root, self._origin_root):
+            if not root:
+                continue
+            steps = self.list(root)
+            if not steps:
+                continue
+            chosen = step if step is not None else steps[-1]
+            if chosen not in steps:
+                continue
+            path = os.path.join(root, "step_%d" % chosen)
+            restore_args = None
+            if like is not None:
+                import orbax.checkpoint as ocp
+
+                restore_args = ocp.args.PyTreeRestore(like)  # noqa: F841
+                return self._checkpointer().restore(path, item=like)
+            return self._checkpointer().restore(path)
+        return None
+
+    @property
+    def latest_step(self):
+        steps = self.list()
+        if steps:
+            return steps[-1]
+        if self._origin_root:
+            steps = self.list(self._origin_root)
+            if steps:
+                return steps[-1]
+        return None
+
+
+class CheckpointDecorator(StepDecorator):
+    """@checkpoint — activates `current.checkpoint` for the step."""
+
+    name = "checkpoint"
+    defaults = {"load_origin": True}
+
+    def task_pre_step(self, step_name, task_datastore, metadata, run_id,
+                      task_id, flow, graph, retry_count, max_user_code_retries,
+                      ubf_context, inputs):
+        ds_root = task_datastore._flow_datastore.ds_root
+        flow_name = task_datastore._flow_datastore.flow_name
+        # attempt-independent scope: retries of the same task share it
+        root = _join(ds_root, flow_name, "checkpoints", str(run_id), step_name,
+                     str(task_id))
+        origin_root = None
+        origin_run = current.origin_run_id
+        if self.attributes.get("load_origin", True) and origin_run:
+            origin_root = _join(
+                ds_root, flow_name, "checkpoints", str(origin_run), step_name,
+                str(task_id),
+            )
+        current._update_env({"checkpoint": Checkpointer(root, origin_root)})
+
+
+def _join(root, *parts):
+    if root.startswith("gs://"):
+        return "/".join([root.rstrip("/")] + list(parts))
+    return os.path.join(root, *parts)

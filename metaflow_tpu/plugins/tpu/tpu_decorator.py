@@ -1,0 +1,89 @@
+"""@tpu: run a step on TPU hardware; the TPU-native compute decorator.
+
+Replaces the role of the reference's @batch/@kubernetes (SURVEY.md §2.6) for
+TPU fleets. Semantics:
+
+  - `@tpu` on a step declares an accelerator topology (e.g. 'v5p-8'). When
+    the step runs on a host that already has TPU devices attached (TPU-VM),
+    it validates/initializes JAX for them and exposes `current.tpu`.
+  - For gang steps (num_parallel), combine with the auto-attached
+    TpuParallelDecorator: the gang maps onto the hosts of one pod slice and
+    `jax.distributed` forms the multi-host program.
+  - Remote provisioning (queued resources / GKE) is a trampoline in
+    `runtime_step_cli`, pluggable via TPUFLOW_TPU_LAUNCHER. Without a
+    launcher configured the step runs where the scheduler runs (the common
+    dev-loop case on a TPU-VM).
+"""
+
+import os
+
+from ...current import current
+from ...decorators import StepDecorator
+from ...exception import TpuFlowException
+
+
+class TpuInfo(object):
+    """Exposed as `current.tpu`."""
+
+    def __init__(self, topology, num_devices, device_kind, mesh_axes):
+        self.topology = topology
+        self.num_devices = num_devices
+        self.device_kind = device_kind
+        self.mesh_axes = mesh_axes
+
+    def __repr__(self):
+        return "TpuInfo(topology=%r, num_devices=%d, kind=%r)" % (
+            self.topology,
+            self.num_devices,
+            self.device_kind,
+        )
+
+
+class TpuDecorator(StepDecorator):
+    """@tpu(topology='v5p-8', mesh=None, donate=True)
+
+    mesh: optional dict of mesh axis sizes, e.g. {'data': 2, 'model': 4};
+    validated against the attached devices and exposed via current.tpu.
+    """
+
+    name = "tpu"
+    defaults = {
+        "topology": None,
+        "mesh": None,
+        "require_tpu": False,
+    }
+
+    def runtime_step_cli(self, cli_args, retry_count, max_user_code_retries,
+                         ubf_context):
+        launcher = os.environ.get("TPUFLOW_TPU_LAUNCHER")
+        if launcher:
+            # trampoline: rewrite argv so the task launches via the
+            # provisioner (same pattern as the reference's `batch step`
+            # rewrite, decorators.py runtime_step_cli:493)
+            cli_args.entrypoint = [launcher] + cli_args.entrypoint
+        if self.attributes["topology"]:
+            cli_args.env["TPUFLOW_TPU_TOPOLOGY"] = str(self.attributes["topology"])
+
+    def task_pre_step(self, step_name, task_datastore, metadata, run_id,
+                      task_id, flow, graph, retry_count, max_user_code_retries,
+                      ubf_context, inputs):
+        import jax
+
+        devices = jax.devices()
+        kinds = {d.platform for d in devices}
+        if self.attributes["require_tpu"] and "tpu" not in kinds:
+            raise TpuFlowException(
+                "@tpu(require_tpu=True) on step *%s* but no TPU devices are "
+                "attached (found: %s)." % (step_name, ", ".join(sorted(kinds)))
+            )
+        current._update_env(
+            {
+                "tpu": TpuInfo(
+                    topology=self.attributes["topology"]
+                    or os.environ.get("TPUFLOW_TPU_TOPOLOGY"),
+                    num_devices=len(devices),
+                    device_kind=devices[0].device_kind if devices else "none",
+                    mesh_axes=self.attributes["mesh"],
+                )
+            }
+        )

@@ -1,0 +1,49 @@
+"""TpuParallelDecorator: gang steps become a JAX multi-host program.
+
+The TPU equivalent of the reference's PytorchParallelDecorator
+(frameworks/pytorch.py:11-46): instead of exporting MASTER_ADDR/RANK env vars
+for torch DDP, it calls `jax.distributed.initialize` with the rendezvous info
+from `current.parallel` — rank 0 (the control task / host 0 of the slice)
+serves as the coordinator, and all collectives ride ICI/DCN via XLA
+(SURVEY.md §2.9 "TPU equivalent to build").
+"""
+
+import os
+
+from ..parallel_decorator import ParallelDecorator
+
+
+class TpuParallelDecorator(ParallelDecorator):
+    name = "tpu_parallel"
+    defaults = {"jax_distributed": True}
+
+    def setup_distributed_env(self, flow):
+        from ...current import current
+
+        p = current.parallel
+        if not self.attributes.get("jax_distributed", True):
+            return
+        if p.num_nodes <= 1:
+            return
+        import jax
+
+        coordinator = "%s:%d" % (p.main_ip, p.coordinator_port)
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=p.num_nodes,
+            process_id=p.node_index,
+        )
+
+    def teardown_distributed_env(self, flow):
+        from ...current import current
+
+        if not self.attributes.get("jax_distributed", True):
+            return
+        if current.parallel.num_nodes <= 1:
+            return
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass

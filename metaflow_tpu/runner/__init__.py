@@ -1,0 +1,141 @@
+"""Programmatic API: Runner shells out to the flow CLI and attaches a client
+Run object (reference behavior: metaflow/runner/metaflow_runner.py:305)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+from ..client import Run
+from ..exception import TpuFlowException
+
+
+class ExecutingRun(object):
+    """Result of Runner.run(): the subprocess + the client Run object."""
+
+    def __init__(self, command, returncode, run, stdout, stderr):
+        self.command = command
+        self.returncode = returncode
+        self.run = run
+        self.stdout = stdout
+        self.stderr = stderr
+
+    @property
+    def status(self):
+        return "successful" if self.returncode == 0 else "failed"
+
+
+class Runner(object):
+    """Run a flow file programmatically:
+
+        with Runner('flow.py') as runner:
+            result = runner.run(alpha=0.5)
+            print(result.run.data.x)
+    """
+
+    def __init__(self, flow_file, show_output=False, env=None, cwd=None,
+                 **top_level_kwargs):
+        self.flow_file = os.path.abspath(flow_file)
+        if not os.path.exists(self.flow_file):
+            raise TpuFlowException("Flow file %s not found" % flow_file)
+        self.show_output = show_output
+        self.env = env or {}
+        self.cwd = cwd
+        self.top_level_kwargs = top_level_kwargs
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _top_level_args(self):
+        args = []
+        for k, v in self.top_level_kwargs.items():
+            key = "--" + k.replace("_", "-")
+            if isinstance(v, bool):
+                if v:
+                    args.append(key)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    args.extend([key, str(item)])
+            else:
+                args.extend([key, str(v)])
+        return args
+
+    def _execute(self, command_args, timeout=None):
+        with tempfile.TemporaryDirectory() as tmp:
+            run_id_file = os.path.join(tmp, "run_id")
+            argv = (
+                [sys.executable, self.flow_file]
+                + self._top_level_args()
+                + command_args
+                + ["--run-id-file", run_id_file]
+            )
+            env = dict(os.environ)
+            env.update({k: str(v) for k, v in self.env.items()})
+            proc = subprocess.run(
+                argv,
+                env=env,
+                cwd=self.cwd,
+                capture_output=not self.show_output,
+                timeout=timeout,
+            )
+            stdout = (proc.stdout or b"").decode("utf-8", errors="replace")
+            stderr = (proc.stderr or b"").decode("utf-8", errors="replace")
+            run = None
+            if os.path.exists(run_id_file):
+                with open(run_id_file) as f:
+                    run_id = f.read().strip()
+                flow_name = self._flow_name()
+                for _attempt in range(3):
+                    try:
+                        run = Run("%s/%s" % (flow_name, run_id),
+                                  _namespace_check=False)
+                        break
+                    except Exception:
+                        time.sleep(0.2)
+            return ExecutingRun(argv, proc.returncode, run, stdout, stderr)
+
+    def _flow_name(self):
+        # flow class name == the click group name; derive by asking the file
+        out = subprocess.run(
+            [sys.executable, self.flow_file, "--help"],
+            capture_output=True,
+        )
+        first = (out.stdout or b"").decode().split("\n", 1)[0]
+        # "Usage: FlowName [OPTIONS] ..."
+        parts = first.split()
+        if len(parts) >= 2 and parts[0] == "Usage:":
+            return parts[1]
+        # fallback: scan the file for the class definition
+        import re
+
+        with open(self.flow_file) as f:
+            m = re.search(r"class\s+(\w+)\s*\(.*FlowSpec", f.read())
+        if m:
+            return m.group(1)
+        raise TpuFlowException("Could not determine flow name")
+
+    def run(self, timeout=None, **params):
+        args = ["run"]
+        for k, v in params.items():
+            if k in ("max_workers", "max_num_splits", "tags", "namespace"):
+                key = "--" + k.replace("_", "-").rstrip("s" if k == "tags" else "")
+                if isinstance(v, (list, tuple)):
+                    for item in v:
+                        args.extend(["--tag", str(item)])
+                else:
+                    args.extend([key, str(v)])
+            else:
+                args.extend(["--" + k.replace("_", "-"), str(v)])
+        return self._execute(args, timeout=timeout)
+
+    def resume(self, step_to_rerun=None, origin_run_id=None, timeout=None):
+        args = ["resume"]
+        if step_to_rerun:
+            args.append(step_to_rerun)
+        if origin_run_id:
+            args.extend(["--origin-run-id", str(origin_run_id)])
+        return self._execute(args, timeout=timeout)

@@ -1,0 +1,637 @@
+"""Local run orchestrator: subprocess-per-task scheduler.
+
+Reference behavior: metaflow/runtime.py (NativeRuntime:352, execute:794,
+Worker:2238, CLIArgs:2094): BFS over the DAG, a worker pool of OS processes,
+foreach fan-out, join barriers, switch, gang (UBF) control tasks, retries and
+clone-based resume. Poll loop uses the selectors module (epoll) to stream
+worker logs — the procpoll equivalent (reference: metaflow/procpoll.py).
+
+Join bookkeeping here is intentionally simpler than the reference's
+index-translation scheme (runtime.py:1076-1143): every queued task carries an
+in-memory branch-context stack of (split_task_pathspec, expected_arrivals)
+frames; a join instance is keyed by its innermost split task's pathspec, which
+is unique per recursion iteration by construction.
+"""
+
+import json
+import os
+import selectors
+import subprocess
+import sys
+import time
+from collections import deque
+
+from .datastore.task_datastore import MAX_ATTEMPTS
+from .exception import TpuFlowException
+from .metadata.metadata import MetaDatum
+from .unbounded_foreach import UBF_CONTROL
+from .util import compress_list, write_latest_run_id
+
+PROGRESS_LINE = "[%s/%s (pid %s)] %s"
+
+
+class TaskFailed(TpuFlowException):
+    headline = "Task failure"
+
+
+class _Task(object):
+    """A schedulable unit: one (step, task_id) with its launch context."""
+
+    __slots__ = (
+        "step",
+        "task_id",
+        "input_paths",
+        "split_index",
+        "ctx",
+        "ubf_context",
+        "num_parallel",
+        "attempt",
+        "user_retries",
+        "error_retries",
+        "is_cloned",
+        "origin_pathspec",
+    )
+
+    def __init__(self, step, task_id, input_paths, split_index=None, ctx=(),
+                 ubf_context=None, num_parallel=0):
+        self.step = step
+        self.task_id = str(task_id)
+        self.input_paths = input_paths
+        self.split_index = split_index
+        self.ctx = tuple(ctx)  # tuple of (split_pathspec, expected, kind)
+        self.ubf_context = ubf_context
+        self.num_parallel = num_parallel
+        self.attempt = 0
+        self.user_retries = 0
+        self.error_retries = 0
+        self.is_cloned = False
+        self.origin_pathspec = None
+
+
+class CLIArgs(object):
+    """Mutable description of a task's subprocess command line; compute
+    decorators rewrite it in runtime_step_cli (trampoline point)."""
+
+    def __init__(self, entrypoint, top_level_options, command_options, env):
+        self.entrypoint = list(entrypoint)
+        self.top_level_options = dict(top_level_options)
+        self.command = "step"
+        self.command_args = []
+        self.command_options = dict(command_options)
+        self.env = dict(env)
+
+    def get_args(self):
+        args = list(self.entrypoint)
+        for k, v in self.top_level_options.items():
+            if v is None or v is False:
+                continue
+            if v is True:
+                args.append("--%s" % k)
+            else:
+                args.extend(["--%s" % k, str(v)])
+        args.append(self.command)
+        args.extend(self.command_args)
+        for k, v in self.command_options.items():
+            if v is None or v is False:
+                continue
+            if v is True:
+                args.append("--%s" % k)
+            else:
+                args.extend(["--%s" % k, str(v)])
+        return args
+
+
+class Worker(object):
+    def __init__(self, task, proc, echo):
+        self.task = task
+        self.proc = proc
+        self.echo = echo
+        self.stdout_buf = b""
+        self.stderr_buf = b""
+        self._partial = {"stdout": b"", "stderr": b""}
+
+    def read_stream(self, name, fileobj):
+        try:
+            data = os.read(fileobj.fileno(), 65536)
+        except (OSError, ValueError):
+            return
+        if not data:
+            return
+        if name == "stdout":
+            self.stdout_buf += data
+        else:
+            self.stderr_buf += data
+        buf = self._partial[name] + data
+        *lines, self._partial[name] = buf.split(b"\n")
+        for line in lines:
+            self.echo(
+                PROGRESS_LINE
+                % (
+                    self.task.step,
+                    self.task.task_id,
+                    self.proc.pid,
+                    line.decode("utf-8", errors="replace"),
+                )
+            )
+
+
+class NativeRuntime(object):
+    def __init__(
+        self,
+        flow,
+        graph,
+        flow_datastore,
+        metadata,
+        environment=None,
+        run_id=None,
+        params=None,
+        namespace=None,
+        max_workers=16,
+        max_num_splits=100,
+        origin_run_id=None,
+        clone_run_id=None,
+        resume_step=None,
+        echo=None,
+        entrypoint=None,
+        decospecs=None,
+        flow_file=None,
+    ):
+        self._flow = flow
+        self._graph = graph
+        self._flow_datastore = flow_datastore
+        self._metadata = metadata
+        self._environment = environment
+        self._params = params or {}
+        self._namespace = namespace
+        self._max_workers = max(1, int(max_workers))
+        self._max_num_splits = int(max_num_splits)
+        self._origin_run_id = origin_run_id
+        self._clone_run_id = clone_run_id
+        self._resume_step = resume_step
+        self._echo = echo or (lambda line: print(line, flush=True))
+        self._decospecs = decospecs or []
+        self._flow_file = flow_file or sys.argv[0]
+        self._entrypoint = entrypoint or [sys.executable, self._flow_file]
+
+        self.run_id = run_id or metadata.new_run_id(
+            sys_tags=metadata.sticky_sys_tags(environment, _user())
+        )
+        metadata.register_run_id(self.run_id)
+
+        self._task_index = 0
+        self._run_queue = deque()
+        self._active = {}  # fd-keyed via selector; pid -> Worker
+        self._join_arrivals = {}  # (join_step, split_pathspec) -> list of tasks
+        self._finished_tasks = 0
+        self._cloned_tasks = 0
+        self._failed = False
+
+        # resume support: index the origin run's finished tasks
+        self._origin_index = {}
+        self._cloned_pathspecs = set()
+        if clone_run_id:
+            self._build_origin_index()
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def execute(self):
+        start_time = time.time()
+        write_latest_run_id(self._flow.name, self.run_id)
+        self._metadata.start_run_heartbeat(self._flow.name, self.run_id)
+        self._echo(
+            "Workflow starting (run-id %s), see it in the UI or with "
+            "Run('%s/%s')" % (self.run_id, self._flow.name, self.run_id)
+        )
+        self._queue_task(_Task("start", self._new_task_id(), []))
+
+        sel = selectors.DefaultSelector()
+        last_beat = time.time()
+        try:
+            while self._run_queue or self._active:
+                # launch as many queued tasks as the worker pool allows
+                while self._run_queue and len(self._active) < self._max_workers:
+                    task = self._run_queue.popleft()
+                    if self._maybe_clone(task):
+                        continue
+                    self._launch_worker(task, sel)
+
+                if not self._active:
+                    continue
+
+                # poll worker pipes
+                for key, _mask in sel.select(timeout=0.2):
+                    worker, stream_name = key.data
+                    worker.read_stream(stream_name, key.fileobj)
+
+                if time.time() - last_beat > 10:
+                    self._metadata.heartbeat()
+                    last_beat = time.time()
+
+                # reap finished workers
+                for pid in list(self._active):
+                    worker = self._active[pid]
+                    returncode = worker.proc.poll()
+                    if returncode is None:
+                        continue
+                    # drain remaining output
+                    for name, stream in (
+                        ("stdout", worker.proc.stdout),
+                        ("stderr", worker.proc.stderr),
+                    ):
+                        while True:
+                            before = len(worker.stdout_buf) + len(worker.stderr_buf)
+                            worker.read_stream(name, stream)
+                            if len(worker.stdout_buf) + len(worker.stderr_buf) == before:
+                                break
+                        try:
+                            sel.unregister(stream)
+                        except (KeyError, ValueError):
+                            pass
+                        stream.close()
+                    del self._active[pid]
+                    self._task_finished(worker, returncode)
+        finally:
+            # never orphan live task subprocesses on an abnormal exit
+            for worker in self._active.values():
+                if worker.proc.poll() is None:
+                    worker.proc.terminate()
+            for worker in self._active.values():
+                try:
+                    worker.proc.wait(timeout=10)
+                except Exception:
+                    worker.proc.kill()
+            sel.close()
+            self._metadata.heartbeat()
+
+        if self._failed:
+            raise TaskFailed("Workflow failed; see task logs above.")
+        self._echo(
+            "Done! Flow finished in %.1fs (%d tasks run, %d cloned)."
+            % (time.time() - start_time, self._finished_tasks, self._cloned_tasks)
+        )
+
+    # ------------------------------------------------------------------
+    # queueing and transitions
+    # ------------------------------------------------------------------
+
+    def _new_task_id(self):
+        self._task_index += 1
+        return str(self._task_index)
+
+    def _queue_task(self, task):
+        self._metadata.register_task_id(
+            self.run_id, task.step, task.task_id, 0
+        )
+        # determine retry budget from decorators
+        user_retries, error_retries = 0, 0
+        step_func = getattr(self._flow, task.step)
+        for deco in step_func.decorators:
+            u, e = deco.step_task_retry_count()
+            user_retries = max(user_retries, u)
+            error_retries = max(error_retries, e)
+        task.user_retries = user_retries
+        task.error_retries = error_retries
+        for deco in step_func.decorators:
+            deco.runtime_task_created(
+                None, task.task_id, task.split_index, task.input_paths,
+                task.is_cloned, task.ubf_context,
+            )
+        self._run_queue.append(task)
+
+    def _pathspec(self, task):
+        return "/".join((self.run_id, task.step, task.task_id))
+
+    def _task_finished(self, worker, returncode):
+        task = worker.task
+        # persist captured logs
+        try:
+            ds = self._flow_datastore.get_task_datastore(
+                self.run_id, task.step, task.task_id, attempt=task.attempt,
+                mode="w",
+            )
+            ds.save_logs(
+                "runtime",
+                {"stdout": worker.stdout_buf, "stderr": worker.stderr_buf},
+            )
+        except Exception:
+            pass
+
+        if returncode != 0:
+            max_retries = task.user_retries + task.error_retries
+            if task.attempt < min(max_retries, MAX_ATTEMPTS - 1):
+                task.attempt += 1
+                self._echo(
+                    "Task %s failed (attempt %d); retrying."
+                    % (self._pathspec(task), task.attempt - 1)
+                )
+                self._run_queue.append(task)
+                return
+            self._echo("Task %s failed." % self._pathspec(task))
+            self._failed = True
+            # fail fast: drain the queue, let active workers finish
+            self._run_queue.clear()
+            return
+
+        self._finished_tasks += 1
+        self._schedule_successors(task)
+
+    def _load_result(self, task):
+        ds = self._flow_datastore.get_task_datastore(
+            self.run_id, task.step, task.task_id, mode="r"
+        )
+        return ds
+
+    def _schedule_successors(self, task):
+        """Read the finished task's transition and queue what comes next."""
+        node = self._graph[task.step]
+        if node.type == "end":
+            return
+        ds = self._load_result(task)
+        transition = ds.get("_transition")
+        if transition is None:
+            self._failed = True
+            self._run_queue.clear()
+            return
+        funcs = transition[0]
+        my_pathspec = self._pathspec(task)
+
+        if node.type in ("foreach", "split-parallel"):
+            child = funcs[0]
+            num_splits = ds.get("_foreach_num_splits")
+            unbounded = bool(ds.get("_unbounded_foreach"))
+            if unbounded or node.type == "split-parallel":
+                # gang: ONE control task owns the fan-out
+                ctx = task.ctx + ((my_pathspec, 1, "parallel"),)
+                control = _Task(
+                    child,
+                    self._new_task_id(),
+                    [my_pathspec],
+                    split_index=0,
+                    ctx=ctx,
+                    ubf_context=UBF_CONTROL,
+                    num_parallel=int(num_splits or 0),
+                )
+                self._queue_task(control)
+                return
+            if num_splits > self._max_num_splits:
+                raise TaskFailed(
+                    "Foreach in step *%s* yields %d splits which exceeds "
+                    "--max-num-splits %d."
+                    % (task.step, num_splits, self._max_num_splits)
+                )
+            ctx = task.ctx + ((my_pathspec, num_splits, "foreach"),)
+            for i in range(num_splits):
+                self._queue_task(
+                    _Task(
+                        child,
+                        self._new_task_id(),
+                        [my_pathspec],
+                        split_index=i,
+                        ctx=ctx,
+                    )
+                )
+            return
+
+        if node.type == "split":
+            ctx = task.ctx + ((my_pathspec, len(funcs), "split"),)
+            for child in funcs:
+                self._queue_task(
+                    _Task(child, self._new_task_id(), [my_pathspec], ctx=ctx)
+                )
+            return
+
+        # linear / switch / start / join: single (chosen) successor each
+        for child in funcs:
+            child_node = self._graph[child]
+            if child_node.type == "join":
+                self._arrive_at_join(child, task, ds)
+            else:
+                self._queue_task(
+                    _Task(child, self._new_task_id(), [my_pathspec],
+                          ctx=task.ctx)
+                )
+
+    def _arrive_at_join(self, join_step, task, ds):
+        if not task.ctx:
+            raise TaskFailed(
+                "Task %s arrived at join %s with an empty split context"
+                % (self._pathspec(task), join_step)
+            )
+        split_pathspec, expected, kind = task.ctx[-1]
+        if kind == "parallel":
+            # the control task arrives alone; its recorded gang membership
+            # is the full input list
+            mapper_tasks = ds.get("_control_mapper_tasks") or []
+            self._queue_task(
+                _Task(
+                    join_step,
+                    self._new_task_id(),
+                    list(mapper_tasks),
+                    ctx=task.ctx[:-1],
+                )
+            )
+            return
+        key = (join_step, split_pathspec)
+        arrivals = self._join_arrivals.setdefault(key, [])
+        arrivals.append(task)
+        if len(arrivals) == expected:
+            input_paths = [self._pathspec(t) for t in arrivals]
+            self._queue_task(
+                _Task(
+                    join_step,
+                    self._new_task_id(),
+                    input_paths,
+                    ctx=task.ctx[:-1],
+                )
+            )
+            del self._join_arrivals[key]
+
+    # ------------------------------------------------------------------
+    # worker launch
+    # ------------------------------------------------------------------
+
+    def _launch_worker(self, task, sel):
+        args = self._build_cli_args(task)
+        env = dict(os.environ)
+        env.update(args.env)
+        proc = subprocess.Popen(
+            args.get_args(),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            bufsize=0,
+        )
+        worker = Worker(task, proc, self._echo)
+        os.set_blocking(proc.stdout.fileno(), False)
+        os.set_blocking(proc.stderr.fileno(), False)
+        sel.register(proc.stdout, selectors.EVENT_READ, (worker, "stdout"))
+        sel.register(proc.stderr, selectors.EVENT_READ, (worker, "stderr"))
+        self._active[proc.pid] = worker
+
+    def _build_cli_args(self, task):
+        top_level = {
+            "datastore": self._flow_datastore.ds_type,
+            "datastore-root": self._flow_datastore.ds_root,
+            "metadata": self._metadata.TYPE,
+            "quiet": True,
+        }
+        command_options = {
+            "run-id": self.run_id,
+            "task-id": task.task_id,
+            "input-paths": compress_list(task.input_paths)
+            if task.input_paths
+            else None,
+            "split-index": task.split_index,
+            "retry-count": task.attempt,
+            "max-user-code-retries": task.user_retries,
+            "namespace": self._namespace,
+            "ubf-context": task.ubf_context,
+        }
+        if self._origin_run_id:
+            command_options["origin-run-id"] = self._origin_run_id
+        if task.step == "start" and self._params:
+            command_options["params-json"] = json.dumps(self._params)
+
+        args = CLIArgs(
+            entrypoint=self._entrypoint,
+            top_level_options=top_level,
+            command_options=command_options,
+            env={},
+        )
+        args.command_args = [task.step]
+        step_func = getattr(self._flow, task.step)
+        for deco in step_func.decorators:
+            deco.runtime_step_cli(
+                args, task.attempt, task.user_retries, task.ubf_context
+            )
+        # decospecs are appended manually since --with repeats
+        if self._decospecs:
+            extra = []
+            for spec in self._decospecs:
+                extra.extend(["--with", spec])
+            args.entrypoint = args.entrypoint + extra
+        return args
+
+    # ------------------------------------------------------------------
+    # clone / resume
+    # ------------------------------------------------------------------
+
+    def _build_origin_index(self):
+        """Index the origin run's DONE tasks by (step, foreach-index-path)."""
+        max_id = 0
+        for ds in self._flow_datastore.get_task_datastores(
+            run_id=self._clone_run_id
+        ):
+            if not ds.is_done():
+                continue
+            stack = ds.get("_foreach_stack") or []
+            index_path = tuple(int(frame[1]) for frame in stack)
+            self._origin_index[(ds.step_name, index_path)] = ds
+            tid = ds.task_id.split("-")[0]
+            if tid.isdigit():
+                max_id = max(max_id, int(tid))
+        self._task_index = max_id
+
+    def _maybe_clone(self, task):
+        """Clone the origin run's equivalent task instead of executing, when
+        safe (origin succeeded AND all of this task's inputs were cloned)."""
+        if not self._clone_run_id:
+            return False
+        if self._resume_step and task.step == self._resume_step:
+            return False
+        # all inputs must themselves be clones for the outputs to be valid
+        for path in task.input_paths:
+            if path not in self._cloned_pathspecs:
+                return False
+        index_path = self._index_path_for(task)
+        origin_ds = self._origin_index.get((task.step, index_path))
+        if origin_ds is None:
+            return False
+
+        self._clone_task(task, origin_ds)
+        return True
+
+    def _index_path_for(self, task):
+        """Foreach index path this task WILL have, derived from its launch
+        context (mirrors task.py _init_foreach)."""
+        path = []
+        # reconstruct from input task's stack + split_index
+        if task.input_paths:
+            parts = task.input_paths[0].split("/")
+            in_ds = self._flow_datastore.get_task_datastore(
+                parts[-3], parts[-2], parts[-1], mode="r"
+            )
+            stack = in_ds.get("_foreach_stack") or []
+            path = [int(f[1]) for f in stack]
+            node = self._graph[task.step]
+            if node.type == "join":
+                path = path[:-1]
+            elif task.split_index is not None:
+                path = path + [int(task.split_index)]
+        return tuple(path)
+
+    def _clone_task(self, task, origin_ds):
+        new_ds = self._flow_datastore.get_task_datastore(
+            self.run_id, task.step, origin_ds.task_id, attempt=0, mode="w"
+        )
+        new_ds.init_task()
+        new_ds.clone(origin_ds)
+        # gang control tasks record their run id inside an artifact: rewrite
+        # it, and clone the worker tasks too (the forked ranks are not
+        # scheduler-queued, so _maybe_clone never sees them)
+        if "_control_mapper_tasks" in origin_ds:
+            origin_mapper = origin_ds["_control_mapper_tasks"]
+            mapper = [
+                "/".join([self.run_id] + p.split("/")[-2:])
+                for p in origin_mapper
+            ]
+            new_ds.save_artifacts([("_control_mapper_tasks", mapper)])
+            for origin_path in origin_mapper:
+                parts = origin_path.split("/")
+                w_step, w_task = parts[-2], parts[-1]
+                if w_task == origin_ds.task_id:
+                    continue  # the control task itself
+                w_origin = self._flow_datastore.get_task_datastore(
+                    self._clone_run_id, w_step, w_task, mode="r"
+                )
+                w_new = self._flow_datastore.get_task_datastore(
+                    self.run_id, w_step, w_task, attempt=0, mode="w"
+                )
+                w_new.init_task()
+                w_new.clone(w_origin)
+                w_new.done()
+                self._metadata.register_task_id(self.run_id, w_step, w_task, 0)
+                self._cloned_pathspecs.add(
+                    "/".join((self.run_id, w_step, w_task))
+                )
+        new_ds.done()
+        task.task_id = origin_ds.task_id
+        task.is_cloned = True
+        task.origin_pathspec = origin_ds.pathspec
+        self._metadata.register_task_id(self.run_id, task.step, task.task_id, 0)
+        self._metadata.register_metadata(
+            self.run_id,
+            task.step,
+            task.task_id,
+            [
+                MetaDatum(
+                    "origin-task", origin_ds.pathspec, "origin-task", []
+                ),
+                MetaDatum("attempt_ok", "true", "internal_attempt_status",
+                          ["attempt_id:0"]),
+            ],
+        )
+        self._cloned_pathspecs.add(self._pathspec(task))
+        self._cloned_tasks += 1
+        self._echo(
+            "Cloned %s from %s" % (self._pathspec(task), origin_ds.pathspec)
+        )
+        self._schedule_successors(task)
+
+
+def _user():
+    from .util import get_username
+
+    return get_username()

@@ -1,0 +1,118 @@
+"""Small shared utilities (reference shape: metaflow/util.py)."""
+
+import os
+import pwd
+import sys
+import zlib
+import base64
+from functools import wraps
+
+from .exception import MetaflowUnknownUser  # noqa: F401  (re-export site)
+
+
+def get_username():
+    """Resolve the current user for namespacing and tags."""
+    for var in ("METAFLOW_USER", "TPUFLOW_USER", "SUDO_USER", "USERNAME", "USER"):
+        user = os.environ.get(var)
+        if user and user != "root":
+            return user
+    try:
+        return pwd.getpwuid(os.getuid()).pw_name
+    except Exception:
+        return os.environ.get("USER", "unknown")
+
+
+def resolve_identity():
+    return "user:%s" % get_username()
+
+
+def pathspec(*components):
+    return "/".join(str(c) for c in components)
+
+
+def compress_list(lst, separator=",", rangedelim=":", zlibmarker="!", zlibmin=500):
+    """Encode a list of strings into a single CLI-safe token.
+
+    Same contract as the reference (metaflow/util.py compress_list): joined
+    list, falling back to zlib+base64 when long. Items must not contain the
+    separator characters.
+    """
+    bad = [x for x in lst if any(c in x for c in (separator, rangedelim, zlibmarker))]
+    if bad:
+        raise RuntimeError("Item(s) %s contain reserved characters" % bad)
+    res = separator.join(lst)
+    if len(res) < zlibmin:
+        return res
+    return zlibmarker + base64.b64encode(
+        zlib.compress(res.encode("utf-8"))
+    ).decode("utf-8")
+
+
+def decompress_list(lststr, separator=",", zlibmarker="!"):
+    if lststr.startswith(zlibmarker):
+        lststr = zlib.decompress(
+            base64.b64decode(lststr[1:].encode("utf-8"))
+        ).decode("utf-8")
+    return lststr.split(separator) if lststr else []
+
+
+def to_unicode(x):
+    if isinstance(x, bytes):
+        return x.decode("utf-8", errors="replace")
+    return str(x)
+
+
+def to_bytes(x):
+    if isinstance(x, bytes):
+        return x
+    return str(x).encode("utf-8")
+
+
+def cached_property(fn):
+    attr = "_cached_" + fn.__name__
+
+    @wraps(fn)
+    def getter(self):
+        if not hasattr(self, attr):
+            setattr(self, attr, fn(self))
+        return getattr(self, attr)
+
+    return property(getter)
+
+
+def is_stringish(x):
+    return isinstance(x, (str, bytes))
+
+
+def all_equal(it):
+    lst = list(it)
+    return not lst or lst.count(lst[0]) == len(lst)
+
+
+def get_tpuflow_root():
+    """Root directory for the local datastore/metadata tree."""
+    return os.environ.get(
+        "TPUFLOW_DATASTORE_SYSROOT_LOCAL",
+        os.environ.get("METAFLOW_DATASTORE_SYSROOT_LOCAL", ""),
+    ) or os.path.join(os.getcwd(), ".tpuflow")
+
+
+def write_latest_run_id(flow_name, run_id, root=None):
+    root = root or get_tpuflow_root()
+    d = os.path.join(root, flow_name)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "latest_run"), "w") as f:
+        f.write(str(run_id))
+
+
+def read_latest_run_id(flow_name, root=None):
+    root = root or get_tpuflow_root()
+    try:
+        with open(os.path.join(root, flow_name, "latest_run")) as f:
+            return f.read().strip()
+    except IOError:
+        return None
+
+
+def unicode_to_stream(text, stream=None):
+    (stream or sys.stdout).write(to_unicode(text))

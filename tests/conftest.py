@@ -1,0 +1,68 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax imports
+(SURVEY.md §7: test multi-chip sharding without TPU hardware)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture()
+def tpuflow_root(tmp_path, monkeypatch):
+    """Isolated datastore/metadata root per test."""
+    root = str(tmp_path / "tpuflow_root")
+    monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL", root)
+    return root
+
+
+@pytest.fixture()
+def run_flow(tpuflow_root):
+    """Helper: run a flow file as a subprocess against the isolated root."""
+    import subprocess
+
+    def _run(flow_file, *args, expect_fail=False, env_extra=None):
+        env = dict(os.environ)
+        env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep
+            + env.get("PYTHONPATH", "")
+        )
+        if env_extra:
+            env.update(env_extra)
+        proc = subprocess.run(
+            [sys.executable, flow_file] + list(args),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if not expect_fail and proc.returncode != 0:
+            raise AssertionError(
+                "flow failed (rc=%d)\nSTDOUT:\n%s\nSTDERR:\n%s"
+                % (proc.returncode, proc.stdout, proc.stderr)
+            )
+        if expect_fail and proc.returncode == 0:
+            raise AssertionError(
+                "flow unexpectedly succeeded\nSTDOUT:\n%s" % proc.stdout
+            )
+        return proc
+
+    return _run
+
+
+FLOWS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "flows")
+
+
+@pytest.fixture()
+def flows_dir():
+    return FLOWS_DIR

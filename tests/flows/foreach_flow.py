@@ -1,0 +1,30 @@
+from metaflow_tpu import FlowSpec, step
+
+
+class ForeachFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = ["a", "b", "c"]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.letter = self.input * 2
+        self.idx = self.index
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.letters = sorted(inp.letter for inp in inputs)
+        self.indices = sorted(inp.idx for inp in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.letters == ["aa", "bb", "cc"], self.letters
+        assert self.indices == [0, 1, 2]
+        print("letters:", self.letters)
+
+
+if __name__ == "__main__":
+    ForeachFlow()

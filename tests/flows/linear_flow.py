@@ -1,0 +1,29 @@
+from metaflow_tpu import FlowSpec, step, Parameter
+
+
+class LinearFlow(FlowSpec):
+    """Simple linear flow with a parameter."""
+
+    alpha = Parameter("alpha", default=0.5, type=float, help="learning rate")
+
+    @step
+    def start(self):
+        self.x = 1
+        self.message = "hello"
+        self.next(self.middle)
+
+    @step
+    def middle(self):
+        self.x = self.x * 10
+        self.scaled = self.x * self.alpha
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.x == 10
+        assert self.message == "hello"
+        print("final x:", self.x, "scaled:", self.scaled)
+
+
+if __name__ == "__main__":
+    LinearFlow()

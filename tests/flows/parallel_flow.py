@@ -1,0 +1,39 @@
+from metaflow_tpu import FlowSpec, step, current
+
+
+class ParallelFlow(FlowSpec):
+    """Gang-scheduled step: 3 ranks, each records its identity; the join
+    checks the full gang arrived. jax.distributed is disabled here (pure
+    gang-semantics test); see test_jax_distributed for the collective path."""
+
+    @step
+    def start(self):
+        self.base = 100
+        self.next(self.train, num_parallel=3)
+
+    @step
+    def train(self):
+        p = current.parallel
+        self.rank = p.node_index
+        self.world = p.num_nodes
+        self.main_ip = p.main_ip
+        self.value = self.base + self.rank
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.ranks = sorted(inp.rank for inp in inputs)
+        self.values = sorted(inp.value for inp in inputs)
+        self.worlds = sorted(inp.world for inp in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.ranks == [0, 1, 2], self.ranks
+        assert self.values == [100, 101, 102], self.values
+        assert self.worlds == [3, 3, 3]
+        print("gang ok:", self.ranks)
+
+
+if __name__ == "__main__":
+    ParallelFlow()
