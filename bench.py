@@ -1,0 +1,131 @@
+"""Benchmark: Llama training throughput (tokens/sec/chip) on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference (Netflix/metaflow) publishes no numbers (BASELINE.md), so
+vs_baseline is reported against the recorded first-round measurement when
+available (BENCH_BASELINE env or 1.0).
+
+Also measures step-launch p50 latency of the orchestration layer when
+BENCH_MODE=launch (the reference's only quantified metric family).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_tokens_per_sec():
+    import jax
+    import jax.numpy as jnp
+
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.parallel import MeshSpec, create_mesh
+    from metaflow_tpu.training import (
+        default_optimizer,
+        make_trainer,
+        shard_batch,
+    )
+
+    n_devices = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+
+    if on_tpu:
+        cfg = llama.LlamaConfig.bench_1b(
+            attention_impl="flash" if n_devices == 1 else "auto"
+        )
+        batch, seq = 8, 2048
+        steps = 10
+    else:  # CPU smoke fallback
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq = 4, 128
+        steps = 3
+
+    mesh = create_mesh(MeshSpec.fsdp() if n_devices > 1 else MeshSpec.dp())
+    state, step, _ = make_trainer(
+        jax.random.PRNGKey(0), cfg, mesh, llama,
+        optimizer=default_optimizer(total_steps=1000),
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
+    data = shard_batch({"tokens": tokens}, mesh)
+
+    with mesh:
+        # compile + warmup
+        state, m = step(state, data)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, data)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tps_per_chip = tokens_per_step * steps / dt / n_devices
+    return {
+        "metric": "llama_%s_train_tokens_per_sec_per_chip"
+        % ("1b_bf16" if on_tpu else "tiny_cpu"),
+        "value": round(tps_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": _vs_baseline(tps_per_chip),
+        "extra": {
+            "n_devices": n_devices,
+            "backend": jax.default_backend(),
+            "params": llama.num_params(state["params"]),
+            "batch": batch,
+            "seq": seq,
+            "loss": float(m["loss"]),
+        },
+    }
+
+
+def bench_step_launch():
+    """p50 latency from scheduler queue → task attempt marker (the reference
+    instruments this via metaflow_profile from_start markers)."""
+    import subprocess
+    import tempfile
+
+    flow = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "flows", "linear_flow.py",
+    )
+    latencies = []
+    with tempfile.TemporaryDirectory() as root:
+        env = dict(os.environ)
+        env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = root
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        for _ in range(5):
+            t0 = time.perf_counter()
+            subprocess.run(
+                [sys.executable, flow, "run"],
+                env=env, capture_output=True, check=True,
+            )
+            # 3 tasks per run → per-task latency
+            latencies.append((time.perf_counter() - t0) / 3)
+    p50 = statistics.median(latencies)
+    return {
+        "metric": "step_launch_p50",
+        "value": round(p50 * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+    }
+
+
+def _vs_baseline(value):
+    base = os.environ.get("BENCH_BASELINE")
+    if base:
+        try:
+            return round(value / float(base), 3)
+        except ValueError:
+            pass
+    return 1.0
+
+
+if __name__ == "__main__":
+    mode = os.environ.get("BENCH_MODE", "train")
+    result = bench_step_launch() if mode == "launch" else bench_tokens_per_sec()
+    print(json.dumps(result))

@@ -19,9 +19,13 @@ import pickle
 
 import numpy as np
 
+import sys
+
 TYPE_NPY = "npy"
 TYPE_PYTREE = "pytree"
 TYPE_PICKLE = "pickle"
+
+_NATIVE_LITTLE = sys.byteorder == "little"
 
 
 def _is_jax_array(obj):
@@ -33,12 +37,30 @@ def _is_jax_array(obj):
         return False
 
 
+_TENSOR_KINDS = frozenset("biufc")  # bool/int/uint/float/complex
+
+
+def _tensor_dtype_ok(dtype):
+    """True when the raw-bytes tensor format can round-trip this dtype:
+    numeric numpy kinds plus the ml_dtypes TPU types (bfloat16, float8_*)."""
+    if dtype.kind in _TENSOR_KINDS:
+        return True
+    try:
+        import ml_dtypes
+
+        return hasattr(ml_dtypes, dtype.name)
+    except ImportError:
+        return False
+
+
 def _tree_only_arrays(obj, depth=0):
     """True if obj is a (nested) dict/list/tuple whose leaves are all
     arrays/scalars — eligible for the fast pytree format."""
     if depth > 16:
         return False
-    if isinstance(obj, (np.ndarray,)) or _is_jax_array(obj):
+    if isinstance(obj, np.ndarray):
+        return _tensor_dtype_ok(obj.dtype)
+    if _is_jax_array(obj):
         return True
     if isinstance(obj, (int, float, bool)) or obj is None:
         return True
@@ -60,18 +82,43 @@ def _to_host(arr):
 
 
 def _npy_bytes(arr):
-    buf = io.BytesIO()
-    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
-    return buf.getvalue()
+    """Tensor format: json header {dtype, shape} + raw C-order bytes.
+
+    Unlike .npy this round-trips TPU dtypes (bfloat16, float8_*) which numpy
+    itself can't describe — ml_dtypes resolves them on load. Data is stored
+    native-endian (non-native input is byteswapped first)."""
+    arr = np.ascontiguousarray(arr)
+    native = "<" if _NATIVE_LITTLE else ">"
+    if arr.dtype.byteorder not in ("=", "|", native):
+        # normalize to the native order so tobytes/frombuffer agree
+        arr = arr.astype(arr.dtype.newbyteorder("="))
+    header = json.dumps({"dtype": arr.dtype.name, "shape": list(arr.shape)}).encode(
+        "utf-8"
+    )
+    return len(header).to_bytes(4, "little") + header + arr.tobytes()
+
+
+def _resolve_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def _npy_load(data):
-    return np.load(io.BytesIO(data), allow_pickle=False)
+    hlen = int.from_bytes(data[:4], "little")
+    header = json.loads(data[4 : 4 + hlen].decode("utf-8"))
+    dtype = _resolve_dtype(header["dtype"])
+    return np.frombuffer(
+        data[4 + hlen :], dtype=dtype
+    ).reshape(header["shape"]).copy()
 
 
 def serialize(obj):
     """Return (payload_bytes, type_tag)."""
-    if isinstance(obj, np.ndarray) and obj.dtype != object:
+    if isinstance(obj, np.ndarray) and _tensor_dtype_ok(obj.dtype):
         return _npy_bytes(obj), TYPE_NPY
     if _is_jax_array(obj):
         return _npy_bytes(_to_host(obj)), TYPE_NPY

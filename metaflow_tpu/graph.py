@@ -50,13 +50,13 @@ class DAGNode(object):
         self.parallel_step = False
         self.condition = None
         self.switch_cases = {}
+        self.parallel_foreach = False
         self._parse(func_ast)
 
         # these attributes are populated by FlowGraph._postprocess/_traverse
         self.in_funcs = set()
         self.split_parents = []
         self.matching_join = None
-        self.parallel_foreach = False
 
     def _expr_str(self, expr):
         return "%s.%s" % (expr.value.id, expr.attr)
@@ -223,7 +223,9 @@ class FlowGraph(object):
 
     def _traverse_graph(self):
         def traverse(node, seen, split_parents):
-            if node.type in ("split", "split-switch", "foreach", "split-parallel"):
+            # split-switch executes one branch only: no join expected, so it
+            # does not open a split level
+            if node.type in ("split", "foreach", "split-parallel"):
                 node.split_parents = split_parents
                 split_parents = split_parents + [node.name]
             elif node.type == "join":

@@ -199,7 +199,9 @@ def check_split_join_balance(graph):
         if node.name in seen:
             return
         seen.add(node.name)
-        if node.type in ("split", "split-switch"):
+        # split-switch executes exactly ONE branch, so it needs no join:
+        # treat it as linear for balance purposes
+        if node.type == "split":
             split_stack = split_stack + ["split:%s" % node.name]
         elif node.type == "foreach":
             split_stack = split_stack + ["foreach:%s" % node.name]

@@ -1,0 +1,5 @@
+from . import llama
+from . import mixtral
+from . import resnet
+
+__all__ = ["llama", "mixtral", "resnet"]

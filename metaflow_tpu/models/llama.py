@@ -1,0 +1,207 @@
+"""Llama-family transformer, TPU-first.
+
+Pure-JAX pytree parameters with a parallel tree of *logical axis* annotations
+(metaflow_tpu.parallel.sharding) — pjit/GSPMD shards the whole model from a
+rule table; no framework indirection between the math and the mesh.
+
+Covers the BASELINE.json targets: Llama-3-8B (dense, GQA, RoPE-500k) and the
+scaled-down variants used for single-chip benchmarking. The layer stack is a
+lax.scan over a stacked-parameters pytree — one compiled layer body,
+layer-count-independent compile time.
+"""
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    rope_llama3_scaling: bool = True
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    attention_impl: str = "auto"
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+    # ---- standard sizes ----
+
+    @staticmethod
+    def llama3_8b(**kw):
+        return replace(LlamaConfig(), **kw)
+
+    @staticmethod
+    def llama3_1b(**kw):
+        """Llama-3.2-1B-shaped."""
+        return replace(
+            LlamaConfig(
+                dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                ffn_dim=8192,
+            ),
+            **kw,
+        )
+
+    @staticmethod
+    def tiny(**kw):
+        """Test-sized config (CPU-runnable)."""
+        return replace(
+            LlamaConfig(
+                vocab_size=512, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                ffn_dim=256, max_seq_len=256, rope_llama3_scaling=False,
+                dtype="float32",
+            ),
+            **kw,
+        )
+
+    @staticmethod
+    def bench_1b(**kw):
+        """~1.2B params: fits one v5e chip in bf16 with Adam state offloaded
+        sharding-free; used by bench.py."""
+        return replace(
+            LlamaConfig(
+                vocab_size=32_000, dim=2048, n_layers=16, n_heads=16,
+                n_kv_heads=8, ffn_dim=5632, max_seq_len=2048,
+                rope_llama3_scaling=False,
+            ),
+            **kw,
+        )
+
+
+def param_dtype(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(rng, cfg):
+    """Initialize the parameter pytree. Per-layer tensors are stacked on a
+    leading 'layers' axis (consumed by lax.scan in forward)."""
+    dt = param_dtype(cfg)
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dt)
+
+    def dense_init(key, fan_in, *shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    keys = jax.random.split(k_layers, 7)
+
+    params = {
+        "embed": dense_init(k_embed, D, cfg.vocab_size, D),
+        "layers": {
+            "attn_norm": norm_init(L, D),
+            "wq": dense_init(keys[0], D, L, D, H * Hd),
+            "wk": dense_init(keys[1], D, L, D, KV * Hd),
+            "wv": dense_init(keys[2], D, L, D, KV * Hd),
+            "wo": dense_init(keys[3], H * Hd, L, H * Hd, D),
+            "ffn_norm": norm_init(L, D),
+            "w_gate": dense_init(keys[4], D, L, D, F),
+            "w_up": dense_init(keys[5], D, L, D, F),
+            "w_down": dense_init(keys[6], F, L, F, D),
+        },
+        "final_norm": norm_init(D),
+        "lm_head": dense_init(k_out, D, D, cfg.vocab_size),
+    }
+    return params
+
+
+def logical_axes(cfg):
+    """Logical axis names for every parameter (same tree structure)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ffn_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def _layer(cfg, cos, sin, x, layer_params):
+    """One transformer block; x: [B, S, D]."""
+    B, S, D = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    q = (h @ layer_params["wq"]).reshape(B, S, H, Hd)
+    k = (h @ layer_params["wk"]).reshape(B, S, KV, Hd)
+    v = (h @ layer_params["wv"]).reshape(B, S, KV, Hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    x = x + attn.reshape(B, S, H * Hd) @ layer_params["wo"]
+
+    h = rms_norm(x, layer_params["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer_params["w_gate"])
+    up = h @ layer_params["w_up"]
+    x = x + (gate * up) @ layer_params["w_down"]
+    return x
+
+
+def forward(params, tokens, cfg):
+    """tokens: [B, S] int32 → logits [B, S, vocab] (float32)."""
+    dt = param_dtype(cfg)
+    x = params["embed"][tokens].astype(dt)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, tokens.shape[1], cfg.rope_theta, dtype=dt,
+        llama3_scaling=cfg.rope_llama3_scaling,
+    )
+
+    layer_fn = lambda x, lp: (_layer(cfg, cos, sin, x, lp), None)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def loss_fn(params, batch, cfg):
+    """Next-token cross-entropy; batch: {'tokens': [B, S+1]} or
+    {'inputs': [B,S], 'targets': [B,S]} (+ optional 'mask')."""
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = forward(params, inputs, cfg)
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    token_lp = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        return -jnp.mean(token_lp)
+    return -jnp.sum(token_lp * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def num_params(params):
+    return sum(int(x.size) for x in jax.tree.leaves(params))

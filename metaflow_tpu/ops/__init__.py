@@ -1,0 +1,19 @@
+from .norms import rms_norm, layer_norm
+from .rope import rope_frequencies, apply_rope
+from .attention import attention, flash_attention, reference_attention
+from .ring_attention import ring_attention, ring_attention_sharded
+from .moe import moe_ffn, top_k_router
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "rope_frequencies",
+    "apply_rope",
+    "attention",
+    "flash_attention",
+    "reference_attention",
+    "ring_attention",
+    "ring_attention_sharded",
+    "moe_ffn",
+    "top_k_router",
+]

@@ -1,0 +1,254 @@
+"""Attention: XLA reference path + pallas TPU flash-attention forward.
+
+The flash kernel follows the standard online-softmax blockwise algorithm
+(grid over [batch*heads, q blocks]; inner fori_loop over k blocks with
+running max/denominator). A custom_vjp recomputes attention blockwise with
+the saved LSE on the backward pass, so the S×S score matrix is never
+materialized in HBM in either direction.
+
+Public entry: `attention(q, k, v, causal=..., impl='auto')` with GQA support
+(num kv heads may divide num q heads).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _broadcast_gqa(k, num_q_heads):
+    """[B, S, Hkv, D] -> [B, S, Hq, D] by repeating kv heads."""
+    num_kv = k.shape[-2]
+    if num_kv == num_q_heads:
+        return k
+    reps = num_q_heads // num_kv
+    return jnp.repeat(k, reps, axis=-2)
+
+
+def reference_attention(q, k, v, causal=True, scale=None):
+    """XLA attention: [B, S, H, D] layout. Materializes S×S scores — fine for
+    moderate sequence lengths; XLA fuses mask+softmax into the matmuls."""
+    B, Sq, H, D = q.shape
+    k = _broadcast_gqa(k, H)
+    v = _broadcast_gqa(v, H)
+    scale = scale or (1.0 / math.sqrt(D))
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        Sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((Sq, Sk), dtype=bool), k=Sk - Sq)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# pallas flash forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                      block_k, seq_len):
+    # blocks carry a leading size-1 (batch*head) dim:
+    # q_ref: [1, BLOCK_Q, D]; k_ref/v_ref: [1, S, D]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, D = q.shape
+
+    m = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc = jnp.zeros((block_q, D), dtype=jnp.float32)
+
+    if causal:
+        # only k blocks at or before the diagonal contribute
+        num_kb_live = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        num_kb_live = seq_len // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, (m, l, acc))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # lse layout is [1, 8, S]: sublane dim padded to the fp32 tile minimum,
+    # each q-block program writes its sequence slice (row 0 is the payload)
+    lse_ref[0, :, pl.ds(qi * block_q, block_q)] = jnp.broadcast_to(
+        (m + jnp.log(l)).reshape(1, -1), (8, block_q)
+    )
+
+
+try:  # pallas import is TPU/CPU-interpret capable; keep soft for portability
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+def _flash_forward(q, k, v, causal, scale, interpret=False):
+    """q,k,v: [BH, S, D] (heads folded into batch). Returns (out, lse)."""
+    BH, S, D = q.shape
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    grid = (BH, S // block_q)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        causal=causal,
+        scale=scale,
+        block_k=block_k,
+        seq_len=S,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+def _fold_heads(x):
+    # [B, S, H, D] -> [B*H, S, D]
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _unfold_heads(x, B, H):
+    BH, S, D = x.shape
+    return x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, scale, interpret):
+    out, _ = _flash_forward(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, interpret):
+    out, lse = _flash_forward(q, k, v, causal, scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(causal, scale, interpret, res, g):
+    """Blockwise recompute backward using the saved LSE (no S×S tensor)."""
+    q, k, v, out, lse = res
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    BH, S, D = q.shape
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [BH, S]
+
+    block = min(BLOCK_Q, S)
+    nb = S // block
+
+    q_pos_all = jnp.arange(S)
+
+    def scan_q(carry, qb):
+        dk, dv = carry
+        qs = jax.lax.dynamic_slice_in_dim(qf, qb * block, block, axis=1)
+        gs = jax.lax.dynamic_slice_in_dim(gf, qb * block, block, axis=1)
+        lses = jax.lax.dynamic_slice_in_dim(lse, qb * block, block, axis=1)
+        deltas = jax.lax.dynamic_slice_in_dim(delta, qb * block, block, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", qs * scale, kf,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qb * block + q_pos_all[:block]
+            mask = qpos[:, None] >= q_pos_all[None, :]
+            s = jnp.where(mask[None], s, NEG_INF)
+        p = jnp.exp(s - lses[..., None])
+        dp = jnp.einsum("bqd,bkd->bqk", gs, vf,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - deltas[..., None]) * scale
+        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf,
+                          preferred_element_type=jnp.float32)
+        dk = dk + jnp.einsum("bqk,bqd->bkd", ds, qs,
+                             preferred_element_type=jnp.float32)
+        dv = dv + jnp.einsum("bqk,bqd->bkd", p, gs,
+                             preferred_element_type=jnp.float32)
+        return (dk, dv), dq_b
+
+    (dk, dv), dq_blocks = jax.lax.scan(
+        scan_q, (jnp.zeros_like(kf), jnp.zeros_like(vf)), jnp.arange(nb)
+    )
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None, interpret=False):
+    """Pallas flash attention; q,k,v: [B, S, H, D] (kv heads may be fewer).
+
+    Requires S to be a multiple of the 128 block size (the `attention`
+    dispatcher falls back to the XLA path otherwise)."""
+    B, S, H, D = q.shape
+    block = min(BLOCK_Q, S)
+    if S % block or S % min(BLOCK_K, S):
+        raise ValueError(
+            "flash_attention requires seq len divisible by the %d block "
+            "size (got %d); use attention(impl='auto') for a fallback"
+            % (BLOCK_Q, S)
+        )
+    k = _broadcast_gqa(k, H)
+    v = _broadcast_gqa(v, H)
+    scale = scale or (1.0 / math.sqrt(D))
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    out = _flash_attention(qf, kf, vf, causal, scale, interpret)
+    return _unfold_heads(out, B, H)
+
+
+def attention(q, k, v, causal=True, scale=None, impl="auto"):
+    """Dispatch: pallas flash on TPU when shapes tile cleanly, XLA otherwise."""
+    if impl == "auto":
+        S, D = q.shape[1], q.shape[3]
+        on_tpu = jax.default_backend() == "tpu"
+        aligned = S % BLOCK_Q == 0 and D % 128 == 0 and S >= BLOCK_Q
+        impl = "flash" if (HAS_PALLAS and on_tpu and aligned) else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "flash_interpret":
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               interpret=True)
+    return reference_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,78 @@
+"""Mixture-of-Experts: top-k router + dense einsum dispatch.
+
+Expert-parallel path (SURVEY.md §5.7, Mixtral target): experts live on the
+'expert' mesh axis. Dispatch uses one-hot einsums (MXU-friendly dense
+matmuls, no dynamic gather/scatter — XLA turns the expert dimension into an
+all-to-all when sharded). Capacity-dropping keeps shapes static for jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_router(logits, num_experts, k, dtype=jnp.float32):
+    """logits: [tokens, experts] → (weights [tokens, k], idx [tokens, k]).
+
+    Softmax over the selected k (Mixtral convention)."""
+    gate_logits, idx = jax.lax.top_k(logits, k)
+    weights = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    return weights.astype(dtype), idx
+
+
+def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
+            capacity_factor=None, activation=jax.nn.silu):
+    """Token-choice MoE feed-forward.
+
+    x:        [B, S, E]
+    router_w: [E, num_experts]
+    w_gate/w_up: [num_experts, E, F]; w_down: [num_experts, F, E]
+
+    Dense dispatch: combine weights become a [tokens, experts] matrix and the
+    expert computation is a batched einsum over the expert dim — sharded on
+    the 'expert' mesh axis this becomes all-to-all + local expert matmuls.
+    """
+    B, S, E = x.shape
+    num_experts = router_w.shape[1]
+    tokens = x.reshape(B * S, E)
+
+    router_logits = jnp.einsum(
+        "te,en->tn", tokens.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    weights, idx = top_k_router(router_logits, num_experts,
+                                num_experts_per_tok, dtype=x.dtype)
+
+    # combine matrix: [tokens, experts], rows sum to 1 over selected experts
+    one_hot = jax.nn.one_hot(idx, num_experts, dtype=x.dtype)  # [t, k, n]
+    combine = jnp.einsum("tkn,tk->tn", one_hot, weights)
+
+    # dense dispatch: every expert sees every token, scaled post-hoc.
+    # With capacity_factor set, tokens beyond an expert's capacity drop out
+    # (position-in-expert computed via a cumulative sum).
+    if capacity_factor is not None:
+        capacity = int(capacity_factor * (B * S) * num_experts_per_tok
+                       / num_experts)
+        dispatch_mask = combine > 0
+        position_in_expert = jnp.cumsum(dispatch_mask, axis=0) * dispatch_mask
+        combine = jnp.where(position_in_expert <= capacity, combine, 0.0)
+
+    # [n, t, E]: per-expert token batch (sharded over 'expert' this is the
+    # all-to-all boundary)
+    h = jnp.einsum("te,tn->nte", tokens, combine != 0)
+    gate = activation(jnp.einsum("nte,nef->ntf", h, w_gate,
+                                 preferred_element_type=jnp.float32))
+    up = jnp.einsum("nte,nef->ntf", h, w_up,
+                    preferred_element_type=jnp.float32)
+    expert_out = jnp.einsum("ntf,nfe->nte", (gate * up).astype(x.dtype),
+                            w_down, preferred_element_type=jnp.float32)
+    out = jnp.einsum("nte,tn->te", expert_out.astype(x.dtype), combine)
+    aux = _load_balancing_loss(router_logits, one_hot)
+    return out.reshape(B, S, E), aux
+
+
+def _load_balancing_loss(router_logits, one_hot):
+    """Switch-style auxiliary loss: num_experts * Σ fraction_i * prob_i."""
+    num_experts = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    fraction = jnp.mean(one_hot.sum(axis=1), axis=0)
+    prob_mean = jnp.mean(probs, axis=0)
+    return num_experts * jnp.sum(fraction * prob_mean)

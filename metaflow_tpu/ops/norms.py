@@ -1,0 +1,27 @@
+"""Normalization ops. Elementwise chains like these fuse into neighbouring
+matmuls under XLA; they are written in float32 accumulation regardless of
+input dtype (bf16-safe)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, weight, eps=1e-6):
+    """RMSNorm (Llama-style): x * w / rms(x)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps=1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    out = x * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)

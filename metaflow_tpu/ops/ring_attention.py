@@ -1,0 +1,118 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+Long-context path (SURVEY.md §5.7): the sequence is sharded across the
+'sequence' mesh axis; each device holds a [B, S/N, H, D] shard of q/k/v. K/V
+blocks rotate around the ring via lax.ppermute while each device accumulates
+blockwise attention with an online softmax — compute overlaps the collective,
+total memory stays O(S/N), and the ppermute hops ride neighbouring ICI links.
+
+Use inside shard_map (ring_attention_sharded builds it for a mesh).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, q_offset, k_offset, causal):
+    """One blockwise attention contribution + online-softmax stats.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, Hkv, D] — GQA broadcast happens HERE,
+    after the ring hop, so ppermute only ever moves kv-head-width blocks.
+    Returns (unnormalized out [B,Sq,H,D] in f32, m [B,H,Sq], l [B,H,Sq]).
+    """
+    H = q.shape[2]
+    if k.shape[2] != H:
+        reps = H // k.shape[2]
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(Sq)[:, None]
+        k_pos = k_offset + jnp.arange(Sk)[None, :]
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out, m, l
+
+
+def _ring_attention_local(q, k, v, axis_name, causal=True, scale=None):
+    """Body run per-device under shard_map."""
+    B, S_local, H, D = q.shape
+    scale = scale or (1.0 / math.sqrt(D))
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    q_offset = my_idx * S_local
+
+    # derive the accumulators from q so they carry q's varying-axes (vma)
+    # annotation — a plain jnp.zeros would be 'unvarying' and fail the scan
+    # carry type check under shard_map
+    zero_q = q.astype(jnp.float32) * 0.0
+    acc = zero_q
+    m_run = zero_q[..., 0].transpose(0, 2, 1) + NEG_INF
+    l_run = zero_q[..., 0].transpose(0, 2, 1)
+
+    def step(carry, r):
+        acc, m_run, l_run, k_cur, v_cur = carry
+        # k block currently held came from device (my_idx - r) mod N
+        src = (my_idx - r) % axis_size
+        k_offset = src * S_local
+        out_b, m_b, l_b = _block_attn(
+            q, k_cur, v_cur, scale, q_offset, k_offset, causal
+        )
+        m_new = jnp.maximum(m_run, m_b)
+        c_run = jnp.exp(m_run - m_new)
+        c_b = jnp.exp(m_b - m_new)
+        l_new = l_run * c_run + l_b * c_b
+        acc = acc * c_run.transpose(0, 2, 1)[..., None] + \
+            out_b * c_b.transpose(0, 2, 1)[..., None]
+        # rotate k/v to the next device (overlaps with next iteration's
+        # compute under XLA latency hiding)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l_new, k_nxt, v_nxt), None
+
+    (acc, m_run, l_run, _, _), _ = jax.lax.scan(
+        step, (acc, m_run, l_run, k, v), jnp.arange(axis_size)
+    )
+    out = acc / l_run.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh, axis_name="sequence", causal=True,
+                           scale=None):
+    """Build a sharded ring-attention fn for [B, S, H, D] inputs with S split
+    over `axis_name` (batch over data axes when present)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    spec = P(batch_axes or None, axis_name, None, None)
+
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+
+def ring_attention(q, k, v, mesh, axis_name="sequence", causal=True,
+                   scale=None):
+    return ring_attention_sharded(mesh, axis_name, causal, scale)(q, k, v)

@@ -1,0 +1,49 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling."""
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim, max_seq_len, theta=500_000.0, dtype=jnp.float32,
+                     llama3_scaling=False):
+    """Precompute cos/sin tables [max_seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if llama3_scaling:
+        inv_freq = _llama3_scale(inv_freq)
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def _llama3_scale(inv_freq, factor=8.0, low_freq_factor=1.0,
+                  high_freq_factor=4.0, original_context=8192):
+    """Llama-3.1 'NTK-by-parts' frequency scaling."""
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wavelen = original_context / low_freq_factor
+    high_wavelen = original_context / high_freq_factor
+    scaled = inv_freq / factor
+    smooth = (original_context / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1 - smooth) * scaled + smooth * inv_freq
+    out = jnp.where(wavelen > low_wavelen, scaled, inv_freq)
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(mid, smoothed, out)
+
+
+def apply_rope(x, cos, sin, positions=None):
+    """x: [..., seq, heads, head_dim]; cos/sin: [max_seq, head_dim//2].
+
+    Uses the interleaved-half convention (rotate_half), matching Llama.
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        c = cos[:seq_len][:, None, :]
+        s = sin[:seq_len][:, None, :]
+    else:
+        c = cos[positions][..., None, :]
+        s = sin[positions][..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,30 @@
+from .mesh import MeshSpec, create_mesh, batch_sharding, data_axes
+from .sharding import (
+    rules_for_mesh,
+    spec_for,
+    tree_specs,
+    tree_shardings,
+    shard_tree,
+    constrain,
+)
+from .distributed import (
+    initialize_from_current,
+    initialize_from_env,
+    process_info,
+)
+
+__all__ = [
+    "MeshSpec",
+    "create_mesh",
+    "batch_sharding",
+    "data_axes",
+    "rules_for_mesh",
+    "spec_for",
+    "tree_specs",
+    "tree_shardings",
+    "shard_tree",
+    "constrain",
+    "initialize_from_current",
+    "initialize_from_env",
+    "process_info",
+]

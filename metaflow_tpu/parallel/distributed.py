@@ -1,0 +1,51 @@
+"""Multi-host bootstrap: `jax.distributed` from gang rendezvous info.
+
+The reference's equivalent is env-var rendezvous for torchrun/NCCL
+(SURVEY.md §5.8); here the control task (host 0 of the slice) is the
+coordinator and XLA collectives ride ICI/DCN.
+"""
+
+import os
+
+
+def initialize_from_current(timeout_ms=60_000):
+    """Call inside a gang (@parallel/num_parallel) step to join the JAX
+    multi-host process group. No-op for single-node gangs or when already
+    initialized."""
+    from ..current import current
+
+    p = getattr(current, "parallel", None)
+    if p is None or p.num_nodes <= 1:
+        return False
+    import jax
+
+    if jax.process_count() > 1:
+        return False  # already initialized
+    jax.distributed.initialize(
+        coordinator_address="%s:%d" % (p.main_ip, p.coordinator_port),
+        num_processes=p.num_nodes,
+        process_id=p.node_index,
+    )
+    return True
+
+
+def initialize_from_env():
+    """TPU pod slice entry: on Cloud TPU VMs jax.distributed.initialize()
+    discovers coordinator/world from the TPU metadata server."""
+    import jax
+
+    if jax.process_count() > 1:
+        return False
+    jax.distributed.initialize()
+    return True
+
+
+def process_info():
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

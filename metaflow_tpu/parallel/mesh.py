@@ -1,0 +1,127 @@
+"""Device mesh construction + parallelism presets.
+
+This is the layer the reference delegates to torchrun/DeepSpeed (SURVEY.md
+§5.7): here DP/FSDP/TP/SP/EP/PP are mesh axes over which pjit/GSPMD shards
+the program, with XLA inserting collectives that ride ICI (intra-slice) and
+DCN (inter-slice).
+
+Canonical axis names (order matters: outermost = slowest-varying = DCN-side):
+
+    data      pure data parallelism (gradient psum)
+    fsdp      data parallelism with sharded params/optimizer (ZeRO-3 style)
+    expert    expert parallelism for MoE layers
+    tensor    tensor (megatron-style) model parallelism — keep innermost so
+              its collectives ride the fastest ICI links
+    sequence  context/sequence parallelism (ring attention)
+    pipeline  pipeline stages (shard_map based)
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "sequence", "tensor")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each mesh axis; -1 means 'absorb remaining devices'."""
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def resolved(self, n_devices):
+        sizes = {k: v for k, v in self.axes.items() if v not in (None, 1)}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("Only one axis may be -1, got %s" % wild)
+        fixed = int(np.prod([v for v in sizes.values() if v != -1] or [1]))
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    "%d devices not divisible by fixed axes %s"
+                    % (n_devices, sizes)
+                )
+            sizes[wild[0]] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    "Mesh %s needs %d devices but %d are available"
+                    % (sizes, fixed, n_devices)
+                )
+        # canonical ordering, dropping size-1 axes
+        return {k: sizes[k] for k in AXIS_ORDER if sizes.get(k, 1) > 1} or {
+            "data": n_devices
+        }
+
+    # ---- presets ----
+
+    @staticmethod
+    def dp():
+        return MeshSpec({"data": -1})
+
+    @staticmethod
+    def fsdp():
+        return MeshSpec({"fsdp": -1})
+
+    @staticmethod
+    def fsdp_tp(tensor):
+        return MeshSpec({"fsdp": -1, "tensor": tensor})
+
+    @staticmethod
+    def dp_tp(tensor):
+        return MeshSpec({"data": -1, "tensor": tensor})
+
+    @staticmethod
+    def moe(expert, tensor=1):
+        return MeshSpec({"fsdp": -1, "expert": expert, "tensor": tensor})
+
+    @staticmethod
+    def long_context(sequence, tensor=1):
+        return MeshSpec({"fsdp": -1, "sequence": sequence, "tensor": tensor})
+
+    @staticmethod
+    def pipelined(pipeline, tensor=1):
+        return MeshSpec({"pipeline": pipeline, "fsdp": -1, "tensor": tensor})
+
+
+def create_mesh(spec=None, devices=None, n_devices=None):
+    """Build a jax.sharding.Mesh from a MeshSpec (or axis dict).
+
+    Device order follows jax.devices(), which enumerates TPU devices in
+    torus-topology order — adjacent mesh coordinates land on ICI neighbours,
+    so the innermost ('tensor') axis gets the fastest links.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if spec is None:
+        spec = MeshSpec.dp()
+    if isinstance(spec, dict):
+        spec = MeshSpec(spec)
+    sizes = spec.resolved(len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes[n] for n in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def mesh_axis_size(mesh, name):
+    return mesh.shape.get(name, 1)
+
+
+def data_axes(mesh):
+    """Axes over which the batch dimension is split."""
+    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh):
+    """NamedSharding for [batch, ...] inputs: batch over data axes."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, PartitionSpec(axes if axes else None))

@@ -1,0 +1,124 @@
+"""Logical-axis sharding: annotate params with semantic axis names, map them
+onto mesh axes with a rule table, let GSPMD insert the collectives.
+
+The recipe (jax-ml.github.io/scaling-book): pick a mesh, annotate shardings,
+profile, iterate. Models in metaflow_tpu.models declare per-parameter logical
+axes like ('embed', 'mlp'); the rule tables below map those to mesh axes for
+each parallelism style.
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# rule tables: logical axis name -> mesh axis (None = replicate).
+# 'fsdp' shards the *parameter* dim that is largest/most even; 'tensor'
+# shards the dim contracted inside the layer (megatron pattern).
+
+FSDP_RULES = {
+    "vocab": None,
+    "embed": "fsdp",
+    "mlp": None,
+    "heads": None,
+    "kv_heads": None,
+    "head_dim": None,
+    "qkv": None,
+    "layers": None,
+    "expert": None,
+    "batch": ("data", "fsdp"),
+    "seq": None,
+}
+
+FSDP_TP_RULES = {
+    "vocab": "tensor",
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "qkv": "tensor",
+    "layers": None,
+    "expert": None,
+    "batch": ("data", "fsdp"),
+    "seq": None,
+}
+
+MOE_RULES = dict(FSDP_TP_RULES, expert="expert")
+
+LONG_CONTEXT_RULES = dict(FSDP_TP_RULES, seq="sequence")
+
+
+def rules_for_mesh(mesh):
+    """Pick the most specific rule table for the mesh's axes."""
+    axes = set(mesh.axis_names)
+    if "expert" in axes:
+        rules = dict(MOE_RULES)
+    elif "sequence" in axes:
+        rules = dict(LONG_CONTEXT_RULES)
+    elif "tensor" in axes:
+        rules = dict(FSDP_TP_RULES)
+    else:
+        rules = dict(FSDP_RULES)
+    # drop references to axes the mesh doesn't have
+    def _filter(v):
+        if v is None:
+            return None
+        if isinstance(v, tuple):
+            kept = tuple(a for a in v if a in axes)
+            return kept or None
+        return v if v in axes else None
+
+    return {k: _filter(v) for k, v in rules.items()}
+
+
+def spec_for(logical_axes, rules):
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    used = set()
+    parts = []
+    for name in logical_axes:
+        axis = rules.get(name)
+        if axis is None:
+            parts.append(None)
+            continue
+        flat = axis if isinstance(axis, tuple) else (axis,)
+        flat = tuple(a for a in flat if a not in used)
+        used.update(flat)
+        if not flat:
+            parts.append(None)
+        elif len(flat) == 1:
+            parts.append(flat[0])
+        else:
+            parts.append(flat)
+    return PartitionSpec(*parts)
+
+
+def tree_specs(logical_tree, rules):
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def tree_shardings(logical_tree, mesh, rules=None):
+    rules = rules or rules_for_mesh(mesh)
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), tree_specs(logical_tree, rules)
+    )
+
+
+def shard_tree(tree, logical_tree, mesh, rules=None):
+    """Device-put a pytree according to its logical axes."""
+    shardings = tree_shardings(logical_tree, mesh, rules)
+    return jax.device_put(tree, shardings)
+
+
+def constrain(x, logical_axes, mesh, rules=None):
+    """with_sharding_constraint via logical axes (use inside jitted fns)."""
+    rules = rules or rules_for_mesh(mesh)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec_for(logical_axes, rules))
+    )

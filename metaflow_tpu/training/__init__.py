@@ -1,0 +1,17 @@
+from .train_step import (
+    default_optimizer,
+    make_train_state,
+    make_train_step,
+    make_trainer,
+    make_eval_step,
+    shard_batch,
+)
+
+__all__ = [
+    "default_optimizer",
+    "make_train_state",
+    "make_train_step",
+    "make_trainer",
+    "make_eval_step",
+    "shard_batch",
+]

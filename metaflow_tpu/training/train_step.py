@@ -1,0 +1,118 @@
+"""Training loop building blocks: sharded init, jitted train step.
+
+The pjit/GSPMD path the reference delegates to user frameworks (SURVEY.md
+§5.7): params and optimizer state are sharded via the model's logical axes +
+the mesh's rule table; the train step donates its state buffers so the update
+is in-place in HBM, and XLA inserts the gradient psum/reduce-scatter over the
+data/fsdp axes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..parallel import sharding as shd
+from ..parallel.mesh import batch_sharding
+
+
+def default_optimizer(lr=3e-4, weight_decay=0.1, clip_norm=1.0,
+                      warmup_steps=100, total_steps=10_000, b1=0.9, b2=0.95):
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1), end_value=lr * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=jnp.float32),
+    )
+
+
+def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):
+    """Sharded init: params + optimizer state placed per the rule table.
+
+    model: module exposing init_params(rng, cfg) and logical_axes(cfg).
+    Returns (state dict, shardings dict).
+    """
+    optimizer = optimizer or default_optimizer()
+    rules = rules or shd.rules_for_mesh(mesh)
+    log_axes = model.logical_axes(cfg)
+    param_shardings = shd.tree_shardings(log_axes, mesh, rules)
+
+    def init():
+        params = model.init_params(rng, cfg)
+        return params
+
+    with mesh:
+        params = jax.jit(init, out_shardings=param_shardings)()
+        opt_state = jax.jit(
+            optimizer.init,
+            # optimizer state mirrors the param tree; let GSPMD propagate
+        )(params)
+    state = {"params": params, "opt_state": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    shardings = {
+        "params": param_shardings,
+        "opt_state": jax.tree.map(lambda x: x.sharding, opt_state),
+        "step": jax.tree.map(lambda x: x.sharding, state["step"]),
+    }
+    return state, shardings
+
+
+def make_train_step(cfg, mesh, model, optimizer=None, rules=None,
+                    loss_fn=None):
+    """Build the jitted, donated train step: (state, batch) → (state, metrics)."""
+    optimizer = optimizer or default_optimizer()
+    loss_fn = loss_fn or model.loss_fn
+
+    def step(state, batch):
+        def compute_loss(params):
+            return loss_fn(params, batch, cfg)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        grad_norm = optax.global_norm(grads)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
+                 loss_fn=None):
+    """One-stop builder: returns (state, train_step_fn, shardings) with a
+    SINGLE shared optimizer — prefer this over calling make_train_state and
+    make_train_step separately (mismatched optimizers give silently wrong or
+    crashing updates)."""
+    optimizer = optimizer or default_optimizer()
+    state, shardings = make_train_state(
+        rng, cfg, mesh, model, optimizer=optimizer, rules=rules
+    )
+    step = make_train_step(
+        cfg, mesh, model, optimizer=optimizer, rules=rules, loss_fn=loss_fn
+    )
+    return state, step, shardings
+
+
+def make_eval_step(cfg, mesh, model, loss_fn=None):
+    loss_fn = loss_fn or model.loss_fn
+
+    def step(params, batch):
+        return loss_fn(params, batch, cfg)
+
+    return jax.jit(step)
+
+
+def shard_batch(batch, mesh):
+    """Place a host batch onto the mesh (batch dim over data axes)."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

@@ -4,12 +4,19 @@
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the axon sandbox pins JAX_PLATFORMS=axon; JAX_PLATFORM_NAME still wins,
+# and subprocess flows inherit it
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

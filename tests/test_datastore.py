@@ -1,0 +1,171 @@
+"""Datastore unit tests: CAS dedup, serializers, task datastore lifecycle.
+
+Reference model: test/unit/test_content_addressed_store.py + serializer tests.
+"""
+
+import numpy as np
+import pytest
+
+from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+from metaflow_tpu.datastore import serializers
+from metaflow_tpu.datastore.cas import ContentAddressedStore
+
+
+@pytest.fixture()
+def flow_ds(tpuflow_root):
+    return FlowDataStore("TestFlow", LocalStorage)
+
+
+class TestCAS:
+    def test_roundtrip(self, flow_ds):
+        cas = flow_ds.ca_store
+        blobs = [b"hello", b"world", b"hello"]
+        results = cas.save_blobs(blobs)
+        assert len(results) == 3
+        # identical content → identical key (dedup)
+        assert results[0][1] == results[2][1]
+        assert results[0][1] != results[1][1]
+        loaded = dict(cas.load_blobs([r[1] for r in results[:2]]))
+        assert loaded[results[0][1]] == b"hello"
+        assert loaded[results[1][1]] == b"world"
+
+    def test_large_blob_skips_gzip(self, flow_ds):
+        cas = flow_ds.ca_store
+        big = np.random.default_rng(0).bytes(ContentAddressedStore.COMPRESS_MAX + 1)
+        [(_, key)] = cas.save_blobs([big])
+        [(k, loaded)] = list(cas.load_blobs([key]))
+        assert loaded == big
+
+    def test_missing_key(self, flow_ds):
+        with pytest.raises(KeyError):
+            list(flow_ds.ca_store.load_blobs(["0" * 64]))
+
+
+class TestSerializers:
+    def test_numpy_fast_path(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        payload, tag = serializers.serialize(arr)
+        assert tag == serializers.TYPE_NPY
+        out = serializers.deserialize(payload, tag)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_jax_array(self):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((4, 4), dtype=jnp.bfloat16)
+        payload, tag = serializers.serialize(arr)
+        assert tag == serializers.TYPE_NPY
+        out = serializers.deserialize(payload, tag)
+        assert out.shape == (4, 4)
+        assert str(out.dtype) == "bfloat16"
+
+    def test_pytree(self):
+        tree = {"w": np.zeros((2, 2)), "layers": [np.ones(3), {"b": np.full(2, 7.0)}],
+                "step": 5}
+        payload, tag = serializers.serialize(tree)
+        assert tag == serializers.TYPE_PYTREE
+        out = serializers.deserialize(payload, tag)
+        assert out["step"] == 5
+        np.testing.assert_array_equal(out["layers"][1]["b"], np.full(2, 7.0))
+
+    def test_pickle_fallback(self):
+        obj = {"fn_name": len, "s": {1, 2, 3}}
+        payload, tag = serializers.serialize(obj)
+        assert tag == serializers.TYPE_PICKLE
+        out = serializers.deserialize(payload, tag)
+        assert out["s"] == {1, 2, 3}
+
+    def test_big_endian_roundtrip(self):
+        arr = np.arange(3, dtype=">f4")
+        payload, tag = serializers.serialize(arr)
+        out = serializers.deserialize(payload, tag)
+        np.testing.assert_array_equal(out, [0.0, 1.0, 2.0])
+
+    def test_string_array_uses_pickle(self):
+        payload, tag = serializers.serialize(np.array(["abc", "de"]))
+        assert tag == serializers.TYPE_PICKLE
+        assert serializers.deserialize(payload, tag).tolist() == ["abc", "de"]
+
+    def test_object_array_in_tree_uses_pickle(self):
+        tree = {"x": np.array([{"a": 1}, None], dtype=object)}
+        payload, tag = serializers.serialize(tree)
+        assert tag == serializers.TYPE_PICKLE
+        assert serializers.deserialize(payload, tag)["x"][0] == {"a": 1}
+
+    def test_complex_scalars_use_pickle(self):
+        payload, tag = serializers.serialize({"z": 1 + 2j})
+        assert tag == serializers.TYPE_PICKLE
+        assert serializers.deserialize(payload, tag)["z"] == 1 + 2j
+
+    def test_jax_array_inside_object_graph(self):
+        import jax.numpy as jnp
+
+        class Holder:
+            pass
+
+        obj = {"nested": [jnp.arange(4)], "x": "y"}
+        # mixed content with only arrays → pytree; arbitrary object → pickle
+        payload, tag = serializers.serialize(obj)
+        out = serializers.deserialize(payload, tag)
+        np.testing.assert_array_equal(np.asarray(out["nested"][0]),
+                                      np.arange(4))
+
+
+class TestTaskDataStore:
+    def test_lifecycle(self, flow_ds):
+        ds = flow_ds.get_task_datastore("1", "start", "t1", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts([("x", 42), ("arr", np.arange(5))])
+        ds.done()
+
+        rd = flow_ds.get_task_datastore("1", "start", "t1")
+        assert rd.is_done()
+        assert rd["x"] == 42
+        np.testing.assert_array_equal(rd["arr"], np.arange(5))
+        assert "x" in rd
+        assert "missing" not in rd
+
+    def test_latest_attempt_resolution(self, flow_ds):
+        # attempt 0 started but never done; attempt 1 done
+        a0 = flow_ds.get_task_datastore("1", "s", "t", attempt=0, mode="w")
+        a0.init_task()
+        a0.save_artifacts([("v", "failed")])
+        a1 = flow_ds.get_task_datastore("1", "s", "t", attempt=1, mode="w")
+        a1.init_task()
+        a1.save_artifacts([("v", "ok")])
+        a1.done()
+
+        rd = flow_ds.get_task_datastore("1", "s", "t")
+        assert rd.attempt == 1
+        assert rd["v"] == "ok"
+
+    def test_clone(self, flow_ds):
+        src = flow_ds.get_task_datastore("1", "s", "t", attempt=0, mode="w")
+        src.init_task()
+        src.save_artifacts([("data", [1, 2, 3])])
+        src.done()
+
+        origin = flow_ds.get_task_datastore("1", "s", "t")
+        dst = flow_ds.get_task_datastore("2", "s", "t", attempt=0, mode="w")
+        dst.init_task()
+        dst.clone(origin)
+        dst.done()
+        rd = flow_ds.get_task_datastore("2", "s", "t")
+        assert rd["data"] == [1, 2, 3]
+
+    def test_write_after_done_rejected(self, flow_ds):
+        from metaflow_tpu.exception import MetaflowInternalError
+
+        ds = flow_ds.get_task_datastore("1", "s", "t9", attempt=0, mode="w")
+        ds.init_task()
+        ds.done()
+        with pytest.raises(MetaflowInternalError):
+            ds.save_artifacts([("x", 1)])
+
+    def test_listing(self, flow_ds):
+        for step, task in (("start", "1"), ("train", "2"), ("train", "3")):
+            ds = flow_ds.get_task_datastore("9", step, task, attempt=0, mode="w")
+            ds.init_task()
+            ds.done()
+        assert set(flow_ds.list_steps("9")) == {"start", "train"}
+        assert set(flow_ds.list_tasks("9", "train")) == {"2", "3"}

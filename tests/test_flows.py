@@ -1,0 +1,148 @@
+"""End-to-end flow execution tests through the real CLI surface.
+
+Reference model: the generative test/core harness (SURVEY.md §4) — here each
+graph shape is a hand-written flow exercised as a subprocess, with client-API
+checkers (the MetadataCheck pattern).
+"""
+
+import os
+
+import pytest
+
+
+def _client(tpuflow_root):
+    os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+    from metaflow_tpu import client
+
+    client.namespace(None)
+    return client
+
+
+def test_linear_with_params(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run", "--alpha", "0.25")
+    c = _client(tpuflow_root)
+    run = c.Flow("LinearFlow").latest_run
+    assert run.successful
+    assert run.data.scaled == 2.5
+    assert run["middle"].task.data.alpha == 0.25
+
+
+def test_branch_join(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "branch_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("BranchFlow").latest_run
+    assert run.data.total == 3
+    assert run.data.common == "base"
+
+
+def test_foreach(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "foreach_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("ForeachFlow").latest_run
+    assert run.data.letters == ["aa", "bb", "cc"]
+    tasks = list(run["body"].tasks())
+    assert len(tasks) == 3
+    assert sorted(t.index for t in tasks) == [0, 1, 2]
+
+
+def test_nested_foreach(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "nested_foreach_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("NestedForeachFlow").latest_run
+    assert run.data.total == 102
+    assert len(list(run["leaf"].tasks())) == 6
+    assert len(list(run["inner_join"].tasks())) == 2
+
+
+def test_switch_and_recursion(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "switch_flow.py"), "run", "--mode", "slow")
+    c = _client(tpuflow_root)
+    run = c.Flow("SwitchFlow").latest_run
+    assert run.data.result == "slow"
+    assert run.data.rounds == 3
+    # recursion: improve ran 3 times
+    assert len(list(run["improve"].tasks())) == 3
+    # the not-chosen branch never ran
+    assert "fast_path" not in [s.id for s in run.steps()]
+
+
+def test_retry_and_catch(run_flow, flows_dir, tpuflow_root, tmp_path):
+    marker = str(tmp_path / "attempts")
+    run_flow(
+        os.path.join(flows_dir, "retry_catch_flow.py"),
+        "run",
+        env_extra={"ATTEMPT_COUNT_FILE": marker},
+    )
+    c = _client(tpuflow_root)
+    run = c.Flow("RetryCatchFlow").latest_run
+    assert run.data.flaky_attempts == 2
+    flaky_task = run["flaky"].task
+    assert flaky_task.current_attempt == 1  # second attempt succeeded
+
+
+def test_parallel_gang(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "parallel_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("ParallelFlow").latest_run
+    assert run.data.ranks == [0, 1, 2]
+    assert run.data.values == [100, 101, 102]
+    # control + 2 workers
+    assert len(list(run["train"].tasks())) == 3
+
+
+def test_resume(run_flow, flows_dir, tpuflow_root, tmp_path):
+    flow_file = str(tmp_path / "resumable_flow.py")
+    with open(os.path.join(flows_dir, "_resumable_flow_template.py")) as f:
+        src = f.read()
+    with open(flow_file, "w") as f:
+        f.write(src)
+    run_flow(flow_file, "run", expect_fail=True,
+             env_extra={"MAKE_IT_FAIL": "1"})
+    proc = run_flow(flow_file, "resume")
+    assert "Cloned" in proc.stdout
+    c = _client(tpuflow_root)
+    run = c.Flow("ResumableFlow").latest_run
+    assert run.successful
+    assert run.data.y == 42
+
+
+def test_failing_run_marked_failed(run_flow, flows_dir, tpuflow_root, tmp_path):
+    flow_file = str(tmp_path / "resumable_flow.py")
+    with open(os.path.join(flows_dir, "_resumable_flow_template.py")) as f:
+        src = f.read()
+    with open(flow_file, "w") as f:
+        f.write(src)
+    run_flow(flow_file, "run", expect_fail=True,
+             env_extra={"MAKE_IT_FAIL": "1"})
+    c = _client(tpuflow_root)
+    run = c.Flow("ResumableFlow").latest_run
+    assert not run.finished
+    mid = run["middle"].task
+    assert not mid.successful
+
+
+def test_dump_and_logs_cli(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+    run_id = open(os.path.join(tpuflow_root, "LinearFlow", "latest_run")).read()
+    proc = run_flow(
+        os.path.join(flows_dir, "linear_flow.py"),
+        "dump",
+        "%s/end/3" % run_id,
+    )
+    assert "x = 10" in proc.stdout
+    proc = run_flow(
+        os.path.join(flows_dir, "linear_flow.py"),
+        "logs",
+        "%s/end/3" % run_id,
+    )
+    assert "final x: 10" in proc.stdout
+
+
+def test_namespace_filtering(run_flow, flows_dir, tpuflow_root):
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+    c = _client(tpuflow_root)
+    c.namespace("user:somebody-else")
+    with pytest.raises(Exception):
+        c.Flow("LinearFlow").latest_run.successful
+    c.namespace(None)
+    assert c.Flow("LinearFlow").latest_run is not None

@@ -1,0 +1,148 @@
+"""Graph builder unit tests (reference model: test/unit/test_graph_structure.py)."""
+
+from metaflow_tpu import FlowSpec, step
+from metaflow_tpu.graph import FlowGraph
+
+
+class _LinearFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.a)
+
+    @step
+    def a(self):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+class _BranchFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.a, self.b)
+
+    @step
+    def a(self):
+        self.next(self.join)
+
+    @step
+    def b(self):
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+class _ForeachFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [1, 2]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+class _ParallelFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=4)
+
+    @step
+    def train(self):
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+class _SwitchFlow(FlowSpec):
+    @step
+    def start(self):
+        self.choice = "x"
+        self.next({"x": self.x, "y": self.y}, condition="choice")
+
+    @step
+    def x(self):
+        self.next(self.end)
+
+    @step
+    def y(self):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_linear_graph():
+    g = FlowGraph(_LinearFlow)
+    assert g["start"].type == "start" or g["start"].type == "linear"
+    assert g["start"].out_funcs == ["a"]
+    assert g["a"].type == "linear"
+    assert g["end"].type == "end"
+    assert g["end"].out_funcs == []
+
+
+def test_branch_graph():
+    g = FlowGraph(_BranchFlow)
+    assert g["start"].type == "split"
+    assert set(g["start"].out_funcs) == {"a", "b"}
+    assert g["join"].type == "join"
+    assert g["join"].num_args == 2
+    assert g["start"].matching_join == "join"
+    assert g["join"].in_funcs == {"a", "b"}
+
+
+def test_foreach_graph():
+    g = FlowGraph(_ForeachFlow)
+    assert g["start"].type == "foreach"
+    assert g["start"].foreach_param == "items"
+    assert g["body"].split_parents == ["start"]
+    assert g["start"].matching_join == "join"
+
+
+def test_parallel_graph():
+    g = FlowGraph(_ParallelFlow)
+    assert g["start"].type == "split-parallel"
+    assert g["start"].num_parallel == 4
+    assert g["train"].parallel_step
+    assert g["start"].parallel_foreach
+
+
+def test_switch_graph():
+    g = FlowGraph(_SwitchFlow)
+    assert g["start"].type == "split-switch"
+    assert g["start"].condition == "choice"
+    assert g["start"].switch_cases == {"x": "x", "y": "y"}
+    assert set(g["start"].out_funcs) == {"x", "y"}
+
+
+def test_sorted_nodes_and_dot():
+    g = FlowGraph(_BranchFlow)
+    order = g.sorted_nodes()
+    assert order[0] == "start"
+    assert order[-1] == "end"
+    dot = g.output_dot()
+    assert '"start" -> "a";' in dot

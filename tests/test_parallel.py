@@ -1,0 +1,60 @@
+"""Mesh/sharding unit tests on the virtual 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from metaflow_tpu.parallel import (
+    MeshSpec,
+    create_mesh,
+    rules_for_mesh,
+    spec_for,
+    tree_shardings,
+)
+
+
+def test_mesh_presets():
+    mesh = create_mesh(MeshSpec.fsdp())
+    assert dict(mesh.shape) == {"fsdp": 8}
+    mesh = create_mesh(MeshSpec.fsdp_tp(2))
+    assert dict(mesh.shape) == {"fsdp": 4, "tensor": 2}
+    mesh = create_mesh(MeshSpec.moe(expert=4, tensor=2))
+    assert dict(mesh.shape) == {"fsdp": 1, "expert": 4, "tensor": 2} or \
+        dict(mesh.shape) == {"expert": 4, "tensor": 2}
+    mesh = create_mesh(MeshSpec.long_context(sequence=4))
+    assert mesh.shape["sequence"] == 4
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        create_mesh(MeshSpec({"data": 3, "tensor": 4}))  # 12 > 8
+    with pytest.raises(ValueError):
+        MeshSpec({"data": -1, "tensor": -1}).resolved(8)
+
+
+def test_rules_and_specs():
+    mesh = create_mesh(MeshSpec.fsdp_tp(2))
+    rules = rules_for_mesh(mesh)
+    assert spec_for(("embed", "mlp"), rules) == P("fsdp", "tensor")
+    assert spec_for(("layers", "embed", "heads"), rules) == P(None, "fsdp",
+                                                              "tensor")
+    # batch spans data+fsdp, but only axes present in the mesh
+    assert spec_for(("batch", "seq"), rules) == P("fsdp", None)
+
+
+def test_duplicate_axis_dropped():
+    mesh = create_mesh(MeshSpec.fsdp_tp(2))
+    rules = rules_for_mesh(mesh)
+    # two logical dims mapping to the same mesh axis: second one replicates
+    spec = spec_for(("embed", "embed"), rules)
+    assert spec == P("fsdp", None)
+
+
+def test_tree_shardings_places_params():
+    mesh = create_mesh(MeshSpec.fsdp())
+    log = {"w": ("embed", "mlp"), "b": ("embed",)}
+    sh = tree_shardings(log, mesh)
+    w = jax.device_put(np.zeros((16, 4)), sh["w"])
+    assert w.sharding.spec[0] == "fsdp"
+    assert w.addressable_shards[0].data.shape == (2, 4)
