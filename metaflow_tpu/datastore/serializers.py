@@ -21,7 +21,8 @@ import numpy as np
 
 import sys
 
-TYPE_NPY = "npy"
+TYPE_NPY = "npy"        # legacy numpy .npy payloads (read-only support)
+TYPE_TENSOR = "tensor"  # raw-bytes tensor format (handles TPU dtypes)
 TYPE_PYTREE = "pytree"
 TYPE_PICKLE = "pickle"
 
@@ -119,17 +120,20 @@ def _npy_load(data):
 def serialize(obj):
     """Return (payload_bytes, type_tag)."""
     if isinstance(obj, np.ndarray) and _tensor_dtype_ok(obj.dtype):
-        return _npy_bytes(obj), TYPE_NPY
+        return _npy_bytes(obj), TYPE_TENSOR
     if _is_jax_array(obj):
-        return _npy_bytes(_to_host(obj)), TYPE_NPY
+        return _npy_bytes(_to_host(obj)), TYPE_TENSOR
     if isinstance(obj, (dict, list, tuple)) and _tree_only_arrays(obj):
         return _pytree_bytes(obj), TYPE_PYTREE
     return pickle.dumps(_pickle_safe(obj), protocol=pickle.HIGHEST_PROTOCOL), TYPE_PICKLE
 
 
 def deserialize(payload, type_tag):
-    if type_tag == TYPE_NPY:
+    if type_tag == TYPE_TENSOR:
         return _npy_load(payload)
+    if type_tag == TYPE_NPY:
+        # legacy artifacts written as real .npy by earlier versions
+        return np.load(io.BytesIO(payload), allow_pickle=False)
     if type_tag == TYPE_PYTREE:
         return _pytree_load(payload)
     return pickle.loads(payload)

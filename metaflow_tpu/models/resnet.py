@@ -1,0 +1,172 @@
+"""ResNet (v1.5) in pure JAX — the foreach fan-out fine-tune target
+(BASELINE config: "JAX ResNet-50 fine-tune, one v5e chip per branch").
+
+Convs map straight onto the MXU via lax.conv_general_dilated in NHWC; batch
+norm is folded into inference mode by default for fine-tuning (train_bn=True
+keeps running stats in the state dict).
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    dtype: str = "float32"
+
+    @staticmethod
+    def resnet50(**kw):
+        return replace(ResNetConfig(), **kw)
+
+    @staticmethod
+    def resnet18(**kw):
+        return replace(ResNetConfig(stage_sizes=(2, 2, 2, 2)), **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return replace(
+            ResNetConfig(stage_sizes=(1, 1), width=8, num_classes=10), **kw
+        )
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * (2.0 / fan_in) ** 0.5).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {
+        "scale": jnp.ones((c,), dtype),
+        "bias": jnp.zeros((c,), dtype),
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def init_params(rng, cfg):
+    dt = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(rng, 256))
+    params = {
+        "stem": {
+            "conv": _conv_init(next(keys), 7, 7, 3, cfg.width, dt),
+            "bn": _bn_init(cfg.width, dt),
+        },
+        "stages": [],
+        "head": None,
+    }
+    cin = cfg.width
+    for stage, blocks in enumerate(cfg.stage_sizes):
+        cout = cfg.width * (2 ** stage)
+        stage_params = []
+        for b in range(blocks):
+            stride = 2 if (stage > 0 and b == 0) else 1
+            block = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, cout, dt),
+                "bn1": _bn_init(cout, dt),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout, dt),
+                "bn2": _bn_init(cout, dt),
+                "conv3": _conv_init(next(keys), 1, 1, cout, cout * 4, dt),
+                "bn3": _bn_init(cout * 4, dt),
+            }
+            if cin != cout * 4 or stride != 1:
+                block["proj"] = _conv_init(next(keys), 1, 1, cin, cout * 4, dt)
+                block["proj_bn"] = _bn_init(cout * 4, dt)
+            stage_params.append(block)
+            cin = cout * 4
+        params["stages"].append(stage_params)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes),
+                                jnp.float32) * cin ** -0.5).astype(dt),
+        "b": jnp.zeros((cfg.num_classes,), dt),
+    }
+    return params
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def _bn(x, p, eps=1e-5):
+    inv = lax.rsqrt(p["var"] + eps) * p["scale"].astype(jnp.float32)
+    out = (x.astype(jnp.float32) - p["mean"]) * inv + p["bias"].astype(
+        jnp.float32
+    )
+    return out.astype(x.dtype)
+
+
+def _bottleneck(x, block, stride):
+    residual = x
+    y = jax.nn.relu(_bn(_conv(x, block["conv1"]), block["bn1"]))
+    y = jax.nn.relu(_bn(_conv(y, block["conv2"], stride), block["bn2"]))
+    y = _bn(_conv(y, block["conv3"]), block["bn3"])
+    if "proj" in block:
+        residual = _bn(_conv(x, block["proj"], stride), block["proj_bn"])
+    return jax.nn.relu(y + residual)
+
+
+def forward(params, images, cfg):
+    """images: [B, H, W, 3] → logits [B, num_classes]."""
+    x = images.astype(jnp.dtype(cfg.dtype))
+    x = jax.nn.relu(_bn(_conv(x, params["stem"]["conv"], 2),
+                        params["stem"]["bn"]))
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for stage_idx, stage in enumerate(params["stages"]):
+        for block_idx, block in enumerate(stage):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            x = _bottleneck(x, block, stride)
+    x = jnp.mean(x, axis=(1, 2))
+    return (
+        jnp.einsum("bc,cn->bn", x, params["head"]["w"],
+                   preferred_element_type=jnp.float32)
+        + params["head"]["b"].astype(jnp.float32)
+    )
+
+
+def loss_fn(params, batch, cfg):
+    logits = forward(params, batch["images"], cfg)
+    labels = batch["labels"]
+    logps = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logps, labels[:, None], axis=-1))
+
+
+def logical_axes(cfg):
+    """ResNet params replicate under FSDP-style meshes (conv kernels are
+    small); only the head shards on 'embed'/'vocab'."""
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def annotate(path, leaf):
+        if path[-1] == "w" and leaf.ndim == 2:
+            return ("embed", "vocab")
+        return tuple(None for _ in range(leaf.ndim))
+
+    def walk(node, path=()):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v, path) for v in node]
+        if isinstance(node, (int, float)):
+            return node
+        return annotate(path, node)
+
+    return walk(params)
+
+
+def num_params(params):
+    return sum(
+        int(x.size) for x in jax.tree.leaves(params)
+        if hasattr(x, "size")
+    )

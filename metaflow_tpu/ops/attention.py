@@ -223,6 +223,11 @@ def flash_attention(q, k, v, causal=True, scale=None, interpret=False):
 
     Requires S to be a multiple of the 128 block size (the `attention`
     dispatcher falls back to the XLA path otherwise)."""
+    if not HAS_PALLAS:
+        raise RuntimeError(
+            "flash_attention requires pallas (jax.experimental.pallas); "
+            "use attention(impl='auto') for an XLA fallback"
+        )
     B, S, H, D = q.shape
     block = min(BLOCK_Q, S)
     if S % block or S % min(BLOCK_K, S):

@@ -61,9 +61,11 @@ def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):
     return state, shardings
 
 
-def make_train_step(cfg, mesh, model, optimizer=None, rules=None,
-                    loss_fn=None):
-    """Build the jitted, donated train step: (state, batch) → (state, metrics)."""
+def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None):
+    """Build the jitted, donated train step: (state, batch) → (state, metrics).
+
+    `mesh` is accepted for signature symmetry with make_train_state; the
+    step itself is mesh-agnostic (shardings propagate from the state)."""
     optimizer = optimizer or default_optimizer()
     loss_fn = loss_fn or model.loss_fn
 
@@ -97,9 +99,8 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
     state, shardings = make_train_state(
         rng, cfg, mesh, model, optimizer=optimizer, rules=rules
     )
-    step = make_train_step(
-        cfg, mesh, model, optimizer=optimizer, rules=rules, loss_fn=loss_fn
-    )
+    step = make_train_step(cfg, mesh, model, optimizer=optimizer,
+                           loss_fn=loss_fn)
     return state, step, shardings
 
 

@@ -45,7 +45,7 @@ class TestSerializers:
     def test_numpy_fast_path(self):
         arr = np.arange(12, dtype=np.float32).reshape(3, 4)
         payload, tag = serializers.serialize(arr)
-        assert tag == serializers.TYPE_NPY
+        assert tag == serializers.TYPE_TENSOR
         out = serializers.deserialize(payload, tag)
         np.testing.assert_array_equal(out, arr)
 
@@ -54,7 +54,7 @@ class TestSerializers:
 
         arr = jnp.ones((4, 4), dtype=jnp.bfloat16)
         payload, tag = serializers.serialize(arr)
-        assert tag == serializers.TYPE_NPY
+        assert tag == serializers.TYPE_TENSOR
         out = serializers.deserialize(payload, tag)
         assert out.shape == (4, 4)
         assert str(out.dtype) == "bfloat16"
