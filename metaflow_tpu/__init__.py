@@ -11,11 +11,13 @@ Public API (mirrors the reference's `from metaflow import ...` surface):
 
 from .flowspec import FlowSpec, step
 from .parameters import Parameter, JSONType
+from .includefile import IncludeFile
+from .config_system import Config, ConfigValue, FlowMutator
 from .current import current
 from .exception import TpuFlowException, MetaflowException
 from .unbounded_foreach import UnboundedForeachInput
-from .decorators import make_step_decorator
-from .plugins import STEP_DECORATORS
+from .decorators import make_step_decorator, make_flow_decorator
+from .plugins import STEP_DECORATORS, FLOW_DECORATORS
 
 # generate user-facing decorator callables from the registry
 retry = make_step_decorator(STEP_DECORATORS["retry"])
@@ -27,6 +29,14 @@ parallel = make_step_decorator(STEP_DECORATORS["parallel"])
 tpu = make_step_decorator(STEP_DECORATORS["tpu"])
 tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
 checkpoint = make_step_decorator(STEP_DECORATORS["checkpoint"])
+secrets = make_step_decorator(STEP_DECORATORS["secrets"])
+card = make_step_decorator(STEP_DECORATORS["card"])
+
+project = make_flow_decorator(FLOW_DECORATORS["project"])
+schedule = make_flow_decorator(FLOW_DECORATORS["schedule"])
+trigger = make_flow_decorator(FLOW_DECORATORS["trigger"])
+trigger_on_finish = make_flow_decorator(FLOW_DECORATORS["trigger_on_finish"])
+exit_hook = make_flow_decorator(FLOW_DECORATORS["exit_hook"])
 
 # client API (lazy-ish: import is cheap, no jax involved)
 from .client import (  # noqa: E402
@@ -41,7 +51,7 @@ from .client import (  # noqa: E402
     default_namespace,
 )
 
-from .runner import Runner  # noqa: E402
+from .runner import Runner, Deployer  # noqa: E402
 
 __version__ = "0.1.0"
 
@@ -50,6 +60,10 @@ __all__ = [
     "step",
     "Parameter",
     "JSONType",
+    "IncludeFile",
+    "Config",
+    "ConfigValue",
+    "FlowMutator",
     "current",
     "TpuFlowException",
     "MetaflowException",
@@ -63,6 +77,13 @@ __all__ = [
     "tpu",
     "tpu_parallel",
     "checkpoint",
+    "secrets",
+    "card",
+    "project",
+    "schedule",
+    "trigger",
+    "trigger_on_finish",
+    "exit_hook",
     "Metaflow",
     "Flow",
     "Run",
@@ -73,4 +94,5 @@ __all__ = [
     "get_namespace",
     "default_namespace",
     "Runner",
+    "Deployer",
 ]

@@ -53,6 +53,7 @@ class CliState(object):
         self.echo = echo
         self.quiet = False
         self.decospecs = []
+        self.config_args = []
 
 
 def _prepare(state, decospecs):
@@ -73,6 +74,8 @@ def _prepare(state, decospecs):
                 step_func.decorators.append(
                     TpuParallelDecorator(statically_defined=False)
                 )
+    _init_flow_decorators(flow, state.graph, None, state.flow_datastore,
+                          state.metadata, state.echo, state.echo, {})
     _init_step_decorators(flow, state.graph, None, state.flow_datastore, state.echo)
 
 
@@ -84,6 +87,17 @@ def _param_options(flow):
             kwargs["help"] = param.help
         opts.append(click.Option(["--" + name.replace("_", "-"), name], **kwargs))
     return opts
+
+
+def _parse_task_pathspec(pathspec):
+    parts = pathspec.split("/")
+    if len(parts) == 4:
+        parts = parts[1:]  # allow flow/run/step/task
+    if len(parts) != 3:
+        raise TpuFlowException(
+            "Specify a task as RUN_ID/STEP/TASK_ID; got %r" % pathspec
+        )
+    return parts
 
 
 def _collect_params(flow, kwargs):
@@ -110,8 +124,15 @@ def main(flow, args=None):
     @click.option("--quiet/--no-quiet", default=False)
     @click.option("--with", "decospecs", multiple=True,
                   help="Attach a decorator to all steps (name:attr=val,...)")
+    @click.option("--config", "config_files", nargs=2, multiple=True,
+                  help="Resolve a Config from a file: --config name path")
+    @click.option("--config-value", "config_values", nargs=2, multiple=True,
+                  help="Resolve a Config inline: --config-value name '<json>'")
     @click.pass_context
-    def start(ctx, datastore, datastore_root, metadata, quiet, decospecs):
+    def start(ctx, datastore, datastore_root, metadata, quiet, decospecs,
+              config_files, config_values):
+        from .config_system import apply_mutators, resolve_configs
+
         storage_impl = STORAGE_BACKENDS[datastore]
         state.flow_datastore = FlowDataStore(
             flow.name, storage_impl, ds_root=datastore_root
@@ -120,6 +141,15 @@ def main(flow, args=None):
         state.quiet = quiet
         if quiet:
             state.echo = echo_quiet
+        resolve_configs(flow.__class__, dict(config_files),
+                        dict(config_values))
+        apply_mutators(flow.__class__)
+        # step subprocesses must re-resolve the same configs
+        state.config_args = []
+        for name, path in config_files:
+            state.config_args += ["--config", name, path]
+        for name, val in config_values:
+            state.config_args += ["--config-value", name, val]
         _prepare(state, decospecs)
         ctx.obj = state
 
@@ -145,6 +175,7 @@ def main(flow, args=None):
             max_num_splits=max_num_splits,
             echo=echo,
             decospecs=state.decospecs,
+            config_args=state.config_args,
         )
         if run_id_file:
             with open(run_id_file, "w") as f:
@@ -199,6 +230,7 @@ def main(flow, args=None):
             resume_step=step_to_rerun,
             echo=echo,
             decospecs=state.decospecs,
+            config_args=state.config_args,
         )
         if run_id_file:
             with open(run_id_file, "w") as f:
@@ -263,6 +295,195 @@ def main(flow, args=None):
         finally:
             beat_stop.set()
 
+    @start.command(help="Re-run ONE task of a past run against its recorded "
+                        "inputs (fast dev loop).")
+    @click.argument("step-name")
+    @click.option("--run-id", default=None, help="Origin run (default: latest)")
+    @click.option("--task-id", default=None,
+                  help="Origin task (default: first task of the step)")
+    @click.pass_obj
+    def spin(state, step_name, run_id, task_id):
+        import time as _time
+
+        origin_run = run_id or read_latest_run_id(flow.name)
+        if origin_run is None:
+            raise TpuFlowException("No previous run to spin from.")
+        if step_name not in state.graph:
+            raise TpuFlowException("Step *%s* does not exist." % step_name)
+        if state.graph[step_name].parallel_step:
+            raise TpuFlowException("spin does not support gang steps.")
+        if task_id is None:
+            tasks = state.flow_datastore.list_tasks(origin_run, step_name)
+            if not tasks:
+                raise TpuFlowException(
+                    "No task of step *%s* found in run %s."
+                    % (step_name, origin_run)
+                )
+            task_id = sorted(tasks)[0]
+        # recorded inputs from the origin task's metadata
+        meta = state.metadata.get_task_metadata(
+            flow.name, origin_run, step_name, task_id
+        )
+        input_paths = []
+        for m in meta:
+            if m.get("field_name") == "input-paths":
+                input_paths = json.loads(m["value"])
+        spin_run_id = "spin-%d" % int(_time.time() * 1000)
+        state.metadata.register_run_id(spin_run_id, sys_tags=["spin"])
+        echo("Spinning %s/%s/%s as run %s"
+             % (origin_run, step_name, task_id, spin_run_id))
+        origin_ds = state.flow_datastore.get_task_datastore(
+            origin_run, step_name, task_id
+        )
+        split_index = None
+        stack = origin_ds.get("_foreach_stack")
+        if stack:
+            split_index = stack[-1][1]
+        # the start step has no input task: replay the origin's parameters
+        params_json = None
+        if step_name == "start":
+            params = {
+                name: origin_ds[name]
+                for name in origin_ds.get("_parameter_names") or []
+                if name in origin_ds
+            }
+            params_json = json.dumps(params)
+        task = MetaflowTask(
+            state.flow, state.flow_datastore, state.metadata,
+            console_logger=echo,
+        )
+        task.run_step(
+            step_name, spin_run_id, "1",
+            origin_run_id=origin_run,
+            input_paths=input_paths,
+            split_index=split_index,
+            parameters_json=params_json,
+        )
+        echo("Spin task done: %s/%s/1 — inspect with dump %s/%s/1"
+             % (spin_run_id, step_name, spin_run_id, step_name))
+
+    @start.group(help="Mutate run tags.")
+    def tag():
+        pass
+
+    @tag.command(name="add")
+    @click.option("--run-id", default=None)
+    @click.argument("tags", nargs=-1, required=True)
+    @click.pass_obj
+    def tag_add(state, run_id, tags):
+        run_id = run_id or read_latest_run_id(flow.name)
+        info = state.metadata.mutate_run_tags(flow.name, run_id, add=tags)
+        if info is None:
+            raise TpuFlowException("Run %s not found" % run_id)
+        echo("Tags of %s/%s: %s" % (flow.name, run_id,
+                                    ", ".join(info["tags"])))
+
+    @tag.command(name="remove")
+    @click.option("--run-id", default=None)
+    @click.argument("tags", nargs=-1, required=True)
+    @click.pass_obj
+    def tag_remove(state, run_id, tags):
+        run_id = run_id or read_latest_run_id(flow.name)
+        info = state.metadata.mutate_run_tags(flow.name, run_id, remove=tags)
+        if info is None:
+            raise TpuFlowException("Run %s not found" % run_id)
+        echo("Tags of %s/%s: %s" % (flow.name, run_id,
+                                    ", ".join(info["tags"])))
+
+    @tag.command(name="list")
+    @click.option("--run-id", default=None)
+    @click.pass_obj
+    def tag_list(state, run_id):
+        run_id = run_id or read_latest_run_id(flow.name)
+        info = state.metadata.get_run_info(flow.name, run_id)
+        if info is None:
+            raise TpuFlowException("Run %s not found" % run_id)
+        for t in info.get("tags", []):
+            echo(t)
+
+    @start.group(help="Inspect task cards.")
+    def card():
+        pass
+
+    @card.command(name="get", help="Print the card HTML of a task.")
+    @click.argument("pathspec")
+    @click.option("--type", "card_type", default="default")
+    @click.pass_obj
+    def card_get(state, pathspec, card_type):
+        from .plugins.cards.card_decorator import card_path
+
+        run_id, step_name, task_id = _parse_task_pathspec(pathspec)
+        path = card_path(state.flow_datastore.storage, flow.name, run_id,
+                         step_name, task_id, card_type)
+        with state.flow_datastore.storage.load_bytes([path]) as loaded:
+            for _p, local, _m in loaded:
+                if local is None:
+                    raise TpuFlowException(
+                        "No card found for %s (type=%s)" % (pathspec,
+                                                            card_type)
+                    )
+                with open(local) as f:
+                    print(f.read())
+
+    @card.command(name="list", help="List cards of a task.")
+    @click.argument("pathspec")
+    @click.pass_obj
+    def card_list(state, pathspec):
+        run_id, step_name, task_id = _parse_task_pathspec(pathspec)
+        prefix = state.flow_datastore.storage.path_join(
+            flow.name, "mf.cards", run_id, step_name, task_id
+        )
+        for path, is_file in state.flow_datastore.storage.list_content(
+            [prefix]
+        ):
+            if is_file:
+                echo(state.flow_datastore.storage.basename(path))
+
+    @start.group(name="argo-workflows",
+                 help="Compile/deploy the flow to Argo Workflows (GKE TPU).")
+    def argo_workflows():
+        pass
+
+    @argo_workflows.command(name="create")
+    @click.option("--image", default=None, help="Container image.")
+    @click.option("--k8s-namespace", default="default")
+    @click.option("--only-json/--deploy", default=True,
+                  help="Print manifests instead of applying them.")
+    @click.option("--package/--no-package", "do_package", default=False,
+                  help="Build+upload the code package first.")
+    @click.pass_obj
+    def argo_create(state, image, k8s_namespace, only_json, do_package):
+        from .plugins.argo import ArgoWorkflows
+
+        package_url = None
+        if do_package:
+            from .package import MetaflowPackage
+
+            pkg = MetaflowPackage(
+                flow_dir=os.path.dirname(os.path.abspath(sys.argv[0]))
+            )
+            package_url, sha = pkg.upload(state.flow_datastore)
+            echo("Code package uploaded: %s (sha %s)" % (package_url,
+                                                         sha[:12]))
+        compiler = ArgoWorkflows(
+            state.flow, state.graph, package_url=package_url, image=image,
+            namespace=k8s_namespace,
+        )
+        manifests = [
+            compiler.compile(),
+            compiler.compile_cron(),
+            compiler.compile_sensor(),
+        ]
+        output = compiler.to_yaml(manifests)
+        if only_json:
+            print(output)
+        else:
+            raise TpuFlowException(
+                "Direct deploy needs kubectl/cluster access: pipe the "
+                "manifests to 'kubectl apply -f -' instead (re-run with "
+                "--only-json)."
+            )
+
     @start.command(help="Validate the flow graph.")
     @click.pass_obj
     def check(state):
@@ -303,13 +524,7 @@ def main(flow, args=None):
     @click.option("--max-value-size", default=1000)
     @click.pass_obj
     def dump(state, pathspec, private, max_value_size):
-        parts = pathspec.split("/")
-        if len(parts) == 3:
-            run_id, step_name, task_id = parts
-        else:
-            raise TpuFlowException(
-                "Specify a task as RUN_ID/STEP/TASK_ID; got %r" % pathspec
-            )
+        run_id, step_name, task_id = _parse_task_pathspec(pathspec)
         ds = state.flow_datastore.get_task_datastore(run_id, step_name, task_id)
         for name, value in sorted(ds.to_dict(show_private=private).items()):
             rep = repr(value)
@@ -322,14 +537,17 @@ def main(flow, args=None):
     @click.option("--stderr/--stdout", default=False)
     @click.pass_obj
     def logs(state, pathspec, stderr):
-        parts = pathspec.split("/")
-        run_id, step_name, task_id = parts[-3], parts[-2], parts[-1]
+        run_id, step_name, task_id = _parse_task_pathspec(pathspec)
         ds = state.flow_datastore.get_task_datastore(
             run_id, step_name, task_id, allow_not_done=True
         )
         name = "stderr" if stderr else "stdout"
+        from . import mflog
+
         data = ds.load_log_legacy("runtime", name)
-        sys.stdout.write(data.decode("utf-8", errors="replace"))
+        sys.stdout.write(
+            mflog.format_merged([data]).decode("utf-8", errors="replace")
+        )
 
     try:
         start(args=args, standalone_mode=False, obj=state)

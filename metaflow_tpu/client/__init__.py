@@ -372,8 +372,10 @@ class Task(MetaflowObject):
         return self._load_log("stderr")
 
     def _load_log(self, name):
+        from .. import mflog
+
         data = self._task_ds.load_log_legacy("runtime", name)
-        return data.decode("utf-8", errors="replace")
+        return mflog.format_merged([data]).decode("utf-8", errors="replace")
 
     @property
     def parent_tasks(self):

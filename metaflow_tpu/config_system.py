@@ -1,0 +1,262 @@
+"""User-facing Config system + flow/step mutators.
+
+Reference behavior: metaflow/user_configs/ (Config, ConfigValue, config_expr)
+and metaflow/user_decorators/ (FlowMutator/StepMutator). Configs are
+class-level values resolved BEFORE the graph runs — from JSON/TOML files or
+inline values given on the CLI — and can drive mutators that rewrite the
+flow (add/remove decorators) before execution.
+
+    class MyFlow(FlowSpec):
+        cfg = Config("cfg", default="config.json")
+
+        @step
+        def start(self):
+            print(self.cfg.lr)
+"""
+
+import json
+import os
+
+from .exception import TpuFlowException
+from .parameters import Parameter
+
+
+class ConfigValue(object):
+    """Immutable dict/attr view over resolved config data."""
+
+    def __init__(self, data):
+        object.__setattr__(self, "_data", data)
+
+    def __getattr__(self, name):
+        data = object.__getattribute__(self, "_data")
+        if isinstance(data, dict) and name in data:
+            return _wrap(data[name])
+        raise AttributeError("Config has no key '%s'" % name)
+
+    def __getitem__(self, key):
+        return _wrap(object.__getattribute__(self, "_data")[key])
+
+    def __contains__(self, key):
+        data = object.__getattribute__(self, "_data")
+        return isinstance(data, dict) and key in data
+
+    def __setattr__(self, name, value):
+        raise TpuFlowException("ConfigValue is immutable")
+
+    def get(self, key, default=None):
+        data = object.__getattribute__(self, "_data")
+        if isinstance(data, dict) and key in data:
+            return _wrap(data[key])
+        return default
+
+    def keys(self):
+        return object.__getattribute__(self, "_data").keys()
+
+    def items(self):
+        return ((k, _wrap(v)) for k, v in
+                object.__getattribute__(self, "_data").items())
+
+    def to_dict(self):
+        return json.loads(json.dumps(object.__getattribute__(self, "_data")))
+
+    def __repr__(self):
+        return "ConfigValue(%r)" % (object.__getattribute__(self, "_data"),)
+
+    def __eq__(self, other):
+        mine = object.__getattribute__(self, "_data")
+        if isinstance(other, ConfigValue):
+            return mine == object.__getattribute__(other, "_data")
+        return mine == other
+
+
+def _wrap(v):
+    return ConfigValue(v) if isinstance(v, dict) else v
+
+
+def parse_config_file(path):
+    """JSON or TOML by extension (pluggable parsers, reference:
+    plugins/parsers.py)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if path.endswith(".toml"):
+        import tomllib
+
+        return tomllib.loads(raw.decode("utf-8"))
+    return json.loads(raw.decode("utf-8"))
+
+
+class Config(Parameter):
+    """Class-level config declaration. `default` is a file path (resolved at
+    start-up), `default_value` an inline dict/JSON string."""
+
+    IS_CONFIG_PARAMETER = True
+
+    def __init__(self, name, default=None, default_value=None, required=False,
+                 help=None, parser=None):
+        super().__init__(name, default=default, required=required, help=help)
+        self.default_path = default
+        self.default_value = default_value
+        self.parser = parser
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        resolved = getattr(objtype or type(obj), "_resolved_configs", None)
+        if resolved and self.name in resolved:
+            return resolved[self.name]
+        # config accessible via datastore in later steps
+        ds = obj.__dict__.get("_datastore") if obj is not None else None
+        if ds is not None and "_config_" + self.name in ds:
+            return ConfigValue(ds["_config_" + self.name])
+        return self
+
+    def resolve(self, file_path=None, inline_value=None):
+        """Return the resolved plain-data value."""
+        if inline_value is not None:
+            data = (json.loads(inline_value)
+                    if isinstance(inline_value, str) else inline_value)
+        elif file_path is not None:
+            data = (self.parser or parse_config_file)(file_path)
+        elif self.default_value is not None:
+            data = (json.loads(self.default_value)
+                    if isinstance(self.default_value, str)
+                    else self.default_value)
+        elif self.default_path is not None:
+            if not os.path.exists(self.default_path):
+                raise TpuFlowException(
+                    "Config *%s*: default file '%s' not found."
+                    % (self.name, self.default_path)
+                )
+            data = (self.parser or parse_config_file)(self.default_path)
+        elif self.is_required:
+            raise TpuFlowException(
+                "Config *%s* is required: pass --config %s <file> or "
+                "--config-value %s '<json>'."
+                % (self.name, self.name, self.name)
+            )
+        else:
+            data = {}
+        return data
+
+
+def resolve_configs(flow_cls, config_files=None, config_values=None):
+    """Resolve every Config on the class; store on `_resolved_configs`."""
+    config_files = dict(config_files or {})
+    config_values = dict(config_values or {})
+    resolved = {}
+    for name, attr in list(vars(flow_cls).items()) + [
+        (n, getattr(flow_cls, n, None))
+        for n in dir(flow_cls) if not n.startswith("__")
+    ]:
+        if isinstance(attr, Config) and attr.name not in resolved:
+            data = attr.resolve(
+                file_path=config_files.get(attr.name),
+                inline_value=config_values.get(attr.name),
+            )
+            resolved[attr.name] = ConfigValue(data)
+    flow_cls._resolved_configs = resolved
+    return resolved
+
+
+# ---------------------------------------------------------------------------
+# mutators: programmatic flow rewriting before execution
+# ---------------------------------------------------------------------------
+
+
+class MutableStep(object):
+    """Handle on one step for mutators (reference: user_decorators/
+    mutable_step.py)."""
+
+    def __init__(self, flow_cls, step_func):
+        self._flow_cls = flow_cls
+        self._func = step_func
+
+    @property
+    def name(self):
+        return self._func.__name__
+
+    @property
+    def decorators(self):
+        return list(self._func.decorators)
+
+    def add_decorator(self, deco_name, **attrs):
+        from .plugins import STEP_DECORATORS
+
+        if deco_name not in STEP_DECORATORS:
+            raise TpuFlowException("Unknown decorator '%s'" % deco_name)
+        cls = STEP_DECORATORS[deco_name]
+        self._func.decorators.append(
+            cls(attributes=attrs, statically_defined=False)
+        )
+
+    def remove_decorator(self, deco_name):
+        self._func.decorators[:] = [
+            d for d in self._func.decorators if d.name != deco_name
+        ]
+
+
+class MutableFlow(object):
+    def __init__(self, flow_cls):
+        self._flow_cls = flow_cls
+
+    @property
+    def configs(self):
+        return dict(getattr(self._flow_cls, "_resolved_configs", {}))
+
+    @property
+    def steps(self):
+        out = []
+        for name in dir(self._flow_cls):
+            attr = getattr(self._flow_cls, name, None)
+            if getattr(attr, "is_step", False):
+                out.append(MutableStep(self._flow_cls, attr))
+        return out
+
+    def step(self, name):
+        attr = getattr(self._flow_cls, name, None)
+        if not getattr(attr, "is_step", False):
+            raise TpuFlowException("No step named '%s'" % name)
+        return MutableStep(self._flow_cls, attr)
+
+
+class FlowMutator(object):
+    """Subclass and apply as a class decorator:
+
+        class AddRetries(FlowMutator):
+            def mutate(self, mutable_flow):
+                for step in mutable_flow.steps:
+                    step.add_decorator('retry', times=2)
+
+        @AddRetries
+        class MyFlow(FlowSpec): ...
+    """
+
+    def __new__(cls, *args, **kwargs):
+        if len(args) == 1 and isinstance(args[0], type) and not kwargs:
+            # bare form: @MyMutator directly above the class — register and
+            # hand the class back (skips __init__ since a type is returned)
+            inst = object.__new__(cls)
+            inst._args, inst._kwargs = (), {}
+            return inst._register(args[0])
+        return object.__new__(cls)
+
+    def __init__(self, *args, **kwargs):
+        self._args = args
+        self._kwargs = kwargs
+
+    def __call__(self, flow_cls):
+        return self._register(flow_cls)
+
+    def _register(self, flow_cls):
+        mutators = list(getattr(flow_cls, "_flow_mutators", []))
+        mutators.append(self)
+        flow_cls._flow_mutators = mutators
+        return flow_cls
+
+    def mutate(self, mutable_flow):
+        raise NotImplementedError
+
+
+def apply_mutators(flow_cls):
+    for mutator in getattr(flow_cls, "_flow_mutators", []):
+        mutator.mutate(MutableFlow(flow_cls))

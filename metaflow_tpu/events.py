@@ -1,0 +1,106 @@
+"""Event publishing + the `current.trigger` view.
+
+Reference behavior: metaflow/events.py + plugins/argo/argo_events.py
+(ArgoEvent.publish:90). Locally, events append to a JSONL bus under the
+datastore root; a deployed flow's @trigger compiles to an Argo Events sensor
+(plugins/argo) and this publisher POSTs to the Argo Events webhook when
+TPUFLOW_ARGO_EVENTS_URL is configured.
+"""
+
+import json
+import os
+import time
+
+from .util import get_tpuflow_root
+
+
+class MetaflowEvent(object):
+    """A consumed event, exposed via `current.trigger.event`."""
+
+    def __init__(self, name, payload=None, timestamp=None, id=None):
+        self.name = name
+        self.payload = payload or {}
+        self.timestamp = timestamp or time.time()
+        self.id = id
+
+    def __repr__(self):
+        return "MetaflowEvent(name=%r)" % self.name
+
+
+class Trigger(object):
+    """`current.trigger` for event-triggered runs."""
+
+    def __init__(self, events):
+        self._events = [
+            e if isinstance(e, MetaflowEvent) else MetaflowEvent(**e)
+            for e in events
+        ]
+
+    @property
+    def event(self):
+        return self._events[0] if self._events else None
+
+    @property
+    def events(self):
+        return list(self._events)
+
+    def __bool__(self):
+        return bool(self._events)
+
+
+class ArgoEvent(object):
+    """Publisher: ArgoEvent('new_data').publish(payload={...})."""
+
+    def __init__(self, name, url=None):
+        self.name = name
+        self.url = url or os.environ.get("TPUFLOW_ARGO_EVENTS_URL")
+        self._payload = {}
+
+    def add_to_payload(self, key, value):
+        self._payload[key] = value
+        return self
+
+    def publish(self, payload=None, force=True):
+        body = dict(self._payload)
+        body.update(payload or {})
+        record = {
+            "name": self.name,
+            "payload": body,
+            "timestamp": time.time(),
+        }
+        if self.url:
+            import urllib.request
+
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps(record).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=10)
+        else:
+            # local bus: append-only JSONL under the datastore root
+            path = os.path.join(get_tpuflow_root(), "_events", "events.jsonl")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        return record
+
+
+def publish_event(name, payload=None):
+    return ArgoEvent(name).publish(payload=payload)
+
+
+def list_events(since=None):
+    path = os.path.join(get_tpuflow_root(), "_events", "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if since is None or record.get("timestamp", 0) >= since:
+                out.append(record)
+    return out

@@ -1,0 +1,44 @@
+"""IncludeFile: a file-as-parameter, stored once in the datastore.
+
+Reference behavior: metaflow/includefile.py (IncludeFile:234) — the file
+given on the CLI is read at the start task and persisted as an artifact (the
+CAS dedups repeat uploads), so every downstream task and the client API see
+the content without touching the original path.
+"""
+
+import os
+
+from .exception import TpuFlowException
+from .parameters import Parameter
+
+
+class IncludeFile(Parameter):
+    IS_INCLUDE_FILE = True
+
+    def __init__(self, name, required=False, is_text=True, encoding="utf-8",
+                 default=None, help=None):
+        super().__init__(name, required=required, default=default, help=help)
+        self.is_text = is_text
+        self.encoding = encoding
+
+    def convert(self, value):
+        """CLI gives a path; the artifact is the file CONTENT."""
+        if value is None:
+            return None
+        if isinstance(value, (bytes,)):
+            return value
+        path = os.path.expanduser(str(value))
+        if not os.path.exists(path):
+            # resume path: the value may already be the file CONTENT
+            # (re-fed from the origin run's artifacts)
+            if self.is_text and ("\n" in value or len(value) > 1024):
+                return value
+            raise TpuFlowException(
+                "IncludeFile *%s*: file '%s' does not exist." % (self.name,
+                                                                 path)
+            )
+        with open(path, "rb") as f:
+            data = f.read()
+        if self.is_text:
+            return data.decode(self.encoding)
+        return data

@@ -94,7 +94,8 @@ class LocalMetadataProvider(MetadataProvider):
                 "ts_epoch": timestamp_millis(),
             },
         )
-        write_latest_run_id(self.flow_name, run_id, root=self._root)
+        if not str(run_id).startswith("spin-"):
+            write_latest_run_id(self.flow_name, run_id, root=self._root)
         return True
 
     def new_task_id(self, run_id, step_name, tags=None, sys_tags=None):
@@ -212,6 +213,8 @@ class LocalMetadataProvider(MetadataProvider):
     def mutate_run_tags(self, flow_name, run_id, add=None, remove=None):
         """Optimistic tag mutation under the run lock."""
         path = os.path.join(self._root, flow_name, str(run_id), "_run.json")
+        if not os.path.exists(path):
+            return None
         lock_path = path + ".lock"
         with open(lock_path, "a+") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)

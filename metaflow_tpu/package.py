@@ -1,0 +1,109 @@
+"""Code package: tarball of the user's flow directory + metaflow_tpu itself,
+stored once per run in the content-addressed datastore.
+
+Reference behavior: metaflow/package/ (MetaflowPackage, _package_and_upload)
++ packaging_sys/. Remote tasks bootstrap by downloading the package and
+untarring before re-running the `step` command (metaflow_environment.py:192
+get_package_commands equivalent: `package_bootstrap_commands`).
+"""
+
+import io
+import json
+import os
+import tarfile
+import time
+
+DEFAULT_SUFFIXES = (".py", ".json", ".toml", ".yaml", ".yml", ".txt", ".sh",
+                    ".md", ".cfg")
+MAX_FILE_BYTES = 1 << 20  # skip giant files by default
+
+
+class MetaflowPackage(object):
+    def __init__(self, flow_dir=None, suffixes=DEFAULT_SUFFIXES,
+                 max_file_bytes=MAX_FILE_BYTES, extra_info=None):
+        self.flow_dir = os.path.abspath(flow_dir or os.getcwd())
+        self.suffixes = tuple(suffixes)
+        self.max_file_bytes = max_file_bytes
+        self.extra_info = extra_info or {}
+        self._blob = None
+        self.sha = None
+        self.url = None
+
+    def _walk(self, root, arc_prefix=""):
+        for dirpath, dirnames, filenames in os.walk(root):
+            # prune caches, VCS dirs, and the datastore itself
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".tpuflow", ".metaflow",
+                             "node_modules", ".venv")
+            ]
+            for fname in sorted(filenames):
+                if not fname.endswith(self.suffixes):
+                    continue
+                full = os.path.join(dirpath, fname)
+                try:
+                    if os.path.getsize(full) > self.max_file_bytes:
+                        continue
+                except OSError:
+                    continue
+                rel = os.path.relpath(full, root)
+                yield full, os.path.join(arc_prefix, rel)
+
+    def blob(self):
+        """Deterministic tarball bytes (stable mtimes → stable CAS key)."""
+        if self._blob is not None:
+            return self._blob
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz", compresslevel=3) as tar:
+
+            def add(full, arcname):
+                info = tar.gettarinfo(full, arcname=arcname)
+                info.mtime = 0
+                info.uid = info.gid = 0
+                info.uname = info.gname = ""
+                with open(full, "rb") as f:
+                    tar.addfile(info, f)
+
+            # the user's flow directory at the package root
+            for full, arc in self._walk(self.flow_dir):
+                add(full, arc)
+            # the framework itself, importable from the package root
+            pkg_root = os.path.dirname(os.path.abspath(__file__))
+            for full, arc in self._walk(pkg_root, "metaflow_tpu"):
+                add(full, arc)
+            # INFO manifest
+            info_bytes = json.dumps(
+                {
+                    "created": int(time.time()),
+                    "flow_dir": self.flow_dir,
+                    **self.extra_info,
+                }
+            ).encode("utf-8")
+            ti = tarfile.TarInfo("INFO")
+            ti.size = len(info_bytes)
+            ti.mtime = 0
+            tar.addfile(ti, io.BytesIO(info_bytes))
+        self._blob = buf.getvalue()
+        return self._blob
+
+    def upload(self, flow_datastore):
+        """Save to the flow's CAS; returns (url, sha)."""
+        [(url, sha)] = flow_datastore.save_data([self.blob()])
+        self.url, self.sha = url, sha
+        return url, sha
+
+    @staticmethod
+    def bootstrap_commands(package_url, workdir="/tmp/mf_package"):
+        """Shell commands a remote host runs to set the package up
+        (reference: metaflow_environment.get_package_commands:192)."""
+        return [
+            "mkdir -p %s" % workdir,
+            "cd %s" % workdir,
+            # package_url is either a local path or gs:// object
+            (
+                "if [ -f '%(u)s' ]; then cp '%(u)s' package.tgz; "
+                "else gsutil cp '%(u)s' package.tgz; fi" % {"u": package_url}
+            ),
+            "tar xzf package.tgz",
+            "export PYTHONPATH=%s:$PYTHONPATH" % workdir,
+        ]

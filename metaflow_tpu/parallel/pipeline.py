@@ -1,0 +1,120 @@
+"""Pipeline parallelism via shard_map over the 'pipeline' mesh axis.
+
+GPipe-style schedule (SURVEY.md §5.7 "pipeline via shard_map"): the layer
+stack is split into S contiguous stages (the stacked-layer pytree's leading
+axis is sharded over 'pipeline'); M microbatches stream through, activations
+hop stage→stage with lax.ppermute over neighbouring ICI links. Total ticks =
+M + S - 1; bubble fraction = (S-1)/(M+S-1).
+
+MPMD-style per-stage programs (PAPERS.md: MPMD pipeline parallelism) are a
+later optimization — this single-SPMD-program formulation lets XLA overlap
+the ppermute with stage compute already.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def pipeline_apply(layer_fn, stage_params, x, mesh, num_microbatches,
+                   axis_name="pipeline"):
+    """Run x through all pipeline stages.
+
+    layer_fn: (carry, layer_params) -> carry, applied per layer via scan
+        inside each stage.
+    stage_params: pytree whose leaves have leading dim n_layers, SHARDED on
+        `axis_name` (n_layers % n_stages == 0).
+    x: [B, ...] global batch (replicated across the pipeline axis);
+        B % num_microbatches == 0.
+    Returns y with x's shape.
+    """
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches < 1:
+        raise ValueError("num_microbatches must be >= 1")
+
+    def local(x_local, params_local):
+        stage = jax.lax.axis_index(axis_name)
+        B = x_local.shape[0]
+        mb_size = B // num_microbatches
+        microbatches = x_local.reshape((num_microbatches, mb_size)
+                                       + x_local.shape[1:])
+
+        def run_stage(act):
+            out, _ = jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp), None), act, params_local
+            )
+            return out
+
+        perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        n_ticks = num_microbatches + n_stages - 1
+        # mark the carries as varying over the pipeline axis (their values
+        # genuinely differ per stage once the loop runs)
+        outputs = jax.lax.pcast(
+            jnp.zeros_like(microbatches), (axis_name,), to="varying"
+        )
+        buf = jax.lax.pcast(
+            jnp.zeros((mb_size,) + x_local.shape[1:], x_local.dtype),
+            (axis_name,), to="varying",
+        )
+
+        def tick(t, carry):
+            buf, outputs = carry
+            # stage 0 ingests microbatch t (when available)
+            mb_idx = jnp.clip(t, 0, num_microbatches - 1)
+            incoming = microbatches[mb_idx]
+            buf = jnp.where(stage == 0,
+                            jnp.where(t < num_microbatches, incoming, buf),
+                            buf)
+            buf = run_stage(buf)
+            # last stage emits microbatch t - (S-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, num_microbatches - 1)
+            emit = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            outputs = jnp.where(
+                emit,
+                outputs.at[out_idx].set(buf),
+                outputs,
+            )
+            # hand activations to the next stage
+            buf = jax.lax.ppermute(buf, axis_name, perm_fwd)
+            return buf, outputs
+
+        buf, outputs = jax.lax.fori_loop(0, n_ticks, tick, (buf, outputs))
+        y_local = outputs.reshape(x_local.shape)
+        # every stage returns a buffer; only the last stage's is real —
+        # broadcast it so the output is replicated over the pipeline axis
+        last = jax.lax.psum(
+            jnp.where(stage == n_stages - 1, 1.0, 0.0) * 0 + (
+                y_local * (stage == n_stages - 1)
+            ),
+            axis_name,
+        )
+        return last
+
+    # params sharded over pipeline axis on the leading (layers) dim;
+    # x replicated; output replicated
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = _shard_map(
+        local, mesh,
+        in_specs=(P(), param_specs),
+        out_specs=P(),
+    )
+    return fn(x, stage_params)
+
+
+def pipelined_forward(model_layer_fn, params_layers, x, mesh,
+                      num_microbatches=4, axis_name="pipeline"):
+    """Convenience wrapper matching models' stacked-layer params."""
+    return pipeline_apply(
+        model_layer_fn, params_layers, x, mesh, num_microbatches, axis_name
+    )

@@ -10,6 +10,8 @@ from .core_decorators import (
     ResourcesDecorator,
 )
 from .parallel_decorator import ParallelDecorator
+from .secrets_decorator import SecretsDecorator
+from .cards.card_decorator import CardDecorator
 from .tpu.tpu_decorator import TpuDecorator
 from .tpu.tpu_parallel import TpuParallelDecorator
 from .tpu.checkpoint_decorator import CheckpointDecorator
@@ -23,13 +25,32 @@ STEP_DECORATORS = {
         EnvironmentDecorator,
         ResourcesDecorator,
         ParallelDecorator,
+        SecretsDecorator,
+        CardDecorator,
         TpuDecorator,
         TpuParallelDecorator,
         CheckpointDecorator,
     )
 }
 
-FLOW_DECORATORS = {}
+from .flow_decorators import (
+    ProjectDecorator,
+    ScheduleDecorator,
+    TriggerDecorator,
+    TriggerOnFinishDecorator,
+    ExitHookDecorator,
+)
+
+FLOW_DECORATORS = {
+    cls.name: cls
+    for cls in (
+        ProjectDecorator,
+        ScheduleDecorator,
+        TriggerDecorator,
+        TriggerOnFinishDecorator,
+        ExitHookDecorator,
+    )
+}
 
 
 def register_step_decorator(cls):

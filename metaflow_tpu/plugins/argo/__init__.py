@@ -1,0 +1,3 @@
+from .argo_workflows import ArgoWorkflows
+
+__all__ = ["ArgoWorkflows"]

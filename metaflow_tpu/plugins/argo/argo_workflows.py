@@ -1,0 +1,291 @@
+"""Compile a FlowGraph → Argo WorkflowTemplate for GKE (TPU-first).
+
+Reference behavior: metaflow/plugins/argo/argo_workflows.py
+(_compile_workflow_template:801, _dag_templates:1237,
+_container_templates:1983): each step becomes a container template running
+the same `step` command the local runtime uses; foreach becomes a fan-out via
+`withParam`; @schedule → CronWorkflow; @trigger → an Argo Events sensor.
+
+TPU-first differences from the reference's K8s compilation:
+  - @tpu steps request `google.com/tpu` resources and set the
+    `cloud.google.com/gke-tpu-accelerator`/`-topology` node selectors GKE
+    uses to schedule onto TPU slices.
+  - gang (num_parallel) steps compile to a single control task whose pod
+    lands on a multi-host TPU slice: the slice IS the gang, host 0 is the
+    control (SURVEY.md §2.9), so no JobSet indirection is needed —
+    jax.distributed discovers peers from the TPU metadata.
+"""
+
+import json
+import sys
+
+from ...exception import TpuFlowException
+
+DEFAULT_IMAGE = "python:3.12"
+
+
+def _argo_name(name):
+    """Argo template/task names must be DNS-1123-ish."""
+    return name.lower().replace("_", "-")
+
+TPU_TOPOLOGY_SELECTORS = {
+    # topology → (accelerator type, gke topology, hosts)
+    "v5p-8": ("tpu-v5p-slice", "2x2x1", 1),
+    "v5p-16": ("tpu-v5p-slice", "2x2x2", 2),
+    "v5p-32": ("tpu-v5p-slice", "2x2x4", 4),
+    "v5p-64": ("tpu-v5p-slice", "2x4x4", 8),
+    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 1),
+    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 1),
+    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 2),
+    "v5e-256": ("tpu-v5-lite-podslice", "16x16", 32),
+}
+
+
+class ArgoWorkflows(object):
+    def __init__(self, flow, graph, package_url=None, image=None,
+                 namespace="default", name=None):
+        self.flow = flow
+        self.graph = graph
+        self.package_url = package_url
+        self.image = image or DEFAULT_IMAGE
+        self.namespace = namespace
+        self.name = (name or flow.name).lower().replace("_", "-")
+
+    # ---------------- step command ----------------
+
+    def _step_command(self, node):
+        """The container command: bootstrap the code package then run the
+        exact same `step` command the local runtime uses."""
+        from ...package import MetaflowPackage
+
+        cmds = []
+        if self.package_url:
+            cmds += MetaflowPackage.bootstrap_commands(self.package_url)
+        input_paths = "{{inputs.parameters.input-paths}}"
+        split_index = "{{inputs.parameters.split-index}}"
+        step_cmd = (
+            "python %s --quiet --metadata local --datastore local step %s "
+            "--run-id {{workflow.name}} --task-id {{inputs.parameters.task-id}} "
+            "--input-paths '%s' --split-index '%s'"
+            % (self.flow.script_name, node.name, input_paths, split_index)
+        )
+        cmds.append(step_cmd)
+        return ["bash", "-c", " && ".join(cmds)]
+
+    # ---------------- per-step container templates ----------------
+
+    def _resources_for(self, node):
+        res = {"requests": {"cpu": "1", "memory": "4Gi"}, "limits": {}}
+        node_selector = {}
+        step_func = getattr(self.flow, node.name)
+        for deco in step_func.decorators:
+            if deco.name == "resources":
+                a = deco.attributes
+                res["requests"]["cpu"] = str(a.get("cpu") or 1)
+                res["requests"]["memory"] = "%sMi" % (a.get("memory") or 4096)
+            if deco.name == "tpu":
+                topo = deco.attributes.get("topology")
+                if topo:
+                    if topo not in TPU_TOPOLOGY_SELECTORS:
+                        raise TpuFlowException(
+                            "Unknown TPU topology %r; known: %s"
+                            % (topo, ", ".join(sorted(TPU_TOPOLOGY_SELECTORS)))
+                        )
+                    acc, gke_topo, _hosts = TPU_TOPOLOGY_SELECTORS[topo]
+                    node_selector = {
+                        "cloud.google.com/gke-tpu-accelerator": acc,
+                        "cloud.google.com/gke-tpu-topology": gke_topo,
+                    }
+                    res["limits"]["google.com/tpu"] = "4"
+        return res, node_selector
+
+    def _container_template(self, node):
+        resources, node_selector = self._resources_for(node)
+        step_func = getattr(self.flow, node.name)
+        retries = 0
+        for deco in step_func.decorators:
+            if deco.name == "retry":
+                retries = int(deco.attributes["times"])
+        template = {
+            "name": _argo_name(node.name),
+            "inputs": {
+                "parameters": [
+                    {"name": "input-paths", "value": ""},
+                    {"name": "split-index", "value": ""},
+                    {"name": "task-id", "value": "{{pod.name}}"},
+                ]
+            },
+            "container": {
+                "image": self.image,
+                "command": self._step_command(node),
+                "resources": resources,
+            },
+        }
+        if node_selector:
+            template["nodeSelector"] = node_selector
+        if retries:
+            template["retryStrategy"] = {
+                "limit": retries,
+                "retryPolicy": "Always",
+            }
+        if node.parallel_step:
+            # gang pods land on one multi-host slice; completions/parallelism
+            # follow the slice's host count via the TPU topology selector
+            template.setdefault("metadata", {}).setdefault("labels", {})[
+                "tpuflow/gang"
+            ] = "true"
+        return template
+
+    # ---------------- DAG wiring ----------------
+
+    def _dag_tasks(self):
+        tasks = []
+        for name in self.graph.sorted_nodes():
+            node = self.graph[name]
+            task = {
+                "name": _argo_name(name),
+                "template": _argo_name(name),
+                "arguments": {"parameters": [
+                    {"name": "input-paths",
+                     "value": "{{workflow.name}}/" + (
+                         node.in_funcs and sorted(node.in_funcs)[0] or "_"
+                     )},
+                    {"name": "split-index", "value": ""},
+                    {"name": "task-id", "value": _argo_name(name)},
+                ]},
+            }
+            deps = sorted(_argo_name(f) for f in node.in_funcs)
+            if deps:
+                task["dependencies"] = deps
+            parent_foreach = None
+            for in_func in node.in_funcs:
+                if self.graph[in_func].type == "foreach":
+                    parent_foreach = in_func
+            if parent_foreach:
+                # fan-out: the foreach parent emits a JSON list of split
+                # indices on its output parameter
+                task["withParam"] = (
+                    "{{tasks.%s.outputs.parameters.num-splits}}"
+                    % _argo_name(parent_foreach)
+                )
+                task["arguments"]["parameters"][1]["value"] = "{{item}}"
+            tasks.append(task)
+        return tasks
+
+    # ---------------- top-level objects ----------------
+
+    def compile(self):
+        """Return the WorkflowTemplate manifest (dict)."""
+        parameters = [
+            {"name": name, "value": json.dumps(param.kwargs.get("default"))}
+            for name, param in self.flow._get_parameters()
+            if not getattr(param, "IS_CONFIG_PARAMETER", False)
+        ]
+        manifest = {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "WorkflowTemplate",
+            "metadata": {
+                "name": self._deployed_name(),
+                "namespace": self.namespace,
+                "labels": {"app.kubernetes.io/part-of": "metaflow-tpu"},
+                "annotations": {
+                    "tpuflow/flow-name": self.flow.name,
+                },
+            },
+            "spec": {
+                "entrypoint": "dag",
+                "arguments": {"parameters": parameters},
+                "templates": [
+                    {"name": "dag", "dag": {"tasks": self._dag_tasks()}}
+                ] + [
+                    self._container_template(self.graph[name])
+                    for name in self.graph.sorted_nodes()
+                ],
+            },
+        }
+        return manifest
+
+    def _deployed_name(self):
+        from ...current import current
+
+        project_flow = getattr(current, "project_flow_name", None)
+        if project_flow:
+            return project_flow.lower().replace("_", "-").replace(".", "-")
+        return self.name
+
+    def compile_cron(self):
+        """CronWorkflow when @schedule is present, else None."""
+        for decos in getattr(self.flow, "_flow_decorators", {}).values():
+            for deco in decos:
+                if deco.name == "schedule" and deco.schedule:
+                    return {
+                        "apiVersion": "argoproj.io/v1alpha1",
+                        "kind": "CronWorkflow",
+                        "metadata": {"name": self._deployed_name() + "-cron",
+                                     "namespace": self.namespace},
+                        "spec": {
+                            "schedule": deco.schedule,
+                            "workflowSpec": {
+                                "workflowTemplateRef": {
+                                    "name": self._deployed_name()
+                                }
+                            },
+                        },
+                    }
+        return None
+
+    def compile_sensor(self):
+        """Argo Events Sensor for @trigger / @trigger_on_finish."""
+        events = []
+        for decos in getattr(self.flow, "_flow_decorators", {}).values():
+            for deco in decos:
+                if deco.name == "trigger":
+                    events += [t["name"] for t in deco.triggers]
+                if deco.name == "trigger_on_finish":
+                    events += ["run-finished." + f for f in deco.triggers]
+        if not events:
+            return None
+        return {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Sensor",
+            "metadata": {"name": self._deployed_name() + "-sensor",
+                         "namespace": self.namespace},
+            "spec": {
+                "dependencies": [
+                    {"name": e.replace(".", "-"),
+                     "eventSourceName": "tpuflow-events",
+                     "eventName": e}
+                    for e in events
+                ],
+                "triggers": [{
+                    "template": {
+                        "name": "submit",
+                        "argoWorkflow": {
+                            "operation": "submit",
+                            "source": {"resource": {
+                                "apiVersion": "argoproj.io/v1alpha1",
+                                "kind": "Workflow",
+                                "metadata": {
+                                    "generateName": self._deployed_name() + "-"
+                                },
+                                "spec": {"workflowTemplateRef": {
+                                    "name": self._deployed_name()
+                                }},
+                            }},
+                        },
+                    }
+                }],
+            },
+        }
+
+    def to_yaml(self, manifests):
+        try:
+            import yaml
+
+            return "---\n".join(
+                yaml.safe_dump(m, sort_keys=False) for m in manifests if m
+            )
+        except ImportError:
+            return "\n".join(
+                json.dumps(m, indent=2) for m in manifests if m
+            )

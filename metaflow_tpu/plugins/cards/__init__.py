@@ -1,0 +1,23 @@
+from .card_decorator import CardDecorator, CardCollector, card_path
+from .components import (
+    Artifact,
+    CardComponent,
+    Image,
+    Markdown,
+    ProgressBar,
+    Table,
+    VegaChart,
+)
+
+__all__ = [
+    "CardDecorator",
+    "CardCollector",
+    "card_path",
+    "Artifact",
+    "CardComponent",
+    "Image",
+    "Markdown",
+    "ProgressBar",
+    "Table",
+    "VegaChart",
+]

@@ -1,0 +1,109 @@
+"""@card: per-task HTML report.
+
+Reference behavior: metaflow/plugins/cards/card_decorator.py:45 +
+card_datastore.py. User code appends components via `current.card`; at
+task_finished the card renders to a self-contained HTML file in the
+datastore under <flow>/mf.cards/<run>/<step>/<task>/<type>.html. The default
+card always includes task info + user artifacts.
+"""
+
+import time
+
+from ...current import current
+from ...decorators import StepDecorator
+from .components import (
+    Artifact,
+    CardComponent,
+    Markdown,
+    Table,
+    render_page,
+)
+
+
+class CardCollector(object):
+    """`current.card`: list-like component collector."""
+
+    def __init__(self):
+        self._components = []
+
+    def append(self, component):
+        if not isinstance(component, CardComponent):
+            component = Artifact(component)
+        self._components.append(component)
+
+    def extend(self, components):
+        for c in components:
+            self.append(c)
+
+    def clear(self):
+        self._components = []
+
+    def __iter__(self):
+        return iter(self._components)
+
+    def __len__(self):
+        return len(self._components)
+
+
+def card_path(storage, flow_name, run_id, step_name, task_id,
+              card_type="default"):
+    return storage.path_join(
+        flow_name, "mf.cards", str(run_id), step_name, str(task_id),
+        "%s.html" % card_type,
+    )
+
+
+class CardDecorator(StepDecorator):
+    """@card(type='default', id=None)"""
+
+    name = "card"
+    defaults = {"type": "default", "id": None}
+    allow_multiple = True
+
+    def task_pre_step(self, step_name, task_datastore, metadata, run_id,
+                      task_id, flow, graph, retry_count, max_user_code_retries,
+                      ubf_context, inputs):
+        self._task_datastore = task_datastore
+        self._run_id = run_id
+        self._step_name = step_name
+        self._task_id = task_id
+        self._start = time.time()
+        self._collector = CardCollector()
+        current._update_env({"card": self._collector})
+
+    def task_finished(self, step_name, flow, graph, is_task_ok, retry_count,
+                      max_user_code_retries):
+        try:
+            self._render(flow, is_task_ok, retry_count)
+        except Exception:
+            # a card failure must never fail the task
+            pass
+
+    def _render(self, flow, is_task_ok, retry_count):
+        fds = self._task_datastore._flow_datastore
+        pathspec = "%s/%s/%s/%s" % (
+            fds.flow_name, self._run_id, self._step_name, self._task_id,
+        )
+        components = [
+            Markdown("# %s" % pathspec),
+            Table.from_dict({
+                "status": "ok" if is_task_ok else "failed",
+                "attempt": retry_count,
+                "duration_s": round(time.time() - self._start, 2),
+                "finished_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            }),
+        ]
+        components.extend(self._collector)
+        artifacts = {
+            k: v for k, v in flow.__dict__.items()
+            if not k.startswith("_") and k not in ("name",)
+        }
+        if artifacts:
+            components.append(Markdown("## Artifacts"))
+            components.append(Table.from_dict(artifacts))
+        page = render_page(pathspec, pathspec, components)
+        path = card_path(
+            fds.storage, fds.flow_name, self._run_id, self._step_name,
+            self._task_id, self.attributes["id"] or self.attributes["type"],
+        )
+        fds.storage.save_bytes([(path, page.encode("utf-8"))], overwrite=True)

@@ -1,0 +1,216 @@
+"""Card component DSL.
+
+Reference behavior: metaflow/plugins/cards/card_modules/components.py
+(Markdown/Table/Image/VegaChart/ProgressBar...). Components render to
+self-contained HTML fragments — no JS bundle; charts embed a vega-lite spec
+with a CDN loader so cards degrade gracefully offline.
+"""
+
+import base64
+import html
+import json
+
+
+class CardComponent(object):
+    def render(self):
+        raise NotImplementedError
+
+
+class Markdown(CardComponent):
+    """Minimal markdown: headers, bold, italics, code, bullet lists."""
+
+    def __init__(self, text):
+        self.text = text
+
+    def render(self):
+        lines_out = []
+        in_list = False
+        for line in self.text.split("\n"):
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                level = len(stripped) - len(stripped.lstrip("#"))
+                content = html.escape(stripped[level:].strip())
+                lines_out.append("<h%d>%s</h%d>" % (level, content, level))
+            elif stripped.startswith(("- ", "* ")):
+                if not in_list:
+                    lines_out.append("<ul>")
+                    in_list = True
+                lines_out.append("<li>%s</li>" % _inline(stripped[2:]))
+            else:
+                if in_list:
+                    lines_out.append("</ul>")
+                    in_list = False
+                if stripped:
+                    lines_out.append("<p>%s</p>" % _inline(stripped))
+        if in_list:
+            lines_out.append("</ul>")
+        return "\n".join(lines_out)
+
+
+def _inline(text):
+    out = html.escape(text)
+    # `code`, **bold**, *italic*
+    import re
+
+    out = re.sub(r"`([^`]+)`", r"<code>\1</code>", out)
+    out = re.sub(r"\*\*([^*]+)\*\*", r"<b>\1</b>", out)
+    out = re.sub(r"\*([^*]+)\*", r"<i>\1</i>", out)
+    return out
+
+
+class Table(CardComponent):
+    def __init__(self, data=None, headers=None):
+        self.data = data or []
+        self.headers = headers or []
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(data=[[k, _fmt(v)] for k, v in d.items()],
+                   headers=["key", "value"])
+
+    def render(self):
+        rows = []
+        if self.headers:
+            rows.append(
+                "<tr>%s</tr>"
+                % "".join("<th>%s</th>" % html.escape(str(h))
+                          for h in self.headers)
+            )
+        for row in self.data:
+            rows.append(
+                "<tr>%s</tr>"
+                % "".join("<td>%s</td>" % html.escape(_fmt(c)) for c in row)
+            )
+        return "<table>%s</table>" % "".join(rows)
+
+
+def _fmt(v):
+    s = repr(v) if not isinstance(v, str) else v
+    return s if len(s) < 500 else s[:500] + "..."
+
+
+class Image(CardComponent):
+    def __init__(self, src=None, label=None):
+        """src: raw image bytes (png/jpeg) or a data/http URL string."""
+        self.src = src
+        self.label = label
+
+    @classmethod
+    def from_matplotlib(cls, fig, label=None):
+        import io
+
+        buf = io.BytesIO()
+        fig.savefig(buf, format="png", bbox_inches="tight")
+        return cls(src=buf.getvalue(), label=label)
+
+    def render(self):
+        if isinstance(self.src, bytes):
+            uri = "data:image/png;base64," + base64.b64encode(
+                self.src
+            ).decode("ascii")
+        else:
+            uri = str(self.src)
+        caption = (
+            "<figcaption>%s</figcaption>" % html.escape(self.label)
+            if self.label else ""
+        )
+        return '<figure><img src="%s" style="max-width:100%%"/>%s</figure>' % (
+            uri, caption,
+        )
+
+
+class Artifact(CardComponent):
+    def __init__(self, obj, name=None):
+        self.obj = obj
+        self.name = name
+
+    def render(self):
+        label = "<b>%s</b> = " % html.escape(self.name) if self.name else ""
+        return "<div class='artifact'>%s<code>%s</code></div>" % (
+            label, html.escape(_fmt(self.obj)),
+        )
+
+
+class ProgressBar(CardComponent):
+    def __init__(self, max=100, label=None, value=0):
+        self.max = max
+        self.value = value
+        self.label = label
+
+    def update(self, value):
+        self.value = value
+
+    def render(self):
+        pct = 100.0 * self.value / max(self.max, 1)
+        label = html.escape(self.label or "")
+        return (
+            "<div class='pbar'><span>%s %d/%d</span>"
+            "<div style='background:#eee;border-radius:4px'>"
+            "<div style='width:%.1f%%;background:#4a90d9;height:10px;"
+            "border-radius:4px'></div></div></div>"
+            % (label, self.value, self.max, pct)
+        )
+
+
+class VegaChart(CardComponent):
+    def __init__(self, spec):
+        self.spec = spec
+
+    @classmethod
+    def line(cls, xs, ys, x_label="x", y_label="y", title=""):
+        return cls({
+            "$schema": "https://vega.github.io/schema/vega-lite/v5.json",
+            "title": title,
+            "data": {"values": [
+                {x_label: float(x), y_label: float(y)}
+                for x, y in zip(xs, ys)
+            ]},
+            "mark": "line",
+            "encoding": {
+                "x": {"field": x_label, "type": "quantitative"},
+                "y": {"field": y_label, "type": "quantitative"},
+            },
+        })
+
+    _counter = [0]
+
+    def render(self):
+        VegaChart._counter[0] += 1
+        div_id = "vega%d" % VegaChart._counter[0]
+        return (
+            "<div id='%s'></div><script>"
+            "if (window.vegaEmbed) vegaEmbed('#%s', %s);"
+            "else document.getElementById('%s').innerText = "
+            "'vega-lite spec (offline): ' + %s;"
+            "</script>"
+            % (div_id, div_id, json.dumps(self.spec), div_id,
+               json.dumps(json.dumps(self.spec)[:2000]))
+        )
+
+
+PAGE_TEMPLATE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<script src="https://cdn.jsdelivr.net/npm/vega@5"></script>
+<script src="https://cdn.jsdelivr.net/npm/vega-lite@5"></script>
+<script src="https://cdn.jsdelivr.net/npm/vega-embed@6"></script>
+<style>
+body {{ font-family: -apple-system, Segoe UI, sans-serif; margin: 2em;
+       max-width: 960px; color: #222; }}
+table {{ border-collapse: collapse; margin: 1em 0; }}
+td, th {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
+th {{ background: #f5f5f5; }}
+code {{ background: #f5f5f5; padding: 1px 4px; border-radius: 3px; }}
+h1 {{ border-bottom: 2px solid #4a90d9; padding-bottom: 4px; }}
+.pbar {{ margin: 0.5em 0; }}
+.artifact {{ margin: 0.3em 0; }}
+</style></head><body>
+{body}
+<hr><footer><small>metaflow_tpu card · {pathspec}</small></footer>
+</body></html>
+"""
+
+
+def render_page(title, pathspec, components):
+    body = "\n".join(c.render() for c in components)
+    return PAGE_TEMPLATE.format(title=html.escape(title), body=body,
+                                pathspec=html.escape(pathspec))

@@ -1,0 +1,116 @@
+"""Flow-level deploy annotations: @project, @schedule, @trigger,
+@trigger_on_finish, @exit_hook.
+
+Reference behavior: metaflow/plugins/{project_decorator,events_decorator,
+exit_hook_decorator}.py + aws/step_functions/schedule_decorator.py. Locally
+these record deployment intent (consumed by the Argo compiler, plugins/argo);
+@project additionally namespaces the deployed flow as user.branch.flow.
+"""
+
+from ..decorators import FlowDecorator
+from ..exception import TpuFlowException
+from ..util import get_username
+
+
+class ProjectDecorator(FlowDecorator):
+    """@project(name='myproject', branch=None)"""
+
+    name = "project"
+    defaults = {"name": None, "branch": None, "production": False}
+
+    def flow_init(self, flow, graph, environment, flow_datastore, metadata,
+                  logger, echo, options):
+        project = self.attributes["name"]
+        if not project:
+            raise TpuFlowException("@project needs a name attribute.")
+        branch = self.attributes["branch"] or (
+            "prod" if self.attributes["production"]
+            else "user.%s" % get_username()
+        )
+        from ..current import current
+
+        current._update_env(
+            {
+                "project_name": project,
+                "branch_name": branch,
+                "project_flow_name": "%s.%s.%s" % (project, branch,
+                                                   flow.name),
+                "is_production": bool(self.attributes["production"]),
+            }
+        )
+
+
+class ScheduleDecorator(FlowDecorator):
+    """@schedule(cron='0 9 * * *') or @schedule(daily=True|hourly=True|
+    weekly=True)"""
+
+    name = "schedule"
+    defaults = {"cron": None, "daily": False, "hourly": False,
+                "weekly": False, "timezone": None}
+
+    def flow_init(self, flow, graph, environment, flow_datastore, metadata,
+                  logger, echo, options):
+        pass
+
+    @property
+    def schedule(self):
+        if self.attributes["cron"]:
+            return self.attributes["cron"]
+        if self.attributes["hourly"]:
+            return "7 * * * *"
+        if self.attributes["daily"]:
+            return "13 5 * * *"
+        if self.attributes["weekly"]:
+            return "13 5 * * 0"
+        return None
+
+
+class TriggerDecorator(FlowDecorator):
+    """@trigger(event='name') or @trigger(events=[...]): start the deployed
+    flow when an event is published."""
+
+    name = "trigger"
+    defaults = {"event": None, "events": [], "options": {}}
+
+    @property
+    def triggers(self):
+        events = list(self.attributes["events"] or [])
+        if self.attributes["event"]:
+            events.append(self.attributes["event"])
+        return [e if isinstance(e, dict) else {"name": e} for e in events]
+
+
+class TriggerOnFinishDecorator(FlowDecorator):
+    """@trigger_on_finish(flow='OtherFlow') / (flows=[...])."""
+
+    name = "trigger_on_finish"
+    defaults = {"flow": None, "flows": [], "options": {}}
+
+    @property
+    def triggers(self):
+        flows = list(self.attributes["flows"] or [])
+        if self.attributes["flow"]:
+            flows.append(self.attributes["flow"])
+        return flows
+
+
+class ExitHookDecorator(FlowDecorator):
+    """@exit_hook(on_success=[fn], on_error=[fn]) — run user callables after
+    the run ends (reference: exit_hook_decorator.py)."""
+
+    name = "exit_hook"
+    defaults = {"on_success": [], "on_error": []}
+
+    def run_hooks(self, success, run_pathspec, echo):
+        hooks = (
+            self.attributes["on_success"] if success
+            else self.attributes["on_error"]
+        )
+        for hook in hooks or []:
+            try:
+                try:
+                    hook(run_pathspec)
+                except TypeError:
+                    hook()
+            except Exception as ex:
+                echo("exit hook %r failed: %s" % (hook, ex))

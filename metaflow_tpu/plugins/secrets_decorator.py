@@ -1,0 +1,70 @@
+"""@secrets: fetch secrets at task start and inject as env vars.
+
+Reference behavior: metaflow/plugins/secrets/ (secrets_decorator.py —
+`@secrets(sources=[...])` fetched in task_pre_step). Providers here:
+
+  - "inline:{json}"       literal key/value JSON (tests, local dev)
+  - "file:/path.json"     JSON file on the task host
+  - "env:PREFIX"          copy host env vars with the given prefix
+  - "gcp:projects/p/secrets/name" GCP Secret Manager (TPU-VM native path;
+    requires google-cloud-secret-manager, gated import)
+"""
+
+import json
+import os
+
+from ..decorators import StepDecorator
+from ..exception import TpuFlowException
+
+
+def _fetch(source):
+    kind, _, arg = source.partition(":")
+    if kind == "inline":
+        return json.loads(arg)
+    if kind == "file":
+        with open(arg) as f:
+            return json.load(f)
+    if kind == "env":
+        return {
+            k[len(arg):].lstrip("_") if arg else k: v
+            for k, v in os.environ.items()
+            if k.startswith(arg)
+        }
+    if kind == "gcp":
+        try:
+            from google.cloud import secretmanager
+        except ImportError:
+            raise TpuFlowException(
+                "@secrets gcp source needs google-cloud-secret-manager"
+            )
+        client = secretmanager.SecretManagerServiceClient()
+        name = arg if arg.endswith("/versions/latest") else (
+            arg + "/versions/latest"
+        )
+        payload = client.access_secret_version(
+            request={"name": name}
+        ).payload.data.decode("utf-8")
+        try:
+            return json.loads(payload)
+        except json.JSONDecodeError:
+            return {arg.rsplit("/", 1)[-1]: payload}
+    raise TpuFlowException("Unknown secrets source %r" % source)
+
+
+class SecretsDecorator(StepDecorator):
+    """@secrets(sources=["file:/etc/keys.json", "gcp:projects/p/secrets/x"])"""
+
+    name = "secrets"
+    defaults = {"sources": [], "role": None}
+
+    def task_pre_step(self, step_name, task_datastore, metadata, run_id,
+                      task_id, flow, graph, retry_count, max_user_code_retries,
+                      ubf_context, inputs):
+        sources = self.attributes["sources"] or []
+        if isinstance(sources, str):
+            sources = [sources]
+        for source in sources:
+            for key, value in _fetch(source).items():
+                if not isinstance(value, str):
+                    value = json.dumps(value)
+                os.environ[key] = value

@@ -9,6 +9,7 @@ import time
 
 from ..client import Run
 from ..exception import TpuFlowException
+from .deployer import Deployer  # noqa: F401  (public API re-export)
 
 
 class ExecutingRun(object):
@@ -99,24 +100,16 @@ class Runner(object):
             return ExecutingRun(argv, proc.returncode, run, stdout, stderr)
 
     def _flow_name(self):
-        # flow class name == the click group name; derive by asking the file
-        out = subprocess.run(
-            [sys.executable, self.flow_file, "--help"],
-            capture_output=True,
-        )
-        first = (out.stdout or b"").decode().split("\n", 1)[0]
-        # "Usage: FlowName [OPTIONS] ..."
-        parts = first.split()
-        if len(parts) >= 2 and parts[0] == "Usage:":
-            return parts[1]
-        # fallback: scan the file for the class definition
+        # the flow name is the FlowSpec subclass name in the file
         import re
 
         with open(self.flow_file) as f:
-            m = re.search(r"class\s+(\w+)\s*\(.*FlowSpec", f.read())
+            m = re.search(r"class\s+(\w+)\s*\([^)]*FlowSpec", f.read())
         if m:
             return m.group(1)
-        raise TpuFlowException("Could not determine flow name")
+        raise TpuFlowException(
+            "Could not determine the flow name from %s" % self.flow_file
+        )
 
     def run(self, timeout=None, **params):
         args = ["run"]
@@ -139,3 +132,81 @@ class Runner(object):
         if origin_run_id:
             args.extend(["--origin-run-id", str(origin_run_id)])
         return self._execute(args, timeout=timeout)
+
+    def async_run(self, **params):
+        """Start the run without blocking; returns an AsyncRun handle."""
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="tpuflow_run_")
+        run_id_file = os.path.join(tmpdir, "run_id")
+        argv = (
+            [sys.executable, self.flow_file]
+            + self._top_level_args()
+            + ["run", "--run-id-file", run_id_file]
+        )
+        for k, v in params.items():
+            argv.extend(["--" + k.replace("_", "-"), str(v)])
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env.items()})
+        proc = subprocess.Popen(
+            argv, env=env, cwd=self.cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        return AsyncRun(self, proc, run_id_file, argv)
+
+
+class AsyncRun(object):
+    def __init__(self, runner, proc, run_id_file, command):
+        self._runner = runner
+        self.proc = proc
+        self._run_id_file = run_id_file
+        self.command = command
+
+    @property
+    def run_id(self):
+        # be patient: flow-file import can take tens of seconds on a TPU VM
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if os.path.exists(self._run_id_file):
+                with open(self._run_id_file) as f:
+                    return f.read().strip()
+            if self.proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        # final re-check: a fast run may exit between poll and file write
+        if os.path.exists(self._run_id_file):
+            with open(self._run_id_file) as f:
+                return f.read().strip()
+        return None
+
+    @property
+    def run(self):
+        run_id = self.run_id
+        if run_id is None:
+            return None
+        try:
+            return Run("%s/%s" % (self._runner._flow_name(), run_id),
+                       _namespace_check=False)
+        except Exception:
+            return None
+
+    def wait(self, timeout=None):
+        stdout, stderr = self.proc.communicate(timeout=timeout)
+        result = ExecutingRun(
+            self.command,
+            self.proc.returncode,
+            self.run,
+            stdout.decode("utf-8", errors="replace"),
+            stderr.decode("utf-8", errors="replace"),
+        )
+        self._cleanup()
+        return result
+
+    def terminate(self):
+        self.proc.terminate()
+        self._cleanup()
+
+    def _cleanup(self):
+        import shutil
+
+        shutil.rmtree(os.path.dirname(self._run_id_file), ignore_errors=True)

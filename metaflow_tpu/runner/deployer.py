@@ -1,0 +1,81 @@
+"""Deployer: programmatic deployment to production orchestrators.
+
+Reference behavior: metaflow/runner/deployer.py:99 —
+`Deployer('flow.py').argo_workflows().create()` returns a DeployedFlow.
+Compilation happens via the flow's own CLI (`argo-workflows create
+--only-json`); applying to a cluster is the caller's `kubectl apply` (no
+cluster access is assumed here).
+"""
+
+import os
+import subprocess
+import sys
+
+from ..exception import TpuFlowException
+
+
+class DeployedFlow(object):
+    def __init__(self, name, manifests_yaml):
+        self.name = name
+        self.manifests = manifests_yaml
+
+    def save(self, path):
+        with open(path, "w") as f:
+            f.write(self.manifests)
+        return path
+
+    def trigger(self, **kwargs):
+        raise TpuFlowException(
+            "Triggering needs cluster access: kubectl apply the manifests "
+            "and submit via 'argo submit --from workflowtemplate/%s'."
+            % self.name
+        )
+
+
+class ArgoWorkflowsDeployer(object):
+    def __init__(self, deployer, image=None, k8s_namespace="default"):
+        self._deployer = deployer
+        self._image = image
+        self._namespace = k8s_namespace
+
+    def create(self, do_package=False):
+        args = [
+            sys.executable,
+            self._deployer.flow_file,
+            "argo-workflows",
+            "create",
+            "--only-json",
+            "--k8s-namespace", self._namespace,
+        ]
+        if self._image:
+            args += ["--image", self._image]
+        if do_package:
+            args += ["--package"]
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              env=self._deployer.env_with_defaults())
+        if proc.returncode != 0:
+            raise TpuFlowException(
+                "argo-workflows create failed:\n%s" % proc.stderr
+            )
+        name = None
+        for line in proc.stdout.split("\n"):
+            if line.strip().startswith("name:") and name is None:
+                name = line.split(":", 1)[1].strip()
+        return DeployedFlow(name or "unknown", proc.stdout)
+
+
+class Deployer(object):
+    def __init__(self, flow_file, env=None, **kwargs):
+        self.flow_file = os.path.abspath(flow_file)
+        if not os.path.exists(self.flow_file):
+            raise TpuFlowException("Flow file %s not found" % flow_file)
+        self.env = env or {}
+
+    def env_with_defaults(self):
+        merged = dict(os.environ)
+        merged.update({k: str(v) for k, v in self.env.items()})
+        return merged
+
+    def argo_workflows(self, image=None, k8s_namespace="default"):
+        return ArgoWorkflowsDeployer(self, image=image,
+                                     k8s_namespace=k8s_namespace)

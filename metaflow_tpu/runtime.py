@@ -101,6 +101,52 @@ class CLIArgs(object):
         return args
 
 
+class ForkProc(object):
+    """Popen-compatible handle for a fork()ed task worker (the warm-pool
+    fast path: the child inherits the scheduler's already-imported modules,
+    skipping ~2s of interpreter+import startup per task)."""
+
+    def __init__(self, pid, stdout, stderr):
+        self.pid = pid
+        self.stdout = stdout
+        self.stderr = stderr
+        self.returncode = None
+
+    def poll(self):
+        if self.returncode is None:
+            pid, status = os.waitpid(self.pid, os.WNOHANG)
+            if pid == self.pid:
+                self.returncode = (
+                    -(status & 0x7F) if (status & 0x7F)
+                    else (status >> 8) & 0xFF
+                )
+        return self.returncode
+
+    def wait(self, timeout=None):
+        deadline = time.time() + (timeout or 3600)
+        while self.poll() is None:
+            if time.time() > deadline:
+                raise TimeoutError("fork worker %d" % self.pid)
+            time.sleep(0.02)
+        return self.returncode
+
+    def terminate(self):
+        import signal
+
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def kill(self):
+        import signal
+
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
 class Worker(object):
     def __init__(self, task, proc, echo):
         self.task = task
@@ -111,19 +157,25 @@ class Worker(object):
         self._partial = {"stdout": b"", "stderr": b""}
 
     def read_stream(self, name, fileobj):
+        """Read available bytes; returns the byte count (0 = nothing left)."""
+        from . import mflog
+
         try:
             data = os.read(fileobj.fileno(), 65536)
         except (OSError, ValueError):
-            return
+            return 0
         if not data:
-            return
-        if name == "stdout":
-            self.stdout_buf += data
-        else:
-            self.stderr_buf += data
+            return 0
         buf = self._partial[name] + data
         *lines, self._partial[name] = buf.split(b"\n")
         for line in lines:
+            # persist with the mflog structured header (timestamped merge
+            # across sources on read); echo the plain line live
+            tagged = mflog.decorate(mflog.TASK, line)
+            if name == "stdout":
+                self.stdout_buf += tagged
+            else:
+                self.stderr_buf += tagged
             self.echo(
                 PROGRESS_LINE
                 % (
@@ -133,6 +185,20 @@ class Worker(object):
                     line.decode("utf-8", errors="replace"),
                 )
             )
+        return len(data)
+
+    def flush_partials(self):
+        """Tag + persist any unterminated trailing line of each stream."""
+        from . import mflog
+
+        for name in ("stdout", "stderr"):
+            if self._partial[name]:
+                tagged = mflog.decorate(mflog.TASK, self._partial[name])
+                if name == "stdout":
+                    self.stdout_buf += tagged
+                else:
+                    self.stderr_buf += tagged
+                self._partial[name] = b""
 
 
 class NativeRuntime(object):
@@ -154,6 +220,7 @@ class NativeRuntime(object):
         echo=None,
         entrypoint=None,
         decospecs=None,
+        config_args=None,
         flow_file=None,
     ):
         self._flow = flow
@@ -170,6 +237,7 @@ class NativeRuntime(object):
         self._resume_step = resume_step
         self._echo = echo or (lambda line: print(line, flush=True))
         self._decospecs = decospecs or []
+        self._config_args = list(config_args or [])
         self._flow_file = flow_file or sys.argv[0]
         self._entrypoint = entrypoint or [sys.executable, self._flow_file]
 
@@ -208,6 +276,7 @@ class NativeRuntime(object):
 
         sel = selectors.DefaultSelector()
         last_beat = time.time()
+        hooks_ran = False
         try:
             while self._run_queue or self._active:
                 # launch as many queued tasks as the worker pool allows
@@ -240,11 +309,8 @@ class NativeRuntime(object):
                         ("stdout", worker.proc.stdout),
                         ("stderr", worker.proc.stderr),
                     ):
-                        while True:
-                            before = len(worker.stdout_buf) + len(worker.stderr_buf)
-                            worker.read_stream(name, stream)
-                            if len(worker.stdout_buf) + len(worker.stderr_buf) == before:
-                                break
+                        while worker.read_stream(name, stream):
+                            pass
                         try:
                             sel.unregister(stream)
                         except (KeyError, ValueError):
@@ -252,6 +318,11 @@ class NativeRuntime(object):
                         stream.close()
                     del self._active[pid]
                     self._task_finished(worker, returncode)
+        except BaseException:
+            # crash path (scheduling error, Ctrl-C): on_error hooks still run
+            self._run_exit_hooks(success=False)
+            hooks_ran = True
+            raise
         finally:
             # never orphan live task subprocesses on an abnormal exit
             for worker in self._active.values():
@@ -265,12 +336,23 @@ class NativeRuntime(object):
             sel.close()
             self._metadata.heartbeat()
 
+        if not hooks_ran:
+            self._run_exit_hooks(success=not self._failed)
         if self._failed:
             raise TaskFailed("Workflow failed; see task logs above.")
         self._echo(
             "Done! Flow finished in %.1fs (%d tasks run, %d cloned)."
             % (time.time() - start_time, self._finished_tasks, self._cloned_tasks)
         )
+
+    def _run_exit_hooks(self, success):
+        for decos in getattr(self._flow, "_flow_decorators", {}).values():
+            for deco in decos:
+                if hasattr(deco, "run_hooks"):
+                    deco.run_hooks(
+                        success, "%s/%s" % (self._flow.name, self.run_id),
+                        self._echo,
+                    )
 
     # ------------------------------------------------------------------
     # queueing and transitions
@@ -305,7 +387,7 @@ class NativeRuntime(object):
 
     def _task_finished(self, worker, returncode):
         task = worker.task
-        # persist captured logs
+        worker.flush_partials()
         try:
             ds = self._flow_datastore.get_task_datastore(
                 self.run_id, task.step, task.task_id, attempt=task.attempt,
@@ -453,22 +535,132 @@ class NativeRuntime(object):
     # ------------------------------------------------------------------
 
     def _launch_worker(self, task, sel):
-        args = self._build_cli_args(task)
-        env = dict(os.environ)
-        env.update(args.env)
-        proc = subprocess.Popen(
-            args.get_args(),
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            bufsize=0,
-        )
+        if self._can_fork(task):
+            proc = self._fork_worker(task)
+        else:
+            args = self._build_cli_args(task)
+            env = dict(os.environ)
+            env.update(args.env)
+            proc = subprocess.Popen(
+                args.get_args(),
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                bufsize=0,
+            )
         worker = Worker(task, proc, self._echo)
         os.set_blocking(proc.stdout.fileno(), False)
         os.set_blocking(proc.stderr.fileno(), False)
         sel.register(proc.stdout, selectors.EVENT_READ, (worker, "stdout"))
         sel.register(proc.stderr, selectors.EVENT_READ, (worker, "stderr"))
         self._active[proc.pid] = worker
+
+    def _can_fork(self, task):
+        """Fork fast path is safe for plain steps: no gang contexts (the
+        control task replays its argv for worker ranks) and no compute
+        decorator that rewrites the CLI (trampolines need exec). Also skip
+        once a JAX backend is live in this process — TPU driver fds must
+        not be shared across fork."""
+        if os.environ.get("TPUFLOW_FORK_WORKERS", "1") != "1":
+            return False
+        if task.ubf_context is not None:
+            return False
+        from .decorators import StepDecorator
+        from .plugins.parallel_decorator import ParallelDecorator
+
+        step_func = getattr(self._flow, task.step)
+        for deco in step_func.decorators:
+            overrides_cli = (
+                type(deco).runtime_step_cli is not StepDecorator.runtime_step_cli
+            )
+            if overrides_cli and not isinstance(deco, ParallelDecorator):
+                # decorator rewrites the task CLI (trampoline): honor via exec
+                return False
+        try:
+            import jax._src.xla_bridge as xb
+
+            if getattr(xb, "_backends", None):
+                return False
+        except Exception:
+            pass
+        return True
+
+    def _fork_worker(self, task):
+        r_out, w_out = os.pipe()
+        r_err, w_err = os.pipe()
+        pid = os.fork()
+        if pid == 0:
+            # ---- child: become the task ----
+            try:
+                os.close(r_out)
+                os.close(r_err)
+                os.dup2(w_out, 1)
+                os.dup2(w_err, 2)
+                os.close(w_out)
+                os.close(w_err)
+                rc = self._run_task_in_child(task)
+            except BaseException:
+                import traceback as tb
+
+                tb.print_exc()
+                rc = 1
+            finally:
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(rc)
+        os.close(w_out)
+        os.close(w_err)
+        return ForkProc(
+            pid, os.fdopen(r_out, "rb", buffering=0),
+            os.fdopen(r_err, "rb", buffering=0),
+        )
+
+    def _run_task_in_child(self, task):
+        """Child-side task execution: mirrors cli.step_cmd without the
+        interpreter round-trip."""
+        from .task import MetaflowTask, TaskFailedException
+
+        self._metadata.start_task_heartbeat(
+            self._flow.name, self.run_id, task.step, task.task_id
+        )
+        import threading
+
+        beat_stop = threading.Event()
+
+        def beats():
+            while not beat_stop.wait(10):
+                self._metadata.heartbeat()
+
+        threading.Thread(target=beats, daemon=True).start()
+        executor = MetaflowTask(
+            self._flow,
+            self._flow_datastore,
+            self._metadata,
+            console_logger=lambda line: print(line, flush=True),
+            ubf_context=task.ubf_context,
+        )
+        try:
+            executor.run_step(
+                task.step,
+                self.run_id,
+                task.task_id,
+                origin_run_id=self._origin_run_id,
+                input_paths=task.input_paths,
+                split_index=task.split_index,
+                retry_count=task.attempt,
+                max_user_code_retries=task.user_retries,
+                namespace=self._namespace,
+                parameters_json=json.dumps(self._params)
+                if task.step == "start" and self._params else None,
+            )
+            return 0
+        except TaskFailedException:
+            return 1
+        except Exception:
+            import traceback as tb
+
+            tb.print_exc()
+            return 1
 
     def _build_cli_args(self, task):
         top_level = {
@@ -506,11 +698,12 @@ class NativeRuntime(object):
             deco.runtime_step_cli(
                 args, task.attempt, task.user_retries, task.ubf_context
             )
-        # decospecs are appended manually since --with repeats
-        if self._decospecs:
-            extra = []
-            for spec in self._decospecs:
-                extra.extend(["--with", spec])
+        # repeated top-level options (--with, --config*) append manually
+        extra = []
+        for spec in self._decospecs:
+            extra.extend(["--with", spec])
+        extra.extend(self._config_args)
+        if extra:
             args.entrypoint = args.entrypoint + extra
         return args
 

@@ -108,6 +108,8 @@ class MetaflowTask(object):
         names = []
         values = json.loads(parameters_json) if parameters_json else {}
         for name, param in self.flow._get_parameters():
+            if getattr(param, "IS_CONFIG_PARAMETER", False):
+                continue  # Configs resolve via the CLI, not as parameters
             if name in values:
                 value = param.convert(values[name])
             else:
@@ -213,6 +215,11 @@ class MetaflowTask(object):
         if step_name == "start":
             self._init_parameters(parameters_json)
             flow._graph_meta = graph.output_steps()
+            # persist resolved configs for client inspection + remote tasks
+            for name, cfg_value in getattr(
+                flow.__class__, "_resolved_configs", {}
+            ).items():
+                setattr(flow, "_config_" + name, cfg_value.to_dict())
 
         # `current` singleton
         current._set_env(

@@ -1,0 +1,25 @@
+import os
+
+from metaflow_tpu import FlowSpec, step
+
+
+class ResumableFlow(FlowSpec):
+    @step
+    def start(self):
+        self.x = 41
+        self.next(self.middle)
+
+    @step
+    def middle(self):
+        if os.environ.get("MAKE_IT_FAIL"):
+            raise ValueError("boom")
+        self.y = self.x + 1
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("y =", self.y)
+
+
+if __name__ == "__main__":
+    ResumableFlow()

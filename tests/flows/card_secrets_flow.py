@@ -1,0 +1,30 @@
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.plugins.cards import Markdown, ProgressBar, Table, VegaChart
+
+import metaflow_tpu
+
+
+class CardSecretsFlow(FlowSpec):
+    @metaflow_tpu.card
+    @metaflow_tpu.secrets(sources=['inline:{"MY_SECRET": "s3cr3t"}'])
+    @step
+    def start(self):
+        import os
+
+        self.secret_seen = os.environ.get("MY_SECRET")
+        current.card.append(Markdown("## Training report\n- all good"))
+        current.card.append(Table(data=[["loss", 0.5]], headers=["k", "v"]))
+        current.card.append(ProgressBar(max=10, value=7, label="epochs"))
+        current.card.append(VegaChart.line([0, 1, 2], [3.0, 2.0, 1.5],
+                                           title="loss"))
+        self.x = 42
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.secret_seen == "s3cr3t"
+        print("secret ok; x =", self.x)
+
+
+if __name__ == "__main__":
+    CardSecretsFlow()

@@ -1,0 +1,38 @@
+from metaflow_tpu import Config, FlowMutator, FlowSpec, IncludeFile, step
+
+
+class AddRetries(FlowMutator):
+    """Mutator driven by config: adds @retry to every step."""
+
+    def mutate(self, mutable_flow):
+        cfg = mutable_flow.configs.get("settings")
+        if cfg and cfg.get("retries"):
+            for s in mutable_flow.steps:
+                if not any(d.name == "retry" for d in s.decorators):
+                    s.add_decorator("retry", times=int(cfg.retries),
+                                    minutes_between_retries=0)
+
+
+@AddRetries
+class ConfigFlow(FlowSpec):
+    settings = Config("settings", default_value='{"lr": 0.1, "retries": 2}')
+    notes = IncludeFile("notes", required=False)
+
+    @step
+    def start(self):
+        self.lr = self.settings.lr
+        self.file_content = self.notes
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.lr == 0.1 or self.lr == 0.5, self.lr
+        print("lr:", self.lr)
+        print("notes:", (self.file_content or "").strip())
+        retry_count = len([d for d in self.end.decorators
+                           if d.name == "retry"])
+        print("retry attached:", retry_count)
+
+
+if __name__ == "__main__":
+    ConfigFlow()

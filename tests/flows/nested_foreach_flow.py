@@ -1,0 +1,40 @@
+from metaflow_tpu import FlowSpec, step
+
+
+class NestedForeachFlow(FlowSpec):
+    @step
+    def start(self):
+        self.outer = [10, 20]
+        self.next(self.mid, foreach="outer")
+
+    @step
+    def mid(self):
+        self.base = self.input
+        self.inner = [1, 2, 3]
+        self.next(self.leaf, foreach="inner")
+
+    @step
+    def leaf(self):
+        self.val = self.base + self.input
+        self.stack_depth = len(self.foreach_stack())
+        self.next(self.inner_join)
+
+    @step
+    def inner_join(self, inputs):
+        self.subtotal = sum(inp.val for inp in inputs)
+        self.next(self.outer_join)
+
+    @step
+    def outer_join(self, inputs):
+        self.total = sum(inp.subtotal for inp in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        # (10+1 + 10+2 + 10+3) + (20+1 + 20+2 + 20+3) = 36 + 66 = 102
+        assert self.total == 102, self.total
+        print("total:", self.total)
+
+
+if __name__ == "__main__":
+    NestedForeachFlow()

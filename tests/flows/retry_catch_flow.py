@@ -1,0 +1,46 @@
+import os
+
+from metaflow_tpu import FlowSpec, step, retry, catch
+
+
+class RetryCatchFlow(FlowSpec):
+    @step
+    def start(self):
+        self.attempt_file = os.environ["ATTEMPT_COUNT_FILE"]
+        self.next(self.flaky, self.doomed)
+
+    @retry(times=2, minutes_between_retries=0)
+    @step
+    def flaky(self):
+        # fails on the first attempt, succeeds on retry
+        with open(self.attempt_file, "a") as f:
+            f.write("x")
+        with open(self.attempt_file) as f:
+            attempts = len(f.read())
+        if attempts < 2:
+            raise RuntimeError("flaky failure %d" % attempts)
+        self.flaky_attempts = attempts
+        self.next(self.join)
+
+    @catch(var="failure")
+    @step
+    def doomed(self):
+        raise ValueError("always fails")
+        self.next(self.join)  # noqa: unreachable — @catch re-derives it
+
+    @step
+    def join(self, inputs):
+        self.flaky_attempts = inputs.flaky.flaky_attempts
+        self.failure = inputs.doomed.failure
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.flaky_attempts == 2
+        assert self.failure, "expected a caught failure artifact"
+        assert self.failure.type == "ValueError"
+        print("retry+catch ok:", self.failure.type)
+
+
+if __name__ == "__main__":
+    RetryCatchFlow()

@@ -1,0 +1,38 @@
+from metaflow_tpu import FlowSpec, step, Parameter
+
+
+class SwitchFlow(FlowSpec):
+    """Switch transition + recursion: loop in 'improve' until converged."""
+
+    mode = Parameter("mode", default="fast", type=str)
+
+    @step
+    def start(self):
+        self.rounds = 0
+        self.next({"fast": self.fast_path, "slow": self.slow_path},
+                  condition="mode")
+
+    @step
+    def fast_path(self):
+        self.result = "fast"
+        self.next(self.improve)
+
+    @step
+    def slow_path(self):
+        self.result = "slow"
+        self.next(self.improve)
+
+    @step
+    def improve(self):
+        self.rounds += 1
+        self.converged = "yes" if self.rounds >= 3 else "no"
+        self.next({"yes": self.end, "no": self.improve}, condition="converged")
+
+    @step
+    def end(self):
+        assert self.rounds == 3, self.rounds
+        print("result:", self.result, "rounds:", self.rounds)
+
+
+if __name__ == "__main__":
+    SwitchFlow()

@@ -1,0 +1,34 @@
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, Parameter, step
+
+
+@metaflow_tpu.project(name="demo")
+@metaflow_tpu.schedule(daily=True)
+@metaflow_tpu.trigger(event="new_data")
+class TpuDeployFlow(FlowSpec):
+    lr = Parameter("lr", default=0.001, type=float)
+
+    @step
+    def start(self):
+        self.shards = list(range(4))
+        self.next(self.train_shard, foreach="shards")
+
+    @metaflow_tpu.tpu(topology="v5e-4")
+    @metaflow_tpu.retry(times=2)
+    @step
+    def train_shard(self):
+        self.result = self.input
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.total = sum(i.result for i in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("total:", self.total)
+
+
+if __name__ == "__main__":
+    TpuDeployFlow()

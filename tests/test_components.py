@@ -1,0 +1,214 @@
+"""Component tests: config system, secrets, cards, packaging, argo compiler,
+events, deployer, spin/tag CLIs."""
+
+import json
+import os
+
+import pytest
+
+
+class TestConfigSystem:
+    def test_config_value(self):
+        from metaflow_tpu.config_system import ConfigValue
+
+        cv = ConfigValue({"a": {"b": 2}, "lst": [1, 2]})
+        assert cv.a.b == 2
+        assert cv["lst"] == [1, 2]
+        assert cv.get("missing", 5) == 5
+        with pytest.raises(Exception):
+            cv.a = 1
+
+    def test_resolve_inline_and_file(self, tmp_path):
+        from metaflow_tpu.config_system import Config, resolve_configs
+
+        cfg_file = tmp_path / "c.json"
+        cfg_file.write_text('{"x": 1}')
+
+        class Holder:
+            c1 = Config("c1", default_value='{"y": 2}')
+            c2 = Config("c2")
+
+        resolved = resolve_configs(
+            Holder, config_files={"c2": str(cfg_file)}
+        )
+        assert resolved["c1"].y == 2
+        assert resolved["c2"].x == 1
+
+    def test_toml_parsing(self, tmp_path):
+        from metaflow_tpu.config_system import parse_config_file
+
+        f = tmp_path / "c.toml"
+        f.write_text('[model]\nlr = 0.5\n')
+        assert parse_config_file(str(f))["model"]["lr"] == 0.5
+
+
+class TestSecrets:
+    def test_inline_and_file(self, tmp_path, monkeypatch):
+        from metaflow_tpu.plugins.secrets_decorator import _fetch
+
+        assert _fetch('inline:{"K": "v"}') == {"K": "v"}
+        f = tmp_path / "s.json"
+        f.write_text('{"A": "b"}')
+        assert _fetch("file:%s" % f) == {"A": "b"}
+        monkeypatch.setenv("MYPREFIX_TOKEN", "t0k")
+        got = _fetch("env:MYPREFIX")
+        assert got.get("TOKEN") == "t0k"
+
+    def test_unknown_source(self):
+        from metaflow_tpu.exception import TpuFlowException
+        from metaflow_tpu.plugins.secrets_decorator import _fetch
+
+        with pytest.raises(TpuFlowException):
+            _fetch("vault:whatever")
+
+
+class TestCards:
+    def test_components_render(self):
+        from metaflow_tpu.plugins.cards import (
+            Image, Markdown, ProgressBar, Table, VegaChart, Artifact,
+        )
+        from metaflow_tpu.plugins.cards.components import render_page
+
+        comps = [
+            Markdown("# Title\n- item **bold**"),
+            Table(data=[["a", 1]], headers=["k", "v"]),
+            ProgressBar(max=10, value=5, label="p"),
+            VegaChart.line([0, 1], [1.0, 0.5], title="loss"),
+            Image(src=b"\x89PNG fake", label="img"),
+            Artifact({"x": 1}, name="art"),
+        ]
+        page = render_page("t", "F/1/s/1", comps)
+        assert "<h1>Title</h1>" in page
+        assert "<b>bold</b>" in page
+        assert "<table>" in page
+        assert "vegaEmbed" in page
+        assert "data:image/png;base64" in page
+        # components escape HTML
+        assert "<script>alert" not in Markdown("<script>alert(1)</script>").render()
+
+
+class TestPackage:
+    def test_blob_deterministic_and_complete(self, tmp_path):
+        from metaflow_tpu.package import MetaflowPackage
+
+        (tmp_path / "flow.py").write_text("print('hi')")
+        (tmp_path / "data.bin").write_bytes(b"\x00" * 10)  # skipped suffix
+        p1 = MetaflowPackage(flow_dir=str(tmp_path)).blob()
+        p2 = MetaflowPackage(flow_dir=str(tmp_path)).blob()
+        assert p1 == p2  # deterministic
+
+        import io
+        import tarfile
+
+        with tarfile.open(fileobj=io.BytesIO(p1)) as tar:
+            names = tar.getnames()
+        assert "flow.py" in names
+        assert "INFO" in names
+        assert any(n.startswith("metaflow_tpu/") for n in names)
+        assert "data.bin" not in names
+
+    def test_upload_dedups(self, tmp_path, tpuflow_root):
+        from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+        from metaflow_tpu.package import MetaflowPackage
+
+        (tmp_path / "flow.py").write_text("x = 1")
+        fds = FlowDataStore("PkgFlow", LocalStorage)
+        pkg = MetaflowPackage(flow_dir=str(tmp_path))
+        url1, sha1 = pkg.upload(fds)
+        url2, sha2 = MetaflowPackage(flow_dir=str(tmp_path)).upload(fds)
+        assert sha1 == sha2
+
+
+class TestEvents:
+    def test_publish_and_list(self, tpuflow_root):
+        from metaflow_tpu.events import publish_event, list_events
+
+        publish_event("data_ready", {"rows": 10})
+        events = list_events()
+        assert events[-1]["name"] == "data_ready"
+        assert events[-1]["payload"]["rows"] == 10
+
+    def test_trigger_view(self):
+        from metaflow_tpu.events import Trigger
+
+        t = Trigger([{"name": "e1", "payload": {"a": 1}}])
+        assert t.event.name == "e1"
+        assert bool(t)
+        assert not Trigger([])
+
+
+class TestArgoCompile:
+    def test_manifest_structure(self, run_flow, flows_dir, tpuflow_root):
+        proc = run_flow(
+            os.path.join(flows_dir, "tpu_deploy_flow.py"),
+            "argo-workflows", "create",
+        )
+        docs = proc.stdout
+        assert "kind: WorkflowTemplate" in docs
+        assert "kind: CronWorkflow" in docs
+        assert "kind: Sensor" in docs
+        assert "google.com/tpu" in docs
+        assert "cloud.google.com/gke-tpu-topology" in docs
+        assert "withParam" in docs
+        assert "train-shard" in docs  # template names are DNS-sanitized
+        assert "template: train-shard" in docs
+
+
+class TestDeployerAPI:
+    def test_deployer_compiles(self, flows_dir, tpuflow_root):
+        import sys
+
+        from metaflow_tpu import Deployer
+
+        dep = Deployer(
+            os.path.join(flows_dir, "tpu_deploy_flow.py"),
+            env={
+                "TPUFLOW_DATASTORE_SYSROOT_LOCAL": tpuflow_root,
+                "PYTHONPATH": os.pathsep.join(
+                    [os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))] + sys.path
+                ),
+            },
+        )
+        deployed = dep.argo_workflows().create()
+        assert "WorkflowTemplate" in deployed.manifests
+        assert deployed.name
+
+
+class TestCliExtras:
+    def test_card_and_spin_and_tag(self, run_flow, flows_dir, tpuflow_root):
+        flow = os.path.join(flows_dir, "card_secrets_flow.py")
+        run_flow(flow, "run")
+        run_id = open(
+            os.path.join(tpuflow_root, "CardSecretsFlow", "latest_run")
+        ).read()
+        # card
+        proc = run_flow(flow, "card", "get", "%s/start/1" % run_id)
+        assert "Training report" in proc.stdout
+        proc = run_flow(flow, "card", "list", "%s/start/1" % run_id)
+        assert "default.html" in proc.stdout
+        # spin
+        proc = run_flow(flow, "spin", "start")
+        assert "Spin task done" in proc.stdout
+        # spin must not change latest_run
+        assert open(
+            os.path.join(tpuflow_root, "CardSecretsFlow", "latest_run")
+        ).read() == run_id
+        # tag
+        proc = run_flow(flow, "tag", "add", "--run-id", run_id, "exp:1")
+        assert "exp:1" in proc.stdout
+        proc = run_flow(flow, "tag", "list", "--run-id", run_id)
+        assert "exp:1" in proc.stdout
+        proc = run_flow(flow, "tag", "remove", "--run-id", run_id, "exp:1")
+        assert "exp:1" not in proc.stdout
+
+    def test_config_flow(self, run_flow, flows_dir, tpuflow_root, tmp_path):
+        flow = os.path.join(flows_dir, "config_flow.py")
+        notes = tmp_path / "notes.txt"
+        notes.write_text("note content")
+        proc = run_flow(flow, "run", "--notes", str(notes))
+        assert "retry attached: 1" in proc.stdout
+        proc = run_flow(flow, "--config-value", "settings", '{"lr": 0.5}',
+                        "run")
+        assert "lr: 0.5" in proc.stdout
+        assert "retry attached: 0" in proc.stdout

@@ -51,6 +51,25 @@ def test_duplicate_axis_dropped():
     assert spec == P("fsdp", None)
 
 
+@pytest.mark.parametrize("num_microbatches", [4, 8])
+def test_pipeline_matches_sequential(num_microbatches):
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from metaflow_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = create_mesh(MeshSpec({"pipeline": 4}), n_devices=4)
+    Ws = jax.random.normal(jax.random.PRNGKey(0), (8, 16, 16)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    layer = lambda h, W: jnp.tanh(h @ W)
+    ref = x
+    for i in range(8):
+        ref = layer(ref, Ws[i])
+    Ws_sharded = jax.device_put(Ws, NamedSharding(mesh, P("pipeline")))
+    out = pipeline_apply(layer, Ws_sharded, x, mesh,
+                         num_microbatches=num_microbatches)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
 def test_tree_shardings_places_params():
     mesh = create_mesh(MeshSpec.fsdp())
     log = {"w": ("embed", "mlp"), "b": ("embed",)}
