@@ -1,5 +1,6 @@
+from . import dit
 from . import llama
 from . import mixtral
 from . import resnet
 
-__all__ = ["llama", "mixtral", "resnet"]
+__all__ = ["dit", "llama", "mixtral", "resnet"]

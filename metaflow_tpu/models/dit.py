@@ -1,0 +1,212 @@
+"""Diffusion transformer (DiT / SD3-style MM-DiT lite) for sharded batch
+inference (BASELINE config: "Stable-Diffusion-3 batch inference over v5e-256
+via unbounded foreach").
+
+A rectified-flow latent diffusion model: patchified latents + timestep/class
+conditioning through adaLN-zero transformer blocks. Same pure-pytree +
+logical-axes design as the other model families; `sample()` runs the Euler
+sampler under jit with static step count.
+"""
+
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    dim: int = 1152
+    n_layers: int = 28
+    n_heads: int = 16
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+    attention_impl: str = "auto"
+
+    @property
+    def num_patches(self):
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self):
+        return self.patch_size * self.patch_size * self.in_channels
+
+    @staticmethod
+    def dit_xl(**kw):
+        return replace(DiTConfig(), **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        return replace(
+            DiTConfig(input_size=8, patch_size=2, in_channels=4, dim=64,
+                      n_layers=2, n_heads=4, num_classes=10,
+                      dtype="float32"),
+            **kw,
+        )
+
+
+def init_params(rng, cfg):
+    dt = jnp.dtype(cfg.dtype)
+    keys = jax.random.split(rng, 12)
+
+    def dense(key, fan_in, *shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    L, D = cfg.n_layers, cfg.dim
+    return {
+        "patch_embed": dense(keys[0], cfg.patch_dim, cfg.patch_dim, D),
+        "pos_embed": (jax.random.normal(keys[1], (cfg.num_patches, D),
+                                        jnp.float32) * 0.02).astype(dt),
+        "time_mlp1": dense(keys[2], 256, 256, D),
+        "time_mlp2": dense(keys[3], D, D, D),
+        "label_embed": dense(keys[4], D, cfg.num_classes + 1, D),
+        "layers": {
+            "qkv": dense(keys[5], D, L, D, 3 * D),
+            "proj": dense(keys[6], D, L, D, D),
+            "mlp1": dense(keys[7], D, L, D, 4 * D),
+            "mlp2": dense(keys[8], 4 * D, L, 4 * D, D),
+            # adaLN-zero modulation: 6 params per block, zero-init
+            "ada": jnp.zeros((L, D, 6 * D), dt),
+        },
+        "final_ada": jnp.zeros((D, 2 * D), dt),
+        "final_proj": jnp.zeros((D, cfg.patch_dim), dt),
+    }
+
+
+def logical_axes(cfg):
+    return {
+        "patch_embed": (None, "embed"),
+        "pos_embed": ("seq", "embed"),
+        "time_mlp1": (None, "embed"),
+        "time_mlp2": ("embed", "embed"),
+        "label_embed": ("vocab", "embed"),
+        "layers": {
+            "qkv": ("layers", "embed", "heads"),
+            "proj": ("layers", "heads", "embed"),
+            "mlp1": ("layers", "embed", "mlp"),
+            "mlp2": ("layers", "mlp", "embed"),
+            "ada": ("layers", "embed", None),
+        },
+        "final_ada": ("embed", None),
+        "final_proj": ("embed", None),
+    }
+
+
+def _timestep_embedding(t, dim=256):
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(10_000.0) * jnp.arange(half) / half)
+    args = t[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _patchify(x, cfg):
+    B, H, W, C = x.shape
+    p = cfg.patch_size
+    x = x.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, (H // p) * (W // p),
+                                              p * p * C)
+    return x
+
+
+def _unpatchify(x, cfg):
+    B, N, _ = x.shape
+    p = cfg.patch_size
+    g = cfg.input_size // p
+    x = x.reshape(B, g, g, p, p, cfg.in_channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, cfg.input_size,
+                                              cfg.input_size,
+                                              cfg.in_channels)
+    return x
+
+
+def _block(cfg, x, cond, lp):
+    B, N, D = x.shape
+    H = cfg.n_heads
+    mod = cond @ lp["ada"]  # [B, 6D]
+    s1, b1, g1, s2, b2, g2 = jnp.split(mod, 6, axis=-1)
+    ones = jnp.ones((D,), x.dtype)
+
+    h = layer_norm(x, ones, None) * (1 + s1[:, None]) + b1[:, None]
+    qkv = (h @ lp["qkv"]).reshape(B, N, 3, H, D // H)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = attention(q, k, v, causal=False, impl=cfg.attention_impl)
+    x = x + g1[:, None] * (attn.reshape(B, N, D) @ lp["proj"])
+
+    h = layer_norm(x, ones, None) * (1 + s2[:, None]) + b2[:, None]
+    h = jax.nn.gelu(h @ lp["mlp1"]) @ lp["mlp2"]
+    return x + g2[:, None] * h
+
+
+def forward(params, latents, t, labels, cfg):
+    """Predict the velocity field. latents: [B, H, W, C]; t: [B] in [0, 1];
+    labels: [B] ints (num_classes = unconditional)."""
+    dt_ = jnp.dtype(cfg.dtype)
+    x = _patchify(latents.astype(dt_), cfg)
+    x = x @ params["patch_embed"] + params["pos_embed"][None]
+
+    temb = _timestep_embedding(t * 1000.0).astype(dt_)
+    cond = jax.nn.silu(temb @ params["time_mlp1"]) @ params["time_mlp2"]
+    cond = cond + params["label_embed"][labels]
+    cond = jax.nn.silu(cond)
+
+    def layer_fn(h, lp):
+        return _block(cfg, h, cond, lp), None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+
+    s, b = jnp.split(cond @ params["final_ada"], 2, axis=-1)
+    ones = jnp.ones((cfg.dim,), x.dtype)
+    x = layer_norm(x, ones, None) * (1 + s[:, None]) + b[:, None]
+    x = x @ params["final_proj"]
+    return _unpatchify(x, cfg).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg):
+    """Rectified-flow matching loss: x_t = (1-t)·noise + t·data,
+    target velocity = data - noise.
+
+    Thread fresh randomness per step: pass batch['rng'] (a PRNG key) or a
+    changing batch['seed'] — otherwise every step reuses one noise draw."""
+    data = batch["latents"]
+    labels = batch["labels"]
+    rng = batch.get("rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(batch.get("seed", 0))
+    k_noise, k_t = jax.random.split(rng)
+    noise = jax.random.normal(k_noise, data.shape, jnp.float32)
+    t = jax.random.uniform(k_t, (data.shape[0],))
+    x_t = (1 - t[:, None, None, None]) * noise + t[:, None, None, None] * data
+    v_pred = forward(params, x_t, t, labels, cfg)
+    v_target = data - noise
+    return jnp.mean((v_pred - v_target) ** 2)
+
+
+def sample(params, rng, labels, cfg, num_steps=20, guidance_scale=1.0):
+    """Euler sampler along the rectified flow, optionally with
+    classifier-free guidance. Returns [B, H, W, C] latents."""
+    B = labels.shape[0]
+    x = jax.random.normal(rng, (B, cfg.input_size, cfg.input_size,
+                                cfg.in_channels), jnp.float32)
+    uncond = jnp.full((B,), cfg.num_classes, jnp.int32)
+    dt_step = 1.0 / num_steps
+
+    def step(i, x):
+        t = jnp.full((B,), i * dt_step)
+        v = forward(params, x, t, labels, cfg)
+        if guidance_scale != 1.0:
+            v_u = forward(params, x, t, uncond, cfg)
+            v = v_u + guidance_scale * (v - v_u)
+        return x + dt_step * v
+
+    return jax.lax.fori_loop(0, num_steps, step, x)
+
+
+def num_params(params):
+    return sum(int(x.size) for x in jax.tree.leaves(params))

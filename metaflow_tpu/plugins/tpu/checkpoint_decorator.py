@@ -105,15 +105,25 @@ class CheckpointDecorator(StepDecorator):
                       ubf_context, inputs):
         ds_root = task_datastore._flow_datastore.ds_root
         flow_name = task_datastore._flow_datastore.flow_name
-        # attempt-independent scope: retries of the same task share it
+        # scope = step + foreach-index path (NOT task id): retries share it,
+        # and `resume` finds the origin run's checkpoints even though the
+        # re-executed task gets a fresh task id
+        # exclude gang frames (var == _parallel_ubf_iter): every rank of a
+        # gang must share ONE checkpoint root so orbax's multihost save
+        # assembles all shards into the same checkpoint
+        stack = [
+            frame for frame in (getattr(flow, "_foreach_stack", None) or [])
+            if frame[0] != "_parallel_ubf_iter"
+        ]
+        scope = "-".join(str(int(frame[1])) for frame in stack) or "root"
         root = _join(ds_root, flow_name, "checkpoints", str(run_id), step_name,
-                     str(task_id))
+                     scope)
         origin_root = None
         origin_run = current.origin_run_id
         if self.attributes.get("load_origin", True) and origin_run:
             origin_root = _join(
                 ds_root, flow_name, "checkpoints", str(origin_run), step_name,
-                str(task_id),
+                scope,
             )
         current._update_env({"checkpoint": Checkpointer(root, origin_root)})
 

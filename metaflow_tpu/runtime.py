@@ -43,6 +43,7 @@ class _Task(object):
         "input_paths",
         "split_index",
         "ctx",
+        "branch",
         "ubf_context",
         "num_parallel",
         "attempt",
@@ -53,12 +54,14 @@ class _Task(object):
     )
 
     def __init__(self, step, task_id, input_paths, split_index=None, ctx=(),
-                 ubf_context=None, num_parallel=0):
+                 branch=(), ubf_context=None, num_parallel=0):
         self.step = step
         self.task_id = str(task_id)
         self.input_paths = input_paths
         self.split_index = split_index
         self.ctx = tuple(ctx)  # tuple of (split_pathspec, expected, kind)
+        # branch index per ctx frame: orders arrivals at the matching join
+        self.branch = tuple(branch)
         self.ubf_context = ubf_context
         self.num_parallel = num_parallel
         self.attempt = 0
@@ -452,6 +455,9 @@ class NativeRuntime(object):
                     [my_pathspec],
                     split_index=0,
                     ctx=ctx,
+                    # mirror the ctx push so the pop at the gang join keeps
+                    # any OUTER split's branch index intact
+                    branch=task.branch + (0,),
                     ubf_context=UBF_CONTROL,
                     num_parallel=int(num_splits or 0),
                 )
@@ -472,15 +478,17 @@ class NativeRuntime(object):
                         [my_pathspec],
                         split_index=i,
                         ctx=ctx,
+                        branch=task.branch + (i,),
                     )
                 )
             return
 
         if node.type == "split":
             ctx = task.ctx + ((my_pathspec, len(funcs), "split"),)
-            for child in funcs:
+            for i, child in enumerate(funcs):
                 self._queue_task(
-                    _Task(child, self._new_task_id(), [my_pathspec], ctx=ctx)
+                    _Task(child, self._new_task_id(), [my_pathspec], ctx=ctx,
+                          branch=task.branch + (i,))
                 )
             return
 
@@ -492,7 +500,7 @@ class NativeRuntime(object):
             else:
                 self._queue_task(
                     _Task(child, self._new_task_id(), [my_pathspec],
-                          ctx=task.ctx)
+                          ctx=task.ctx, branch=task.branch)
                 )
 
     def _arrive_at_join(self, join_step, task, ds):
@@ -512,6 +520,7 @@ class NativeRuntime(object):
                     self._new_task_id(),
                     list(mapper_tasks),
                     ctx=task.ctx[:-1],
+                    branch=task.branch[:-1] if task.branch else (),
                 )
             )
             return
@@ -519,6 +528,9 @@ class NativeRuntime(object):
         arrivals = self._join_arrivals.setdefault(key, [])
         arrivals.append(task)
         if len(arrivals) == expected:
+            # order join inputs by branch index (foreach split order /
+            # static-split declaration order), not completion order
+            arrivals.sort(key=lambda t: t.branch[-1] if t.branch else 0)
             input_paths = [self._pathspec(t) for t in arrivals]
             self._queue_task(
                 _Task(
@@ -526,6 +538,7 @@ class NativeRuntime(object):
                     self._new_task_id(),
                     input_paths,
                     ctx=task.ctx[:-1],
+                    branch=task.branch[:-1] if task.branch else (),
                 )
             )
             del self._join_arrivals[key]

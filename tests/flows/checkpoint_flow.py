@@ -1,0 +1,54 @@
+"""@checkpoint semantics: a task crash mid-training resumes from the last
+orbax checkpoint on retry (attempt-independent scope), and `resume` of a
+failed run can read the origin run's checkpoints (SURVEY.md §5.4 made
+first-class)."""
+
+import os
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+
+
+class CheckpointFlow(FlowSpec):
+    @step
+    def start(self):
+        self.total_steps = 6
+        self.next(self.train)
+
+    @metaflow_tpu.retry(times=2, minutes_between_retries=0)
+    @metaflow_tpu.checkpoint
+    @step
+    def train(self):
+        import jax.numpy as jnp
+
+        ckpt = current.checkpoint
+        start_step = 0
+        restored = ckpt.load()
+        if restored is not None:
+            start_step = int(restored["step"]) + 1
+            w = jnp.asarray(restored["w"])
+        else:
+            w = jnp.zeros((4,))
+
+        self.resumed_from = start_step
+        for i in range(start_step, self.total_steps):
+            w = w + 1.0
+            ckpt.save({"w": w, "step": i}, step=i)
+            # crash mid-training on the first attempt
+            if i == 2 and current.retry_count == 0 and not os.environ.get(
+                "NO_CRASH"
+            ):
+                raise RuntimeError("simulated preemption at step %d" % i)
+        self.w_sum = float(w.sum())
+        self.next(self.end)
+
+    @step
+    def end(self):
+        # 6 increments of a 4-vector → 24, NOT restarted from zero
+        assert self.w_sum == 24.0, self.w_sum
+        assert self.resumed_from == 3, self.resumed_from
+        print("checkpoint resume ok: resumed from step", self.resumed_from)
+
+
+if __name__ == "__main__":
+    CheckpointFlow()

@@ -138,6 +138,70 @@ def test_dump_and_logs_cli(run_flow, flows_dir, tpuflow_root):
     assert "final x: 10" in proc.stdout
 
 
+def test_gang_jax_distributed_training(run_flow, flows_dir, tpuflow_root):
+    """North-star: num_parallel gang trains a sharded Llama with
+    jax.distributed across rank processes (BASELINE @parallel FSDP path)."""
+    proc = run_flow(os.path.join(flows_dir, "train_gang_flow.py"), "run")
+    assert "gang training ok" in proc.stdout
+    c = _client(tpuflow_root)
+    run = c.Flow("TrainGangFlow").latest_run
+    assert run.data.final_loss < run.data.first_loss
+
+
+def test_checkpoint_retry_resume(run_flow, flows_dir, tpuflow_root):
+    proc = run_flow(os.path.join(flows_dir, "checkpoint_flow.py"), "run")
+    assert "resumed from step 3" in proc.stdout
+
+
+def test_checkpoint_across_run_resume(run_flow, flows_dir, tpuflow_root,
+                                      tmp_path):
+    """`resume` of a crashed run loads the ORIGIN run's checkpoints even
+    though the re-executed task gets a fresh task id."""
+    src = """
+import os
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+
+class CkptResumeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train)
+
+    @metaflow_tpu.checkpoint
+    @step
+    def train(self):
+        import jax.numpy as jnp
+        ckpt = current.checkpoint
+        restored = ckpt.load()
+        start = int(restored["step"]) + 1 if restored else 0
+        self.resumed_from = start
+        for i in range(start, 4):
+            ckpt.save({"w": jnp.full((2,), float(i)), "step": i}, step=i)
+            if i == 1 and os.environ.get("CRASH"):
+                raise RuntimeError("die")
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("resumed_from:", self.resumed_from)
+
+if __name__ == "__main__":
+    CkptResumeFlow()
+"""
+    flow_file = str(tmp_path / "ckpt_resume_flow.py")
+    with open(flow_file, "w") as f:
+        f.write(src)
+    run_flow(flow_file, "run", expect_fail=True, env_extra={"CRASH": "1"})
+    proc = run_flow(flow_file, "resume")
+    assert "resumed_from: 2" in proc.stdout
+
+
+def test_sharded_batch_inference(run_flow, flows_dir, tpuflow_root):
+    """Foreach join inputs arrive ordered by split index."""
+    proc = run_flow(os.path.join(flows_dir, "batch_inference_flow.py"), "run")
+    assert "batch inference ok" in proc.stdout
+
+
 def test_namespace_filtering(run_flow, flows_dir, tpuflow_root):
     run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
     c = _client(tpuflow_root)

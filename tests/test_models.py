@@ -46,6 +46,40 @@ class TestMixtral:
         assert losses[-1] < losses[0], losses
 
 
+class TestDiT:
+    def test_forward_and_loss(self):
+        from metaflow_tpu.models import dit
+
+        cfg = dit.DiTConfig.tiny()
+        params = dit.init_params(jax.random.PRNGKey(0), cfg)
+        lat = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 4))
+        labels = jnp.array([1, 2])
+        v = dit.forward(params, lat, jnp.array([0.3, 0.7]), labels, cfg)
+        assert v.shape == (2, 8, 8, 4)
+        loss = dit.loss_fn(params, {"latents": lat, "labels": labels}, cfg)
+        assert float(loss) > 0
+
+    def test_sample_finite_and_guided(self):
+        from metaflow_tpu.models import dit
+
+        cfg = dit.DiTConfig.tiny()
+        params = dit.init_params(jax.random.PRNGKey(0), cfg)
+        labels = jnp.array([0, 3])
+        out = dit.sample(params, jax.random.PRNGKey(2), labels, cfg,
+                         num_steps=4, guidance_scale=2.0)
+        assert out.shape == (2, 8, 8, 4)
+        assert bool(jnp.isfinite(out).all())
+
+    def test_patchify_roundtrip(self):
+        from metaflow_tpu.models import dit
+
+        cfg = dit.DiTConfig.tiny()
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
+        np.testing.assert_allclose(
+            dit._unpatchify(dit._patchify(x, cfg), cfg), x
+        )
+
+
 class TestResNet:
     def test_forward(self):
         cfg = resnet.ResNetConfig.tiny()
