@@ -42,12 +42,23 @@ def get_namespace():
 
 
 def _metadata_provider():
+    kind = os.environ.get("TPUFLOW_DEFAULT_METADATA", "local")
+    if kind == "service":
+        from ..metadata import ServiceMetadataProvider
+
+        return ServiceMetadataProvider()
     return LocalMetadataProvider()
 
 
 def _flow_datastore(flow_name):
     ds_type = os.environ.get("TPUFLOW_DEFAULT_DATASTORE", "local")
-    return FlowDataStore(flow_name, STORAGE_BACKENDS[ds_type])
+    fds = FlowDataStore(flow_name, STORAGE_BACKENDS[ds_type])
+    if ds_type != "local":
+        # remote reads go through the on-disk LRU blob cache
+        from .filecache import FileCache
+
+        fds.ca_store.set_blob_cache(FileCache())
+    return fds
 
 
 class MetaflowObject(object):

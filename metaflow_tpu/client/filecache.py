@@ -1,0 +1,85 @@
+"""Client-side on-disk blob cache (LRU by atime, size-capped).
+
+Reference behavior: metaflow/client/filecache.py:44 — artifacts fetched from
+remote storage are cached locally keyed by content hash; content addressing
+makes entries immutable so invalidation is just eviction.
+"""
+
+import os
+import tempfile
+
+
+class FileCache(object):
+    """Plugs into ContentAddressedStore.set_blob_cache."""
+
+    def __init__(self, cache_dir=None, max_size=4 << 30):
+        self._dir = cache_dir or os.environ.get(
+            "TPUFLOW_CLIENT_CACHE",
+            os.path.join(tempfile.gettempdir(), "tpuflow_cache"),
+        )
+        self._max_size = max_size
+        self._approx_total = None  # lazily initialized running size counter
+        os.makedirs(self._dir, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self._dir, key[:2], key)
+
+    def load_key(self, key):
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            os.utime(path)  # LRU touch
+            return data
+        except OSError:
+            return None
+
+    def store_key(self, key, blob):
+        path = self._path(key)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            return
+        if self._approx_total is None:
+            self._approx_total = self._scan_total()
+        else:
+            self._approx_total += len(blob)
+        if self._approx_total > self._max_size:
+            self._evict()
+
+    def _scan_total(self):
+        total = 0
+        for dirpath, _dirs, files in os.walk(self._dir):
+            for name in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, name))
+                except OSError:
+                    continue
+        return total
+
+    def _evict(self):
+        entries = []
+        total = 0
+        for dirpath, _dirs, files in os.walk(self._dir):
+            for name in files:
+                full = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(full)
+                except OSError:
+                    continue
+                entries.append((st.st_atime, st.st_size, full))
+                total += st.st_size
+        entries.sort()  # oldest atime first
+        for _atime, size, full in entries:
+            if total <= self._max_size:
+                break
+            try:
+                os.unlink(full)
+            except OSError:
+                continue
+            total -= size
+        self._approx_total = total

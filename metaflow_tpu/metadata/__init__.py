@@ -1,6 +1,17 @@
 from .metadata import MetaDatum, MetadataProvider
 from .local import LocalMetadataProvider
+from .service import ServiceMetadataProvider, MetadataService
 
-METADATA_PROVIDERS = {"local": LocalMetadataProvider}
+METADATA_PROVIDERS = {
+    "local": LocalMetadataProvider,
+    "service": ServiceMetadataProvider,
+}
 
-__all__ = ["MetaDatum", "MetadataProvider", "LocalMetadataProvider", "METADATA_PROVIDERS"]
+__all__ = [
+    "MetaDatum",
+    "MetadataProvider",
+    "LocalMetadataProvider",
+    "ServiceMetadataProvider",
+    "MetadataService",
+    "METADATA_PROVIDERS",
+]

@@ -1,0 +1,324 @@
+"""REST metadata provider + a minimal reference service implementation.
+
+Reference behavior: metaflow/plugins/metadata_providers/service.py:36 — a
+REST client (retrying requests, version negotiation, heartbeats) against the
+Metaflow metadata service API shape (/flows/<f>/runs/<r>/steps/<s>/tasks/...).
+Keeping the same REST shape means an existing Metaflow UI/metadata deployment
+can front this framework.
+
+`MetadataService` is a self-contained reference server (stdlib http.server +
+the local JSON layout) used by tests and small deployments.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ..exception import TpuFlowException
+from .local import LocalMetadataProvider
+from .metadata import MetadataProvider, timestamp_millis
+
+
+class ServiceException(TpuFlowException):
+    headline = "Metadata service error"
+
+
+class ServiceMetadataProvider(MetadataProvider):
+    TYPE = "service"
+
+    def __init__(self, environment=None, flow=None, event_logger=None,
+                 monitor=None, url=None):
+        super().__init__(environment, flow, event_logger, monitor)
+        import os
+
+        self._url = (url or os.environ.get("TPUFLOW_SERVICE_URL", "")
+                     ).rstrip("/")
+        if not self._url:
+            raise ServiceException(
+                "Metadata service URL not configured: set TPUFLOW_SERVICE_URL"
+            )
+        self._sticky_tags = set()
+        self._sticky_sys_tags = set()
+
+    def add_sticky_tags(self, tags=None, sys_tags=None):
+        self._sticky_tags.update(tags or [])
+        self._sticky_sys_tags.update(sys_tags or [])
+
+    # ---- HTTP with retry/backoff (reference: service.py _request:467) ----
+
+    def _request(self, method, path, body=None, retries=4):
+        url = self._url + path
+        data = json.dumps(body).encode("utf-8") if body is not None else None
+        last_err = None
+        for attempt in range(retries):
+            try:
+                req = urllib.request.Request(
+                    url, data=data, method=method,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    payload = resp.read()
+                    return json.loads(payload) if payload else None
+            except urllib.error.HTTPError as ex:
+                if ex.code in (409,):  # already exists: idempotent registers
+                    return None
+                last_err = ex
+                if ex.code < 500:
+                    break
+            except (urllib.error.URLError, OSError) as ex:
+                last_err = ex
+            time.sleep(0.2 * (2 ** attempt))
+        raise ServiceException("%s %s failed: %s" % (method, path, last_err))
+
+    def version(self):
+        info = self._request("GET", "/ping")
+        return (info or {}).get("version", "unknown")
+
+    # ---- write side ----
+
+    def new_run_id(self, tags=None, sys_tags=None):
+        out = self._request(
+            "POST", "/flows/%s/run" % self.flow_name,
+            {
+                "tags": sorted(set(tags or []) | self._sticky_tags),
+                "system_tags": sorted(
+                    set(sys_tags or []) | self._sticky_sys_tags
+                ),
+            },
+        )
+        if not out or "run_number" not in out:
+            raise ServiceException(
+                "Metadata service returned no run id (response: %r)" % out
+            )
+        return str(out["run_number"])
+
+    def register_run_id(self, run_id, tags=None, sys_tags=None):
+        self._request(
+            "POST", "/flows/%s/runs/%s" % (self.flow_name, run_id),
+            {
+                "tags": sorted(set(tags or []) | self._sticky_tags),
+                "system_tags": sorted(
+                    set(sys_tags or []) | self._sticky_sys_tags
+                ),
+            },
+        )
+        return True
+
+    def new_task_id(self, run_id, step_name, tags=None, sys_tags=None):
+        out = self._request(
+            "POST",
+            "/flows/%s/runs/%s/steps/%s/task" % (self.flow_name, run_id,
+                                                 step_name),
+            {"tags": sorted(tags or [])},
+        )
+        if not out or "task_id" not in out:
+            raise ServiceException(
+                "Metadata service returned no task id (response: %r)" % out
+            )
+        return str(out["task_id"])
+
+    def register_task_id(self, run_id, step_name, task_id, attempt=0,
+                         tags=None, sys_tags=None):
+        self._request(
+            "POST",
+            "/flows/%s/runs/%s/steps/%s/tasks/%s"
+            % (self.flow_name, run_id, step_name, task_id),
+            {"attempt": attempt, "tags": sorted(tags or [])},
+        )
+
+    def register_metadata(self, run_id, step_name, task_id, metadata):
+        records = [
+            {
+                "field_name": m.field,
+                "value": m.value,
+                "type": m.type,
+                "tags": list(m.tags or []),
+            }
+            for m in metadata
+        ]
+        self._request(
+            "POST",
+            "/flows/%s/runs/%s/steps/%s/tasks/%s/metadata"
+            % (self.flow_name, run_id, step_name, task_id),
+            records,
+        )
+
+    # ---- heartbeats ----
+
+    def start_run_heartbeat(self, flow_id, run_id):
+        self._hb_path = "/flows/%s/runs/%s/heartbeat" % (flow_id, run_id)
+        self.heartbeat()
+
+    def start_task_heartbeat(self, flow_id, run_id, step_id, task_id):
+        self._hb_path = (
+            "/flows/%s/runs/%s/steps/%s/tasks/%s/heartbeat"
+            % (flow_id, run_id, step_id, task_id)
+        )
+        self.heartbeat()
+
+    def heartbeat(self):
+        try:
+            self._request("POST", getattr(self, "_hb_path", "/ping"), {})
+        except ServiceException:
+            pass
+
+    # ---- read side ----
+
+    def get_run_info(self, flow_name, run_id):
+        try:
+            return self._request(
+                "GET", "/flows/%s/runs/%s" % (flow_name, run_id)
+            )
+        except ServiceException:
+            return None
+
+    def list_runs(self, flow_name):
+        return self._request("GET", "/flows/%s/runs" % flow_name) or []
+
+    def get_task_metadata(self, flow_name, run_id, step_name, task_id):
+        return self._request(
+            "GET",
+            "/flows/%s/runs/%s/steps/%s/tasks/%s/metadata"
+            % (flow_name, run_id, step_name, task_id),
+        ) or []
+
+    def mutate_run_tags(self, flow_name, run_id, add=None, remove=None):
+        return self._request(
+            "PATCH", "/flows/%s/runs/%s/tags" % (flow_name, run_id),
+            {"add": sorted(add or []), "remove": sorted(remove or [])},
+        )
+
+
+class MetadataService(object):
+    """Minimal reference metadata service: the REST shape above over the
+    local JSON provider's on-disk layout. Run in-process for tests or via
+    `python -m metaflow_tpu.metadata.service <root> <port>`."""
+
+    def __init__(self, root, host="127.0.0.1", port=0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, obj, code=200):
+                payload = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return None
+                return json.loads(self.rfile.read(length))
+
+            def do_GET(self):
+                self._send(*service.handle("GET", self.path, None))
+
+            def do_POST(self):
+                self._send(*service.handle("POST", self.path, self._body()))
+
+            def do_PATCH(self):
+                self._send(*service.handle("PATCH", self.path, self._body()))
+
+        self._root = root
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.url = "http://%s:%d" % (host, self.port)
+        self._thread = None
+
+    def _provider(self, flow_name):
+        class _Flow:
+            name = flow_name
+
+        return LocalMetadataProvider(flow=_Flow(), root=self._root)
+
+    def handle(self, method, path, body):
+        parts = [p for p in path.split("/") if p]
+        try:
+            if parts == ["ping"]:
+                return {"version": "tpuflow-service/1"}, 200
+            if parts[0] != "flows":
+                return {"error": "not found"}, 404
+            flow = parts[1]
+            p = self._provider(flow)
+            rest = parts[2:]
+            if rest == ["run"] and method == "POST":
+                run_id = p.new_run_id(tags=(body or {}).get("tags"),
+                                      sys_tags=(body or {}).get("system_tags"))
+                return {"run_number": run_id}, 200
+            if rest == ["runs"] and method == "GET":
+                return p.list_runs(flow), 200
+            if len(rest) == 2 and rest[0] == "runs":
+                run_id = rest[1]
+                if method == "POST":
+                    p.register_run_id(run_id, (body or {}).get("tags"),
+                                      (body or {}).get("system_tags"))
+                    return {}, 200
+                info = p.get_run_info(flow, run_id)
+                return (info, 200) if info else ({"error": "no run"}, 404)
+            if len(rest) == 3 and rest[0] == "runs" and rest[2] == "tags":
+                info = p.mutate_run_tags(flow, rest[1],
+                                         add=(body or {}).get("add"),
+                                         remove=(body or {}).get("remove"))
+                return (info, 200) if info else ({"error": "no run"}, 404)
+            if len(rest) == 3 and rest[2] == "heartbeat":
+                p.start_run_heartbeat(flow, rest[1])
+                return {}, 200
+            if len(rest) >= 5 and rest[0] == "runs" and rest[2] == "steps":
+                run_id, step = rest[1], rest[3]
+                if rest[4] == "task" and method == "POST":
+                    task_id = p.new_task_id(run_id, step)
+                    return {"task_id": task_id}, 200
+                if rest[4] == "tasks" and len(rest) >= 6:
+                    task_id = rest[5]
+                    tail = rest[6:]
+                    if not tail and method == "POST":
+                        p.register_task_id(run_id, step, task_id,
+                                           (body or {}).get("attempt", 0))
+                        return {}, 200
+                    if tail == ["metadata"]:
+                        if method == "POST":
+                            from .metadata import MetaDatum
+
+                            p.register_metadata(
+                                run_id, step, task_id,
+                                [MetaDatum(r["field_name"], r["value"],
+                                           r["type"], r.get("tags"))
+                                 for r in (body or [])],
+                            )
+                            return {}, 200
+                        return p.get_task_metadata(flow, run_id, step,
+                                                   task_id), 200
+                    if tail == ["heartbeat"]:
+                        p.start_task_heartbeat(flow, run_id, step, task_id)
+                        return {}, 200
+            return {"error": "not found"}, 404
+        except Exception as ex:  # robust server: surface as 500
+            return {"error": str(ex)}, 500
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.url
+
+    def stop(self):
+        self._server.shutdown()
+
+
+if __name__ == "__main__":
+    import sys
+
+    root = sys.argv[1] if len(sys.argv) > 1 else ".tpuflow"
+    port = int(sys.argv[2]) if len(sys.argv) > 2 else 8080
+    svc = MetadataService(root, port=port)
+    print("metadata service on %s (root=%s)" % (svc.start(), root))
+    svc._thread.join()

@@ -35,6 +35,9 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     attention_impl: str = "auto"
     remat: bool = True
+    # remat policy: None = recompute everything; "dots" = save matmul
+    # outputs (less recompute, more memory)
+    remat_policy: str = None
 
     @property
     def head_dim(self):
@@ -175,7 +178,10 @@ def forward(params, tokens, cfg):
 
     layer_fn = lambda x, lp: (_layer(cfg, cos, sin, x, lp), None)
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)

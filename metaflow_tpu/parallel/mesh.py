@@ -110,6 +110,63 @@ def create_mesh(spec=None, devices=None, n_devices=None):
     return Mesh(dev_array, names)
 
 
+def create_hybrid_mesh(ici_spec, dcn_axis="data", num_slices=None,
+                       devices=None):
+    """Multi-slice mesh: `dcn_axis` spans TPU slices over DCN, every other
+    axis stays inside a slice on ICI (SURVEY.md §5.8 — model-parallel
+    collectives must ride ICI; only the data/fsdp gradient reduction
+    crosses slices).
+
+    ici_spec: MeshSpec for the per-slice axes. num_slices defaults to the
+    distinct `slice_index` values of the attached devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if num_slices is None:
+        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices <= 1:
+        return create_mesh(ici_spec, devices=devices)
+    if len(devices) % num_slices:
+        raise ValueError(
+            "%d devices not divisible into %d slices"
+            % (len(devices), num_slices)
+        )
+    per_slice = len(devices) // num_slices
+
+    # group by slice (fall back to even contiguous partition when the
+    # backend does not expose slice_index, e.g. the virtual CPU mesh)
+    by_slice = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", None), []).append(d)
+    if len(by_slice) == num_slices:
+        groups = [v for _k, v in sorted(by_slice.items(),
+                                        key=lambda kv: str(kv[0]))]
+    else:
+        groups = [
+            devices[i * per_slice:(i + 1) * per_slice]
+            for i in range(num_slices)
+        ]
+
+    if isinstance(ici_spec, dict):
+        ici_spec = MeshSpec(ici_spec)
+    ici_sizes = {
+        k: v for k, v in ici_spec.resolved(per_slice).items()
+        if k != dcn_axis
+    }
+    if int(np.prod(list(ici_sizes.values()) or [1])) != per_slice:
+        raise ValueError(
+            "ICI axes %s do not cover the %d per-slice devices"
+            % (ici_sizes, per_slice)
+        )
+    names = (dcn_axis,) + tuple(ici_sizes)
+    shape = (num_slices,) + tuple(ici_sizes.values())
+    dev_array = np.asarray(groups, dtype=object).reshape(shape)
+    return Mesh(dev_array, names)
+
+
 def mesh_axis_size(mesh, name):
     return mesh.shape.get(name, 1)
 

@@ -1,0 +1,202 @@
+"""Cloud TPU VM launcher: run a task (or a gang) on a provisioned slice.
+
+The TPU analogue of the reference's batch_cli/kubernetes_cli trampolines
+(SURVEY.md §2.6): `runtime_step_cli` rewrites a task's argv to
+
+    python -m metaflow_tpu.plugins.tpu.launcher -- <original step argv...>
+
+which provisions (or reuses) a TPU VM/slice via `gcloud compute tpus tpu-vm`,
+ships the code package, runs the step on every worker of the slice (worker i
+= gang rank i, so a pod slice IS the gang), streams logs back, and reaps the
+resource. Requires gcloud credentials; every external call is isolated in
+GcloudTpu for testing.
+
+Config (env):
+    TPUFLOW_TPU_PROJECT / TPUFLOW_TPU_ZONE     GCP project/zone
+    TPUFLOW_TPU_TYPE                           accelerator (e.g. v5p-8)
+    TPUFLOW_TPU_VERSION                        runtime version
+    TPUFLOW_TPU_REUSE=name                     use an existing TPU VM
+"""
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+from ...exception import TpuFlowException
+
+
+class GcloudTpu(object):
+    """Thin wrapper over `gcloud compute tpus tpu-vm` (mockable)."""
+
+    def __init__(self, project, zone):
+        self.project = project
+        self.zone = zone
+
+    def _base(self, *args):
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", *args,
+            "--project", self.project, "--zone", self.zone,
+            "--quiet", "--format", "json",
+        ]
+
+    def _run(self, argv, check=True, timeout=1800):
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout)
+        if check and proc.returncode != 0:
+            raise TpuFlowException(
+                "gcloud failed (%s): %s"
+                % (" ".join(argv[:6]), proc.stderr.strip()[-500:])
+            )
+        return proc
+
+    def create(self, name, accelerator_type, version, spot=False):
+        args = self._base(
+            "create", name,
+            "--accelerator-type", accelerator_type,
+            "--version", version,
+        )
+        if spot:
+            args.append("--spot")
+        self._run(args)
+
+    def describe(self, name):
+        proc = self._run(self._base("describe", name), check=False)
+        if proc.returncode != 0:
+            return None
+        return json.loads(proc.stdout or "{}")
+
+    def delete(self, name):
+        self._run(self._base("delete", name), check=False)
+
+    def ssh(self, name, command, worker="all", stream=False):
+        args = self._base("ssh", name) + [
+            "--worker", str(worker), "--command", command,
+        ]
+        if stream:
+            return subprocess.Popen(args, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+        return self._run(args, timeout=None)
+
+    def scp(self, name, local, remote, worker="all"):
+        args = self._base("scp", local, "%s:%s" % (name, remote)) + [
+            "--worker", str(worker),
+        ]
+        self._run(args)
+
+
+class TpuVmLauncher(object):
+    def __init__(self, gcloud=None):
+        project = os.environ.get("TPUFLOW_TPU_PROJECT")
+        zone = os.environ.get("TPUFLOW_TPU_ZONE")
+        if gcloud is None and not (project and zone):
+            raise TpuFlowException(
+                "TPU launcher needs TPUFLOW_TPU_PROJECT and TPUFLOW_TPU_ZONE"
+            )
+        self.gcloud = gcloud or GcloudTpu(project, zone)
+        self.accelerator = os.environ.get(
+            "TPUFLOW_TPU_TYPE",
+            os.environ.get("TPUFLOW_TPU_TOPOLOGY", "v5litepod-4"),
+        )
+        self.version = os.environ.get(
+            "TPUFLOW_TPU_VERSION", "tpu-ubuntu2204-base"
+        )
+        self.reuse = os.environ.get("TPUFLOW_TPU_REUSE")
+        self.spot = os.environ.get("TPUFLOW_TPU_SPOT", "0") == "1"
+
+    def _ensure_tpu(self, name):
+        if self.reuse:
+            return self.reuse, False
+        if self.gcloud.describe(name) is None:
+            self.gcloud.create(name, self.accelerator, self.version,
+                               spot=self.spot)
+            # wait for READY
+            deadline = time.time() + 1800
+            while time.time() < deadline:
+                info = self.gcloud.describe(name) or {}
+                if info.get("state") == "READY":
+                    break
+                time.sleep(10)
+            else:
+                raise TpuFlowException("TPU %s never became READY" % name)
+        return name, not self.reuse
+
+    def launch_step(self, step_argv, package_url, run_id, task_id,
+                    echo=print):
+        """Run one step command on every worker of a slice; rank i = worker
+        i (the slice is the gang). Returns the worker exit code."""
+        from ...package import MetaflowPackage
+
+        name = "tpuflow-%s-%s" % (str(run_id).lower(), str(task_id).lower())
+        name, ephemeral = self._ensure_tpu(name)
+        try:
+            info = self.gcloud.describe(name) or {}
+            num_workers = max(len(info.get("networkEndpoints", [])), 1)
+            bootstrap = " && ".join(
+                MetaflowPackage.bootstrap_commands(package_url)
+            )
+            step_cmd = " ".join(shlex.quote(a) for a in step_argv)
+            # gang contract (mirrors the local fork path,
+            # parallel_decorator.py): every worker learns its rank from the
+            # TPU metadata; rank>0 workers get derived task ids so artifacts
+            # never clobber; jax.distributed auto-discovers peers on a slice
+            # (MF_PARALLEL_REMOTE=1 → tpu_parallel auto-init path)
+            remote_cmd = (
+                "%(bootstrap)s && "
+                "RANK=$(curl -s -H 'Metadata-Flavor: Google' "
+                "'http://metadata.google.internal/computeMetadata/v1/instance/"
+                "attributes/agent-worker-number' || echo 0) && "
+                "export MF_PARALLEL_REMOTE=1 MF_PARALLEL_NODE_INDEX=$RANK "
+                "MF_PARALLEL_NUM_NODES=%(num)d "
+                "MF_PARALLEL_CONTROL_TASK_ID=%(task)s && "
+                "EXTRA=''; if [ \"$RANK\" != \"0\" ]; then "
+                "EXTRA=\"--task-id %(task)s-node-$RANK "
+                "--ubf-context ubf_task --split-index $RANK\"; fi && "
+                "%(step)s $EXTRA"
+                % {
+                    "bootstrap": bootstrap,
+                    "num": num_workers,
+                    "task": str(task_id),
+                    "step": step_cmd,
+                }
+            )
+            proc = self.gcloud.ssh(name, remote_cmd, worker="all",
+                                   stream=True)
+            for line in proc.stdout:
+                echo(line.rstrip("\n"))
+            return proc.wait()
+        finally:
+            if ephemeral and os.environ.get("TPUFLOW_TPU_KEEP", "0") != "1":
+                self.gcloud.delete(name)
+
+
+def main(argv=None):
+    """Entry used by the runtime trampoline:
+    python -m metaflow_tpu.plugins.tpu.launcher -- <step argv...>"""
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        raise TpuFlowException("launcher needs a step command after --")
+    package_url = os.environ.get("TPUFLOW_PACKAGE_URL")
+    if not package_url:
+        raise TpuFlowException(
+            "TPUFLOW_PACKAGE_URL not set: the runtime must upload the code "
+            "package before launching remotely"
+        )
+
+    def opt(name, default=""):
+        return argv[argv.index(name) + 1] if name in argv else default
+
+    launcher = TpuVmLauncher()
+    rc = launcher.launch_step(
+        argv, package_url,
+        run_id=opt("--run-id", "run"), task_id=opt("--task-id", "task"),
+    )
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

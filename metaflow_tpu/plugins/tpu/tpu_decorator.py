@@ -53,14 +53,45 @@ class TpuDecorator(StepDecorator):
         "require_tpu": False,
     }
 
+    def step_init(self, flow, graph, step_name, decorators, environment,
+                  flow_datastore, logger):
+        self._flow_datastore = flow_datastore
+
+    def runtime_init(self, flow, graph, package, run_id):
+        # remote mode: upload the code package once per run so the launcher
+        # can bootstrap the TPU VM (reference pattern: package_and_upload)
+        if not os.environ.get("TPUFLOW_TPU_LAUNCHER"):
+            return
+        if os.environ.get("TPUFLOW_PACKAGE_URL"):
+            return
+        import sys
+
+        from ...package import MetaflowPackage
+
+        pkg = MetaflowPackage(
+            flow_dir=os.path.dirname(os.path.abspath(sys.argv[0]))
+        )
+        url, _sha = pkg.upload(self._flow_datastore)
+        os.environ["TPUFLOW_PACKAGE_URL"] = url
+
     def runtime_step_cli(self, cli_args, retry_count, max_user_code_retries,
                          ubf_context):
         launcher = os.environ.get("TPUFLOW_TPU_LAUNCHER")
         if launcher:
-            # trampoline: rewrite argv so the task launches via the
-            # provisioner (same pattern as the reference's `batch step`
-            # rewrite, decorators.py runtime_step_cli:493)
-            cli_args.entrypoint = [launcher] + cli_args.entrypoint
+            # trampoline: rewrite argv so the task launches on a provisioned
+            # TPU VM/slice (same pattern as the reference's `batch step`
+            # rewrite, decorators.py runtime_step_cli:493).
+            # '1'/'gcloud' = the built-in gcloud launcher; any other value
+            # is a custom launcher executable prefix
+            import sys
+
+            if launcher in ("1", "gcloud", "true"):
+                cli_args.entrypoint = [
+                    sys.executable, "-m",
+                    "metaflow_tpu.plugins.tpu.launcher", "--",
+                ] + cli_args.entrypoint
+            else:
+                cli_args.entrypoint = [launcher] + cli_args.entrypoint
         if self.attributes["topology"]:
             cli_args.env["TPUFLOW_TPU_TOPOLOGY"] = str(self.attributes["topology"])
 

@@ -18,6 +18,8 @@ class TpuParallelDecorator(ParallelDecorator):
     defaults = {"jax_distributed": True}
 
     def setup_distributed_env(self, flow):
+        import os
+
         from ...current import current
 
         p = current.parallel
@@ -27,6 +29,11 @@ class TpuParallelDecorator(ParallelDecorator):
             return
         import jax
 
+        if os.environ.get("MF_PARALLEL_REMOTE") == "1":
+            # on a real TPU pod slice jax discovers the coordinator and
+            # world from the TPU metadata — no explicit rendezvous needed
+            jax.distributed.initialize()
+            return
         coordinator = "%s:%d" % (p.main_ip, p.coordinator_port)
         jax.distributed.initialize(
             coordinator_address=coordinator,

@@ -269,6 +269,9 @@ class NativeRuntime(object):
 
     def execute(self):
         start_time = time.time()
+        for step_func in self._flow:
+            for deco in step_func.decorators:
+                deco.runtime_init(self._flow, self._graph, None, self.run_id)
         write_latest_run_id(self._flow.name, self.run_id)
         self._metadata.start_run_heartbeat(self._flow.name, self.run_id)
         self._echo(

@@ -1,0 +1,119 @@
+"""Sidecar framework: detached helper subprocess + one-way lossy pipe.
+
+Reference behavior: metaflow/sidecar/ (sidecar_subprocess.py — NDJSON
+messages over the child's stdin, lossy by design, MUST_SEND retries; null
+implementation when disabled). Sidecars host telemetry (monitor/event
+logger) and periodic uploaders without threatening the task process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+MUST_SEND_RETRIES = 3
+
+
+class Message(object):
+    BEST_EFFORT = "best_effort"
+    MUST_SEND = "must_send"
+    SHUTDOWN = "shutdown"
+
+    def __init__(self, kind, payload=None):
+        self.kind = kind
+        self.payload = payload or {}
+
+    def serialize(self):
+        return (
+            json.dumps({"kind": self.kind, "payload": self.payload}) + "\n"
+        ).encode("utf-8")
+
+    @staticmethod
+    def deserialize(line):
+        obj = json.loads(line)
+        return Message(obj["kind"], obj.get("payload"))
+
+
+class Sidecar(object):
+    """Launch `python -m <worker_module>` and stream messages to it."""
+
+    def __init__(self, worker_module, env=None):
+        self._worker_module = worker_module
+        self._env = env or {}
+        self._proc = None
+
+    def start(self):
+        env = dict(os.environ)
+        env.update(self._env)
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", self._worker_module],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            start_new_session=True,  # survive the parent's process group
+        )
+        return self
+
+    @property
+    def is_alive(self):
+        return self._proc is not None and self._proc.poll() is None
+
+    def send(self, message):
+        retries = (
+            MUST_SEND_RETRIES if message.kind == Message.MUST_SEND else 1
+        )
+        for _ in range(retries):
+            if not self.is_alive:
+                return False  # lossy by design
+            try:
+                self._proc.stdin.write(message.serialize())
+                self._proc.stdin.flush()
+                return True
+            except (BrokenPipeError, OSError):
+                continue
+        return False
+
+    def terminate(self):
+        if self._proc is None:
+            return
+        self.send(Message(Message.SHUTDOWN))
+        try:
+            self._proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self._proc.wait(timeout=2)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+
+
+class NullSidecar(object):
+    """Disabled sidecar: every operation is a no-op."""
+
+    is_alive = False
+
+    def start(self):
+        return self
+
+    def send(self, message):
+        return False
+
+    def terminate(self):
+        pass
+
+
+def sidecar_worker_loop(handler):
+    """Run inside a worker module's __main__: read NDJSON from stdin and
+    dispatch to handler(message) until shutdown/EOF."""
+    for line in sys.stdin.buffer:
+        try:
+            msg = Message.deserialize(line)
+        except (ValueError, KeyError):
+            continue
+        if msg.kind == Message.SHUTDOWN:
+            break
+        try:
+            handler(msg)
+        except Exception:
+            pass

@@ -1,0 +1,156 @@
+"""System telemetry: event logger + monitor (counters/timers/gauges).
+
+Reference behavior: metaflow/event_logger.py + monitor.py — pluggable
+telemetry with debug implementations; the task executor wraps user code in a
+timer and counts task starts/ends (reference task.py:793-807). Records here
+flush to a JSONL under the datastore root ('debug' impl prints to stderr).
+"""
+
+import json
+import os
+import sys
+import time
+from contextlib import contextmanager
+
+
+class BaseEventLogger(object):
+    TYPE = "null"
+
+    def log(self, payload):
+        pass
+
+
+class BaseMonitor(object):
+    TYPE = "null"
+
+    @contextmanager
+    def measure(self, name):
+        yield
+
+    @contextmanager
+    def count(self, name):
+        yield
+
+    def gauge(self, name, value):
+        pass
+
+
+class DebugEventLogger(BaseEventLogger):
+    TYPE = "debug"
+
+    def log(self, payload):
+        sys.stderr.write("event: %s\n" % json.dumps(payload))
+
+
+class DebugMonitor(BaseMonitor):
+    TYPE = "debug"
+
+    @contextmanager
+    def measure(self, name):
+        start = time.time()
+        yield
+        sys.stderr.write(
+            "timer %s: %.1f ms\n" % (name, (time.time() - start) * 1000)
+        )
+
+    @contextmanager
+    def count(self, name):
+        yield
+        sys.stderr.write("counter %s: +1\n" % name)
+
+    def gauge(self, name, value):
+        sys.stderr.write("gauge %s: %s\n" % (name, value))
+
+
+class FileMonitor(BaseMonitor):
+    """Append metrics to <root>/_telemetry/metrics.jsonl (local default)."""
+
+    TYPE = "file"
+
+    def __init__(self, root=None):
+        from .util import get_tpuflow_root
+
+        self._path = os.path.join(
+            root or get_tpuflow_root(), "_telemetry", "metrics.jsonl"
+        )
+
+    def _write(self, record):
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            record["ts"] = time.time()
+            record["pid"] = os.getpid()
+            with open(self._path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            pass
+
+    @contextmanager
+    def measure(self, name):
+        start = time.time()
+        yield
+        self._write(
+            {"type": "timer", "name": name,
+             "ms": round((time.time() - start) * 1000, 3)}
+        )
+
+    @contextmanager
+    def count(self, name):
+        yield
+        self._write({"type": "counter", "name": name, "inc": 1})
+
+    def gauge(self, name, value):
+        self._write({"type": "gauge", "name": name, "value": value})
+
+
+class FileEventLogger(BaseEventLogger):
+    TYPE = "file"
+
+    def __init__(self, root=None):
+        from .util import get_tpuflow_root
+
+        self._path = os.path.join(
+            root or get_tpuflow_root(), "_telemetry", "events.jsonl"
+        )
+
+    def log(self, payload):
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            with open(self._path, "a") as f:
+                f.write(json.dumps({"ts": time.time(), **payload}) + "\n")
+        except OSError:
+            pass
+
+
+MONITORS = {"null": BaseMonitor, "debug": DebugMonitor, "file": FileMonitor}
+EVENT_LOGGERS = {
+    "null": BaseEventLogger,
+    "debug": DebugEventLogger,
+    "file": FileEventLogger,
+}
+
+
+def get_monitor(kind=None):
+    kind = kind or os.environ.get("TPUFLOW_MONITOR", "file")
+    return MONITORS.get(kind, BaseMonitor)()
+
+
+def get_event_logger(kind=None):
+    kind = kind or os.environ.get("TPUFLOW_EVENT_LOGGER", "file")
+    return EVENT_LOGGERS.get(kind, BaseEventLogger)()
+
+
+def read_metrics(root=None):
+    from .util import get_tpuflow_root
+
+    path = os.path.join(root or get_tpuflow_root(), "_telemetry",
+                        "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

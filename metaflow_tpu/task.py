@@ -92,6 +92,11 @@ class MetaflowTask(object):
         self.metadata = metadata
         self.environment = environment
         self.console_logger = console_logger or (lambda *a, **k: None)
+        if event_logger is None or monitor is None:
+            from .system import get_event_logger, get_monitor
+
+            event_logger = event_logger or get_event_logger()
+            monitor = monitor or get_monitor()
         self.event_logger = event_logger
         self.monitor = monitor
         self.ubf_context = ubf_context
@@ -282,7 +287,15 @@ class MetaflowTask(object):
                     self.ubf_context,
                 )
 
-            self._exec_step_function(wrapped, step_func, inputs_obj)
+            # telemetry mirrors the reference's task wrap (task.py:793-807)
+            with self.monitor.count("metaflow.task.start"):
+                pass
+            self.event_logger.log(
+                {"event": "task_start", "pathspec": output.pathspec,
+                 "attempt": retry_count}
+            )
+            with self.monitor.measure("metaflow.task.duration"):
+                self._exec_step_function(wrapped, step_func, inputs_obj)
 
             for deco in decorators:
                 deco.task_post_step(

@@ -1,0 +1,104 @@
+"""Tracing: OpenTelemetry with a graceful no-op default.
+
+Reference behavior: metaflow/tracing/ (__init__.py:14-50 no-op shims unless
+deps + an endpoint are configured; spans wrap CLI commands; context
+propagates into subprocesses via env). Enable by setting
+TPUFLOW_OTEL_ENDPOINT (requires opentelemetry-sdk to be installed).
+"""
+
+import functools
+import os
+from contextlib import contextmanager
+
+_ENDPOINT_VAR = "TPUFLOW_OTEL_ENDPOINT"
+_TRACEPARENT_VAR = "TRACEPARENT"
+
+_tracer = None
+_initialized = False
+
+
+def _init():
+    global _tracer, _initialized
+    if _initialized:
+        return _tracer
+    _initialized = True
+    endpoint = os.environ.get(_ENDPOINT_VAR)
+    if not endpoint:
+        return None
+    try:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": "metaflow_tpu"})
+        )
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+        )
+        trace.set_tracer_provider(provider)
+        _tracer = trace.get_tracer("metaflow_tpu")
+    except ImportError:
+        _tracer = None
+    return _tracer
+
+
+@contextmanager
+def span(name, attributes=None):
+    """Span context manager; no-op when tracing is disabled."""
+    tracer = _init()
+    if tracer is None:
+        yield None
+        return
+    with tracer.start_as_current_span(name) as s:
+        for k, v in (attributes or {}).items():
+            s.set_attribute(k, v)
+        yield s
+
+
+def cli(name):
+    """Decorator wrapping a CLI command in a span (reference: @tracing.cli)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+def inject_tracing_vars(env):
+    """Propagate trace context into a subprocess env (no-op when off)."""
+    tracer = _init()
+    if tracer is None:
+        return env
+    try:
+        from opentelemetry.propagate import inject
+
+        carrier = {}
+        inject(carrier)
+        env.update({k.upper().replace("-", "_"): v
+                    for k, v in carrier.items()})
+    except ImportError:
+        pass
+    return env
+
+
+def get_trace_id():
+    tracer = _init()
+    if tracer is None:
+        return ""
+    try:
+        from opentelemetry import trace
+
+        ctx = trace.get_current_span().get_span_context()
+        return format(ctx.trace_id, "032x") if ctx.is_valid else ""
+    except ImportError:
+        return ""

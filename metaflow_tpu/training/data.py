@@ -1,0 +1,91 @@
+"""Input pipeline: host-side batching + device prefetch.
+
+The reference delegates data loading to user code entirely; on TPU the
+framework must keep the MXU fed — this module provides a minimal sharded
+loader: deterministic global batches cut per-host, placed onto the mesh
+asynchronously one step ahead (double buffering hides the host→HBM copy).
+"""
+
+import collections
+import threading
+
+import numpy as np
+
+
+def token_batches(data, batch_size, seq_len, *, rng=None, drop_last=True):
+    """Yield {'tokens': [B, seq_len+1]} batches from a 1-D token array
+    (next-token LM convention: targets are inputs shifted by one)."""
+    data = np.asarray(data)
+    window = seq_len + 1
+    n_windows = len(data) // window
+    order = np.arange(n_windows)
+    if rng is not None:
+        rng.shuffle(order)
+    batch = []
+    for idx in order:
+        batch.append(data[idx * window:(idx + 1) * window])
+        if len(batch) == batch_size:
+            yield {"tokens": np.stack(batch)}
+            batch = []
+    if batch and not drop_last:
+        yield {"tokens": np.stack(batch)}
+
+
+def shard_iterator(it, mesh):
+    """Place each host batch onto the mesh (batch dim over data axes)."""
+    from .train_step import shard_batch
+
+    for batch in it:
+        yield shard_batch(batch, mesh)
+
+
+def prefetch(iterator, depth=2):
+    """Run `iterator` in a background thread, keeping `depth` items ready —
+    device transfer of step N+1 overlaps compute of step N."""
+    queue = collections.deque()
+    lock = threading.Condition()
+    done = []
+    error = []
+
+    def producer():
+        try:
+            for item in iterator:
+                with lock:
+                    while len(queue) >= depth:
+                        lock.wait()
+                    queue.append(item)
+                    lock.notify_all()
+        except BaseException as ex:  # surface in the consumer, never swallow
+            with lock:
+                error.append(ex)
+                lock.notify_all()
+        finally:
+            with lock:
+                done.append(True)
+                lock.notify_all()
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    while True:
+        with lock:
+            while not queue and not done:
+                lock.wait()
+            if queue:
+                item = queue.popleft()
+                lock.notify_all()
+            elif error:
+                raise error[0]
+            else:
+                return
+        yield item
+
+
+def sharded_dataset(data, batch_size, seq_len, mesh, rng=None,
+                    prefetch_depth=2):
+    """token_batches → mesh placement → background prefetch, composed."""
+    return prefetch(
+        shard_iterator(
+            token_batches(data, batch_size, seq_len, rng=rng), mesh
+        ),
+        depth=prefetch_depth,
+    )

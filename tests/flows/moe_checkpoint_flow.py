@@ -1,0 +1,78 @@
+"""BASELINE config: Mixtral expert-parallel training + checkpoint resume —
+EP mesh training inside a step, crash mid-run, retry resumes from orbax."""
+
+import os
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+
+
+class MoeCheckpointFlow(FlowSpec):
+    @step
+    def start(self):
+        self.total_steps = 4
+        self.next(self.train)
+
+    @metaflow_tpu.retry(times=2, minutes_between_retries=0)
+    @metaflow_tpu.checkpoint
+    @step
+    def train(self):
+        import jax
+
+        from metaflow_tpu.models import mixtral
+        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.training import (
+            default_optimizer,
+            make_trainer,
+            shard_batch,
+        )
+
+        n = len(jax.devices())
+        cfg = mixtral.MixtralConfig.tiny()
+        mesh = create_mesh(
+            MeshSpec.moe(expert=min(4, n)) if n >= 4 else MeshSpec.dp()
+        )
+        state, step_fn, _ = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, mixtral,
+            optimizer=default_optimizer(lr=5e-3, warmup_steps=1,
+                                        total_steps=50),
+        )
+        ckpt = current.checkpoint
+        restored_step = ckpt.latest_step
+        start_step = 0
+        if restored_step is not None:
+            params = ckpt.load(step=restored_step)
+            state["params"] = jax.tree.map(
+                lambda old, new: old.astype(new.dtype) if hasattr(
+                    old, "astype") else old,
+                jax.device_put(params, jax.tree.map(
+                    lambda x: x.sharding, state["params"])),
+                state["params"],
+            )
+            start_step = restored_step + 1
+        self.resumed_from = start_step
+
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 33), 0, cfg.vocab_size
+        )
+        batch = shard_batch({"tokens": tokens}, mesh)
+        with mesh:
+            for i in range(start_step, self.total_steps):
+                state, m = step_fn(state, batch)
+                ckpt.save(jax.device_get(state["params"]), step=i)
+                if i == 1 and current.retry_count == 0 and not os.environ.get(
+                    "NO_CRASH"
+                ):
+                    raise RuntimeError("simulated preemption")
+            self.final_loss = float(m["loss"])
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.resumed_from == 2, self.resumed_from
+        print("moe checkpoint ok: resumed from %d, loss %.3f"
+              % (self.resumed_from, self.final_loss))
+
+
+if __name__ == "__main__":
+    MoeCheckpointFlow()

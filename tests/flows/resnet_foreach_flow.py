@@ -1,0 +1,53 @@
+"""BASELINE config: foreach fan-out fine-tune — one model variant per
+branch (one chip per branch on a TPU fleet), join picks the best."""
+
+from metaflow_tpu import FlowSpec, step
+
+
+class ResnetForeachFlow(FlowSpec):
+    @step
+    def start(self):
+        self.learning_rates = [0.02, 0.01, 0.005]
+        self.next(self.finetune, foreach="learning_rates")
+
+    @step
+    def finetune(self):
+        import jax
+        import jax.numpy as jnp
+
+        from metaflow_tpu.models import resnet
+
+        cfg = resnet.ResNetConfig.tiny()
+        params = resnet.init_params(jax.random.PRNGKey(0), cfg)
+        images = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+        labels = jnp.arange(8) % cfg.num_classes
+        batch = {"images": images, "labels": labels}
+        lr = self.input
+
+        loss_grad = jax.jit(jax.value_and_grad(
+            lambda p: resnet.loss_fn(p, batch, cfg)
+        ))
+        for _ in range(3):
+            loss, grads = loss_grad(params)
+            params = jax.tree.map(
+                lambda p, g: p - lr * g if p.dtype.kind == "f" else p,
+                params, grads,
+            )
+        self.lr = lr
+        self.final_loss = float(loss)
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        results = [(inp.final_loss, inp.lr) for inp in inputs]
+        self.best_loss, self.best_lr = min(results)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.best_lr in (0.02, 0.01, 0.005)
+        print("best lr %s -> loss %.3f" % (self.best_lr, self.best_loss))
+
+
+if __name__ == "__main__":
+    ResnetForeachFlow()

@@ -1,0 +1,170 @@
+"""Mini generative test harness: graphs × contexts.
+
+Reference model: test/core (SURVEY.md §4) — orthogonal graph shapes and
+execution contexts are combined, a real flow file is code-generated for each
+combination, executed through the actual CLI, and checked via the client
+API. This multiplies coverage across the DSL/scheduler/datastore layers.
+"""
+
+GRAPHS = {
+    "linear": [
+        {"name": "start", "next": ["a"]},
+        {"name": "a", "next": ["b"]},
+        {"name": "b", "next": ["end"]},
+        {"name": "end"},
+    ],
+    "branch": [
+        {"name": "start", "next": ["a", "b"]},
+        {"name": "a", "next": ["j"]},
+        {"name": "b", "next": ["j"]},
+        {"name": "j", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
+    "foreach": [
+        {"name": "start", "foreach": 3, "next": ["body"]},
+        {"name": "body", "next": ["j"]},
+        {"name": "j", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
+    "nested_foreach": [
+        {"name": "start", "foreach": 2, "next": ["mid"]},
+        {"name": "mid", "foreach": 2, "next": ["leaf"]},
+        {"name": "leaf", "next": ["ji"]},
+        {"name": "ji", "join": True, "next": ["jo"]},
+        {"name": "jo", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
+    "branch_of_foreach": [
+        {"name": "start", "next": ["p", "q"]},
+        {"name": "p", "foreach": 2, "next": ["pb"]},
+        {"name": "pb", "next": ["pj"]},
+        {"name": "pj", "join": True, "next": ["j"]},
+        {"name": "q", "next": ["j"]},
+        {"name": "j", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
+}
+
+# execution contexts: CLI/env variations every graph must survive
+CONTEXTS = {
+    "default": {"args": [], "env": {}},
+    "exec_workers": {"args": [], "env": {"TPUFLOW_FORK_WORKERS": "0"}},
+    "with_retry": {
+        "args": ["--with", "retry:times=1,minutes_between_retries=0"],
+        "env": {},
+    },
+}
+
+
+def expected_task_counts(graph):
+    """Cardinality of each step given the template's foreach sizes."""
+    by_name = {s["name"]: s for s in graph}
+    counts = {}
+
+    def visit(name, multiplier):
+        spec = by_name[name]
+        counts[name] = counts.get(name, 0) + multiplier
+        child_mult = multiplier * spec.get("foreach", 1)
+        for child in spec.get("next", []):
+            if by_name[child].get("join"):
+                continue  # joins handled once per join instance
+            visit(child, child_mult)
+
+    visit("start", 1)
+    # joins: one task per instance of the *parent* split level
+    changed = True
+    while changed:
+        changed = False
+        for spec in graph:
+            if not spec.get("join") or spec["name"] in counts:
+                continue
+            # a join's count = count of the split ancestor that opened the
+            # level being joined = count of its in-step divided by the
+            # foreach factor of the innermost split
+            in_steps = [
+                s for s in graph if spec["name"] in s.get("next", [])
+            ]
+            if not all(s["name"] in counts for s in in_steps):
+                continue
+            # innermost split parent's multiplier:
+            inner = min(counts[s["name"]] for s in in_steps)
+            # dividing by the foreach factor: find the split that fans into
+            # this join's inputs
+            split = _innermost_split(graph, spec["name"])
+            factor = (
+                by_name[split].get("foreach",
+                                   len(by_name[split].get("next", [])))
+                if split else 1
+            )
+            counts[spec["name"]] = max(1, inner // factor)
+            changed = True
+            # propagate beyond the join
+            for child in spec.get("next", []):
+                if not by_name[child].get("join"):
+                    visit(child, counts[spec["name"]])
+    return counts
+
+
+def _innermost_split(graph, join_name):
+    """Walk backwards from the join to the split it closes (templates here
+    are simple enough for a stack walk)."""
+    by_name = {s["name"]: s for s in graph}
+    # DFS from start tracking the open-split stack
+    result = {}
+
+    def walk(name, stack):
+        spec = by_name[name]
+        if spec.get("join"):
+            if stack:
+                result.setdefault(name, stack[-1])
+                stack = stack[:-1]
+        elif spec.get("foreach") or len(spec.get("next", [])) > 1:
+            stack = stack + [name]
+        for child in spec.get("next", []):
+            walk(child, stack)
+
+    walk("start", [])
+    return result.get(join_name)
+
+
+def generate_flow(graph, flow_name):
+    """Emit a runnable flow file for a graph template. Each task appends its
+    step name to a 'trace' artifact; joins merge traces."""
+    lines = [
+        "from metaflow_tpu import FlowSpec, step",
+        "",
+        "",
+        "class %s(FlowSpec):" % flow_name,
+    ]
+    for spec in graph:
+        name = spec["name"]
+        args = "(self, inputs)" if spec.get("join") else "(self)"
+        lines.append("    @step")
+        lines.append("    def %s%s:" % (name, args))
+        if spec.get("join"):
+            lines.append(
+                "        self.trace = sorted(set(sum((i.trace for i in "
+                "inputs), [])))"
+            )
+            lines.append("        self.trace = self.trace + [%r]" % name)
+        elif name == "start":
+            lines.append("        self.trace = [%r]" % name)
+        else:
+            lines.append("        self.trace = self.trace + [%r]" % name)
+        if spec.get("foreach"):
+            lines.append("        self.items = list(range(%d))"
+                         % spec["foreach"])
+            lines.append("        self.next(self.%s, foreach='items')"
+                         % spec["next"][0])
+        elif spec.get("next"):
+            lines.append(
+                "        self.next(%s)"
+                % ", ".join("self.%s" % n for n in spec["next"])
+            )
+        else:
+            lines.append("        print('TRACE:', ','.join(self.trace))")
+        lines.append("")
+    lines.append("")
+    lines.append("if __name__ == '__main__':")
+    lines.append("    %s()" % flow_name)
+    return "\n".join(lines)

@@ -141,7 +141,11 @@ def test_dump_and_logs_cli(run_flow, flows_dir, tpuflow_root):
 def test_gang_jax_distributed_training(run_flow, flows_dir, tpuflow_root):
     """North-star: num_parallel gang trains a sharded Llama with
     jax.distributed across rank processes (BASELINE @parallel FSDP path)."""
-    proc = run_flow(os.path.join(flows_dir, "train_gang_flow.py"), "run")
+    # 1 device per rank keeps cross-process CPU collectives fast
+    proc = run_flow(
+        os.path.join(flows_dir, "train_gang_flow.py"), "run",
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+    )
     assert "gang training ok" in proc.stdout
     c = _client(tpuflow_root)
     run = c.Flow("TrainGangFlow").latest_run
@@ -200,6 +204,19 @@ def test_sharded_batch_inference(run_flow, flows_dir, tpuflow_root):
     """Foreach join inputs arrive ordered by split index."""
     proc = run_flow(os.path.join(flows_dir, "batch_inference_flow.py"), "run")
     assert "batch inference ok" in proc.stdout
+
+
+def test_resnet_foreach_finetune(run_flow, flows_dir, tpuflow_root):
+    proc = run_flow(os.path.join(flows_dir, "resnet_foreach_flow.py"), "run")
+    assert "best lr" in proc.stdout
+
+
+def test_moe_expert_parallel_checkpoint(run_flow, flows_dir, tpuflow_root):
+    proc = run_flow(
+        os.path.join(flows_dir, "moe_checkpoint_flow.py"), "run",
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    assert "resumed from 2" in proc.stdout
 
 
 def test_namespace_filtering(run_flow, flows_dir, tpuflow_root):

@@ -1,0 +1,52 @@
+"""Generative graphs × contexts matrix (reference: test/core pattern)."""
+
+import itertools
+import os
+
+import pytest
+
+from harness import CONTEXTS, GRAPHS, expected_task_counts, generate_flow
+
+# full matrix is graphs × contexts; keep the cross product lean by running
+# every graph in the default context and every context on two probe graphs
+MATRIX = [(g, "default") for g in GRAPHS] + [
+    (g, c)
+    for g, c in itertools.product(("foreach", "branch"), CONTEXTS)
+    if c != "default"
+]
+
+
+@pytest.mark.parametrize("graph_name,context_name", MATRIX)
+def test_generated_flow(graph_name, context_name, run_flow, tpuflow_root,
+                        tmp_path):
+    graph = GRAPHS[graph_name]
+    context = CONTEXTS[context_name]
+    flow_name = "Gen%s%sFlow" % (
+        graph_name.title().replace("_", ""), context_name.title().replace("_", ""),
+    )
+    src = generate_flow(graph, flow_name)
+    flow_file = str(tmp_path / ("%s.py" % flow_name))
+    with open(flow_file, "w") as f:
+        f.write(src)
+
+    proc = run_flow(flow_file, *(context["args"] + ["run"]),
+                    env_extra=context["env"])
+    assert "TRACE:" in proc.stdout
+
+    # client-side checker: every step ran with the expected cardinality
+    os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+    from metaflow_tpu import client
+
+    client.namespace(None)
+    run = client.Flow(flow_name).latest_run
+    assert run.successful
+    expected = expected_task_counts(graph)
+    for step_name, count in expected.items():
+        tasks = list(run[step_name].tasks())
+        assert len(tasks) == count, (
+            "%s/%s: expected %d tasks, found %d"
+            % (flow_name, step_name, count, len(tasks))
+        )
+    # the end task saw every step
+    trace = run.data.trace
+    assert set(trace) == {s["name"] for s in graph}, trace

@@ -1,0 +1,81 @@
+"""REST metadata service provider: end-to-end over the reference service."""
+
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def service(tpuflow_root):
+    from metaflow_tpu.metadata import MetadataService
+
+    svc = MetadataService(tpuflow_root)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def test_provider_roundtrip(service, tpuflow_root):
+    from metaflow_tpu.metadata import ServiceMetadataProvider
+    from metaflow_tpu.metadata.metadata import MetaDatum
+
+    class _Flow:
+        name = "SvcFlow"
+
+    p = ServiceMetadataProvider(flow=_Flow(), url=service.url)
+    assert "tpuflow" in p.version()
+    run_id = p.new_run_id(tags=["exp:1"])
+    assert run_id
+    p.register_task_id(run_id, "start", "1", 0)
+    p.register_metadata(run_id, "start", "1",
+                        [MetaDatum("attempt", "0", "attempt", [])])
+    meta = p.get_task_metadata("SvcFlow", run_id, "start", "1")
+    assert meta and meta[0]["field_name"] == "attempt"
+    info = p.get_run_info("SvcFlow", run_id)
+    assert "exp:1" in info["tags"]
+    runs = p.list_runs("SvcFlow")
+    assert any(r["run_number"] == run_id for r in runs)
+    p.mutate_run_tags("SvcFlow", run_id, add=["k:v"])
+    assert "k:v" in p.get_run_info("SvcFlow", run_id)["tags"]
+
+
+def test_flow_runs_against_service(service, run_flow, flows_dir,
+                                   tpuflow_root):
+    """`--metadata service` drives a real run through the REST provider."""
+    proc = run_flow(
+        os.path.join(flows_dir, "linear_flow.py"),
+        "--metadata", "service", "run",
+        env_extra={"TPUFLOW_SERVICE_URL": service.url},
+    )
+    assert "Done!" in proc.stdout
+
+
+def test_client_reads_over_rest(service, run_flow, flows_dir, tpuflow_root,
+                                monkeypatch):
+    """TPUFLOW_DEFAULT_METADATA=service routes client reads through REST."""
+    run_flow(
+        os.path.join(flows_dir, "linear_flow.py"),
+        "--metadata", "service", "run",
+        env_extra={"TPUFLOW_SERVICE_URL": service.url},
+    )
+    monkeypatch.setenv("TPUFLOW_DEFAULT_METADATA", "service")
+    monkeypatch.setenv("TPUFLOW_SERVICE_URL", service.url)
+    monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL", tpuflow_root)
+    from metaflow_tpu import client
+
+    client.namespace(None)
+    run = client.Flow("LinearFlow").latest_run
+    assert run.successful
+    assert run.data.x == 10
+
+
+def test_missing_url_errors():
+    from metaflow_tpu.metadata import ServiceMetadataProvider
+    from metaflow_tpu.metadata.service import ServiceException
+
+    class _Flow:
+        name = "X"
+
+    os.environ.pop("TPUFLOW_SERVICE_URL", None)
+    with pytest.raises(ServiceException):
+        ServiceMetadataProvider(flow=_Flow())

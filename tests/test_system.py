@@ -1,0 +1,168 @@
+"""Sidecar framework, telemetry, tracing shim, FileCache, hybrid mesh,
+data loader."""
+
+import os
+
+import numpy as np
+import pytest
+
+
+class TestSidecar:
+    def test_message_roundtrip(self):
+        from metaflow_tpu.sidecar import Message
+
+        m = Message(Message.MUST_SEND, {"a": 1})
+        out = Message.deserialize(m.serialize())
+        assert out.kind == Message.MUST_SEND
+        assert out.payload == {"a": 1}
+
+    def test_null_sidecar(self):
+        from metaflow_tpu.sidecar import Message, NullSidecar
+
+        s = NullSidecar().start()
+        assert not s.send(Message(Message.BEST_EFFORT))
+        s.terminate()
+
+    def test_lossy_send_after_death(self):
+        from metaflow_tpu.sidecar import Message, Sidecar
+
+        s = Sidecar("json.tool").start()  # exits immediately on bad input
+        s._proc.kill()
+        s._proc.wait()
+        assert not s.send(Message(Message.MUST_SEND, {"x": 1}))
+
+
+class TestTelemetry:
+    def test_file_monitor_and_logger(self, tpuflow_root):
+        from metaflow_tpu.system import (
+            FileEventLogger,
+            FileMonitor,
+            read_metrics,
+        )
+
+        mon = FileMonitor(root=tpuflow_root)
+        with mon.measure("compile"):
+            pass
+        with mon.count("tasks"):
+            pass
+        mon.gauge("hbm_gb", 3.5)
+        records = read_metrics(root=tpuflow_root)
+        kinds = {r["type"] for r in records}
+        assert kinds == {"timer", "counter", "gauge"}
+
+        logger = FileEventLogger(root=tpuflow_root)
+        logger.log({"event": "x"})
+
+    def test_task_emits_metrics(self, run_flow, flows_dir, tpuflow_root):
+        from metaflow_tpu.system import read_metrics
+
+        run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+        names = {r["name"] for r in read_metrics(root=tpuflow_root)}
+        assert "metaflow.task.duration" in names
+        assert "metaflow.task.start" in names
+
+
+class TestTracing:
+    def test_noop_by_default(self, monkeypatch):
+        monkeypatch.delenv("TPUFLOW_OTEL_ENDPOINT", raising=False)
+        import metaflow_tpu.tracing as tracing
+
+        tracing._initialized = False
+        with tracing.span("x") as s:
+            assert s is None
+        assert tracing.get_trace_id() == ""
+        env = tracing.inject_tracing_vars({"A": "1"})
+        assert env == {"A": "1"}
+
+        @tracing.cli("cmd")
+        def f():
+            return 42
+
+        assert f() == 42
+
+
+class TestFileCache:
+    def test_store_load_evict(self, tmp_path):
+        from metaflow_tpu.client.filecache import FileCache
+
+        cache = FileCache(cache_dir=str(tmp_path / "c"), max_size=100)
+        key1 = "a" * 64
+        key2 = "b" * 64
+        cache.store_key(key1, b"x" * 80)
+        assert cache.load_key(key1) == b"x" * 80
+        assert cache.load_key("f" * 64) is None
+        cache.store_key(key2, b"y" * 80)  # exceeds cap → evict oldest
+        assert cache.load_key(key2) == b"y" * 80
+        assert cache.load_key(key1) is None
+
+
+class TestHybridMesh:
+    def test_explicit_slices(self):
+        import jax
+
+        from metaflow_tpu.parallel import MeshSpec
+        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(
+            MeshSpec.fsdp_tp(2), num_slices=2,
+            devices=jax.devices()[:8],
+        )
+        assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2}
+
+    def test_single_slice_falls_back(self):
+        from metaflow_tpu.parallel import MeshSpec
+        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(MeshSpec.fsdp(), num_slices=1)
+        assert "fsdp" in mesh.axis_names
+
+    def test_bad_division(self):
+        import jax
+
+        from metaflow_tpu.parallel import MeshSpec
+        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+
+        with pytest.raises(ValueError):
+            create_hybrid_mesh(MeshSpec.fsdp(), num_slices=3,
+                               devices=jax.devices()[:8])
+
+
+class TestDataLoader:
+    def test_token_batches(self):
+        from metaflow_tpu.training.data import token_batches
+
+        data = np.arange(100)
+        batches = list(token_batches(data, batch_size=2, seq_len=9))
+        assert all(b["tokens"].shape == (2, 10) for b in batches)
+        # windows tile the stream without overlap
+        flat = np.concatenate([b["tokens"].ravel() for b in batches])
+        assert len(set(flat.tolist())) == len(flat)
+
+    def test_sharded_prefetch_trains(self):
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.training import (
+            default_optimizer,
+            make_trainer,
+        )
+        from metaflow_tpu.training.data import sharded_dataset
+
+        cfg = llama.LlamaConfig.tiny()
+        mesh = create_mesh(MeshSpec.fsdp())
+        state, step_fn, _ = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama,
+            optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                        total_steps=10),
+        )
+        data = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=8 * 33 * 4
+        )
+        losses = []
+        with mesh:
+            for batch in sharded_dataset(data, 8, 32, mesh):
+                state, m = step_fn(state, batch)
+                losses.append(float(m["loss"]))
+        assert len(losses) == 4
+        assert losses[-1] < losses[0]

@@ -1,0 +1,91 @@
+"""TPU VM launcher unit tests against a fake gcloud."""
+
+import pytest
+
+from metaflow_tpu.plugins.tpu.launcher import TpuVmLauncher
+
+
+class FakeProc(object):
+    def __init__(self, lines, rc=0):
+        import io
+
+        self.stdout = io.StringIO("".join(l + "\n" for l in lines))
+        self._rc = rc
+
+    def wait(self):
+        return self._rc
+
+
+class FakeGcloud(object):
+    def __init__(self):
+        self.calls = []
+        self.tpus = {}
+
+    def create(self, name, accelerator_type, version, spot=False):
+        self.calls.append(("create", name, accelerator_type))
+        self.tpus[name] = {"state": "READY"}
+
+    def describe(self, name):
+        self.calls.append(("describe", name))
+        return self.tpus.get(name)
+
+    def delete(self, name):
+        self.calls.append(("delete", name))
+        self.tpus.pop(name, None)
+
+    def ssh(self, name, command, worker="all", stream=False):
+        self.calls.append(("ssh", name, command))
+        return FakeProc(["bootstrapping", "step ok"])
+
+    def scp(self, *a, **k):
+        self.calls.append(("scp",) + a)
+
+
+def test_launch_creates_runs_and_reaps(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TPU_TYPE", "v5litepod-8")
+    gcloud = FakeGcloud()
+    launcher = TpuVmLauncher(gcloud=gcloud)
+    lines = []
+    rc = launcher.launch_step(
+        ["python", "flow.py", "step", "train", "--run-id", "7",
+         "--task-id", "3"],
+        package_url="gs://bucket/pkg",
+        run_id="7", task_id="3",
+        echo=lines.append,
+    )
+    assert rc == 0
+    kinds = [c[0] for c in gcloud.calls]
+    assert "create" in kinds
+    assert "ssh" in kinds
+    assert "delete" in kinds  # ephemeral TPU reaped
+    ssh_cmd = next(c[2] for c in gcloud.calls if c[0] == "ssh")
+    assert "gs://bucket/pkg" in ssh_cmd       # bootstrap ships the package
+    assert "MF_PARALLEL_NODE_INDEX=$RANK" in ssh_cmd  # rank from metadata
+    assert "MF_PARALLEL_NUM_NODES=" in ssh_cmd        # gang world size
+    assert "-node-$RANK" in ssh_cmd           # per-rank task ids
+    assert "ubf_task" in ssh_cmd              # workers get the UBF context
+    assert "step train" in ssh_cmd
+    assert "step ok" in lines
+
+
+def test_reuse_skips_provisioning(monkeypatch):
+    monkeypatch.setenv("TPUFLOW_TPU_REUSE", "my-tpu")
+    gcloud = FakeGcloud()
+    launcher = TpuVmLauncher(gcloud=gcloud)
+    rc = launcher.launch_step(
+        ["python", "flow.py", "step", "a", "--run-id", "1", "--task-id", "2"],
+        "gs://b/p", "1", "2", echo=lambda *_: None,
+    )
+    assert rc == 0
+    kinds = [c[0] for c in gcloud.calls]
+    assert "create" not in kinds
+    assert "delete" not in kinds  # reused TPUs are not reaped
+
+
+def test_missing_config_errors(monkeypatch):
+    from metaflow_tpu.exception import TpuFlowException
+
+    monkeypatch.delenv("TPUFLOW_TPU_PROJECT", raising=False)
+    monkeypatch.delenv("TPUFLOW_TPU_ZONE", raising=False)
+    with pytest.raises(TpuFlowException):
+        TpuVmLauncher()

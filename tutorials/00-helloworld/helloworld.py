@@ -1,0 +1,31 @@
+"""Episode 00: the simplest possible flow.
+
+Run:  python helloworld.py run
+Then: python helloworld.py show
+"""
+
+from metaflow_tpu import FlowSpec, step
+
+
+class HelloFlow(FlowSpec):
+    """A flow where the steps just say hello."""
+
+    @step
+    def start(self):
+        """Every flow begins with 'start'."""
+        print("Metaflow-on-TPU says: Hi!")
+        self.next(self.hello)
+
+    @step
+    def hello(self):
+        self.greeting = "Hello from a task subprocess"
+        self.next(self.end)
+
+    @step
+    def end(self):
+        """Every flow ends with 'end'."""
+        print(self.greeting, "— and goodbye!")
+
+
+if __name__ == "__main__":
+    HelloFlow()

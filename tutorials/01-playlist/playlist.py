@@ -1,0 +1,48 @@
+"""Episode 01: parameters, branches, and artifacts.
+
+Run:  python playlist.py run --genre classical
+Read: python -c "from metaflow_tpu import Flow; \
+print(Flow('PlaylistFlow').latest_run.data.playlist)"
+"""
+
+from metaflow_tpu import FlowSpec, Parameter, step
+
+SONGS = {
+    "classical": ["Gymnopedie No.1", "Clair de Lune", "Spiegel im Spiegel"],
+    "electronic": ["Oberheim Drift", "Sine Language", "Packet Loss"],
+}
+
+
+class PlaylistFlow(FlowSpec):
+    genre = Parameter("genre", default="classical", type=str)
+    top_k = Parameter("top_k", default=2, type=int)
+
+    @step
+    def start(self):
+        self.catalog = SONGS
+        self.next(self.pick_genre, self.bonus_track)
+
+    @step
+    def pick_genre(self):
+        self.songs = self.catalog.get(self.genre, [])[: self.top_k]
+        self.next(self.join)
+
+    @step
+    def bonus_track(self):
+        self.bonus = "Warmup (TPU Mix)"
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.playlist = inputs.pick_genre.songs + [inputs.bonus_track.bonus]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("Your playlist:")
+        for i, song in enumerate(self.playlist, 1):
+            print("  %d. %s" % (i, song))
+
+
+if __name__ == "__main__":
+    PlaylistFlow()

@@ -1,0 +1,57 @@
+"""Episode 02: foreach fan-out + numeric artifacts (the reference's
+BASELINE config flow: tutorials/02-statistics).
+
+Run:  python stats.py run
+"""
+
+from metaflow_tpu import FlowSpec, card, current, step
+
+
+class StatsFlow(FlowSpec):
+    @step
+    def start(self):
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        self.series = {
+            "latency_ms": rng.lognormal(3.0, 0.4, 1000),
+            "throughput": rng.normal(100, 15, 1000),
+            "errors": rng.poisson(2.0, 1000).astype(float),
+        }
+        self.names = list(self.series)
+        self.next(self.compute, foreach="names")
+
+    @card
+    @step
+    def compute(self):
+        import numpy as np
+
+        from metaflow_tpu.plugins.cards import Markdown, Table
+
+        name = self.input
+        values = self.series[name]
+        self.name_ = name
+        self.stats = {
+            "mean": float(np.mean(values)),
+            "median": float(np.median(values)),
+            "p95": float(np.percentile(values, 95)),
+            "std": float(np.std(values)),
+        }
+        current.card.append(Markdown("## %s" % name))
+        current.card.append(Table.from_dict(self.stats))
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.report = {inp.name_: inp.stats for inp in inputs}
+        self.next(self.end)
+
+    @step
+    def end(self):
+        for name, stats in self.report.items():
+            print("%-12s mean=%.2f p95=%.2f" % (name, stats["mean"],
+                                                stats["p95"]))
+
+
+if __name__ == "__main__":
+    StatsFlow()

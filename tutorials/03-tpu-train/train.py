@@ -1,0 +1,64 @@
+"""Episode 03: the TPU path — gang-scheduled sharded training with
+checkpoints (scaled-down; swap the config for llama3_8b + a pod slice).
+
+Run:  python train.py run
+"""
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+
+
+class TpuTrainFlow(FlowSpec):
+    @step
+    def start(self):
+        self.num_steps = 5
+        self.next(self.train, num_parallel=2)
+
+    @metaflow_tpu.checkpoint
+    @step
+    def train(self):
+        # jax.distributed is already initialized: this process is one host
+        # of the gang (rank = current.parallel.node_index)
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.training import (
+            default_optimizer,
+            make_trainer,
+            shard_batch,
+        )
+
+        cfg = llama.LlamaConfig.tiny()   # llama3_8b() on real hardware
+        mesh = create_mesh(MeshSpec.fsdp())
+        state, train_step, _ = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama,
+            optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                        total_steps=100),
+        )
+        batch_size = max(4, len(jax.devices()))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, 33), 0, cfg.vocab_size
+        )
+        batch = shard_batch({"tokens": tokens}, mesh)
+        with mesh:
+            for i in range(self.num_steps):
+                state, metrics = train_step(state, batch)
+        self.loss = float(metrics["loss"])
+        self.rank = current.parallel.node_index
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        losses = {inp.rank: inp.loss for inp in inputs}
+        assert len(set(losses.values())) == 1, "ranks must agree"
+        self.loss = losses[0]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("trained to loss %.3f" % self.loss)
+
+
+if __name__ == "__main__":
+    TpuTrainFlow()
