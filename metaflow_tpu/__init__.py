@@ -31,6 +31,8 @@ tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
 checkpoint = make_step_decorator(STEP_DECORATORS["checkpoint"])
 secrets = make_step_decorator(STEP_DECORATORS["secrets"])
 card = make_step_decorator(STEP_DECORATORS["card"])
+pypi = make_step_decorator(STEP_DECORATORS["pypi"])
+conda = make_step_decorator(STEP_DECORATORS["conda"])
 
 project = make_flow_decorator(FLOW_DECORATORS["project"])
 schedule = make_flow_decorator(FLOW_DECORATORS["schedule"])
@@ -79,6 +81,8 @@ __all__ = [
     "checkpoint",
     "secrets",
     "card",
+    "pypi",
+    "conda",
     "project",
     "schedule",
     "trigger",

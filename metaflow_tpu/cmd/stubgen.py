@@ -1,0 +1,102 @@
+"""Type stub (.pyi) generator for the public API.
+
+Reference behavior: metaflow/cmd/develop/stub_generator.py (walks live
+modules, emits a stubs package for IDE/type-checker support). Minimal
+equivalent: introspect signatures + docstrings of the public surface.
+
+    python -m metaflow_tpu.cmd.stubgen [out_dir]
+"""
+
+import inspect
+import os
+import sys
+
+
+def _fmt_signature(obj):
+    try:
+        sig = inspect.signature(obj)
+    except (ValueError, TypeError):
+        return "(*args, **kwargs)"
+    parts = []
+    for p in sig.parameters.values():
+        s = p.name
+        if p.kind == p.VAR_POSITIONAL:
+            s = "*" + s
+        elif p.kind == p.VAR_KEYWORD:
+            s = "**" + s
+        elif p.default is not p.empty:
+            s += "=..."
+        parts.append(s)
+    return "(%s)" % ", ".join(parts)
+
+
+def _doc_line(obj):
+    doc = inspect.getdoc(obj)
+    if not doc:
+        return ""
+    first = doc.split("\n", 1)[0].replace('"""', "'''")
+    return '\n    """%s"""' % first
+
+
+def _class_stub(name, cls):
+    lines = ["class %s:" % name]
+    doc = _doc_line(cls)
+    if doc:
+        lines[0] += doc.replace("\n    ", "\n    ", 1)
+    members = []
+    for attr_name, attr in sorted(vars(cls).items()):
+        if attr_name.startswith("_") and attr_name != "__init__":
+            continue
+        if isinstance(attr, property):
+            members.append("    @property")
+            members.append("    def %s(self): ..." % attr_name)
+        elif inspect.isfunction(attr):
+            members.append(
+                "    def %s%s: ..." % (attr_name, _fmt_signature(attr))
+            )
+        elif isinstance(attr, (staticmethod, classmethod)):
+            fn = attr.__func__
+            deco = ("    @staticmethod" if isinstance(attr, staticmethod)
+                    else "    @classmethod")
+            members.append(deco)
+            members.append(
+                "    def %s%s: ..." % (attr_name, _fmt_signature(fn))
+            )
+    if not members:
+        members = ["    ..."]
+    return "\n".join(lines + members)
+
+
+def generate(out_dir):
+    import metaflow_tpu
+
+    blocks = [
+        '"""Auto-generated type stubs for metaflow_tpu '
+        '(python -m metaflow_tpu.cmd.stubgen)."""',
+        "from typing import Any",
+        "",
+    ]
+    for name in sorted(metaflow_tpu.__all__):
+        obj = getattr(metaflow_tpu, name)
+        if inspect.isclass(obj):
+            blocks.append(_class_stub(name, obj))
+        elif callable(obj):
+            doc = _doc_line(obj)
+            if doc:
+                blocks.append("def %s%s:%s\n    ..."
+                              % (name, _fmt_signature(obj), doc))
+            else:
+                blocks.append("def %s%s: ..." % (name, _fmt_signature(obj)))
+        else:
+            blocks.append("%s: Any" % name)
+        blocks.append("")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, "__init__.pyi")
+    with open(out_path, "w") as f:
+        f.write("\n".join(blocks))
+    return out_path
+
+
+if __name__ == "__main__":
+    out = generate(sys.argv[1] if len(sys.argv) > 1 else "metaflow_tpu-stubs")
+    print("wrote %s" % out)

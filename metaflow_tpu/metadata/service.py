@@ -69,7 +69,8 @@ class ServiceMetadataProvider(MetadataProvider):
                     break
             except (urllib.error.URLError, OSError) as ex:
                 last_err = ex
-            time.sleep(0.2 * (2 ** attempt))
+            if attempt < retries - 1:
+                time.sleep(0.2 * (2 ** attempt))
         raise ServiceException("%s %s failed: %s" % (method, path, last_err))
 
     def version(self):

@@ -169,7 +169,154 @@ def _flash_attention_fwd(q, k, v, causal, scale, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, causal, scale, block_k, seq_len):
+    """dq for one q block: iterate k blocks (≤ diagonal when causal)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    block_q, D = q.shape
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+
+    if causal:
+        num_kb = (qi * block_q) // block_k + pl.cdiv(block_q, block_k)
+    else:
+        num_kb = seq_len // block_k
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                            s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                            s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, D), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, causal, scale, block_q,
+                          seq_len):
+    """dk/dv for one k block: iterate q blocks (≥ diagonal when causal)."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, D = k.shape
+    num_qb = seq_len // block_q
+    first_qb = (ki * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
+        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32,
+                                                            s.shape, 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                            s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        first_qb, num_qb, body,
+        (jnp.zeros((block_k, D), jnp.float32),
+         jnp.zeros((block_k, D), jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_pallas(q, k, v, g, out, lse, causal, scale, interpret):
+    """Pallas backward: dq grid over q blocks, dk/dv grid over k blocks."""
+    BH, S, D = q.shape
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [BH, S]
+    # pad stats to the fp32 (8, 128) tile shape: [BH, 8, S], row 0 is live
+    lse_t = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
+    delta_t = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
+
+    stats_spec = pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0))
+    full_spec = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, scale=scale,
+            block_k=block_k, seq_len=S,
+        ),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
+            full_spec,                                              # k
+            full_spec,                                              # v
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # g
+            stats_spec,                                             # lse
+            stats_spec,                                             # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse_t, delta_t)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal, scale=scale,
+            block_q=block_q, seq_len=S,
+        ),
+        grid=(BH, S // block_k),
+        in_specs=[
+            full_spec,                                              # q
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
+            full_spec,                                              # g
+            stats_spec,                                             # lse
+            stats_spec,                                             # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse_t, delta_t)
+    return dq, dk, dv
+
+
 def _flash_attention_bwd(causal, scale, interpret, res, g):
+    """Backward dispatch: pallas kernels when available, else the XLA
+    blockwise-recompute fallback (both use the saved LSE, no S×S tensor)."""
+    q, k, v, out, lse = res
+    if HAS_PALLAS:
+        return _flash_backward_pallas(q, k, v, g, out, lse, causal, scale,
+                                      interpret)
+    return _flash_attention_bwd_xla(causal, scale, res, g)
+
+
+def _flash_attention_bwd_xla(causal, scale, res, g):
     """Blockwise recompute backward using the saved LSE (no S×S tensor)."""
     q, k, v, out, lse = res
     qf = q.astype(jnp.float32)

@@ -156,6 +156,12 @@ def create_hybrid_mesh(ici_spec, dcn_axis="data", num_slices=None,
         k: v for k, v in ici_spec.resolved(per_slice).items()
         if k != dcn_axis
     }
+    if not ici_sizes and per_slice > 1:
+        # pure data parallelism over slices: the dcn axis absorbs the
+        # per-slice devices too (ordering stays slice-grouped, so the
+        # gradient reduction tree stays ICI-local first)
+        flat = [d for group in groups for d in group]
+        return Mesh(np.asarray(flat, dtype=object), (dcn_axis,))
     if int(np.prod(list(ici_sizes.values()) or [1])) != per_slice:
         raise ValueError(
             "ICI axes %s do not cover the %d per-slice devices"

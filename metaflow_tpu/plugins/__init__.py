@@ -10,6 +10,7 @@ from .core_decorators import (
     ResourcesDecorator,
 )
 from .parallel_decorator import ParallelDecorator
+from .pypi.pypi_decorator import CondaStepDecorator, PyPIStepDecorator
 from .secrets_decorator import SecretsDecorator
 from .cards.card_decorator import CardDecorator
 from .tpu.tpu_decorator import TpuDecorator
@@ -25,6 +26,8 @@ STEP_DECORATORS = {
         EnvironmentDecorator,
         ResourcesDecorator,
         ParallelDecorator,
+        PyPIStepDecorator,
+        CondaStepDecorator,
         SecretsDecorator,
         CardDecorator,
         TpuDecorator,

@@ -1,0 +1,9 @@
+from .pypi_decorator import CondaStepDecorator, PyPIStepDecorator
+from .pypi_environment import PyPIEnvironment, env_id
+
+__all__ = [
+    "CondaStepDecorator",
+    "PyPIStepDecorator",
+    "PyPIEnvironment",
+    "env_id",
+]

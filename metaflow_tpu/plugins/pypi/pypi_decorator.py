@@ -1,0 +1,55 @@
+"""@pypi / @conda step decorators: run the step inside a per-step env.
+
+Reference behavior: metaflow/plugins/pypi/pypi_decorator.py +
+conda_decorator.py — the step's subprocess runs under the environment's
+interpreter (runtime_step_cli rewrites the entrypoint, which also opts the
+task out of the fork fast path automatically). @conda here shares the venv
+backend (a micromamba backend can slot into PyPIEnvironment later); the
+`libraries` attribute maps to packages for source compatibility.
+"""
+
+from ...decorators import StepDecorator
+from .pypi_environment import PyPIEnvironment
+
+
+class PyPIStepDecorator(StepDecorator):
+    """@pypi(packages={'pandas': '2.1.0'}, python=None)"""
+
+    name = "pypi"
+    defaults = {"packages": {}, "python": None, "disabled": False}
+
+    def _env(self):
+        return PyPIEnvironment(
+            self.attributes.get("packages") or {},
+            python=self.attributes.get("python"),
+        )
+
+    def runtime_init(self, flow, graph, package, run_id):
+        if self.attributes.get("disabled"):
+            return
+        # build once per run, before any task launches
+        self._env().ensure(echo=print)
+
+    def runtime_step_cli(self, cli_args, retry_count, max_user_code_retries,
+                         ubf_context):
+        if self.attributes.get("disabled"):
+            return
+        env = self._env()
+        interpreter = env.ensure()
+        # the step subprocess runs under the environment's interpreter
+        cli_args.entrypoint[0] = interpreter
+
+
+class CondaStepDecorator(PyPIStepDecorator):
+    """@conda(packages={...}, libraries={...}) — same env machinery; a
+    micromamba-based backend can replace PyPIEnvironment for non-Python
+    dependencies."""
+
+    name = "conda"
+    defaults = {"packages": {}, "libraries": {}, "python": None,
+                "disabled": False}
+
+    def _env(self):
+        packages = dict(self.attributes.get("libraries") or {})
+        packages.update(self.attributes.get("packages") or {})
+        return PyPIEnvironment(packages, python=self.attributes.get("python"))

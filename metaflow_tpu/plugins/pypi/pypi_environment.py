@@ -1,0 +1,116 @@
+"""Per-step Python environments.
+
+Reference behavior: metaflow/plugins/pypi/ (§2.8 — per-step locked envs,
+cached, bootstrap on remote hosts). TPU-first simplification: environments
+are virtualenvs layered over the system interpreter (--system-site-packages,
+so jax/the TPU runtime stay shared) with only the step's extra packages
+installed on top. Environments are content-addressed by their package spec
+and cached under <datastore root>/envs/.
+
+Offline/airgapped installs: set TPUFLOW_WHEELHOUSE to a directory of wheels
+(pip runs with --no-index --find-links), the natural mode on TPU fleets with
+no egress.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import venv
+
+from ...exception import TpuFlowException
+
+
+def env_id(packages, python=None):
+    """Content address of an environment spec."""
+    spec = json.dumps(
+        {"packages": dict(sorted((packages or {}).items())),
+         "python": python or "%d.%d" % sys.version_info[:2]},
+        sort_keys=True,
+    )
+    return hashlib.sha256(spec.encode("utf-8")).hexdigest()[:16]
+
+
+class PyPIEnvironment(object):
+    def __init__(self, packages, python=None, root=None):
+        from ...util import get_tpuflow_root
+
+        self.packages = dict(packages or {})
+        self.python = python
+        self.id = env_id(self.packages, python)
+        self.root = os.path.join(root or get_tpuflow_root(), "envs", self.id)
+
+    @property
+    def interpreter(self):
+        return os.path.join(self.root, "bin", "python")
+
+    @property
+    def ready_marker(self):
+        return os.path.join(self.root, ".ready")
+
+    def is_ready(self):
+        return os.path.exists(self.ready_marker)
+
+    def ensure(self, echo=lambda *_: None):
+        """Create + provision the venv once; concurrent builders race
+        benignly on the marker file."""
+        if self.is_ready():
+            return self.interpreter
+        echo("Building environment %s (%d packages)..."
+             % (self.id, len(self.packages)))
+        os.makedirs(os.path.dirname(self.root), exist_ok=True)
+        # system-site-packages: jax/the TPU libtpu stack stay shared —
+        # re-installing them per step would be slow and version-hazardous
+        venv.create(self.root, with_pip=True, system_site_packages=True,
+                    clear=not os.path.exists(self.interpreter))
+        self._link_parent_site_packages()
+        if self.packages:
+            self._pip_install()
+        with open(self.ready_marker, "w") as f:
+            json.dump({"packages": self.packages}, f)
+        return self.interpreter
+
+    def _link_parent_site_packages(self):
+        """When the launching interpreter is itself a venv (common on
+        TPU-VM images), --system-site-packages points at the BASE python,
+        not the launching venv — link the parent's site-packages explicitly
+        via a .pth so jax/numpy stay importable."""
+        import glob
+        import site
+
+        parent_sites = []
+        try:
+            parent_sites += site.getsitepackages()
+        except (AttributeError, OSError):
+            pass
+        child_sites = glob.glob(
+            os.path.join(self.root, "lib", "python*", "site-packages")
+        )
+        for child_site in child_sites:
+            targets = [p for p in parent_sites
+                       if os.path.isdir(p)
+                       and os.path.abspath(p) != os.path.abspath(child_site)]
+            if targets:
+                with open(os.path.join(child_site,
+                                       "_tpuflow_parent.pth"), "w") as f:
+                    f.write("\n".join(targets) + "\n")
+
+    def _pip_install(self):
+        reqs = [
+            name if version in (None, "", "*") else "%s==%s" % (name, version)
+            for name, version in self.packages.items()
+        ]
+        cmd = [self.interpreter, "-m", "pip", "install", "--quiet",
+               "--disable-pip-version-check"]
+        wheelhouse = os.environ.get("TPUFLOW_WHEELHOUSE")
+        if wheelhouse:
+            cmd += ["--no-index", "--find-links", wheelhouse]
+        cmd += reqs
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=1800)
+        if proc.returncode != 0:
+            raise TpuFlowException(
+                "pip install failed for environment %s:\n%s"
+                % (self.id, proc.stderr.strip()[-1000:])
+            )

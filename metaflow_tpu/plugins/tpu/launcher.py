@@ -109,19 +109,29 @@ class TpuVmLauncher(object):
     def _ensure_tpu(self, name):
         if self.reuse:
             return self.reuse, False
-        if self.gcloud.describe(name) is None:
-            self.gcloud.create(name, self.accelerator, self.version,
-                               spot=self.spot)
-            # wait for READY
-            deadline = time.time() + 1800
-            while time.time() < deadline:
+        created = False
+        try:
+            info = self.gcloud.describe(name)
+            if info is None:
+                self.gcloud.create(name, self.accelerator, self.version,
+                                   spot=self.spot)
+                created = True
                 info = self.gcloud.describe(name) or {}
-                if info.get("state") == "READY":
-                    break
+            # wait for READY whether we created it or found it mid-provision
+            deadline = time.time() + 1800
+            while (info or {}).get("state") != "READY":
+                if time.time() > deadline:
+                    raise TpuFlowException(
+                        "TPU %s never became READY" % name
+                    )
                 time.sleep(10)
-            else:
-                raise TpuFlowException("TPU %s never became READY" % name)
-        return name, not self.reuse
+                info = self.gcloud.describe(name)
+            return name, True
+        except BaseException:
+            # never leak a billed slice we provisioned
+            if created and os.environ.get("TPUFLOW_TPU_KEEP", "0") != "1":
+                self.gcloud.delete(name)
+            raise
 
     def launch_step(self, step_argv, package_url, run_id, task_id,
                     echo=print):

@@ -46,13 +46,16 @@ def prefetch(iterator, depth=2):
     lock = threading.Condition()
     done = []
     error = []
+    stopped = []
 
     def producer():
         try:
             for item in iterator:
                 with lock:
-                    while len(queue) >= depth:
+                    while len(queue) >= depth and not stopped:
                         lock.wait()
+                    if stopped:
+                        return
                     queue.append(item)
                     lock.notify_all()
         except BaseException as ex:  # surface in the consumer, never swallow
@@ -66,18 +69,26 @@ def prefetch(iterator, depth=2):
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
-    while True:
+    try:
+        while True:
+            with lock:
+                while not queue and not done:
+                    lock.wait()
+                if queue:
+                    item = queue.popleft()
+                    lock.notify_all()
+                elif error:
+                    raise error[0]
+                else:
+                    return
+            yield item
+    finally:
+        # consumer stopped early (break / close): release the producer so
+        # the thread and its prefetched device buffers are reclaimed
         with lock:
-            while not queue and not done:
-                lock.wait()
-            if queue:
-                item = queue.popleft()
-                lock.notify_all()
-            elif error:
-                raise error[0]
-            else:
-                return
-        yield item
+            stopped.append(True)
+            queue.clear()
+            lock.notify_all()
 
 
 def sharded_dataset(data, batch_size, seq_len, mesh, rng=None,

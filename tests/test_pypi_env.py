@@ -1,0 +1,130 @@
+"""Per-step @pypi environments: offline wheelhouse install + interpreter
+swap, exercised through a real flow run."""
+
+import base64
+import hashlib
+import os
+import zipfile
+
+import pytest
+
+
+def _make_wheel(directory, name="tpuflow_testpkg", version="1.0.0"):
+    """Craft a minimal pure-python wheel offline (no build tooling)."""
+    dist = "%s-%s" % (name, version)
+    wheel_path = os.path.join(directory, "%s-py3-none-any.whl" % dist)
+    module_src = 'MARKER = "installed-from-wheelhouse"\n'
+    metadata = (
+        "Metadata-Version: 2.1\nName: %s\nVersion: %s\n" % (name, version)
+    )
+    wheel_meta = (
+        "Wheel-Version: 1.0\nGenerator: tpuflow-test\nRoot-Is-Purelib: true\n"
+        "Tag: py3-none-any\n"
+    )
+
+    def record_line(arcname, data):
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data.encode()).digest()
+        ).rstrip(b"=").decode()
+        return "%s,sha256=%s,%d" % (arcname, digest, len(data))
+
+    files = {
+        "%s.py" % name: module_src,
+        "%s.dist-info/METADATA" % dist: metadata,
+        "%s.dist-info/WHEEL" % dist: wheel_meta,
+    }
+    record = "\n".join(
+        [record_line(k, v) for k, v in files.items()]
+        + ["%s.dist-info/RECORD,," % dist, ""]
+    )
+    with zipfile.ZipFile(wheel_path, "w") as zf:
+        for arcname, data in files.items():
+            zf.writestr(arcname, data)
+        zf.writestr("%s.dist-info/RECORD" % dist, record)
+    return wheel_path
+
+
+FLOW_SRC = """
+import sys
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, step
+
+
+class PypiFlow(FlowSpec):
+    @step
+    def start(self):
+        self.outer_python = sys.executable
+        self.next(self.isolated)
+
+    @metaflow_tpu.pypi(packages={"tpuflow-testpkg": "1.0.0"})
+    @step
+    def isolated(self):
+        import tpuflow_testpkg
+
+        self.marker = tpuflow_testpkg.MARKER
+        self.inner_python = sys.executable
+        # system site-packages still visible (shared jax stack)
+        import numpy  # noqa: F401
+
+        self.next(self.end)
+
+    @step
+    def end(self):
+        try:
+            import tpuflow_testpkg  # noqa: F401
+
+            self.leaked = True
+        except ImportError:
+            self.leaked = False
+        print("marker:", self.marker)
+        print("isolated interpreter:", self.inner_python != sys.executable)
+        print("leaked:", self.leaked)
+
+
+if __name__ == "__main__":
+    PypiFlow()
+"""
+
+
+def test_env_id_stable():
+    from metaflow_tpu.plugins.pypi import env_id
+
+    a = env_id({"x": "1", "y": "2"})
+    b = env_id({"y": "2", "x": "1"})
+    assert a == b
+    assert env_id({"x": "2"}) != a
+
+
+def test_pypi_flow_offline_wheelhouse(run_flow, tpuflow_root, tmp_path):
+    wheelhouse = tmp_path / "wheels"
+    wheelhouse.mkdir()
+    _make_wheel(str(wheelhouse))
+    flow_file = tmp_path / "pypi_flow.py"
+    flow_file.write_text(FLOW_SRC)
+
+    proc = run_flow(
+        str(flow_file), "run",
+        env_extra={"TPUFLOW_WHEELHOUSE": str(wheelhouse)},
+    )
+    assert "marker: installed-from-wheelhouse" in proc.stdout
+    assert "isolated interpreter: True" in proc.stdout
+    assert "leaked: False" in proc.stdout
+    # second run reuses the cached env (no rebuild message)
+    proc2 = run_flow(
+        str(flow_file), "run",
+        env_extra={"TPUFLOW_WHEELHOUSE": str(wheelhouse)},
+    )
+    assert "Building environment" not in proc2.stdout
+
+
+def test_missing_package_fails_cleanly(run_flow, tpuflow_root, tmp_path):
+    wheelhouse = tmp_path / "empty_wheels"
+    wheelhouse.mkdir()
+    flow_file = tmp_path / "pypi_flow.py"
+    flow_file.write_text(FLOW_SRC)
+    proc = run_flow(
+        str(flow_file), "run", expect_fail=True,
+        env_extra={"TPUFLOW_WHEELHOUSE": str(wheelhouse)},
+    )
+    assert "pip install failed" in proc.stdout + proc.stderr
