@@ -127,7 +127,49 @@ def _vs_baseline(value):
     return 1.0
 
 
+def _tpu_backend_responsive(timeout=180):
+    """Probe backend init in a SUBPROCESS: a wedged TPU tunnel (stale lease
+    on the chip) hangs jax.devices() forever — never let that hang the
+    bench itself."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        backend = out.stdout.strip()
+        # a crashed probe (nonzero rc / empty or garbage output) needs the
+        # fallback just as much as a hung one
+        if out.returncode != 0 or backend not in ("tpu", "cpu", "gpu"):
+            return None
+        return backend
+    except subprocess.TimeoutExpired:
+        return None
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "train")
-    result = bench_step_launch() if mode == "launch" else bench_tokens_per_sec()
+    if mode == "launch":
+        result = bench_step_launch()
+    else:
+        if os.environ.get("BENCH_SKIP_PROBE") != "1":
+            backend = _tpu_backend_responsive()
+            if backend is None:
+                # TPU tunnel wedged: fall back to a CPU run rather than hang
+                import subprocess
+
+                env = dict(os.environ)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["JAX_PLATFORM_NAME"] = "cpu"
+                env["BENCH_SKIP_PROBE"] = "1"
+                env["PYTHONPATH"] = os.pathsep.join(
+                    p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if p and "axon_site" not in p
+                )
+                sys.exit(subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env
+                ).returncode)
+        result = bench_tokens_per_sec()
     print(json.dumps(result))

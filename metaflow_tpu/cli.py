@@ -425,6 +425,33 @@ def main(flow, args=None):
                 with open(local) as f:
                     print(f.read())
 
+    @card.command(name="server", help="Serve cards over HTTP for browsing.")
+    @click.option("--port", default=8324)
+    @click.pass_obj
+    def card_server(state, port):
+        import http.server
+
+        if state.flow_datastore.ds_type != "local":
+            raise TpuFlowException(
+                "card server currently serves local datastores only; for "
+                "remote stores use 'card get' (reads via the storage "
+                "abstraction)."
+            )
+        root = state.flow_datastore.storage.datastore_root
+        cards_root = os.path.join(root, flow.name, "mf.cards")
+
+        class Handler(http.server.SimpleHTTPRequestHandler):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, directory=cards_root, **kw)
+
+            def log_message(self, *args):
+                pass
+
+        echo("Serving cards of %s on http://127.0.0.1:%d (run/step/task/"
+             "default.html)" % (flow.name, port))
+        http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler
+                                        ).serve_forever()
+
     @card.command(name="list", help="List cards of a task.")
     @click.argument("pathspec")
     @click.pass_obj

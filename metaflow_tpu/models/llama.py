@@ -146,7 +146,7 @@ def logical_axes(cfg):
     }
 
 
-def _layer(cfg, cos, sin, x, layer_params):
+def _layer(cfg, cos, sin, x, layer_params, mesh=None):
     """One transformer block; x: [B, S, D]."""
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -157,7 +157,19 @@ def _layer(cfg, cos, sin, x, layer_params):
     v = (h @ layer_params["wv"]).reshape(B, S, KV, Hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    if cfg.attention_impl == "ring":
+        # context parallelism: sequence stays sharded, KV blocks rotate
+        # around the 'sequence' mesh axis (ops/ring_attention.py)
+        from ..ops.ring_attention import ring_attention
+
+        if mesh is None or "sequence" not in mesh.axis_names:
+            raise ValueError(
+                "attention_impl='ring' needs a mesh with a 'sequence' axis "
+                "passed to forward/loss_fn"
+            )
+        attn = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        attn = attention(q, k, v, causal=True, impl=cfg.attention_impl)
     x = x + attn.reshape(B, S, H * Hd) @ layer_params["wo"]
 
     h = rms_norm(x, layer_params["ffn_norm"], cfg.norm_eps)
@@ -167,8 +179,10 @@ def _layer(cfg, cos, sin, x, layer_params):
     return x
 
 
-def forward(params, tokens, cfg):
-    """tokens: [B, S] int32 → logits [B, S, vocab] (float32)."""
+def forward(params, tokens, cfg, mesh=None):
+    """tokens: [B, S] int32 → logits [B, S, vocab] (float32).
+
+    `mesh` is only needed for attention_impl='ring' (sequence parallelism)."""
     dt = param_dtype(cfg)
     x = params["embed"][tokens].astype(dt)
     cos, sin = rope_frequencies(
@@ -176,7 +190,7 @@ def forward(params, tokens, cfg):
         llama3_scaling=cfg.rope_llama3_scaling,
     )
 
-    layer_fn = lambda x, lp: (_layer(cfg, cos, sin, x, lp), None)
+    layer_fn = lambda x, lp: (_layer(cfg, cos, sin, x, lp, mesh=mesh), None)
     if cfg.remat:
         policy = None
         if cfg.remat_policy == "dots":
@@ -192,7 +206,7 @@ def forward(params, tokens, cfg):
     return logits
 
 
-def loss_fn(params, batch, cfg):
+def loss_fn(params, batch, cfg, mesh=None):
     """Next-token cross-entropy; batch: {'tokens': [B, S+1]} or
     {'inputs': [B,S], 'targets': [B,S]} (+ optional 'mask')."""
     if "tokens" in batch:
@@ -200,7 +214,7 @@ def loss_fn(params, batch, cfg):
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(params, inputs, cfg)
+    logits = forward(params, inputs, cfg, mesh=mesh)
     logps = jax.nn.log_softmax(logits, axis=-1)
     token_lp = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")

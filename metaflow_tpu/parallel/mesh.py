@@ -183,7 +183,10 @@ def data_axes(mesh):
 
 
 def batch_sharding(mesh):
-    """NamedSharding for [batch, ...] inputs: batch over data axes."""
+    """NamedSharding for [batch, ...] inputs: batch over data axes.
+
+    Sequence-dim placement lives in training.shard_batch (it must check
+    per-array divisibility); this stays a rank-agnostic 1-dim spec."""
     from jax.sharding import NamedSharding, PartitionSpec
 
     axes = data_axes(mesh)

@@ -14,7 +14,6 @@ import jax.numpy as jnp
 import optax
 
 from ..parallel import sharding as shd
-from ..parallel.mesh import batch_sharding
 
 
 def default_optimizer(lr=3e-4, weight_decay=0.1, clip_norm=1.0,
@@ -69,8 +68,14 @@ def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None):
     optimizer = optimizer or default_optimizer()
     loss_fn = loss_fn or model.loss_fn
 
+    import inspect
+
+    loss_takes_mesh = "mesh" in inspect.signature(loss_fn).parameters
+
     def step(state, batch):
         def compute_loss(params):
+            if loss_takes_mesh:
+                return loss_fn(params, batch, cfg, mesh=mesh)
             return loss_fn(params, batch, cfg)
 
         loss, grads = jax.value_and_grad(compute_loss)(state["params"])
@@ -105,15 +110,41 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
 
 
 def make_eval_step(cfg, mesh, model, loss_fn=None):
+    import inspect
+
     loss_fn = loss_fn or model.loss_fn
+    loss_takes_mesh = "mesh" in inspect.signature(loss_fn).parameters
 
     def step(params, batch):
+        if loss_takes_mesh:
+            return loss_fn(params, batch, cfg, mesh=mesh)
         return loss_fn(params, batch, cfg)
 
     return jax.jit(step)
 
 
 def shard_batch(batch, mesh):
-    """Place a host batch onto the mesh (batch dim over data axes)."""
-    sh = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+    """Place a host batch onto the mesh: batch dim over data axes; the
+    sequence dim over the 'sequence' axis when present AND divisible (a
+    [B, S+1] token array stays batch-sharded; GSPMD reshards the sliced
+    [B, S] inputs inside the step)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..parallel.mesh import data_axes
+
+    axes = data_axes(mesh)
+    batch_spec = axes if axes else None
+    seq_size = mesh.shape.get("sequence", 1)
+
+    def place(x):
+        if (
+            seq_size > 1
+            and getattr(x, "ndim", 0) >= 2
+            and x.shape[1] % seq_size == 0
+        ):
+            spec = PartitionSpec(batch_spec, "sequence")
+        else:
+            spec = PartitionSpec(batch_spec)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, batch)

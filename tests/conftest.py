@@ -39,11 +39,20 @@ def run_flow(tpuflow_root):
     def _run(flow_file, *args, expect_fail=False, env_extra=None):
         env = dict(os.environ)
         env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
-        env["PYTHONPATH"] = (
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-            + os.pathsep
-            + env.get("PYTHONPATH", "")
+        # CPU-only subprocesses: drop the axon TPU plugin site dir entirely.
+        # Initializing the axon backend from test processes both serializes
+        # on the single tunnel slot (a hung test wedges the chip for every
+        # later process) and costs ~1.7s of jax import per task.
+        inherited = [
+            p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p
+        ]
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + inherited
         )
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_PLATFORM_NAME"] = "cpu"
         if env_extra:
             env.update(env_extra)
         proc = subprocess.run(

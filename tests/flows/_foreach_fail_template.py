@@ -1,0 +1,35 @@
+import os
+
+from metaflow_tpu import FlowSpec, step
+
+
+class ForeachResumeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [0, 1, 2, 3]
+        self.next(self.work, foreach="items")
+
+    @step
+    def work(self):
+        if self.input == 2 and os.environ.get("FAIL_BRANCH_2"):
+            raise RuntimeError("branch 2 dies")
+        self.marker_file = os.environ.get("WORK_MARKER")
+        if self.marker_file:
+            with open(self.marker_file, "a") as f:
+                f.write("%d\n" % self.input)
+        self.result = self.input * 10
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.results = [inp.result for inp in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.results == [0, 10, 20, 30], self.results
+        print("results:", self.results)
+
+
+if __name__ == "__main__":
+    ForeachResumeFlow()

@@ -1,0 +1,59 @@
+"""Datatools GS client (local-path mode; gs:// shares the same surface)."""
+
+import pytest
+
+from metaflow_tpu.datatools import GS
+
+
+def test_put_get_roundtrip(tmp_path):
+    with GS(gsroot=str(tmp_path / "store")) as gs:
+        url = gs.put("dir/a.txt", b"hello")
+        assert url.endswith("dir/a.txt")
+        obj = gs.get("dir/a.txt")
+        assert obj.exists
+        assert obj.blob == b"hello"
+        assert obj.text == "hello"
+        assert obj.size == 5
+
+
+def test_missing_object(tmp_path):
+    with GS(gsroot=str(tmp_path / "store")) as gs:
+        obj = gs.get("nope")
+        assert not obj.exists
+        with pytest.raises(Exception):
+            obj.blob
+
+
+def test_batched_ops_and_listing(tmp_path):
+    with GS(gsroot=str(tmp_path / "store")) as gs:
+        gs.put_many([("k%d" % i, b"v%d" % i) for i in range(20)])
+        objs = gs.get_many(["k%d" % i for i in range(20)])
+        assert all(o.exists for o in objs)
+        assert objs[7].blob == b"v7"
+        assert len(gs.list_paths()) == 20
+
+
+def test_no_tempfile_collision(tmp_path):
+    """Keys that flatten to the same name must not share a temp file."""
+    with GS(gsroot=str(tmp_path / "store")) as gs:
+        gs.put("a/b", b"slash")
+        gs.put("a_b", b"underscore")
+        objs = gs.get_many(["a/b", "a_b"])
+        assert objs[0].blob == b"slash"
+        assert objs[1].blob == b"underscore"
+        assert objs[0].path != objs[1].path
+
+
+def test_run_scoped_paths(tmp_path, tpuflow_root):
+    from metaflow_tpu.current import current
+
+    class FakeFlow:
+        name = "ScopedFlow"
+
+    current._set_env(run_id="123")
+    try:
+        with GS(gsroot=str(tmp_path / "store"), run=FakeFlow()) as gs:
+            url = gs.put("x", b"1")
+            assert "ScopedFlow" in url and "123" in url
+    finally:
+        current._set_env(run_id=None, is_running=False)

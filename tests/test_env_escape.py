@@ -1,0 +1,71 @@
+"""env_escape RPC bridge: outer-interpreter calls from a separate process."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from metaflow_tpu.plugins.env_escape import (
+    EscapeClient,
+    EscapeServer,
+    RemoteError,
+)
+
+
+@pytest.fixture()
+def server():
+    srv = EscapeServer(modules=["math", "json"]).start()
+    yield srv
+    srv.stop()
+
+
+def test_module_call_roundtrip(server):
+    client = EscapeClient(server.socket_path)
+    math = client.load_module("math")
+    assert math.sqrt(4.0) == 2.0
+    assert math.pi > 3.14
+    json_mod = client.load_module("json")
+    assert json_mod.loads('{"a": 1}') == {"a": 1}
+    client.close()
+
+
+def test_remote_exception_transfers(server):
+    client = EscapeClient(server.socket_path)
+    math = client.load_module("math")
+    with pytest.raises(RemoteError) as exc:
+        math.sqrt(-1.0)
+    assert "math domain error" in str(exc.value)
+    client.close()
+
+
+def test_allow_list_enforced(server):
+    client = EscapeClient(server.socket_path)
+    with pytest.raises(RemoteError) as exc:
+        client.load_module("os").getcwd()
+    assert "allow-list" in str(exc.value)
+    client.close()
+
+
+def test_unpicklable_results_become_proxies(server):
+    client = EscapeClient(server.socket_path)
+    # a generator is unpicklable: comes back as a proxy usable remotely
+    json_mod = client.load_module("json")
+    decoder = json_mod.JSONDecoder()  # instance lives on the server
+    assert decoder.decode("[1, 2]") == [1, 2]
+    client.close()
+
+
+def test_cross_process(server):
+    """The real scenario: a different interpreter process calls back."""
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from metaflow_tpu.plugins.env_escape import load_module; "
+        "print(load_module('math').factorial(5))"
+        % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "120"

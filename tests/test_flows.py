@@ -106,6 +106,36 @@ def test_resume(run_flow, flows_dir, tpuflow_root, tmp_path):
     assert run.data.y == 42
 
 
+def test_foreach_resume_clones_successful_branches(run_flow, flows_dir,
+                                                   tpuflow_root, tmp_path):
+    """Resume of a partially-failed foreach: surviving branches clone (do
+    not re-execute), the failed branch + join + end re-run, and join order
+    is preserved."""
+    import shutil
+
+    flow_file = str(tmp_path / "foreach_resume_flow.py")
+    shutil.copy(
+        os.path.join(flows_dir, "_foreach_fail_template.py"), flow_file
+    )
+    marker = str(tmp_path / "executed")
+    run_flow(flow_file, "run", expect_fail=True,
+             env_extra={"FAIL_BRANCH_2": "1", "WORK_MARKER": marker})
+    executed_first = sorted(open(marker).read().split())
+
+    marker2 = str(tmp_path / "executed2")
+    proc = run_flow(flow_file, "resume", env_extra={"WORK_MARKER": marker2})
+    assert "Cloned" in proc.stdout
+    # only the failed branch re-executed
+    executed_resume = sorted(open(marker2).read().split())
+    assert executed_resume == ["2"], executed_resume
+    assert "0" in executed_first and "2" not in executed_first
+
+    c = _client(tpuflow_root)
+    run = c.Flow("ForeachResumeFlow").latest_run
+    assert run.successful
+    assert run.data.results == [0, 10, 20, 30]
+
+
 def test_failing_run_marked_failed(run_flow, flows_dir, tpuflow_root, tmp_path):
     flow_file = str(tmp_path / "resumable_flow.py")
     with open(os.path.join(flows_dir, "_resumable_flow_template.py")) as f:
