@@ -33,6 +33,7 @@ secrets = make_step_decorator(STEP_DECORATORS["secrets"])
 card = make_step_decorator(STEP_DECORATORS["card"])
 pypi = make_step_decorator(STEP_DECORATORS["pypi"])
 conda = make_step_decorator(STEP_DECORATORS["conda"])
+uv = make_step_decorator(STEP_DECORATORS["uv"])
 
 project = make_flow_decorator(FLOW_DECORATORS["project"])
 schedule = make_flow_decorator(FLOW_DECORATORS["schedule"])
@@ -54,6 +55,14 @@ from .client import (  # noqa: E402
 )
 
 from .runner import Runner, Deployer  # noqa: E402
+
+
+def __getattr__(name):
+    if name == "NBRunner":
+        from .runner.nbrun import NBRunner
+
+        return NBRunner
+    raise AttributeError("module 'metaflow_tpu' has no attribute %r" % name)
 
 __version__ = "0.1.0"
 
@@ -83,6 +92,7 @@ __all__ = [
     "card",
     "pypi",
     "conda",
+    "uv",
     "project",
     "schedule",
     "trigger",

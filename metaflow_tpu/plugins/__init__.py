@@ -10,7 +10,11 @@ from .core_decorators import (
     ResourcesDecorator,
 )
 from .parallel_decorator import ParallelDecorator
-from .pypi.pypi_decorator import CondaStepDecorator, PyPIStepDecorator
+from .pypi.pypi_decorator import (
+    CondaStepDecorator,
+    PyPIStepDecorator,
+    UVStepDecorator,
+)
 from .secrets_decorator import SecretsDecorator
 from .cards.card_decorator import CardDecorator
 from .tpu.tpu_decorator import TpuDecorator
@@ -28,6 +32,7 @@ STEP_DECORATORS = {
         ParallelDecorator,
         PyPIStepDecorator,
         CondaStepDecorator,
+        UVStepDecorator,
         SecretsDecorator,
         CardDecorator,
         TpuDecorator,

@@ -172,20 +172,34 @@ class ParallelDecorator(StepDecorator):
                 )
             }
         )
-        self.setup_distributed_env(flow)
         try:
-            step_func()
-        finally:
-            self.teardown_distributed_env(flow)
+            self.setup_distributed_env(flow)
+            try:
+                step_func()
+            finally:
+                self.teardown_distributed_env(flow)
 
-        failed = []
-        for proc, task_id in zip(procs, mapper_task_ids[1:]):
-            if proc.wait() != 0:
-                failed.append(task_id)
-        if failed:
-            raise TpuFlowException(
-                "Gang worker task(s) failed: %s" % ", ".join(failed)
-            )
+            failed = []
+            for proc, task_id in zip(procs, mapper_task_ids[1:]):
+                if proc.wait() != 0:
+                    failed.append(task_id)
+            if failed:
+                raise TpuFlowException(
+                    "Gang worker task(s) failed: %s" % ", ".join(failed)
+                )
+        except BaseException:
+            # rank 0 died: never leave worker ranks running (a stalled rank
+            # would hold collective state — and on shared-chip dev boxes,
+            # the TPU itself)
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+            raise
 
     @staticmethod
     def _free_port():

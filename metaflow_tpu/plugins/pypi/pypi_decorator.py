@@ -53,3 +53,17 @@ class CondaStepDecorator(PyPIStepDecorator):
         packages = dict(self.attributes.get("libraries") or {})
         packages.update(self.attributes.get("packages") or {})
         return PyPIEnvironment(packages, python=self.attributes.get("python"))
+
+
+class UVStepDecorator(PyPIStepDecorator):
+    """@uv(packages={...}) — uv-backed installs when the uv binary exists
+    (reference: plugins/uv/); falls back to pip transparently."""
+
+    name = "uv"
+
+    def _env(self):
+        return PyPIEnvironment(
+            self.attributes.get("packages") or {},
+            python=self.attributes.get("python"),
+            installer="uv",
+        )

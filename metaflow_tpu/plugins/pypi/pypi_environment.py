@@ -33,11 +33,12 @@ def env_id(packages, python=None):
 
 
 class PyPIEnvironment(object):
-    def __init__(self, packages, python=None, root=None):
+    def __init__(self, packages, python=None, root=None, installer="pip"):
         from ...util import get_tpuflow_root
 
         self.packages = dict(packages or {})
         self.python = python
+        self.installer = installer  # "pip" | "uv" (uv falls back to pip)
         self.id = env_id(self.packages, python)
         self.root = os.path.join(root or get_tpuflow_root(), "envs", self.id)
 
@@ -97,13 +98,34 @@ class PyPIEnvironment(object):
                     f.write("\n".join(targets) + "\n")
 
     def _pip_install(self):
+        import shutil as _shutil
+
         reqs = [
             name if version in (None, "", "*") else "%s==%s" % (name, version)
             for name, version in self.packages.items()
         ]
+        wheelhouse = os.environ.get("TPUFLOW_WHEELHOUSE")
+
+        uv = _shutil.which("uv") if self.installer == "uv" else None
+        if uv:
+            # uv resolves/installs much faster than pip when available
+            # (reference: plugins/uv/uv_environment.py); explicit opt-in via
+            # @uv only — @pypi/@conda keep pip's resolver
+            cmd = [uv, "pip", "install", "--quiet", "--python",
+                   self.interpreter]
+            if wheelhouse:
+                cmd += ["--no-index", "--find-links", wheelhouse]
+            try:
+                proc = subprocess.run(cmd + reqs, capture_output=True,
+                                      text=True, timeout=1800)
+                if proc.returncode == 0:
+                    return
+            except subprocess.TimeoutExpired:
+                pass
+            # fall through to pip on any uv failure (incl. hang)
+
         cmd = [self.interpreter, "-m", "pip", "install", "--quiet",
                "--disable-pip-version-check"]
-        wheelhouse = os.environ.get("TPUFLOW_WHEELHOUSE")
         if wheelhouse:
             cmd += ["--no-index", "--find-links", wheelhouse]
         cmd += reqs

@@ -12,6 +12,16 @@ from ..exception import TpuFlowException
 from .deployer import Deployer  # noqa: F401  (public API re-export)
 
 
+def __getattr__(name):
+    # NBRunner imports lazily: nbrun pulls in Runner machinery that isn't
+    # needed for the common CLI path
+    if name == "NBRunner":
+        from .nbrun import NBRunner
+
+        return NBRunner
+    raise AttributeError(name)
+
+
 class ExecutingRun(object):
     """Result of Runner.run(): the subprocess + the client Run object."""
 

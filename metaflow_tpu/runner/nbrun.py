@@ -1,0 +1,50 @@
+"""Notebook ergonomics: run a flow defined in a notebook cell.
+
+Reference behavior: metaflow/runner/nbrun.py (NBRunner) — the flow class is
+defined interactively; we materialize it to a temp .py file and drive the
+normal Runner machinery, so the notebook flow behaves exactly like a file
+flow (subprocess tasks, datastore, client API).
+"""
+
+import inspect
+import os
+import tempfile
+
+from ..exception import TpuFlowException
+from . import Runner
+
+
+DEFAULT_PRELUDE = "import metaflow_tpu\nfrom metaflow_tpu import *\n"
+
+
+class NBRunner(object):
+    def __init__(self, flow_cls, prelude=None, env=None, **top_level_kwargs):
+        try:
+            source = inspect.getsource(flow_cls)
+        except (OSError, TypeError):
+            raise TpuFlowException(
+                "Could not get the source of %r — define the flow class in "
+                "its own cell." % flow_cls
+            )
+        self._dir = tempfile.mkdtemp(prefix="tpuflow_nb_")
+        flow_file = os.path.join(self._dir, "%s.py" % flow_cls.__name__)
+        with open(flow_file, "w") as f:
+            f.write(prelude or DEFAULT_PRELUDE)
+            f.write("\n")
+            f.write(source)
+            f.write(
+                "\n\nif __name__ == '__main__':\n    %s()\n"
+                % flow_cls.__name__
+            )
+        self._runner = Runner(flow_file, env=env, **top_level_kwargs)
+
+    def run(self, **params):
+        return self._runner.run(**params)
+
+    def async_run(self, **params):
+        return self._runner.async_run(**params)
+
+    def cleanup(self):
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)

@@ -557,13 +557,19 @@ class NativeRuntime(object):
             args = self._build_cli_args(task)
             env = dict(os.environ)
             env.update(args.env)
+            # own process group: terminating the task also reaps anything it
+            # spawned (gang worker ranks, trampolined children) — a hung
+            # rank must never outlive its control task
             proc = subprocess.Popen(
                 args.get_args(),
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
                 bufsize=0,
+                start_new_session=True,
             )
+            proc.terminate = _group_killer(proc, 15)  # SIGTERM
+            proc.kill = _group_killer(proc, 9)        # SIGKILL
         worker = Worker(task, proc, self._echo)
         os.set_blocking(proc.stdout.fileno(), False)
         os.set_blocking(proc.stderr.fileno(), False)
@@ -838,6 +844,23 @@ class NativeRuntime(object):
             "Cloned %s from %s" % (self._pathspec(task), origin_ds.pathspec)
         )
         self._schedule_successors(task)
+
+
+def _group_killer(proc, sig):
+    def _kill():
+        # mirror Popen.send_signal's guard: once reaped, the pid (and its
+        # pgid) may be recycled by an unrelated process
+        if proc.returncode is not None:
+            return
+        try:
+            os.killpg(proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                os.kill(proc.pid, sig)
+            except ProcessLookupError:
+                pass
+
+    return _kill
 
 
 def _user():
