@@ -511,6 +511,56 @@ def main(flow, args=None):
                 "--only-json)."
             )
 
+    @start.command(help="Show the live status of a run (heartbeats, "
+                        "attempts, durations).")
+    @click.option("--run-id", default=None)
+    @click.pass_obj
+    def status(state, run_id):
+        import time as _time
+
+        run_id = run_id or read_latest_run_id(flow.name)
+        if run_id is None:
+            raise TpuFlowException("No run found for %s." % flow.name)
+        info = state.metadata.get_run_info(flow.name, run_id)
+        if info is None:
+            raise TpuFlowException("Run %s not found." % run_id)
+        echo("Run %s/%s (user %s, tags: %s)"
+             % (flow.name, run_id, info.get("user"),
+                ", ".join(info.get("tags", [])) or "-"))
+        for step_name in state.flow_datastore.list_steps(run_id):
+            for task_id in sorted(
+                state.flow_datastore.list_tasks(run_id, step_name)
+            ):
+                ds = state.flow_datastore.get_task_datastore(
+                    run_id, step_name, task_id, allow_not_done=True
+                )
+                meta = {
+                    m["field_name"]: m["value"]
+                    for m in state.metadata.get_task_metadata(
+                        flow.name, run_id, step_name, task_id
+                    )
+                }
+                age = state.metadata.task_heartbeat_age(
+                    flow.name, run_id, step_name, task_id
+                )
+                if ds.is_done():
+                    word = "done"
+                elif age is not None and age < 30:
+                    # a live heartbeat wins over a prior attempt's failure
+                    # record (a retry may be running right now)
+                    word = "running"
+                elif meta.get("attempt_ok") == "false":
+                    word = "FAILED"
+                elif age is not None:
+                    word = "DEAD? (no heartbeat %.0fs)" % age
+                else:
+                    word = "pending"
+                duration = meta.get("duration-ms")
+                extra = " %sms" % duration if duration else ""
+                echo("  %-20s %-8s attempt=%s%s"
+                     % ("%s/%s" % (step_name, task_id), word,
+                        ds.attempt if ds.has_attempt() else "-", extra))
+
     @start.command(help="Validate the flow graph.")
     @click.pass_obj
     def check(state):

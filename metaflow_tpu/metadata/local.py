@@ -210,6 +210,16 @@ class LocalMetadataProvider(MetadataProvider):
             or []
         )
 
+    def task_heartbeat_age(self, flow_name, run_id, step_name, task_id):
+        path = os.path.join(
+            self._task_dir(run_id, step_name, task_id, flow_name),
+            "_heartbeat.json",
+        )
+        try:
+            return time.time() - os.path.getmtime(path)
+        except OSError:
+            return None
+
     def mutate_run_tags(self, flow_name, run_id, add=None, remove=None):
         """Optimistic tag mutation under the run lock."""
         path = os.path.join(self._root, flow_name, str(run_id), "_run.json")

@@ -86,6 +86,10 @@ class MetadataProvider(object):
     def get_task_metadata(self, flow_name, run_id, step_name, task_id):
         raise NotImplementedError
 
+    def task_heartbeat_age(self, flow_name, run_id, step_name, task_id):
+        """Seconds since the task's last heartbeat, or None if unknown."""
+        return None
+
 
 def _python_version():
     import sys

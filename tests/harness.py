@@ -43,6 +43,20 @@ GRAPHS = {
         {"name": "j", "join": True, "next": ["end"]},
         {"name": "end"},
     ],
+    "switch": [
+        {"name": "start", "switch": {"left": "a", "right": "b"},
+         "condition_value": "right", "next": ["a", "b"]},
+        {"name": "a", "next": ["done"]},
+        {"name": "b", "next": ["done"]},
+        {"name": "done", "next": ["end"]},
+        {"name": "end"},
+    ],
+    "gang": [
+        {"name": "start", "num_parallel": 3, "next": ["train"]},
+        {"name": "train", "next": ["j"]},
+        {"name": "j", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
 }
 
 # execution contexts: CLI/env variations every graph must survive
@@ -64,7 +78,13 @@ def expected_task_counts(graph):
     def visit(name, multiplier):
         spec = by_name[name]
         counts[name] = counts.get(name, 0) + multiplier
-        child_mult = multiplier * spec.get("foreach", 1)
+        child_mult = multiplier * spec.get("foreach", 1) \
+            * spec.get("num_parallel", 1)
+        if spec.get("switch"):
+            # only the chosen case executes
+            chosen = spec["switch"][spec["condition_value"]]
+            visit(chosen, child_mult)
+            return
         for child in spec.get("next", []):
             if by_name[child].get("join"):
                 continue  # joins handled once per join instance
@@ -92,8 +112,11 @@ def expected_task_counts(graph):
             # this join's inputs
             split = _innermost_split(graph, spec["name"])
             factor = (
-                by_name[split].get("foreach",
-                                   len(by_name[split].get("next", [])))
+                by_name[split].get(
+                    "foreach",
+                    by_name[split].get("num_parallel",
+                                       len(by_name[split].get("next", []))),
+                )
                 if split else 1
             )
             counts[spec["name"]] = max(1, inner // factor)
@@ -118,7 +141,10 @@ def _innermost_split(graph, join_name):
             if stack:
                 result.setdefault(name, stack[-1])
                 stack = stack[:-1]
-        elif spec.get("foreach") or len(spec.get("next", [])) > 1:
+        elif spec.get("switch"):
+            pass  # a switch executes ONE branch: no split level opened
+        elif (spec.get("foreach") or spec.get("num_parallel")
+              or len(spec.get("next", [])) > 1):
             stack = stack + [name]
         for child in spec.get("next", []):
             walk(child, stack)
@@ -151,7 +177,17 @@ def generate_flow(graph, flow_name):
             lines.append("        self.trace = [%r]" % name)
         else:
             lines.append("        self.trace = self.trace + [%r]" % name)
-        if spec.get("foreach"):
+        if spec.get("switch"):
+            lines.append("        self.choice = %r" % spec["condition_value"])
+            cases = ", ".join(
+                "%r: self.%s" % (k, v) for k, v in spec["switch"].items()
+            )
+            lines.append("        self.next({%s}, condition='choice')"
+                         % cases)
+        elif spec.get("num_parallel"):
+            lines.append("        self.next(self.%s, num_parallel=%d)"
+                         % (spec["next"][0], spec["num_parallel"]))
+        elif spec.get("foreach"):
             lines.append("        self.items = list(range(%d))"
                          % spec["foreach"])
             lines.append("        self.next(self.%s, foreach='items')"
