@@ -1,0 +1,112 @@
+"""The framework-level CLI: `python -m metaflow_tpu <cmd>`.
+
+Reference behavior: metaflow/cmd/main_cli.py (`metaflow configure/
+tutorials/develop`). Subcommands:
+
+    version                      print the framework version
+    configure show               resolved config + its sources
+    configure set KEY VALUE      persist a key to the profile JSON
+    configure unset KEY          remove a key
+    tutorials list|pull [DIR]    list / copy the tutorial episodes
+    stubs [OUT_DIR]              generate .pyi type stubs
+"""
+
+import os
+import shutil
+import sys
+
+import click
+
+
+@click.group()
+def main():
+    pass
+
+
+@main.command()
+def version():
+    import metaflow_tpu
+
+    click.echo("metaflow_tpu %s" % metaflow_tpu.__version__)
+
+
+@main.group()
+def configure():
+    pass
+
+
+@configure.command(name="show")
+def configure_show():
+    from . import metaflow_config as cfg
+
+    click.echo("profile file: %s" % cfg._profile_path())
+    for name, fn in (
+        ("DATASTORE_SYSROOT_LOCAL", cfg.datastore_sysroot_local),
+        ("DATASTORE_SYSROOT_GS", cfg.datastore_sysroot_gs),
+        ("DEFAULT_DATASTORE", cfg.default_datastore),
+        ("DEFAULT_METADATA", cfg.default_metadata),
+        ("SERVICE_URL", cfg.service_url),
+    ):
+        click.echo("  %-26s = %s" % (name, fn()))
+
+
+@configure.command(name="set")
+@click.argument("key")
+@click.argument("value")
+def configure_set(key, value):
+    from .metaflow_config import set_conf
+
+    path = set_conf(key, value)
+    click.echo("wrote %s=%s to %s" % (key.upper(), value, path))
+
+
+@configure.command(name="unset")
+@click.argument("key")
+def configure_unset(key):
+    from .metaflow_config import set_conf
+
+    path = set_conf(key, None)
+    click.echo("removed %s from %s" % (key.upper(), path))
+
+
+@main.group()
+def tutorials():
+    pass
+
+
+def _tutorials_dir():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "tutorials")
+
+
+@tutorials.command(name="list")
+def tutorials_list():
+    root = _tutorials_dir()
+    if not os.path.isdir(root):
+        click.echo("no tutorials directory found")
+        return
+    for name in sorted(os.listdir(root)):
+        if os.path.isdir(os.path.join(root, name)):
+            click.echo(name)
+
+
+@tutorials.command(name="pull")
+@click.argument("dest", default="tpuflow-tutorials")
+def tutorials_pull(dest):
+    root = _tutorials_dir()
+    if not os.path.isdir(root):
+        raise click.ClickException("no tutorials directory found")
+    shutil.copytree(root, dest, dirs_exist_ok=True)
+    click.echo("tutorials copied to %s" % dest)
+
+
+@main.command()
+@click.argument("out_dir", default="metaflow_tpu-stubs")
+def stubs(out_dir):
+    from .cmd.stubgen import generate
+
+    click.echo("wrote %s" % generate(out_dir))
+
+
+if __name__ == "__main__":
+    main()

@@ -516,8 +516,6 @@ def main(flow, args=None):
     @click.option("--run-id", default=None)
     @click.pass_obj
     def status(state, run_id):
-        import time as _time
-
         run_id = run_id or read_latest_run_id(flow.name)
         if run_id is None:
             raise TpuFlowException("No run found for %s." % flow.name)
@@ -560,6 +558,87 @@ def main(flow, args=None):
                 echo("  %-20s %-8s attempt=%s%s"
                      % ("%s/%s" % (step_name, task_id), word,
                         ds.attempt if ds.has_attempt() else "-", extra))
+
+    @start.command(help="Garbage-collect old runs (keep the newest N) and "
+                        "unreferenced CAS blobs.")
+    @click.option("--keep", default=5, show_default=True,
+                  help="How many most-recent runs to keep.")
+    @click.option("--dry-run/--delete", default=True,
+                  help="Only report what would be removed (default).")
+    @click.pass_obj
+    def gc(state, keep, dry_run):
+        import shutil
+
+        if state.flow_datastore.ds_type != "local":
+            raise TpuFlowException("gc currently supports local datastores.")
+        root = state.flow_datastore.ds_root
+        flow_dir = os.path.join(root, flow.name)
+        runs = sorted(
+            (r for r in state.flow_datastore.list_runs()
+             if not r.startswith("spin-")),
+            key=lambda r: os.path.getmtime(os.path.join(flow_dir, r)),
+        )
+        doomed = runs[:-keep] if keep else runs
+        kept = [r for r in runs if r not in doomed]
+
+        # never sweep while a run is alive: an executing task's blobs are
+        # unreferenced until its manifest lands
+        import time as _t
+
+        for run_id in runs:
+            age = None
+            hb = os.path.join(flow_dir, run_id, "_heartbeat.json")
+            try:
+                age = _t.time() - os.path.getmtime(hb)
+            except OSError:
+                pass
+            if age is None or age >= 60:
+                continue
+            # fresh heartbeat on a COMPLETED run is fine (the scheduler
+            # beats once more on exit); only refuse for unfinished runs
+            end_done = any(
+                state.flow_datastore.get_task_datastore(
+                    run_id, "end", t, mode="d", allow_not_done=True
+                ).is_done()
+                for t in state.flow_datastore.list_tasks(run_id, "end")
+            )
+            if not end_done:
+                raise TpuFlowException(
+                    "Run %s looks alive (heartbeat %.0fs ago) — rerun gc "
+                    "after it finishes." % (run_id, age)
+                )
+
+        # mark: every CAS key referenced by a kept run's manifests, plus
+        # registered raw data (code packages, include files)
+        live = set(state.flow_datastore.registered_data_keys())
+        for run_id in kept + [r for r in state.flow_datastore.list_runs()
+                              if r.startswith("spin-")]:
+            for ds in state.flow_datastore.get_task_datastores(
+                run_id=run_id, allow_not_done=True
+            ):
+                live.update(key for _name, key in ds.items())
+        # sweep: blobs not referenced by any kept run
+        data_dir = os.path.join(flow_dir, "data")
+        dead_blobs = []
+        for dirpath, _dirs, files in os.walk(data_dir):
+            for name in files:
+                if name not in live:
+                    dead_blobs.append(os.path.join(dirpath, name))
+
+        verb = "would remove" if dry_run else "removing"
+        echo("%s %d run(s): %s" % (verb, len(doomed),
+                                   ", ".join(doomed) or "-"))
+        echo("%s %d unreferenced blob(s)" % (verb, len(dead_blobs)))
+        if not dry_run:
+            for run_id in doomed:
+                shutil.rmtree(os.path.join(flow_dir, run_id),
+                              ignore_errors=True)
+            for path in dead_blobs:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            echo("gc done (%d runs kept)" % len(kept))
 
     @start.command(help="Validate the flow graph.")
     @click.pass_obj

@@ -225,6 +225,26 @@ class Run(MetaflowObject):
         except MetaflowNotFound:
             return None
 
+    def lineage_index(self):
+        """Reverse input-paths index: parent pathspec → [child pathspecs].
+        Built in ONE pass over the run's task metadata (cached per Run)."""
+        if getattr(self, "_lineage_index", None) is not None:
+            return self._lineage_index
+        index = {}
+        meta = _metadata_provider()
+        for step_name in self._ds.list_steps(self.id):
+            for task_id in self._ds.list_tasks(self.id, step_name):
+                records = meta.get_task_metadata(
+                    self.flow_name, self.id, step_name, task_id
+                )
+                child = "%s/%s/%s" % (self.id, step_name, task_id)
+                for m in records:
+                    if m.get("field_name") == "input-paths":
+                        for parent in json.loads(m["value"]):
+                            index.setdefault(parent, []).append(child)
+        self._lineage_index = index
+        return index
+
 
 class Step(MetaflowObject):
     _NAME = "step"
@@ -397,6 +417,21 @@ class Task(MetaflowObject):
         return [
             Task("%s/%s" % (self.flow_name, p), _namespace_check=False)
             for p in json.loads(paths)
+        ]
+
+    @property
+    def child_tasks(self):
+        """Tasks of this run whose recorded input-paths include this task.
+
+        One metadata pass over the run per call; to traverse lineage for
+        MANY tasks, build `Run.lineage_index()` once instead."""
+        run = Run("%s/%s" % (self.flow_name, self.run_id),
+                  _namespace_check=False)
+        me = "%s/%s/%s" % (self.run_id, self.step_name, self.id)
+        index = run.lineage_index()
+        return [
+            Task("%s/%s" % (self.flow_name, child), _namespace_check=False)
+            for child in index.get(me, [])
         ]
 
 

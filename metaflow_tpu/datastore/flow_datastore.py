@@ -107,8 +107,38 @@ class FlowDataStore(object):
     # ---------- raw data (code packages, include files) ----------
 
     def save_data(self, data_iter):
-        """Save raw byte blobs; returns [(uri, key)] in order."""
-        return self.ca_store.save_blobs(data_iter, raw=True)
+        """Save raw byte blobs (code packages, include files); returns
+        [(uri, key)] in order. Keys are recorded in the flow's package
+        registry so gc's mark phase keeps them live."""
+        results = self.ca_store.save_blobs(data_iter, raw=True)
+        self._register_data_keys([key for _uri, key in results])
+        return results
+
+    def _registry_path(self):
+        return self.storage.path_join(self.flow_name, "_packages.json")
+
+    def _register_data_keys(self, keys):
+        import json
+
+        existing = set(self.registered_data_keys())
+        new = existing | set(keys)
+        if new != existing:
+            self.storage.save_bytes(
+                [(self._registry_path(),
+                  json.dumps(sorted(new)).encode("utf-8"))],
+                overwrite=True,
+            )
+
+    def registered_data_keys(self):
+        import json
+
+        with self.storage.load_bytes([self._registry_path()]) as loaded:
+            for _p, local, _m in loaded:
+                if local is None:
+                    return []
+                with open(local) as f:
+                    return json.load(f)
+        return []
 
     def load_data(self, keys):
         return {k: blob for k, blob in self.ca_store.load_blobs(keys, force_raw=True)}

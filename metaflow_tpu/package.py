@@ -30,12 +30,18 @@ class MetaflowPackage(object):
         self.url = None
 
     def _walk(self, root, arc_prefix=""):
+        from .util import get_tpuflow_root
+
+        ds_root = os.path.abspath(get_tpuflow_root())
         for dirpath, dirnames, filenames in os.walk(root):
-            # prune caches, VCS dirs, and the datastore itself
+            # prune caches, VCS dirs, and the datastore itself — by the
+            # well-known names AND by the configured root's actual path
+            # (which may live inside the flow dir under any name)
             dirnames[:] = [
                 d for d in dirnames
                 if d not in ("__pycache__", ".git", ".tpuflow", ".metaflow",
                              "node_modules", ".venv")
+                and os.path.abspath(os.path.join(dirpath, d)) != ds_root
             ]
             for fname in sorted(filenames):
                 if not fname.endswith(self.suffixes):
@@ -53,8 +59,15 @@ class MetaflowPackage(object):
         """Deterministic tarball bytes (stable mtimes → stable CAS key)."""
         if self._blob is not None:
             return self._blob
+        import gzip
+
         buf = io.BytesIO()
-        with tarfile.open(fileobj=buf, mode="w:gz", compresslevel=3) as tar:
+        # gzip with mtime=0: tarfile's own w:gz stamps the CURRENT time into
+        # the gzip header, silently breaking content-addressed dedup across
+        # second boundaries
+        gz = gzip.GzipFile(filename="", mode="wb", fileobj=buf,
+                           compresslevel=3, mtime=0)
+        with tarfile.open(fileobj=gz, mode="w") as tar:
 
             def add(full, arcname):
                 info = tar.gettarinfo(full, arcname=arcname)
@@ -71,18 +84,20 @@ class MetaflowPackage(object):
             pkg_root = os.path.dirname(os.path.abspath(__file__))
             for full, arc in self._walk(pkg_root, "metaflow_tpu"):
                 add(full, arc)
-            # INFO manifest
+            # INFO manifest — no timestamps: identical content must hash
+            # identically for CAS dedup
             info_bytes = json.dumps(
                 {
-                    "created": int(time.time()),
                     "flow_dir": self.flow_dir,
                     **self.extra_info,
-                }
+                },
+                sort_keys=True,
             ).encode("utf-8")
             ti = tarfile.TarInfo("INFO")
             ti.size = len(info_bytes)
             ti.mtime = 0
             tar.addfile(ti, io.BytesIO(info_bytes))
+        gz.close()
         self._blob = buf.getvalue()
         return self._blob
 

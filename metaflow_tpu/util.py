@@ -90,11 +90,11 @@ def all_equal(it):
 
 
 def get_tpuflow_root():
-    """Root directory for the local datastore/metadata tree."""
-    return os.environ.get(
-        "TPUFLOW_DATASTORE_SYSROOT_LOCAL",
-        os.environ.get("METAFLOW_DATASTORE_SYSROOT_LOCAL", ""),
-    ) or os.path.join(os.getcwd(), ".tpuflow")
+    """Root directory for the local datastore/metadata tree (env →
+    profile config → ./.tpuflow)."""
+    from .metaflow_config import datastore_sysroot_local
+
+    return datastore_sysroot_local()
 
 
 def write_latest_run_id(flow_name, run_id, root=None):

@@ -1,0 +1,92 @@
+"""Framework-level CLI, config layering, gc, lineage."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mcli(*args, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "metaflow_tpu"] + list(args),
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+
+
+class TestMainCli:
+    def test_version(self):
+        out = _mcli("version")
+        assert out.returncode == 0
+        assert "metaflow_tpu" in out.stdout
+
+    def test_configure_roundtrip(self, tmp_path):
+        home = str(tmp_path / "cfghome")
+        env = {"TPUFLOW_HOME": home}
+        out = _mcli("configure", "set", "default_datastore", "gs",
+                    env_extra=env)
+        assert out.returncode == 0
+        conf = json.load(open(os.path.join(home, "config.json")))
+        assert conf["DEFAULT_DATASTORE"] == "gs"
+        out = _mcli("configure", "show", env_extra=env)
+        assert "DEFAULT_DATASTORE" in out.stdout and "gs" in out.stdout
+        _mcli("configure", "unset", "default_datastore", env_extra=env)
+        conf = json.load(open(os.path.join(home, "config.json")))
+        assert "DEFAULT_DATASTORE" not in conf
+
+    def test_tutorials_list(self):
+        out = _mcli("tutorials", "list")
+        assert "00-helloworld" in out.stdout
+
+
+class TestConfigLayering:
+    def test_env_beats_profile(self, tmp_path, monkeypatch):
+        from metaflow_tpu import metaflow_config as cfg
+
+        home = tmp_path / "home"
+        home.mkdir()
+        (home / "config.json").write_text('{"DEFAULT_DATASTORE": "gs"}')
+        monkeypatch.setenv("TPUFLOW_HOME", str(home))
+        cfg.reset_conf_cache()
+        assert cfg.default_datastore() == "gs"
+        monkeypatch.setenv("TPUFLOW_DEFAULT_DATASTORE", "local")
+        assert cfg.default_datastore() == "local"
+        cfg.reset_conf_cache()
+
+    def test_metaflow_alias_env(self, monkeypatch):
+        from metaflow_tpu import metaflow_config as cfg
+
+        monkeypatch.delenv("TPUFLOW_SERVICE_URL", raising=False)
+        monkeypatch.setenv("METAFLOW_SERVICE_URL", "http://svc:8080")
+        cfg.reset_conf_cache()
+        assert cfg.service_url() == "http://svc:8080"
+
+
+class TestGcAndLineage:
+    def test_gc_keeps_latest_and_lineage(self, run_flow, flows_dir,
+                                         tpuflow_root):
+        flow = os.path.join(flows_dir, "linear_flow.py")
+        for alpha in ("0.1", "0.2"):
+            run_flow(flow, "run", "--alpha", alpha)
+        proc = run_flow(flow, "gc", "--keep", "1")
+        assert "would remove 1 run" in proc.stdout
+        proc = run_flow(flow, "gc", "--keep", "1", "--delete")
+        assert "gc done" in proc.stdout
+
+        os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+        from metaflow_tpu import client
+
+        client.namespace(None)
+        run = client.Flow("LinearFlow").latest_run
+        assert run.data.scaled == 2.0  # latest (alpha=0.2) survived
+        # lineage both ways
+        mid = run["middle"].task
+        assert [t.step_name for t in mid.parent_tasks] == ["start"]
+        assert [t.step_name for t in mid.child_tasks] == ["end"]
