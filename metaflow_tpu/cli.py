@@ -112,13 +112,15 @@ def _collect_params(flow, kwargs):
 def main(flow, args=None):
     state = CliState(flow)
 
+    from . import metaflow_config as _cfg
+
     @click.group(name=flow.name, invoke_without_command=False)
-    @click.option("--datastore", default="local",
+    @click.option("--datastore", default=_cfg.default_datastore,
                   type=click.Choice(list(STORAGE_BACKENDS)),
                   help="Artifact storage backend.")
     @click.option("--datastore-root", default=None,
                   help="Root path for the datastore.")
-    @click.option("--metadata", default="local",
+    @click.option("--metadata", default=_cfg.default_metadata,
                   type=click.Choice(list(METADATA_PROVIDERS)),
                   help="Metadata provider.")
     @click.option("--quiet/--no-quiet", default=False)
@@ -608,15 +610,37 @@ def main(flow, args=None):
                     "after it finishes." % (run_id, age)
                 )
 
-        # mark: every CAS key referenced by a kept run's manifests, plus
-        # registered raw data (code packages, include files)
-        live = set(state.flow_datastore.registered_data_keys())
-        for run_id in kept + [r for r in state.flow_datastore.list_runs()
-                              if r.startswith("spin-")]:
-            for ds in state.flow_datastore.get_task_datastores(
-                run_id=run_id, allow_not_done=True
-            ):
-                live.update(key for _name, key in ds.items())
+        # registry pruning cutoff: packages registered before the oldest
+        # kept run started belonged to doomed runs
+        oldest_kept_ts = min(
+            (os.path.getmtime(os.path.join(flow_dir, r)) for r in kept),
+            default=0,
+        )
+
+        # mark: every CAS key referenced by ANY attempt manifest of a kept
+        # run (earlier attempts stay readable), plus still-registered raw
+        # data (code packages, include files)
+        import json as _json
+
+        live = set(
+            state.flow_datastore.registered_data_keys(
+                newer_than=oldest_kept_ts if doomed else None
+            )
+        )
+        keep_runs = kept + [r for r in state.flow_datastore.list_runs()
+                            if r.startswith("spin-")]
+        for run_id in keep_runs:
+            run_dir = os.path.join(flow_dir, run_id)
+            for dirpath, _dirs, files in os.walk(run_dir):
+                for name in files:
+                    if not name.endswith(".artifacts.json"):
+                        continue
+                    try:
+                        with open(os.path.join(dirpath, name)) as f:
+                            manifest = _json.load(f)
+                        live.update(manifest.get("objects", {}).values())
+                    except (OSError, ValueError):
+                        continue
         # sweep: blobs not referenced by any kept run
         data_dir = os.path.join(flow_dir, "data")
         dead_blobs = []
@@ -638,6 +662,10 @@ def main(flow, args=None):
                     os.unlink(path)
                 except OSError:
                     pass
+            if doomed and oldest_kept_ts:
+                state.flow_datastore.prune_registered_data_keys(
+                    older_than=oldest_kept_ts
+                )
             echo("gc done (%d runs kept)" % len(kept))
 
     @start.command(help="Validate the flow graph.")

@@ -42,8 +42,9 @@ def get_namespace():
 
 
 def _metadata_provider():
-    kind = os.environ.get("TPUFLOW_DEFAULT_METADATA", "local")
-    if kind == "service":
+    from ..metaflow_config import default_metadata
+
+    if default_metadata() == "service":
         from ..metadata import ServiceMetadataProvider
 
         return ServiceMetadataProvider()
@@ -51,7 +52,9 @@ def _metadata_provider():
 
 
 def _flow_datastore(flow_name):
-    ds_type = os.environ.get("TPUFLOW_DEFAULT_DATASTORE", "local")
+    from ..metaflow_config import default_datastore
+
+    ds_type = default_datastore()
     fds = FlowDataStore(flow_name, STORAGE_BACKENDS[ds_type])
     if ds_type != "local":
         # remote reads go through the on-disk LRU blob cache

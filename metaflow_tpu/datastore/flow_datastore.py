@@ -5,6 +5,7 @@ get_task_datastores:79 latest-attempt resolution, save_data:348).
 """
 
 import hashlib
+import os
 
 from .cas import ContentAddressedStore
 from .task_datastore import TaskDataStore
@@ -117,28 +118,81 @@ class FlowDataStore(object):
     def _registry_path(self):
         return self.storage.path_join(self.flow_name, "_packages.json")
 
-    def _register_data_keys(self, keys):
-        import json
+    def _registry_lock(self):
+        """Exclusive lock for registry read-modify-write (local storage);
+        remote stores get best-effort last-writer-wins."""
+        import contextlib
 
-        existing = set(self.registered_data_keys())
-        new = existing | set(keys)
-        if new != existing:
-            self.storage.save_bytes(
-                [(self._registry_path(),
-                  json.dumps(sorted(new)).encode("utf-8"))],
-                overwrite=True,
-            )
+        if self.ds_type != "local":
+            return contextlib.nullcontext()
 
-    def registered_data_keys(self):
+        import fcntl
+
+        path = self.storage.full_uri(self._registry_path()) + ".lock"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+
+        @contextlib.contextmanager
+        def locked():
+            with open(path, "a+") as lock:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+                yield
+
+        return locked()
+
+    def _read_registry(self):
         import json
 
         with self.storage.load_bytes([self._registry_path()]) as loaded:
             for _p, local, _m in loaded:
                 if local is None:
-                    return []
+                    return {}
                 with open(local) as f:
-                    return json.load(f)
-        return []
+                    data = json.load(f)
+                    if isinstance(data, list):  # pre-timestamp format
+                        return {k: 0 for k in data}
+                    return data
+        return {}
+
+    def _write_registry(self, registry):
+        import json
+
+        self.storage.save_bytes(
+            [(self._registry_path(),
+              json.dumps(registry, sort_keys=True).encode("utf-8"))],
+            overwrite=True,
+        )
+
+    def _register_data_keys(self, keys):
+        import time
+
+        with self._registry_lock():
+            registry = self._read_registry()
+            now = time.time()
+            changed = False
+            for key in keys:
+                if key not in registry:
+                    registry[key] = now
+                    changed = True
+            if changed:
+                self._write_registry(registry)
+
+    def registered_data_keys(self, newer_than=None):
+        registry = self._read_registry()
+        if newer_than is None:
+            return sorted(registry)
+        return sorted(k for k, ts in registry.items() if ts >= newer_than)
+
+    def prune_registered_data_keys(self, older_than):
+        """Drop registry entries older than the cutoff (gc of packages that
+        belonged to deleted runs). Returns the dropped keys."""
+        with self._registry_lock():
+            registry = self._read_registry()
+            dropped = [k for k, ts in registry.items() if ts < older_than]
+            if dropped:
+                self._write_registry(
+                    {k: ts for k, ts in registry.items() if ts >= older_than}
+                )
+            return dropped
 
     def load_data(self, keys):
         return {k: blob for k, blob in self.ca_store.load_blobs(keys, force_raw=True)}

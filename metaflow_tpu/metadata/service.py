@@ -31,10 +31,9 @@ class ServiceMetadataProvider(MetadataProvider):
     def __init__(self, environment=None, flow=None, event_logger=None,
                  monitor=None, url=None):
         super().__init__(environment, flow, event_logger, monitor)
-        import os
+        from ..metaflow_config import service_url
 
-        self._url = (url or os.environ.get("TPUFLOW_SERVICE_URL", "")
-                     ).rstrip("/")
+        self._url = (url or service_url() or "").rstrip("/")
         if not self._url:
             raise ServiceException(
                 "Metadata service URL not configured: set TPUFLOW_SERVICE_URL"

@@ -51,7 +51,9 @@ def from_conf(name, default=None):
     """Lookup order: TPUFLOW_<name> env → METAFLOW_<name> env → profile
     JSON (key with or without the TPUFLOW_ prefix) → default."""
     name = name.upper()
-    for env_name in ("TPUFLOW_" + name, "METAFLOW_" + name, name):
+    # prefixed env vars only: a generic SERVICE_URL/DEFAULT_* in the shell
+    # must not silently steer the framework
+    for env_name in ("TPUFLOW_" + name, "METAFLOW_" + name):
         # empty-string env values count as unset (CI templates often
         # export VAR= to mean "use the default")
         if os.environ.get(env_name):

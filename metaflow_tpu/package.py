@@ -11,7 +11,6 @@ import io
 import json
 import os
 import tarfile
-import time
 
 DEFAULT_SUFFIXES = (".py", ".json", ".toml", ".yaml", ".yml", ".txt", ".sh",
                     ".md", ".cfg")

@@ -71,7 +71,7 @@ class TestConfigLayering:
 
 class TestGcAndLineage:
     def test_gc_keeps_latest_and_lineage(self, run_flow, flows_dir,
-                                         tpuflow_root):
+                                         tpuflow_root, monkeypatch):
         flow = os.path.join(flows_dir, "linear_flow.py")
         for alpha in ("0.1", "0.2"):
             run_flow(flow, "run", "--alpha", alpha)
@@ -80,7 +80,7 @@ class TestGcAndLineage:
         proc = run_flow(flow, "gc", "--keep", "1", "--delete")
         assert "gc done" in proc.stdout
 
-        os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+        monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL", tpuflow_root)
         from metaflow_tpu import client
 
         client.namespace(None)

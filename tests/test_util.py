@@ -1,0 +1,74 @@
+"""Unit tests for util, mflog, and CLIArgs internals."""
+
+import pytest
+
+from metaflow_tpu import mflog
+from metaflow_tpu.runtime import CLIArgs
+from metaflow_tpu.util import compress_list, decompress_list
+
+
+class TestCompressList:
+    def test_roundtrip_short(self):
+        lst = ["1/start/1", "1/a/2", "1/b/3"]
+        assert decompress_list(compress_list(lst)) == lst
+
+    def test_roundtrip_long_zlib(self):
+        lst = ["run/step/task%04d" % i for i in range(200)]
+        token = compress_list(lst)
+        assert token.startswith("!")  # zlib marker
+        assert decompress_list(token) == lst
+
+    def test_empty(self):
+        assert decompress_list(compress_list([])) == []
+
+    def test_reserved_chars_rejected(self):
+        with pytest.raises(RuntimeError):
+            compress_list(["a,b"])
+
+
+class TestMflog:
+    def test_decorate_parse_roundtrip(self):
+        line = mflog.decorate(mflog.TASK, b"hello world")
+        ts, source, message = mflog.parse(line.rstrip(b"\n"))
+        assert source == "task"
+        assert message == b"hello world"
+        assert "T" in ts  # iso timestamp
+
+    def test_merge_orders_by_timestamp(self):
+        a = mflog.decorate(mflog.TASK, b"first", now="2026-01-01T00:00:00.0")
+        b = mflog.decorate(mflog.RUNTIME, b"second",
+                           now="2026-01-01T00:00:01.0")
+        merged = mflog.format_merged([b, a])
+        assert merged.index(b"first") < merged.index(b"second")
+
+    def test_untagged_lines_survive(self):
+        out = mflog.format_merged([b"raw line\n"])
+        assert b"raw line" in out
+
+    def test_source_and_timestamp_rendering(self):
+        line = mflog.decorate(mflog.TASK, b"x")
+        out = mflog.format_merged([line], show_source=True,
+                                  show_timestamp=True)
+        assert b"[task]" in out
+
+
+class TestCLIArgs:
+    def test_get_args_layout(self):
+        args = CLIArgs(
+            entrypoint=["python", "flow.py"],
+            top_level_options={"datastore": "local", "quiet": True,
+                               "skip": None, "off": False},
+            command_options={"run-id": "7", "split-index": 0},
+            env={},
+        )
+        args.command_args = ["train"]
+        argv = args.get_args()
+        assert argv[:2] == ["python", "flow.py"]
+        assert "--datastore" in argv and "local" in argv
+        assert "--quiet" in argv
+        assert "--skip" not in argv and "--off" not in argv
+        # command comes after top-level options
+        assert argv.index("step") > argv.index("--quiet")
+        assert argv.index("train") == argv.index("step") + 1
+        # int-zero option values are preserved
+        assert argv[argv.index("--split-index") + 1] == "0"
