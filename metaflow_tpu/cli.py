@@ -53,7 +53,11 @@ class CliState(object):
         self.echo = echo
         self.quiet = False
         self.decospecs = []
+        self.raw_decospecs = []
         self.config_args = []
+        self.config_files = {}
+        self.config_values = {}
+        self.finalized = False
 
 
 def _prepare(state, decospecs):
@@ -77,6 +81,40 @@ def _prepare(state, decospecs):
     _init_flow_decorators(flow, state.graph, None, state.flow_datastore,
                           state.metadata, state.echo, state.echo, {})
     _init_step_decorators(flow, state.graph, None, state.flow_datastore, state.echo)
+
+
+def _finalize(state, origin_run=None):
+    """Resolve configs ONCE (merging the origin run's values under any
+    explicit --config/--config-value flags when resuming), run mutators,
+    lint, and init decorators. Idempotent per process."""
+    if state.finalized:
+        return
+    from .config_system import apply_mutators, resolve_configs
+
+    files = dict(state.config_files)
+    values = dict(state.config_values)
+    if origin_run is not None:
+        try:
+            origin_start = state.flow_datastore.get_task_datastores(
+                run_id=origin_run, steps=["start"]
+            )
+        except Exception:
+            origin_start = []
+        if origin_start:
+            ds = origin_start[0]
+            for name in list(ds.keys()):
+                if not name.startswith("_config_"):
+                    continue
+                cfg = name[len("_config_"):]
+                if cfg in files or cfg in values:
+                    continue  # explicit flags on resume win
+                serialized = json.dumps(ds[name])
+                values[cfg] = serialized
+                state.config_args += ["--config-value", cfg, serialized]
+    resolve_configs(state.flow.__class__, files, values)
+    apply_mutators(state.flow.__class__)
+    _prepare(state, state.raw_decospecs)
+    state.finalized = True
 
 
 def _param_options(flow):
@@ -133,8 +171,6 @@ def main(flow, args=None):
     @click.pass_context
     def start(ctx, datastore, datastore_root, metadata, quiet, decospecs,
               config_files, config_values):
-        from .config_system import apply_mutators, resolve_configs
-
         storage_impl = STORAGE_BACKENDS[datastore]
         state.flow_datastore = FlowDataStore(
             flow.name, storage_impl, ds_root=datastore_root
@@ -143,16 +179,17 @@ def main(flow, args=None):
         state.quiet = quiet
         if quiet:
             state.echo = echo_quiet
-        resolve_configs(flow.__class__, dict(config_files),
-                        dict(config_values))
-        apply_mutators(flow.__class__)
-        # step subprocesses must re-resolve the same configs
+        # config resolution + mutators + lint happen in _finalize, invoked
+        # by the commands that execute the graph (resume merges the origin
+        # run's configs FIRST — resolving here would be too early)
+        state.config_files = dict(config_files)
+        state.config_values = dict(config_values)
+        state.raw_decospecs = list(decospecs)
         state.config_args = []
         for name, path in config_files:
             state.config_args += ["--config", name, path]
         for name, val in config_values:
             state.config_args += ["--config-value", name, val]
-        _prepare(state, decospecs)
         ctx.obj = state
 
     @start.command(help="Run the workflow locally.")
@@ -164,6 +201,7 @@ def main(flow, args=None):
     @click.pass_obj
     def run(state, max_workers, max_num_splits, tags, run_id_file,
             user_namespace, **kwargs):
+        _finalize(state)
         params, _ = _collect_params(state.flow, kwargs)
         state.metadata.add_sticky_tags(tags=tags)
         runtime = NativeRuntime(
@@ -202,6 +240,9 @@ def main(flow, args=None):
                 "No previous run found for flow %s: nothing to resume."
                 % flow.name
             )
+        # single config resolution: origin-run values merged under any
+        # explicit flags, BEFORE mutators/lint run
+        _finalize(state, origin_run=origin)
         if step_to_rerun and step_to_rerun not in state.graph:
             raise TpuFlowException(
                 "Step *%s* does not exist in flow %s." % (step_to_rerun, flow.name)
@@ -255,6 +296,7 @@ def main(flow, args=None):
     def step(state, step_name, run_id, task_id, input_paths, split_index,
              retry_count, max_user_code_retries, user_namespace, ubf_context,
              origin_run_id, params_json):
+        _finalize(state)
         os.environ[STEP_ARGV_ENV] = json.dumps(sys.argv)
         if ubf_context not in (None, "", "none"):
             ubf = ubf_context
@@ -306,6 +348,8 @@ def main(flow, args=None):
     @click.pass_obj
     def spin(state, step_name, run_id, task_id):
         import time as _time
+
+        _finalize(state)
 
         origin_run = run_id or read_latest_run_id(flow.name)
         if origin_run is None:
@@ -483,6 +527,8 @@ def main(flow, args=None):
     @click.pass_obj
     def argo_create(state, image, k8s_namespace, only_json, do_package):
         from .plugins.argo import ArgoWorkflows
+
+        _finalize(state)
 
         package_url = None
         if do_package:
@@ -671,13 +717,14 @@ def main(flow, args=None):
     @start.command(help="Validate the flow graph.")
     @click.pass_obj
     def check(state):
-        # lint already ran in _prepare; reaching here means the graph is valid
+        _finalize(state)
         echo("Validating your flow...")
         echo("    The graph looks good!")
 
     @start.command(help="Show the structure of the flow.")
     @click.pass_obj
     def show(state):
+        _finalize(state)
         echo("\n%s\n" % (state.graph.doc or flow.name))
         for name in state.graph.sorted_nodes():
             node = state.graph[name]
@@ -699,6 +746,7 @@ def main(flow, args=None):
     @start.command(name="output-dot", help="Print the DAG in DOT format.")
     @click.pass_obj
     def output_dot(state):
+        _finalize(state)
         print(state.graph.output_dot())
 
     @start.command(help="Dump artifacts of a task: dump RUN/STEP/TASK")

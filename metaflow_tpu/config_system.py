@@ -220,7 +220,9 @@ class MutableFlow(object):
 
 
 class FlowMutator(object):
-    """Subclass and apply as a class decorator:
+    """Subclass and apply as a class decorator. mutate() must be IDEMPOTENT
+    (it can run more than once per process, e.g. when `resume` replays the
+    origin run's configs) — guard add_decorator calls with a presence check:
 
         class AddRetries(FlowMutator):
             def mutate(self, mutable_flow):

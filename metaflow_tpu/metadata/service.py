@@ -184,6 +184,18 @@ class ServiceMetadataProvider(MetadataProvider):
             % (flow_name, run_id, step_name, task_id),
         ) or []
 
+    def task_heartbeat_age(self, flow_name, run_id, step_name, task_id):
+        try:
+            out = self._request(
+                "GET",
+                "/flows/%s/runs/%s/steps/%s/tasks/%s/heartbeat"
+                % (flow_name, run_id, step_name, task_id),
+                retries=1,
+            )
+            return (out or {}).get("age_seconds")
+        except ServiceException:
+            return None
+
     def mutate_run_tags(self, flow_name, run_id, add=None, remove=None):
         return self._request(
             "PATCH", "/flows/%s/runs/%s/tags" % (flow_name, run_id),
@@ -298,8 +310,13 @@ class MetadataService(object):
                         return p.get_task_metadata(flow, run_id, step,
                                                    task_id), 200
                     if tail == ["heartbeat"]:
-                        p.start_task_heartbeat(flow, run_id, step, task_id)
-                        return {}, 200
+                        if method == "POST":
+                            p.start_task_heartbeat(flow, run_id, step,
+                                                   task_id)
+                            return {}, 200
+                        age = p.task_heartbeat_age(flow, run_id, step,
+                                                   task_id)
+                        return {"age_seconds": age}, 200
             return {"error": "not found"}, 404
         except Exception as ex:  # robust server: surface as 500
             return {"error": str(ex)}, 500

@@ -202,6 +202,17 @@ class TestCliExtras:
         proc = run_flow(flow, "tag", "remove", "--run-id", run_id, "exp:1")
         assert "exp:1" not in proc.stdout
 
+    def test_resume_replays_origin_configs(self, run_flow, flows_dir,
+                                           tpuflow_root):
+        """`resume start` without --config flags re-executes start with the
+        ORIGIN run's resolved config values."""
+        flow = os.path.join(flows_dir, "config_flow.py")
+        run_flow(flow, "--config-value", "settings",
+                 '{"lr": 0.5, "retries": 2}', "run")
+        proc = run_flow(flow, "resume", "start")
+        assert "lr: 0.5" in proc.stdout        # replayed value, not default
+        assert "retry attached: 1" in proc.stdout  # mutator saw it too
+
     def test_config_flow(self, run_flow, flows_dir, tpuflow_root, tmp_path):
         flow = os.path.join(flows_dir, "config_flow.py")
         notes = tmp_path / "notes.txt"

@@ -236,6 +236,58 @@ def test_sharded_batch_inference(run_flow, flows_dir, tpuflow_root):
     assert "batch inference ok" in proc.stdout
 
 
+def test_resume_clones_gang(run_flow, flows_dir, tpuflow_root, tmp_path):
+    """Resume after a post-gang failure: control AND worker rank tasks are
+    cloned (not re-executed), and the gang join still sees all ranks."""
+    src = """
+import os
+from metaflow_tpu import FlowSpec, current, step
+
+class GangResumeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index
+        marker = os.environ.get("GANG_MARKER")
+        if marker:
+            with open(marker, "a") as f:
+                f.write("r%d " % self.rank)
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.ranks = sorted(i.rank for i in inputs)
+        if os.environ.get("FAIL_AFTER_GANG"):
+            raise RuntimeError("post-gang failure")
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.ranks == [0, 1], self.ranks
+        print("gang resume ok:", self.ranks)
+
+if __name__ == "__main__":
+    GangResumeFlow()
+"""
+    flow_file = str(tmp_path / "gang_resume_flow.py")
+    with open(flow_file, "w") as f:
+        f.write(src)
+    marker = str(tmp_path / "gang_marker")
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "GANG_MARKER": marker}
+    run_flow(flow_file, "run", expect_fail=True,
+             env_extra=dict(env, FAIL_AFTER_GANG="1"))
+    first = open(marker).read()
+    proc = run_flow(flow_file, "resume", env_extra=env)
+    assert "gang resume ok: [0, 1]" in proc.stdout
+    # gang ranks were CLONED on resume: no new marker writes
+    assert open(marker).read() == first
+    assert proc.stdout.count("Cloned") >= 2  # start + gang control
+
+
 def test_resnet_foreach_finetune(run_flow, flows_dir, tpuflow_root):
     proc = run_flow(os.path.join(flows_dir, "resnet_foreach_flow.py"), "run")
     assert "best lr" in proc.stdout

@@ -69,6 +69,21 @@ def test_client_reads_over_rest(service, run_flow, flows_dir, tpuflow_root,
     assert run.data.x == 10
 
 
+def test_heartbeat_age_over_rest(service):
+    from metaflow_tpu.metadata import ServiceMetadataProvider
+
+    class _Flow:
+        name = "HbFlow"
+
+    p = ServiceMetadataProvider(flow=_Flow(), url=service.url)
+    run_id = p.new_run_id()
+    p.register_task_id(run_id, "s", "1", 0)
+    assert p.task_heartbeat_age("HbFlow", run_id, "s", "1") is None
+    p.start_task_heartbeat("HbFlow", run_id, "s", "1")
+    age = p.task_heartbeat_age("HbFlow", run_id, "s", "1")
+    assert age is not None and age < 5
+
+
 def test_missing_url_errors():
     from metaflow_tpu.metadata import ServiceMetadataProvider
     from metaflow_tpu.metadata.service import ServiceException
