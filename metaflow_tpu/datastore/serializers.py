@@ -56,20 +56,25 @@ def _tensor_dtype_ok(dtype):
 
 def _tree_only_arrays(obj, depth=0):
     """True if obj is a (nested) dict/list/tuple whose leaves are all
-    arrays/scalars — eligible for the fast pytree format."""
+    arrays/scalars — eligible for the fast pytree format.
+
+    Container types must match EXACTLY: subclasses (namedtuples, OrderedDict,
+    defaultdict, flax FrozenDict...) fall through to pickle, which preserves
+    their type — the pytree format would silently flatten them to plain
+    dict/list/tuple (e.g. optax's ScaleByAdamState namedtuple)."""
     if depth > 16:
         return False
     if isinstance(obj, np.ndarray):
         return _tensor_dtype_ok(obj.dtype)
     if _is_jax_array(obj):
         return True
-    if isinstance(obj, (int, float, bool)) or obj is None:
+    if obj is None or type(obj) in (int, float, bool):
         return True
-    if isinstance(obj, dict):
+    if type(obj) is dict:
         return all(isinstance(k, str) for k in obj) and all(
             _tree_only_arrays(v, depth + 1) for v in obj.values()
         )
-    if isinstance(obj, (list, tuple)):
+    if type(obj) in (list, tuple):
         return bool(obj) and all(_tree_only_arrays(v, depth + 1) for v in obj)
     return False
 
@@ -123,7 +128,7 @@ def serialize(obj):
         return _npy_bytes(obj), TYPE_TENSOR
     if _is_jax_array(obj):
         return _npy_bytes(_to_host(obj)), TYPE_TENSOR
-    if isinstance(obj, (dict, list, tuple)) and _tree_only_arrays(obj):
+    if type(obj) in (dict, list, tuple) and _tree_only_arrays(obj):
         return _pytree_bytes(obj), TYPE_PYTREE
     return pickle.dumps(_pickle_safe(obj), protocol=pickle.HIGHEST_PROTOCOL), TYPE_PICKLE
 
@@ -142,15 +147,51 @@ def deserialize(payload, type_tag):
 def _pickle_safe(obj):
     """Move any device-resident arrays in an arbitrary object graph to host
     before pickling (a jax.Array inside a random user object would otherwise
-    force pickle through a slow fallback or fail on non-addressable shards)."""
+    force pickle through a slow fallback or fail on non-addressable shards).
+    Container *types* are preserved: namedtuples rebuild via their class,
+    dict subclasses via .copy() — flattening optax state to a plain tuple
+    would break attribute access on load."""
     if _is_jax_array(obj):
         return _to_host(obj)
-    if isinstance(obj, dict):
+    if type(obj) is dict:
         return {k: _pickle_safe(v) for k, v in obj.items()}
-    if isinstance(obj, list):
+    if type(obj) is list:
         return [_pickle_safe(v) for v in obj]
-    if isinstance(obj, tuple):
+    if type(obj) is tuple:
         return tuple(_pickle_safe(v) for v in obj)
+    if isinstance(obj, tuple):
+        vals = [_pickle_safe(v) for v in obj]
+        if all(v is o for v, o in zip(vals, obj)):
+            return obj  # nothing device-resident inside: keep as-is
+        if hasattr(obj, "_fields"):  # namedtuple: _make bypasses custom __new__
+            try:
+                return type(obj)._make(vals)
+            except Exception:
+                return tuple(vals)
+        try:
+            return type(obj)(vals)
+        except Exception:
+            return tuple(vals)  # host transfer beats type fidelity
+    if isinstance(obj, dict):
+        vals = {k: _pickle_safe(v) for k, v in obj.items()}
+        if all(vals[k] is obj[k] for k in obj):
+            return obj
+        try:
+            clone = obj.copy()  # preserves OrderedDict/defaultdict/UserDict
+            clone.update(vals)
+            return clone
+        except Exception:
+            return vals
+    if isinstance(obj, list):
+        vals = [_pickle_safe(v) for v in obj]
+        if all(v is o for v, o in zip(vals, obj)):
+            return obj
+        try:
+            clone = obj.copy()
+            clone[:] = vals
+            return clone
+        except Exception:
+            return vals
     return obj
 
 
@@ -163,11 +204,13 @@ def _pytree_bytes(tree):
     leaves = []
 
     def encode(node):
-        if isinstance(node, dict):
+        # exact-type dispatch mirrors _tree_only_arrays: subclasses never
+        # reach here (they route the whole tree to pickle)
+        if type(node) is dict:
             return {"t": "d", "v": {k: encode(v) for k, v in node.items()}}
-        if isinstance(node, list):
+        if type(node) is list:
             return {"t": "l", "v": [encode(v) for v in node]}
-        if isinstance(node, tuple):
+        if type(node) is tuple:
             return {"t": "t", "v": [encode(v) for v in node]}
         if isinstance(node, (np.ndarray,)) or _is_jax_array(node):
             leaves.append(_npy_bytes(_to_host(node)))

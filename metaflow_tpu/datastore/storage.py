@@ -267,9 +267,12 @@ class GCSStorage(DataStoreStorage):
 
         tmpdir = tempfile.mkdtemp(prefix="tpuflow_gs_")
 
-        def download(path):
+        def download(idx_path):
+            idx, path = idx_path
             blob = self.bucket.blob(self._key(path))
-            local = os.path.join(tmpdir, path.replace("/", "_"))
+            # index-derived local name: distinct remote paths must never
+            # collide in the shared tmpdir ('a/b_c' vs 'a_b/c')
+            local = os.path.join(tmpdir, str(idx))
             try:
                 blob.download_to_filename(local)
                 return path, local, None
@@ -282,7 +285,7 @@ class GCSStorage(DataStoreStorage):
 
         paths = list(paths)
         with ThreadPoolExecutor(max_workers=min(32, max(1, len(paths)))) as ex:
-            results = list(ex.map(download, paths))
+            results = list(ex.map(download, enumerate(paths)))
         return CloseAfterUse(iter(results), closer=_Closer())
 
     def delete(self, paths):

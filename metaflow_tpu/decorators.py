@@ -25,10 +25,11 @@ class BadStepDecoratorException(TpuFlowException):
 
     def __init__(self, deco, func):
         msg = (
-            "You tried to apply decorator '{deco}' on '{func}' which is not "
-            "declared as a @step. Make sure you apply this decorator on a "
-            "function which has @step on the line just before the function "
-            "name and @{deco} above it.".format(deco=deco, func=func.__name__)
+            "@{deco} was applied to '{func}', but '{func}' is not a step. "
+            "Step decorators stack on top of @step: put @step directly above "
+            "the method and @{deco} above that.".format(
+                deco=deco, func=func.__name__
+            )
         )
         super().__init__(msg=msg)
 

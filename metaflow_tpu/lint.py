@@ -9,7 +9,7 @@ from .exception import TpuFlowException
 
 
 class LintWarn(TpuFlowException):
-    headline = "Validity checker found an issue"
+    headline = "Flow graph failed a lint check"
 
     def __init__(self, msg, lineno=None, source_file=None):
         if source_file and lineno:

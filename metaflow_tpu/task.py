@@ -339,8 +339,19 @@ class MetaflowTask(object):
                         step_name, flow, graph, task_ok, retry_count,
                         max_user_code_retries,
                     )
-                except Exception:
+                except Exception as hook_ex:
+                    # a failed task_finished hook must fail the attempt
+                    # *attributably*: record the exception so the failure
+                    # path below raises and the worker exits nonzero —
+                    # otherwise the scheduler sees a "successful" task with
+                    # no DONE marker and fails the run with a generic error
                     task_ok = False
+                    self.console_logger(traceback.format_exc())
+                    # a suppressed (@catch) step exception is not the cause
+                    # of this failure — the hook error is
+                    if exception is None or suppressed:
+                        exception = hook_ex
+                        suppressed = False
 
             self.metadata.register_metadata(
                 run_id,

@@ -30,14 +30,12 @@ def pathspec(*components):
     return "/".join(str(c) for c in components)
 
 
-def compress_list(lst, separator=",", rangedelim=":", zlibmarker="!", zlibmin=500):
-    """Encode a list of strings into a single CLI-safe token.
-
-    Same contract as the reference (metaflow/util.py compress_list): joined
-    list, falling back to zlib+base64 when long. Items must not contain the
-    separator characters.
-    """
-    bad = [x for x in lst if any(c in x for c in (separator, rangedelim, zlibmarker))]
+def compress_list(lst, separator=",", zlibmarker="!", zlibmin=500):
+    """Encode a list of strings into a single CLI-safe token: the joined
+    list, switching to zlib+base64 once it grows past zlibmin (fills the
+    same role as the reference's input-path encoding, metaflow/util.py).
+    Items must not contain the separator or marker characters."""
+    bad = [x for x in lst if separator in x or zlibmarker in x]
     if bad:
         raise RuntimeError("Item(s) %s contain reserved characters" % bad)
     res = separator.join(lst)

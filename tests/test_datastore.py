@@ -3,12 +3,18 @@
 Reference model: test/unit/test_content_addressed_store.py + serializer tests.
 """
 
+import collections
+
 import numpy as np
 import pytest
 
 from metaflow_tpu.datastore import FlowDataStore, LocalStorage
 from metaflow_tpu.datastore import serializers
 from metaflow_tpu.datastore.cas import ContentAddressedStore
+
+# module-level so pickle can find them
+_State = collections.namedtuple("_State", ["count", "mu"])
+_Inner = collections.namedtuple("_Inner", ["v"])
 
 
 @pytest.fixture()
@@ -91,6 +97,42 @@ class TestSerializers:
         payload, tag = serializers.serialize(tree)
         assert tag == serializers.TYPE_PICKLE
         assert serializers.deserialize(payload, tag)["x"][0] == {"a": 1}
+
+    def test_container_subclasses_preserve_type(self):
+        # namedtuples (e.g. optax optimizer state) and dict subclasses must
+        # NOT be flattened to plain tuple/dict by the pytree fast path
+        obj = _State(count=np.int32(3), mu=np.ones(2))
+        payload, tag = serializers.serialize(obj)
+        assert tag == serializers.TYPE_PICKLE
+        out = serializers.deserialize(payload, tag)
+        assert type(out).__name__ == "_State"
+        assert out.count == 3
+
+        od = collections.OrderedDict([("b", np.ones(1)), ("a", np.zeros(1))])
+        payload, tag = serializers.serialize(od)
+        assert tag == serializers.TYPE_PICKLE
+        out = serializers.deserialize(payload, tag)
+        assert isinstance(out, collections.OrderedDict)
+        assert list(out) == ["b", "a"]
+
+    def test_nested_namedtuple_routes_tree_to_pickle(self):
+        tree = {"opt": _Inner(v=np.ones(2)), "w": np.zeros(2)}
+        payload, tag = serializers.serialize(tree)
+        assert tag == serializers.TYPE_PICKLE
+        out = serializers.deserialize(payload, tag)
+        assert type(out["opt"]).__name__ == "_Inner"
+
+    def test_optax_state_roundtrip(self):
+        # the exact case from the advisory: ScaleByAdamState artifact
+        import jax.numpy as jnp
+        import optax
+
+        opt = optax.adam(1e-3)
+        state = opt.init({"w": jnp.ones((2, 2))})
+        payload, tag = serializers.serialize(state)
+        out = serializers.deserialize(payload, tag)
+        # attribute access must survive the round-trip
+        assert out[0].count == state[0].count
 
     def test_complex_scalars_use_pickle(self):
         payload, tag = serializers.serialize({"z": 1 + 2j})
