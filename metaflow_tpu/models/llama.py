@@ -38,6 +38,11 @@ class LlamaConfig:
     # remat policy: None = recompute everything; "dots" = save matmul
     # outputs (less recompute, more memory)
     remat_policy: str = None
+    # cross-entropy chunk length (tokens): the [B, S, vocab] fp32 logits are
+    # the single biggest activation (batch 16 × 2048 × 32k fp32 = 4.2 GB on
+    # one v5e); chunking the loss over the sequence bounds that to
+    # [B, chunk, vocab] fwd AND bwd (per-chunk remat). 0 = unchunked.
+    loss_chunk: int = 256
 
     @property
     def head_dim(self):
@@ -179,10 +184,10 @@ def _layer(cfg, cos, sin, x, layer_params, mesh=None):
     return x
 
 
-def forward(params, tokens, cfg, mesh=None):
-    """tokens: [B, S] int32 → logits [B, S, vocab] (float32).
-
-    `mesh` is only needed for attention_impl='ring' (sequence parallelism)."""
+def hidden_states(params, tokens, cfg, mesh=None):
+    """tokens: [B, S] int32 → final-norm hidden states [B, S, D] (model
+    dtype). The lm_head projection is deliberately separate so the loss can
+    chunk it (see loss_fn)."""
     dt = param_dtype(cfg)
     x = params["embed"][tokens].astype(dt)
     cos, sin = rope_frequencies(
@@ -198,29 +203,83 @@ def forward(params, tokens, cfg, mesh=None):
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = jnp.einsum(
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def forward(params, tokens, cfg, mesh=None):
+    """tokens: [B, S] int32 → logits [B, S, vocab] (float32).
+
+    `mesh` is only needed for attention_impl='ring' (sequence parallelism)."""
+    x = hidden_states(params, tokens, cfg, mesh=mesh)
+    return jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"],
         preferred_element_type=jnp.float32,
     )
-    return logits
+
+
+def _ce_sums(x, lm_head, targets, mask):
+    """Summed cross-entropy + token count for one [B, C, D] hidden chunk.
+    fp32 logits live only inside this function."""
+    logits = jnp.einsum(
+        "bcd,dv->bcv", x, lm_head, preferred_element_type=jnp.float32
+    )
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - tl
+    if mask is None:
+        return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask), jnp.sum(mask)
 
 
 def loss_fn(params, batch, cfg, mesh=None):
     """Next-token cross-entropy; batch: {'tokens': [B, S+1]} or
-    {'inputs': [B,S], 'targets': [B,S]} (+ optional 'mask')."""
+    {'inputs': [B,S], 'targets': [B,S]} (+ optional 'mask').
+
+    When cfg.loss_chunk divides the sequence, the head projection +
+    log-softmax run as a rematerialized lax.scan over sequence chunks, so
+    peak activation memory is [B, chunk, vocab] fp32 instead of the full
+    [B, S, vocab] in BOTH the forward and backward pass."""
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = forward(params, inputs, cfg, mesh=mesh)
-    logps = jax.nn.log_softmax(logits, axis=-1)
-    token_lp = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
-    if mask is None:
-        return -jnp.mean(token_lp)
-    return -jnp.sum(token_lp * mask) / jnp.maximum(jnp.sum(mask), 1)
+    x = hidden_states(params, inputs, cfg, mesh=mesh)
+
+    B, S, D = x.shape
+    chunk = cfg.loss_chunk
+    if chunk and S % chunk:
+        # snap to the largest divisor of S that fits the requested bound so
+        # an off-size sequence never silently reverts to full-logit memory
+        chunk = next((c for c in range(min(chunk, S), 0, -1) if S % c == 0))
+        if chunk < 32:
+            chunk = 0  # degenerate chunking would be slower than the memory win
+    if not chunk or S == chunk:
+        loss_sum, count = _ce_sums(x, params["lm_head"], targets, mask)
+        return loss_sum / jnp.maximum(count, 1)
+
+    n = S // chunk
+    xs = jnp.moveaxis(x.reshape(B, n, chunk, D), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+    ms = None if mask is None else jnp.moveaxis(mask.reshape(B, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(carry, sl):
+        loss_sum, count = carry
+        s, c = _ce_sums(
+            sl["x"], params["lm_head"], sl["t"], sl.get("m")
+        )
+        return (loss_sum + s, count + c), None
+
+    sl = {"x": xs, "t": ts}
+    if ms is not None:
+        sl["m"] = ms
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), sl
+    )
+    return loss_sum / jnp.maximum(count, 1)
 
 
 def num_params(params):

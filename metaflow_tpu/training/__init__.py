@@ -1,5 +1,6 @@
 from .train_step import (
     default_optimizer,
+    memory_efficient_optimizer,
     make_train_state,
     make_train_step,
     make_trainer,
@@ -9,6 +10,7 @@ from .train_step import (
 
 __all__ = [
     "default_optimizer",
+    "memory_efficient_optimizer",
     "make_train_state",
     "make_train_step",
     "make_trainer",

@@ -16,16 +16,43 @@ import optax
 from ..parallel import sharding as shd
 
 
-def default_optimizer(lr=3e-4, weight_decay=0.1, clip_norm=1.0,
-                      warmup_steps=100, total_steps=10_000, b1=0.9, b2=0.95):
-    schedule = optax.warmup_cosine_decay_schedule(
+def _lr_schedule(lr, warmup_steps, total_steps):
+    return optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=lr, warmup_steps=warmup_steps,
         decay_steps=max(total_steps, warmup_steps + 1), end_value=lr * 0.1,
     )
+
+
+def default_optimizer(lr=3e-4, weight_decay=0.1, clip_norm=1.0,
+                      warmup_steps=100, total_steps=10_000, b1=0.9, b2=0.95,
+                      mu_dtype=jnp.float32):
+    schedule = _lr_schedule(lr, warmup_steps, total_steps)
     return optax.chain(
         optax.clip_by_global_norm(clip_norm),
         optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
-                    mu_dtype=jnp.float32),
+                    mu_dtype=mu_dtype),
+    )
+
+
+def memory_efficient_optimizer(lr=3e-4, clip_norm=1.0, warmup_steps=100,
+                               total_steps=10_000, b1=0.9):
+    """Adafactor-style state: bf16 first moment + factored second moment
+    (~2 bytes/param of optimizer state vs adamw's 8). On a single v5e chip
+    this is what unlocks batch >16 for the ~1B bench config — optimizer
+    state stops competing with activations for HBM."""
+    schedule = _lr_schedule(lr, warmup_steps, total_steps)
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adafactor(
+            learning_rate=schedule,
+            multiply_by_parameter_scale=False,
+            clipping_threshold=None,
+            momentum=b1,
+            dtype_momentum=jnp.bfloat16,
+            weight_decay_rate=None,
+            eps=1e-30,
+            factored=True,
+        ),
     )
 
 
