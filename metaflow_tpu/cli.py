@@ -138,6 +138,24 @@ def _parse_task_pathspec(pathspec):
     return parts
 
 
+def _write_argo_outputs(state, out_dir, run_id, step_name, task_id):
+    """Drop Argo output-parameter files (read via valueFrom.path): the
+    foreach fan-out cardinality as a JSON index list (consumed by withParam
+    and by the join's --join-inputs), and the switch's chosen next step
+    (consumed by `when` conditions)."""
+    os.makedirs(out_dir, exist_ok=True)
+    ds = state.flow_datastore.get_task_datastore(run_id, step_name, task_id)
+    num_splits = ds.get("_foreach_num_splits") or 0
+    transition = ds.get("_transition")
+    next_step = ""
+    if transition and transition[0]:
+        next_step = transition[0][0]
+    with open(os.path.join(out_dir, "num-splits"), "w") as f:
+        json.dump(list(range(int(num_splits))), f)
+    with open(os.path.join(out_dir, "next-step"), "w") as f:
+        f.write(next_step)
+
+
 def _collect_params(flow, kwargs):
     params = {}
     for name, _param in flow._get_parameters():
@@ -176,6 +194,12 @@ def main(flow, args=None):
             flow.name, storage_impl, ds_root=datastore_root
         )
         state.metadata = METADATA_PROVIDERS[metadata](flow=flow)
+        # raw selections, re-emitted into compiled (Argo) container commands
+        state.datastore_type = datastore
+        state.metadata_type = metadata
+        # the *explicit* root only: a defaulted local root is this machine's
+        # filesystem and must not be compiled into remote pod commands
+        state.datastore_root_explicit = datastore_root
         state.quiet = quiet
         if quiet:
             state.echo = echo_quiet
@@ -285,6 +309,18 @@ def main(flow, args=None):
     @click.option("--run-id", required=True)
     @click.option("--task-id", required=True)
     @click.option("--input-paths", default=None)
+    @click.option("--input-paths-any", default=None,
+                  help="Candidate input paths of which exactly ONE exists "
+                       "(the step after alternative switch branches — only "
+                       "the taken branch's task is in the datastore).")
+    @click.option("--join-inputs", default=None,
+                  help="Join inputs as '<run>/<step>:<json index list>' — "
+                       "expands to that step's deterministic per-split task "
+                       "ids (used by compiled Argo workflows, where the "
+                       "scheduler isn't around to enumerate arrivals).")
+    @click.option("--join-inputs-control", default=None,
+                  help="Gang-join inputs: pathspec of the control task; its "
+                       "recorded _control_mapper_tasks become the inputs.")
     @click.option("--split-index", default=None)
     @click.option("--retry-count", default=0)
     @click.option("--max-user-code-retries", default=0)
@@ -292,10 +328,14 @@ def main(flow, args=None):
     @click.option("--ubf-context", default=None)
     @click.option("--origin-run-id", default=None)
     @click.option("--params-json", default=None)
+    @click.option("--argo-output-dir", default=None,
+                  help="Directory to drop Argo output-parameter files into "
+                       "after the task finishes (num-splits, next-step).")
     @click.pass_obj
     def step(state, step_name, run_id, task_id, input_paths, split_index,
              retry_count, max_user_code_retries, user_namespace, ubf_context,
-             origin_run_id, params_json):
+             origin_run_id, params_json, input_paths_any, join_inputs,
+             join_inputs_control, argo_output_dir):
         _finalize(state)
         os.environ[STEP_ARGV_ENV] = json.dumps(sys.argv)
         if ubf_context not in (None, "", "none"):
@@ -303,6 +343,37 @@ def main(flow, args=None):
         else:
             ubf = None
         paths = decompress_list(input_paths) if input_paths else []
+        if input_paths_any:
+            existing = []
+            for cand in decompress_list(input_paths_any):
+                c_run, c_step, c_task = cand.split("/")
+                ds = state.flow_datastore.get_task_datastore(
+                    c_run, c_step, c_task, allow_not_done=True
+                )
+                if ds.is_done():
+                    existing.append(cand)
+            if len(existing) != 1:
+                raise TpuFlowException(
+                    "Expected exactly one completed input among %s, found "
+                    "%s." % (input_paths_any, existing or "none")
+                )
+            paths += existing
+        if join_inputs:
+            prefix, _, indices = join_inputs.rpartition(":")
+            j_run, _, j_step = prefix.partition("/")
+            paths += [
+                "%s/%s/%s-%d" % (j_run, j_step, j_step, int(i))
+                for i in json.loads(indices)
+            ]
+        if join_inputs_control:
+            ctl_run, ctl_step, ctl_task = join_inputs_control.split("/")
+            ctl_ds = state.flow_datastore.get_task_datastore(
+                ctl_run, ctl_step, ctl_task
+            )
+            paths += [
+                "/".join(ps.split("/")[-3:])
+                for ps in ctl_ds["_control_mapper_tasks"]
+            ]
 
         # task heartbeat: mtime-based liveness, 10s cadence
         state.metadata.start_task_heartbeat(flow.name, run_id, step_name, task_id)
@@ -336,6 +407,9 @@ def main(flow, args=None):
                 parameters_json=params_json,
                 num_parallel=0,
             )
+            if argo_output_dir:
+                _write_argo_outputs(state, argo_output_dir, run_id, step_name,
+                                    task_id)
         finally:
             beat_stop.set()
 
@@ -525,10 +599,13 @@ def main(flow, args=None):
     @click.option("--package/--no-package", "do_package", default=False,
                   help="Build+upload the code package first.")
     @click.pass_obj
-    def argo_create(state, image, k8s_namespace, only_json, do_package):
+    def argo_create(state, image, k8s_namespace, only_json, do_package,
+                    **param_kwargs):
         from .plugins.argo import ArgoWorkflows
 
         _finalize(state)
+        # deploy-time parameter values become the workflow's defaults
+        deploy_params, _ = _collect_params(flow, param_kwargs)
 
         package_url = None
         if do_package:
@@ -540,9 +617,18 @@ def main(flow, args=None):
             package_url, sha = pkg.upload(state.flow_datastore)
             echo("Code package uploaded: %s (sha %s)" % (package_url,
                                                          sha[:12]))
+        from .metaflow_config import service_url as _service_url
+
         compiler = ArgoWorkflows(
             state.flow, state.graph, package_url=package_url, image=image,
             namespace=k8s_namespace,
+            datastore=state.datastore_type,
+            datastore_root=(state.datastore_root_explicit
+                            or (None if state.datastore_type == "local"
+                                else state.flow_datastore.ds_root)),
+            metadata=state.metadata_type,
+            service_url=_service_url(),
+            parameters=deploy_params,
         )
         manifests = [
             compiler.compile(),
@@ -558,6 +644,8 @@ def main(flow, args=None):
                 "manifests to 'kubectl apply -f -' instead (re-run with "
                 "--only-json)."
             )
+
+    argo_create.params.extend(_param_options(flow))
 
     @start.command(help="Show the live status of a run (heartbeats, "
                         "attempts, durations).")

@@ -1,0 +1,137 @@
+"""Remote log capture wrapper: run a task command, persist its logs.
+
+On a cluster there is no local scheduler reaping worker pipes (runtime.py
+does that for local runs), so a remote pod wraps its `step` command in this
+module:
+
+    python -m metaflow_tpu.mflog_capture \
+        --flow-name F --run-id R --step S --task-id T --attempt 0 \
+        --datastore gs --datastore-root gs://bucket/prefix \
+        -- python flow.py --quiet ... step ...
+
+It tees the child's stdout/stderr through (so `kubectl logs` still works),
+buffers them with mflog structured headers, and persists both streams to the
+task datastore when the child exits — success OR failure — then exits with
+the child's return code. Fills the role of the reference's bash capture
+wrapper + save_logs (metaflow/metaflow_environment.py:192,
+metaflow/mflog/save_logs.py), as one supervising process instead of shell
+redirection.
+
+Flush cadence: logs are (re)persisted every FLUSH_SECS while the child runs,
+with the reference's sigmoid-style backoff idea simplified to a linear ramp
+(frequent early, settling at 30s) so a killed pod loses at most the last
+window of output (ref: metaflow/mflog/__init__.py:69-81).
+"""
+
+import argparse
+import os
+import selectors
+import subprocess
+import sys
+import time
+
+from . import mflog
+from .datastore import FlowDataStore
+from .datastore.storage import STORAGE_BACKENDS
+
+MIN_FLUSH_SECS = 1.0
+MAX_FLUSH_SECS = 30.0
+
+
+def _flush_delay(uploads_done):
+    """Start at 1s, ramp to 30s by the 10th upload."""
+    return min(MAX_FLUSH_SECS, MIN_FLUSH_SECS + 3.0 * uploads_done)
+
+
+def capture(args, child_argv):
+    storage_impl = STORAGE_BACKENDS[args.datastore]
+    flow_ds = FlowDataStore(
+        args.flow_name, storage_impl, ds_root=args.datastore_root
+    )
+    task_ds = flow_ds.get_task_datastore(
+        args.run_id, args.step, args.task_id, attempt=args.attempt, mode="w"
+    )
+
+    proc = subprocess.Popen(
+        child_argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+    )
+    os.set_blocking(proc.stdout.fileno(), False)
+    os.set_blocking(proc.stderr.fileno(), False)
+
+    bufs = {"stdout": b"", "stderr": b""}
+    partial = {"stdout": b"", "stderr": b""}
+    passthrough = {"stdout": sys.stdout.buffer, "stderr": sys.stderr.buffer}
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ, "stdout")
+    sel.register(proc.stderr, selectors.EVENT_READ, "stderr")
+    open_streams = 2
+
+    def drain(fileobj, name):
+        nonlocal open_streams
+        try:
+            data = fileobj.read()
+        except (OSError, ValueError):
+            data = b""
+        if not data:
+            sel.unregister(fileobj)
+            open_streams -= 1
+            return
+        passthrough[name].write(data)
+        passthrough[name].flush()
+        chunk = partial[name] + data
+        lines = chunk.split(b"\n")
+        partial[name] = lines.pop()
+        for line in lines:
+            bufs[name] += mflog.decorate(mflog.TASK, line)
+
+    def persist():
+        out = {
+            n: bufs[n] + (mflog.decorate(mflog.TASK, partial[n])
+                          if partial[n] else b"")
+            for n in bufs
+        }
+        try:
+            # same logsource name the local scheduler uses when it reaps
+            # worker pipes — the logs CLI and client read this file
+            task_ds.save_logs("runtime", out)
+        except Exception as ex:  # a failed upload must not kill the task
+            sys.stderr.write("mflog_capture: log upload failed: %s\n" % ex)
+
+    uploads = 0
+    next_flush = time.time() + _flush_delay(0)
+    while open_streams:
+        for key, _ in sel.select(timeout=1.0):
+            drain(key.fileobj, key.data)
+        if time.time() >= next_flush:
+            persist()
+            uploads += 1
+            next_flush = time.time() + _flush_delay(uploads)
+    rc = proc.wait()
+    for name in partial:
+        if partial[name]:
+            bufs[name] += mflog.decorate(mflog.TASK, partial[name])
+            partial[name] = b""
+    persist()
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="mflog_capture")
+    parser.add_argument("--flow-name", required=True)
+    parser.add_argument("--run-id", required=True)
+    parser.add_argument("--step", required=True)
+    parser.add_argument("--task-id", required=True)
+    parser.add_argument("--attempt", type=int, default=0)
+    parser.add_argument("--datastore", default="local")
+    parser.add_argument("--datastore-root", default=None)
+    args, rest = parser.parse_known_args(argv)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        parser.error("no command given after '--'")
+    sys.exit(capture(args, rest))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,6 +6,25 @@ _container_templates:1983): each step becomes a container template running
 the same `step` command the local runtime uses; foreach becomes a fan-out via
 `withParam`; @schedule → CronWorkflow; @trigger → an Argo Events sensor.
 
+What makes the compiled workflow actually EXECUTABLE on a cluster (not just
+compile-shaped):
+  - every container command carries the deploy-time datastore/metadata
+    selection (`--datastore gs --datastore-root gs://…`, `--metadata
+    service` + TPUFLOW_SERVICE_URL env) so all pods share one artifact
+    root — the only inter-task data channel;
+  - the step command is wrapped in `python -m metaflow_tpu.mflog_capture`
+    which persists the pod's stdout/stderr to the task datastore on exit
+    (the reference wraps in bash, metaflow_environment.py:192);
+  - task ids are DETERMINISTIC (step name, plus `-<split index>` inside a
+    foreach), so downstream input paths are computable at compile time
+    instead of needing scheduler bookkeeping;
+  - a foreach parent writes its fan-out cardinality to an Argo output
+    parameter (valueFrom file, written by `step --argo-output-dir`); the
+    children fan out via withParam over it and the join re-derives its
+    input paths from the same list via `step --join-inputs`;
+  - a switch parent writes its chosen next step to an output parameter and
+    each branch guards on it with a `when` expression.
+
 TPU-first differences from the reference's K8s compilation:
   - @tpu steps request `google.com/tpu` resources and set the
     `cloud.google.com/gke-tpu-accelerator`/`-topology` node selectors GKE
@@ -17,11 +36,16 @@ TPU-first differences from the reference's K8s compilation:
 """
 
 import json
-import sys
+import shlex
 
 from ...exception import TpuFlowException
 
 DEFAULT_IMAGE = "python:3.12"
+
+ARGO_OUTPUT_DIR = "/tmp/tpuflow-argo-outputs"
+
+# the compiled run id namespace: one Argo workflow execution = one run
+RUN_ID = "argo-{{workflow.name}}"
 
 
 def _argo_name(name):
@@ -43,34 +67,222 @@ TPU_TOPOLOGY_SELECTORS = {
 
 class ArgoWorkflows(object):
     def __init__(self, flow, graph, package_url=None, image=None,
-                 namespace="default", name=None):
+                 namespace="default", name=None, datastore="local",
+                 datastore_root=None, metadata="local", service_url=None,
+                 parameters=None):
         self.flow = flow
         self.graph = graph
         self.package_url = package_url
         self.image = image or DEFAULT_IMAGE
         self.namespace = namespace
         self.name = (name or flow.name).lower().replace("_", "-")
+        self.datastore = datastore
+        self.datastore_root = datastore_root
+        self.metadata = metadata
+        self.service_url = service_url
+        self.parameters = parameters or {}
+        self._validate()
+
+    def _validate(self):
+        """Refuse graphs the Argo compilation can't express yet, and configs
+        that would compile to pods writing into their own ephemeral disks."""
+        if self.datastore == "local" and not self.datastore_root:
+            raise TpuFlowException(
+                "Argo pods need a SHARED datastore: pass --datastore gs "
+                "(with TPUFLOW_DATASTORE_SYSROOT_GS set) or an explicit "
+                "--datastore-root on a shared filesystem. A default local "
+                "datastore would strand every pod's artifacts on its own "
+                "ephemeral disk."
+            )
+        for name in self.graph.sorted_nodes():
+            node = self.graph[name]
+            if (node.type in ("foreach", "split-parallel")
+                    and self._foreach_parent_of(name)):
+                raise TpuFlowException(
+                    "Step *%s*: a foreach/num_parallel fan-out nested inside "
+                    "a foreach is not supported on Argo Workflows yet — "
+                    "flatten the loops or run locally." % name
+                )
+            if node.type == "split-switch":
+                for target in node.out_funcs:
+                    if self.graph[target] is node or target == name:
+                        raise TpuFlowException(
+                            "Step *%s*: recursive switch is not supported "
+                            "on Argo Workflows yet." % name
+                        )
+            if self._is_switch_merge(node):
+                for in_func in node.in_funcs:
+                    if self.graph[in_func].type == "split-switch":
+                        raise TpuFlowException(
+                            "Step *%s*: a step that is both a direct switch "
+                            "target and a merge of other branches is not "
+                            "supported on Argo Workflows yet." % name
+                        )
+
+    # ---------------- graph helpers ----------------
+
+    def _foreach_parent_of(self, name):
+        """The foreach node this step fans out under (split_parents walk),
+        or None when the step is outside any foreach."""
+        node = self.graph[name]
+        for parent in reversed(node.split_parents or []):
+            if self.graph[parent].type == "foreach":
+                return parent
+        return None
+
+
+    def _is_switch_merge(self, node):
+        """A non-join step with several in-steps: only legal when those
+        in-steps are alternative switch branches, of which exactly one ran.
+        (A normal split demands a join, so the linter never lets any other
+        shape through.)"""
+        return node.type != "join" and len(node.in_funcs or []) > 1
+
+    def _switch_parent_of(self, name):
+        """(switch_node, ) when this step is a direct switch branch."""
+        for in_func in self.graph[name].in_funcs:
+            if self.graph[in_func].type == "split-switch":
+                return in_func
+        return None
 
     # ---------------- step command ----------------
 
+    def _top_level_flags(self):
+        flags = "--quiet --datastore %s" % self.datastore
+        if self.datastore_root:
+            flags += " --datastore-root %s" % shlex.quote(self.datastore_root)
+        flags += " --metadata %s" % self.metadata
+        return flags
+
     def _step_command(self, node):
-        """The container command: bootstrap the code package then run the
-        exact same `step` command the local runtime uses."""
+        """The container command: bootstrap the code package, then run the
+        same `step` command the local runtime uses — wrapped in the mflog
+        capture supervisor so pod logs land in the shared datastore."""
         from ...package import MetaflowPackage
 
         cmds = []
         if self.package_url:
             cmds += MetaflowPackage.bootstrap_commands(self.package_url)
-        input_paths = "{{inputs.parameters.input-paths}}"
-        split_index = "{{inputs.parameters.split-index}}"
-        step_cmd = (
-            "python %s --quiet --metadata local --datastore local step %s "
-            "--run-id {{workflow.name}} --task-id {{inputs.parameters.task-id}} "
-            "--input-paths '%s' --split-index '%s'"
-            % (self.flow.script_name, node.name, input_paths, split_index)
+
+        task_id = "{{inputs.parameters.task-id}}"
+        retries = "{{retries}}" if self._retries_for(node) else "0"
+        step_opts = [
+            "--run-id %s" % RUN_ID,
+            "--task-id %s" % task_id,
+            "--retry-count %s" % retries,
+            "--max-user-code-retries %d" % self._retries_for(node),
+        ]
+
+        if node.name == "start":
+            params_json = self._params_json_template()
+            if params_json:
+                step_opts.append("--params-json %s" % params_json)
+        else:
+            join_mode = self._join_input_mode(node)
+            if join_mode == "foreach":
+                child = sorted(node.in_funcs)[0]
+                step_opts.append(
+                    "--join-inputs '%s/%s:{{inputs.parameters.num-splits}}'"
+                    % (RUN_ID, child)
+                )
+            elif join_mode == "gang":
+                ctl = sorted(node.in_funcs)[0]
+                step_opts.append(
+                    "--join-inputs-control '%s/%s/%s'" % (RUN_ID, ctl, ctl)
+                )
+            elif self._is_switch_merge(node):
+                step_opts.append(
+                    "--input-paths-any '{{inputs.parameters.input-paths}}'"
+                )
+            else:
+                step_opts.append(
+                    "--input-paths '{{inputs.parameters.input-paths}}'"
+                )
+
+        if self._is_foreach_child(node):
+            step_opts.append(
+                "--split-index '{{inputs.parameters.split-index}}'"
+            )
+        if node.parallel_step:
+            from ...unbounded_foreach import UBF_CONTROL
+
+            step_opts += ["--ubf-context %s" % UBF_CONTROL,
+                          "--split-index 0"]
+        if node.type in ("foreach", "split-switch", "split-parallel"):
+            step_opts.append("--argo-output-dir %s" % ARGO_OUTPUT_DIR)
+
+        step_cmd = "python %s %s step %s %s" % (
+            self.flow.script_name,
+            self._top_level_flags(),
+            node.name,
+            " ".join(step_opts),
         )
-        cmds.append(step_cmd)
+        capture = (
+            "python -m metaflow_tpu.mflog_capture --flow-name %s "
+            "--run-id %s --step %s --task-id %s --attempt %s "
+            "--datastore %s%s -- %s"
+            % (
+                self.flow.name, RUN_ID, node.name, task_id, retries,
+                self.datastore,
+                (" --datastore-root %s" % shlex.quote(self.datastore_root)
+                 if self.datastore_root else ""),
+                step_cmd,
+            )
+        )
+        cmds.append("mkdir -p %s" % ARGO_OUTPUT_DIR)
+        cmds.append(capture)
         return ["bash", "-c", " && ".join(cmds)]
+
+    def _params_json_template(self):
+        """--params-json payload with {{workflow.parameters.X}} holes: Argo
+        substitutes submit-time values textually; parameter values are JSON
+        literals, so the assembled blob parses as JSON inside the pod."""
+        entries = [
+            '"%s": {{workflow.parameters.%s}}' % (name, _argo_name(name))
+            for name, param in self.flow._get_parameters()
+            if not getattr(param, "IS_CONFIG_PARAMETER", False)
+        ]
+        if not entries:
+            return None
+        return shlex.quote("{%s}" % ", ".join(entries))
+
+    def _joined_split(self, node):
+        """The split node this join collects (a join's own split_parents
+        already excludes it — graph.py pops on the way down, so look at the
+        branches' innermost split parent instead)."""
+        if node.type != "join" or not node.in_funcs:
+            return None
+        in0 = self.graph[sorted(node.in_funcs)[0]]
+        if not in0.split_parents:
+            return None
+        return self.graph[in0.split_parents[-1]]
+
+    def _join_input_mode(self, node):
+        """'foreach' when this is the join collecting a foreach fan-out,
+        'gang' for a num_parallel join, else None."""
+        split = self._joined_split(node)
+        if split is None:
+            return None
+        if split.type == "foreach":
+            return "foreach"
+        if split.type == "split-parallel":
+            return "gang"
+        return None
+
+    def _is_foreach_child(self, node):
+        """True when the step itself fans out per split (inside a foreach,
+        but not the join that collects it)."""
+        return (
+            self._foreach_parent_of(node.name) is not None
+            and self._join_input_mode(node) is None
+        )
+
+    def _retries_for(self, node):
+        step_func = getattr(self.flow, node.name)
+        for deco in step_func.decorators:
+            if deco.name == "retry":
+                return int(deco.attributes["times"])
+        return 0
 
     # ---------------- per-step container templates ----------------
 
@@ -99,28 +311,51 @@ class ArgoWorkflows(object):
                     res["limits"]["google.com/tpu"] = "4"
         return res, node_selector
 
+    def _container_env(self):
+        env = []
+        if self.metadata == "service" and self.service_url:
+            env.append({"name": "TPUFLOW_SERVICE_URL",
+                        "value": self.service_url})
+        return env
+
     def _container_template(self, node):
         resources, node_selector = self._resources_for(node)
-        step_func = getattr(self.flow, node.name)
-        retries = 0
-        for deco in step_func.decorators:
-            if deco.name == "retry":
-                retries = int(deco.attributes["times"])
+        retries = self._retries_for(node)
+        input_params = [
+            {"name": "input-paths", "value": ""},
+            {"name": "split-index", "value": ""},
+            {"name": "num-splits", "value": "[]"},
+            {"name": "task-id", "value": node.name},
+        ]
         template = {
             "name": _argo_name(node.name),
-            "inputs": {
-                "parameters": [
-                    {"name": "input-paths", "value": ""},
-                    {"name": "split-index", "value": ""},
-                    {"name": "task-id", "value": "{{pod.name}}"},
-                ]
-            },
+            "inputs": {"parameters": input_params},
             "container": {
                 "image": self.image,
                 "command": self._step_command(node),
                 "resources": resources,
             },
         }
+        env = self._container_env()
+        if env:
+            template["container"]["env"] = env
+        if node.type in ("foreach", "split-switch", "split-parallel"):
+            template["outputs"] = {"parameters": [
+                {
+                    "name": "num-splits",
+                    "valueFrom": {
+                        "path": "%s/num-splits" % ARGO_OUTPUT_DIR,
+                        "default": "[]",
+                    },
+                },
+                {
+                    "name": "next-step",
+                    "valueFrom": {
+                        "path": "%s/next-step" % ARGO_OUTPUT_DIR,
+                        "default": "",
+                    },
+                },
+            ]}
         if node_selector:
             template["nodeSelector"] = node_selector
         if retries:
@@ -138,37 +373,85 @@ class ArgoWorkflows(object):
 
     # ---------------- DAG wiring ----------------
 
+    def _input_paths_value(self, node):
+        """Compile-time input paths (run/step/task-id) for steps whose
+        inputs don't need runtime expansion (linear + static joins)."""
+        paths = []
+        for in_func in sorted(node.in_funcs):
+            # datastore pathspecs use REAL step names; only Argo
+            # template/task names are DNS-1123-restricted
+            if self._foreach_parent_of(in_func) and node.type != "join":
+                # linear step inside the foreach: same-split parent
+                paths.append("%s/%s/%s-{{item}}" % (RUN_ID, in_func, in_func))
+            else:
+                paths.append("%s/%s/%s" % (RUN_ID, in_func, in_func))
+        return ",".join(paths)
+
     def _dag_tasks(self):
         tasks = []
         for name in self.graph.sorted_nodes():
             node = self.graph[name]
-            task = {
-                "name": _argo_name(name),
-                "template": _argo_name(name),
-                "arguments": {"parameters": [
-                    {"name": "input-paths",
-                     "value": "{{workflow.name}}/" + (
-                         node.in_funcs and sorted(node.in_funcs)[0] or "_"
-                     )},
-                    {"name": "split-index", "value": ""},
-                    {"name": "task-id", "value": _argo_name(name)},
-                ]},
-            }
+            argo = _argo_name(name)
+            foreach_parent = self._foreach_parent_of(name)
+            is_child = self._is_foreach_child(node)
+            task_id = "%s-{{item}}" % name if is_child else name
+
+            params = [
+                {"name": "task-id", "value": task_id},
+            ]
             deps = sorted(_argo_name(f) for f in node.in_funcs)
+            if is_child and foreach_parent and _argo_name(foreach_parent) not in deps:
+                # withParam reads the foreach parent's output parameter
+                deps.append(_argo_name(foreach_parent))
+
+            join_mode = self._join_input_mode(node)
+            if join_mode == "foreach":
+                split = self._joined_split(node).name
+                params.append({
+                    "name": "num-splits",
+                    "value": "{{tasks.%s.outputs.parameters.num-splits}}"
+                    % _argo_name(split),
+                })
+                if _argo_name(split) not in deps:
+                    deps.append(_argo_name(split))
+            elif join_mode != "gang" and node.name != "start":
+                params.append({
+                    "name": "input-paths",
+                    "value": self._input_paths_value(node),
+                })
+
+            if is_child:
+                params.append({"name": "split-index", "value": "{{item}}"})
+
+            task = {
+                "name": argo,
+                "template": argo,
+                "arguments": {"parameters": params},
+            }
+            # `depends` (never `dependencies` — Argo forbids mixing them in
+            # one DAG, and plain dependencies treat Skipped as satisfied,
+            # which would run the descendants of an untaken switch branch):
+            # requiring .Succeeded makes Argo mark a task Omitted when its
+            # upstream was skipped/omitted, so omission propagates down the
+            # untaken branch; a switch merge ORs its alternatives instead.
+            joiner = " || " if self._is_switch_merge(node) else " && "
             if deps:
-                task["dependencies"] = deps
-            parent_foreach = None
-            for in_func in node.in_funcs:
-                if self.graph[in_func].type == "foreach":
-                    parent_foreach = in_func
-            if parent_foreach:
-                # fan-out: the foreach parent emits a JSON list of split
-                # indices on its output parameter
+                task["depends"] = joiner.join(
+                    "%s.Succeeded" % d for d in sorted(deps)
+                )
+
+            if is_child and foreach_parent:
                 task["withParam"] = (
                     "{{tasks.%s.outputs.parameters.num-splits}}"
-                    % _argo_name(parent_foreach)
+                    % _argo_name(foreach_parent)
                 )
-                task["arguments"]["parameters"][1]["value"] = "{{item}}"
+
+            switch_parent = self._switch_parent_of(name)
+            if switch_parent:
+                task["when"] = (
+                    "{{tasks.%s.outputs.parameters.next-step}} == %s"
+                    % (_argo_name(switch_parent), name)
+                )
             tasks.append(task)
         return tasks
 
@@ -177,7 +460,10 @@ class ArgoWorkflows(object):
     def compile(self):
         """Return the WorkflowTemplate manifest (dict)."""
         parameters = [
-            {"name": name, "value": json.dumps(param.kwargs.get("default"))}
+            {"name": _argo_name(name),
+             "value": json.dumps(
+                 self.parameters.get(name, param.kwargs.get("default"))
+             )}
             for name, param in self.flow._get_parameters()
             if not getattr(param, "IS_CONFIG_PARAMETER", False)
         ]

@@ -1,0 +1,199 @@
+"""A minimal local Argo Workflows executor for e2e-testing compiled manifests.
+
+This is the MinIO trick applied to Argo (SURVEY.md §4): instead of asserting
+on YAML shape, actually EXECUTE the compiled WorkflowTemplate — walk the DAG,
+expand withParam fan-outs from recorded output parameters, evaluate `when`
+guards, substitute the same template variables the Argo controller would
+({{workflow.name}}, {{inputs.parameters.*}}, {{tasks.*.outputs.parameters.*}},
+{{item}}, {{retries}}), and run each pod's container command as a local
+subprocess against a shared datastore root. If the compiled command strings
+are wrong (the round-1 failure mode: pods writing to their own ephemeral
+local datastore), flows fail here exactly as they would on a cluster.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+from metaflow_tpu.plugins.argo.argo_workflows import ARGO_OUTPUT_DIR
+
+_PARAM_RE = re.compile(r"\{\{([^}]+)\}\}")
+
+
+class ArgoSimError(Exception):
+    pass
+
+
+class ArgoSimulator(object):
+    def __init__(self, manifest, workflow_name, env, cwd, output_dir):
+        self.spec = manifest["spec"]
+        self.workflow_name = workflow_name
+        self.env = env
+        self.cwd = cwd
+        # per-simulator stand-in for the pod-local output dir (pods are
+        # isolated on a cluster; sequential pods share /tmp here)
+        self.output_dir = output_dir
+        self.templates = {t["name"]: t for t in self.spec["templates"]}
+        self.workflow_params = {
+            p["name"]: p["value"]
+            for p in self.spec.get("arguments", {}).get("parameters", [])
+        }
+        self.task_outputs = {}  # dag task name -> {param: value}
+        self.pods_run = []      # (dag task name, item) in execution order
+
+    # ---------------- template variable substitution ----------------
+
+    def _subst(self, text, scopes):
+        def repl(m):
+            key = m.group(1).strip()
+            for scope in scopes:
+                if key in scope:
+                    return str(scope[key])
+            raise ArgoSimError("Unresolved template variable {{%s}}" % key)
+
+        return _PARAM_RE.sub(repl, text)
+
+    def _dag_scope(self, item=None):
+        scope = {"workflow.name": self.workflow_name}
+        for pname, pval in self.workflow_params.items():
+            scope["workflow.parameters.%s" % pname] = pval
+        for tname, outs in self.task_outputs.items():
+            for oname, oval in outs.items():
+                scope["tasks.%s.outputs.parameters.%s" % (tname, oname)] = oval
+        if item is not None:
+            scope["item"] = item
+        return scope
+
+    # ---------------- execution ----------------
+
+    @staticmethod
+    def _deps_of(task):
+        if "dependencies" in task:
+            raise ArgoSimError(
+                "Task %s uses `dependencies`; the compiler must emit only "
+                "`depends` (Argo forbids mixing the two in one DAG, and "
+                "their skip semantics differ)" % task["name"]
+            )
+        # "a.Succeeded || b.Succeeded" / "a.Succeeded && b.Succeeded"
+        return [
+            tok.split(".")[0]
+            for tok in task.get("depends", "").replace("(", " ").replace(")", " ").split()
+            if tok not in ("&&", "||", "!")
+        ]
+
+    def run(self):
+        """Argo `depends` semantics: a task becomes schedulable once every
+        referenced task is resolved (Succeeded/Skipped/Omitted); its depends
+        expression is then evaluated with `X.Succeeded` — false → the task is
+        OMITTED (so omission propagates down an untaken switch branch); a
+        true expression with a false `when` → SKIPPED."""
+        dag_tasks = {t["name"]: t for t in self.templates["dag"]["dag"]["tasks"]}
+        succeeded = set()
+        not_run = set()  # Skipped + Omitted
+        pending = dict(dag_tasks)
+        while pending:
+            resolved = succeeded | not_run
+            ready = [
+                t for t in pending.values()
+                if all(d in resolved for d in self._deps_of(t))
+            ]
+            if not ready:
+                raise ArgoSimError(
+                    "Deadlocked DAG: pending=%s" % sorted(pending)
+                )
+            for task in sorted(ready, key=lambda t: t["name"]):
+                if not self._depends_true(task, succeeded):
+                    not_run.add(task["name"])      # Omitted
+                elif self._when_false(task):
+                    not_run.add(task["name"])      # Skipped
+                else:
+                    self._run_task(task)
+                    succeeded.add(task["name"])
+                del pending[task["name"]]
+
+    def _depends_true(self, task, succeeded):
+        expr = task.get("depends", "")
+        if not expr:
+            return True
+        # supported grammar: X.Succeeded joined by all-&& or all-||
+        if "||" in expr and "&&" in expr:
+            raise ArgoSimError("Mixed depends operators in %r" % expr)
+        terms = [t.strip() for t in
+                 expr.replace("||", "&&").split("&&")]
+        values = []
+        for term in terms:
+            name, _, status = term.partition(".")
+            if status != "Succeeded":
+                raise ArgoSimError("Unsupported depends term %r" % term)
+            values.append(name in succeeded)
+        return any(values) if "||" in expr else all(values)
+
+    def _when_false(self, task):
+        if "when" not in task:
+            return False
+        cond = self._subst(task["when"], [self._dag_scope()])
+        left, _, right = cond.partition("==")
+        return left.strip() != right.strip()
+
+    def _run_task(self, task):
+        dag_scope = self._dag_scope()
+        if "withParam" in task:
+            items = json.loads(self._subst(task["withParam"], [dag_scope]))
+            for item in items:
+                self._run_pod(task, item)
+        else:
+            self._run_pod(task, None)
+
+    def _run_pod(self, task, item):
+        template = self.templates[task["template"]]
+        params = {
+            p["name"]: p.get("value", "")
+            for p in template.get("inputs", {}).get("parameters", [])
+        }
+        dag_scope = self._dag_scope(item=item)
+        for p in task.get("arguments", {}).get("parameters", []):
+            params[p["name"]] = self._subst(p["value"], [dag_scope])
+
+        pod_scope = {"retries": "0", "pod.name": "sim-pod"}
+        for pname, pval in params.items():
+            pod_scope["inputs.parameters.%s" % pname] = pval
+
+        cmd = template["container"]["command"]
+        assert cmd[:2] == ["bash", "-c"], cmd
+        script = self._subst(cmd[2], [pod_scope, dag_scope])
+        script = script.replace(ARGO_OUTPUT_DIR, self.output_dir)
+
+        shutil.rmtree(self.output_dir, ignore_errors=True)
+        proc = subprocess.run(
+            ["bash", "-c", script], env=self.env, cwd=self.cwd,
+            capture_output=True, text=True, timeout=300,
+        )
+        if proc.returncode != 0:
+            raise ArgoSimError(
+                "Pod %s (item=%r) failed rc=%d\nscript: %s\nstdout:\n%s\n"
+                "stderr:\n%s"
+                % (task["name"], item, proc.returncode, script,
+                   proc.stdout[-4000:], proc.stderr[-4000:])
+            )
+        self.pods_run.append((task["name"], item))
+
+        outs = {}
+        for p in template.get("outputs", {}).get("parameters", []):
+            path = p["valueFrom"]["path"].replace(
+                ARGO_OUTPUT_DIR, self.output_dir
+            )
+            if os.path.exists(path):
+                with open(path) as f:
+                    outs[p["name"]] = f.read()
+            elif "default" in p["valueFrom"]:
+                outs[p["name"]] = p["valueFrom"]["default"]
+            else:
+                raise ArgoSimError(
+                    "Pod %s: missing output parameter file %s"
+                    % (task["name"], path)
+                )
+        if item is None:
+            self.task_outputs[task["name"]] = outs

@@ -1,0 +1,43 @@
+from metaflow_tpu import FlowSpec, step, Parameter
+
+
+class ArgoSwitchFlow(FlowSpec):
+    """Non-recursive switch (the Argo compiler supports switch via `when`
+    guards but not recursion)."""
+
+    mode = Parameter("mode", default="fast", type=str)
+
+    @step
+    def start(self):
+        self.next({"fast": self.fast_path, "slow": self.slow_path},
+                  condition="mode")
+
+    @step
+    def fast_path(self):
+        self.result = "fast"
+        self.next(self.done)
+
+    @step
+    def slow_path(self):
+        self.result = "slow"
+        self.next(self.slow_extra)
+
+    @step
+    def slow_extra(self):
+        # a second hop inside the branch: on Argo, omission of the untaken
+        # branch must propagate past the directly-guarded step
+        self.result = self.result + "-extra"
+        self.next(self.done)
+
+    @step
+    def done(self):
+        self.final = self.result + "!"
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("result:", self.final)
+
+
+if __name__ == "__main__":
+    ArgoSwitchFlow()

@@ -1,0 +1,192 @@
+"""E2E: compiled Argo workflows actually EXECUTE (VERDICT round-1 item #2).
+
+Compile flows to WorkflowTemplates, then run every pod's container command
+locally through the ArgoSimulator against a SHARED datastore root, and read
+the results back through the client API — proving the compiled commands
+round-trip artifacts between pods the way cluster pods must.
+
+Reference pattern: metaflow's full-stack argo test
+(devtools/ + .github/workflows/full-stack-test.yml) — scaled to an
+in-process controller instead of k3d.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from argo_sim import ArgoSimulator
+
+FLOWS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "flows")
+
+
+def _pod_env(root):
+    env = dict(os.environ)
+    env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = root
+    inherited = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p
+    ]
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + inherited
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    return env
+
+
+def _compile(flow_file, root, *extra):
+    """Run `flow.py --datastore local --datastore-root <shared> argo-workflows
+    create` and return the WorkflowTemplate manifest."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(FLOWS, flow_file),
+         "--datastore", "local", "--datastore-root", root,
+         "argo-workflows", "create"] + list(extra),
+        env=_pod_env(root), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    try:
+        import yaml
+
+        return next(iter(yaml.safe_load_all(proc.stdout)))
+    except ImportError:
+        return json.loads(proc.stdout.split("\n}\n")[0] + "\n}")
+
+
+def _simulate(flow_file, root, tmp_path, wf_name, *compile_args):
+    manifest = _compile(flow_file, root, *compile_args)
+    sim = ArgoSimulator(
+        manifest, workflow_name=wf_name, env=_pod_env(root), cwd=FLOWS,
+        output_dir=str(tmp_path / "argo-outputs"),
+    )
+    sim.run()
+    return sim
+
+
+@pytest.fixture()
+def client(tpuflow_root):
+    """Client API bound to the shared root."""
+    from metaflow_tpu import client as client_mod
+    from metaflow_tpu.client import Flow, namespace
+
+    namespace(None)
+    return Flow
+
+
+class TestArgoE2E:
+    def test_linear_flow_round_trips_artifacts(self, tpuflow_root, tmp_path,
+                                               client):
+        sim = _simulate("linear_flow.py", tpuflow_root, tmp_path, "wf-lin")
+        assert [p[0] for p in sim.pods_run] == ["start", "middle", "end"]
+
+        run = client("LinearFlow")["argo-wf-lin"]
+        assert run.successful
+        task = run["middle"].task
+        assert task["x"].data == 10
+        # default parameter flowed from workflow.parameters into start
+        assert abs(task["scaled"].data - 5.0) < 1e-9
+
+    def test_parameter_override_at_submit_time(self, tpuflow_root, tmp_path,
+                                               client):
+        sim = _simulate("linear_flow.py", tpuflow_root, tmp_path, "wf-p",
+                        "--alpha", "2.0")
+        run = client("LinearFlow")["argo-wf-p"]
+        assert run["middle"].task["scaled"].data == 20.0
+
+    def test_pod_logs_persisted_via_mflog_capture(self, tpuflow_root,
+                                                  tmp_path, client):
+        _simulate("linear_flow.py", tpuflow_root, tmp_path, "wf-logs")
+        end_task = client("LinearFlow")["argo-wf-logs"]["end"].task
+        assert "final x: 10" in end_task.stdout
+
+    def test_foreach_fan_out_and_join(self, tpuflow_root, tmp_path, client):
+        sim = _simulate("foreach_flow.py", tpuflow_root, tmp_path, "wf-fe")
+        # 1 start + 3 body pods + join + end
+        body_items = sorted(i for n, i in sim.pods_run if n == "body")
+        assert body_items == [0, 1, 2]
+
+        run = client("ForeachFlow")["argo-wf-fe"]
+        assert run.successful
+        assert run["join"].task["letters"].data == ["aa", "bb", "cc"]
+        # per-split tasks readable individually
+        tasks = {t.id: t for t in run["body"]}
+        assert len(tasks) == 3
+
+    def test_branch_join(self, tpuflow_root, tmp_path, client):
+        _simulate("branch_flow.py", tpuflow_root, tmp_path, "wf-br")
+        run = client("BranchFlow")["argo-wf-br"]
+        assert run.successful
+
+    def test_gang_control_and_join(self, tpuflow_root, tmp_path, client):
+        # the control pod runs the whole gang (local fork mode stands in for
+        # a multi-host slice); the join re-derives its inputs from the
+        # control task's recorded _control_mapper_tasks
+        sim = _simulate("parallel_flow.py", tpuflow_root, tmp_path, "wf-gang")
+        ran = [n for n, _ in sim.pods_run]
+        assert ran.count("train") == 1  # ONE control pod, not N
+        run = client("ParallelFlow")["argo-wf-gang"]
+        assert run.successful
+        # the join saw every rank's task
+        assert len(list(run["train"])) == 3
+
+    def test_switch_runs_only_taken_branch(self, tpuflow_root, tmp_path,
+                                           client):
+        sim = _simulate("argo_switch_flow.py", tpuflow_root, tmp_path,
+                        "wf-sw", "--mode", "slow")
+        ran = [n for n, _ in sim.pods_run]
+        assert "slow-path" in ran and "slow-extra" in ran
+        assert "fast-path" not in ran
+        run = client("ArgoSwitchFlow")["argo-wf-sw"]
+        assert run["done"].task["final"].data == "slow-extra!"
+
+    def test_switch_untaken_branch_omission_propagates(self, tpuflow_root,
+                                                       tmp_path, client):
+        # take the SHORT branch: the untaken branch's second hop
+        # (slow-extra) has no `when` of its own — only correct depends
+        # semantics keep it from running
+        sim = _simulate("argo_switch_flow.py", tpuflow_root, tmp_path,
+                        "wf-sw2", "--mode", "fast")
+        ran = [n for n, _ in sim.pods_run]
+        assert "fast-path" in ran
+        assert "slow-path" not in ran and "slow-extra" not in ran
+        run = client("ArgoSwitchFlow")["argo-wf-sw2"]
+        assert run["done"].task["final"].data == "fast!"
+
+
+class TestArgoCompileValidation:
+    def test_local_datastore_without_root_refused(self, tpuflow_root):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(FLOWS, "linear_flow.py"),
+             "argo-workflows", "create"],
+            env=_pod_env(tpuflow_root), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "SHARED datastore" in proc.stderr + proc.stdout
+
+    def test_nested_foreach_refused(self, tpuflow_root):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(FLOWS, "nested_foreach_flow.py"),
+             "--datastore", "local", "--datastore-root", tpuflow_root,
+             "argo-workflows", "create"],
+            env=_pod_env(tpuflow_root), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "nested" in (proc.stderr + proc.stdout).lower()
+
+    def test_recursive_switch_refused(self, tpuflow_root):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(FLOWS, "switch_flow.py"),
+             "--datastore", "local", "--datastore-root", tpuflow_root,
+             "argo-workflows", "create"],
+            env=_pod_env(tpuflow_root), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode != 0
+        assert "recursive" in (proc.stderr + proc.stdout).lower()
