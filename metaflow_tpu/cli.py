@@ -328,20 +328,32 @@ def main(flow, args=None):
     @click.option("--ubf-context", default=None)
     @click.option("--origin-run-id", default=None)
     @click.option("--params-json", default=None)
+    @click.option("--params-from-env", default=None,
+                  help="Read parameter values from environment variables "
+                       "named <prefix><param> (JSON-encoded values). Used "
+                       "by compiled Argo workflows: env injection is "
+                       "shell-safe where argv templating is not.")
     @click.option("--argo-output-dir", default=None,
                   help="Directory to drop Argo output-parameter files into "
                        "after the task finishes (num-splits, next-step).")
     @click.pass_obj
     def step(state, step_name, run_id, task_id, input_paths, split_index,
              retry_count, max_user_code_retries, user_namespace, ubf_context,
-             origin_run_id, params_json, input_paths_any, join_inputs,
-             join_inputs_control, argo_output_dir):
+             origin_run_id, params_json, params_from_env, input_paths_any,
+             join_inputs, join_inputs_control, argo_output_dir):
         _finalize(state)
         os.environ[STEP_ARGV_ENV] = json.dumps(sys.argv)
         if ubf_context not in (None, "", "none"):
             ubf = ubf_context
         else:
             ubf = None
+        if params_from_env and not params_json:
+            values = {}
+            for name, _param in flow._get_parameters():
+                raw = os.environ.get(params_from_env + name)
+                if raw is not None:
+                    values[name] = json.loads(raw)
+            params_json = json.dumps(values)
         paths = decompress_list(input_paths) if input_paths else []
         if input_paths_any:
             existing = []

@@ -120,3 +120,11 @@ class MissingInMergeArtifactsException(TpuFlowException):
     def __init__(self, msg, missing):
         super().__init__(msg=msg)
         self.artifact_names = list(missing)
+
+
+class TaskPreempted(TpuFlowException):
+    """The host received a preemption notice (spot/queued TPU capacity
+    reclaim); the attempt fails retryably so the next attempt can resume
+    from the last checkpoint."""
+
+    headline = "Task preempted"

@@ -47,6 +47,10 @@ ARGO_OUTPUT_DIR = "/tmp/tpuflow-argo-outputs"
 # the compiled run id namespace: one Argo workflow execution = one run
 RUN_ID = "argo-{{workflow.name}}"
 
+# parameter values ride container env vars (shell-safe), read back by
+# `step --params-from-env`
+PARAM_ENV_PREFIX = "TPUFLOW_PARAM_"
+
 
 def _argo_name(name):
     """Argo template/task names must be DNS-1123-ish."""
@@ -174,9 +178,12 @@ class ArgoWorkflows(object):
         ]
 
         if node.name == "start":
-            params_json = self._params_json_template()
-            if params_json:
-                step_opts.append("--params-json %s" % params_json)
+            if self._param_names():
+                # values arrive via container env (PARAM_ENV_PREFIX vars):
+                # Argo substitutes them into env values, which never pass
+                # through a shell — a parameter containing quotes or shell
+                # metacharacters cannot break or inject into the command
+                step_opts.append("--params-from-env %s" % PARAM_ENV_PREFIX)
         else:
             join_mode = self._join_input_mode(node)
             if join_mode == "foreach":
@@ -233,18 +240,11 @@ class ArgoWorkflows(object):
         cmds.append(capture)
         return ["bash", "-c", " && ".join(cmds)]
 
-    def _params_json_template(self):
-        """--params-json payload with {{workflow.parameters.X}} holes: Argo
-        substitutes submit-time values textually; parameter values are JSON
-        literals, so the assembled blob parses as JSON inside the pod."""
-        entries = [
-            '"%s": {{workflow.parameters.%s}}' % (name, _argo_name(name))
-            for name, param in self.flow._get_parameters()
+    def _param_names(self):
+        return [
+            name for name, param in self.flow._get_parameters()
             if not getattr(param, "IS_CONFIG_PARAMETER", False)
         ]
-        if not entries:
-            return None
-        return shlex.quote("{%s}" % ", ".join(entries))
 
     def _joined_split(self, node):
         """The split node this join collects (a join's own split_parents
@@ -311,11 +311,17 @@ class ArgoWorkflows(object):
                     res["limits"]["google.com/tpu"] = "4"
         return res, node_selector
 
-    def _container_env(self):
+    def _container_env(self, node):
         env = []
         if self.metadata == "service" and self.service_url:
             env.append({"name": "TPUFLOW_SERVICE_URL",
                         "value": self.service_url})
+        if node.name == "start":
+            for pname in self._param_names():
+                env.append({
+                    "name": PARAM_ENV_PREFIX + pname,
+                    "value": "{{workflow.parameters.%s}}" % _argo_name(pname),
+                })
         return env
 
     def _container_template(self, node):
@@ -336,7 +342,7 @@ class ArgoWorkflows(object):
                 "resources": resources,
             },
         }
-        env = self._container_env()
+        env = self._container_env(node)
         if env:
             template["container"]["env"] = env
         if node.type in ("foreach", "split-switch", "split-parallel"):

@@ -172,6 +172,46 @@ class ParallelDecorator(StepDecorator):
                 )
             }
         )
+        # watch workers WHILE rank 0 runs: a worker dying mid-step (e.g.
+        # preempted) must fail the gang promptly, not after rank 0 finishes
+        # a step that may be blocked on the dead peer. SIGUSR1 raises in
+        # rank 0's main thread at the next bytecode boundary; a rank blocked
+        # inside an XLA collective is instead broken by the jax.distributed
+        # coordination-service heartbeat, which errors the collective out.
+        import signal as _signal
+        import threading as _threading
+
+        watcher_stop = _threading.Event()
+        early_failed = []
+
+        def _on_worker_failure(signum, frame):
+            exc = TpuFlowException(
+                "Gang worker task(s) failed mid-step: %s"
+                % ", ".join(early_failed)
+            )
+            # route through the preemption handler so a shield()ed critical
+            # section (checkpoint save) is never interrupted mid-write
+            handler = getattr(current, "preemption", None)
+            if handler is not None:
+                handler.deliver(exc)
+            else:
+                raise exc
+
+        prev_usr1 = _signal.signal(_signal.SIGUSR1, _on_worker_failure)
+
+        def _watch():
+            main_pid = os.getpid()
+            while not watcher_stop.wait(0.2):
+                for proc, task_id in zip(procs, mapper_task_ids[1:]):
+                    rc = proc.poll()
+                    if rc is not None and rc != 0:
+                        early_failed.append(task_id)
+                        os.kill(main_pid, _signal.SIGUSR1)
+                        return
+
+        watcher = _threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+
         try:
             self.setup_distributed_env(flow)
             try:
@@ -179,6 +219,8 @@ class ParallelDecorator(StepDecorator):
             finally:
                 self.teardown_distributed_env(flow)
 
+            watcher_stop.set()
+            watcher.join(timeout=5)
             failed = []
             for proc, task_id in zip(procs, mapper_task_ids[1:]):
                 if proc.wait() != 0:
@@ -188,9 +230,10 @@ class ParallelDecorator(StepDecorator):
                     "Gang worker task(s) failed: %s" % ", ".join(failed)
                 )
         except BaseException:
-            # rank 0 died: never leave worker ranks running (a stalled rank
-            # would hold collective state — and on shared-chip dev boxes,
-            # the TPU itself)
+            # rank 0 died (or a watched worker failed): never leave worker
+            # ranks running (a stalled rank would hold collective state —
+            # and on shared-chip dev boxes, the TPU itself)
+            watcher_stop.set()
             for proc in procs:
                 if proc.poll() is None:
                     proc.terminate()
@@ -200,6 +243,9 @@ class ParallelDecorator(StepDecorator):
                 except Exception:
                     proc.kill()
             raise
+        finally:
+            watcher_stop.set()
+            _signal.signal(_signal.SIGUSR1, prev_usr1)
 
     @staticmethod
     def _free_port():

@@ -1,0 +1,185 @@
+"""Spot/preemption handling: monitor sidecar + in-task graceful handler.
+
+Preemptible (spot / queued-resource) capacity is the default economics of
+TPU fleets, so preemption is a first-class event here, not an afterthought:
+
+  - `PreemptionMonitor` (run as `python -m
+    metaflow_tpu.plugins.tpu.preemption`) polls the GCE metadata server's
+    preemption endpoint (the reference polls EC2 IMDS the same way,
+    metaflow/plugins/aws/batch/spot_monitor_sidecar.py:12-16) and, when the
+    VM is marked for preemption, SIGTERMs the task process — turning the
+    platform's ~30s warning into a catchable in-process event.
+
+  - `PreemptionHandler` (installed in the task process) converts SIGTERM
+    into a `TaskPreempted` exception raised in the main thread, giving the
+    step its normal failure path: the attempt is recorded as failed and
+    retryable, and a `@checkpoint`-enabled step resumes from its last saved
+    state on the next attempt. User code can defer the raise across
+    critical sections with `current.preemption.shield()` (e.g. while orbax
+    writes a checkpoint) or poll `current.preemption.requested` in a
+    training loop to checkpoint-then-exit at a step boundary.
+
+Gang semantics: any preempted rank fails its process; the control task's
+reaper tears down the remaining ranks (parallel_decorator teardown), the
+attempt fails, and the scheduler's retry re-forks the WHOLE gang — which
+re-rendezvouses jax.distributed and resumes from the shared checkpoint root
+(checkpoint scope excludes the gang frame precisely so all ranks of every
+attempt share one root).
+"""
+
+import contextlib
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+from ...exception import TaskPreempted
+
+# GCE metadata: TRUE once the VM is scheduled for preemption
+DEFAULT_METADATA_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/preempted"
+)
+POLL_SECS = 5.0
+
+
+def _notice_marker(pid):
+    return os.path.join(tempfile.gettempdir(), "tpuflow-preempted-%d" % pid)
+
+
+def notify_preemption(pid):
+    """Deliver a preemption notice to a task process: drop the marker file
+    (distinguishes a real spot reclaim from a routine teardown SIGTERM, e.g.
+    the gang control terminating workers after a rank-0 failure), then
+    SIGTERM it."""
+    with open(_notice_marker(pid), "w") as f:
+        f.write(str(time.time()))
+    os.kill(pid, signal.SIGTERM)
+
+
+class PreemptionHandler(object):
+    """In-task SIGTERM → TaskPreempted bridge. Exposed as
+    `current.preemption`."""
+
+    def __init__(self):
+        self.requested = threading.Event()
+        # True when the SIGTERM was a real spot notice (monitor marker
+        # present) rather than a teardown kill
+        self.spot_notice = False
+        self._shield_depth = 0
+        self._pending_exc = None
+        self._prev_handler = None
+        self._installed = False
+
+    def install(self):
+        if self._installed or threading.current_thread() is not threading.main_thread():
+            return self
+        self._prev_handler = signal.signal(signal.SIGTERM, self._on_sigterm)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if self._installed:
+            signal.signal(signal.SIGTERM, self._prev_handler or signal.SIG_DFL)
+            self._installed = False
+
+    def _on_sigterm(self, signum, frame):
+        self.requested.set()
+        marker = _notice_marker(os.getpid())
+        if os.path.exists(marker):
+            self.spot_notice = True
+            try:
+                os.unlink(marker)
+            except OSError:
+                pass
+        self.deliver(TaskPreempted(
+            "Preemption notice received (SIGTERM): failing the attempt so "
+            "retry can resume from the last checkpoint."
+        ))
+
+    def deliver(self, exc):
+        """Raise `exc` in the main thread now, or defer it past any active
+        shield()ed critical section. Other async failure sources (e.g. the
+        gang control's worker watcher) route through this too, so a shield
+        around a checkpoint save protects against EVERY mid-save raise, not
+        just SIGTERM."""
+        if self._shield_depth > 0:
+            self._pending_exc = exc
+            return
+        raise exc
+
+    @contextlib.contextmanager
+    def shield(self):
+        """Defer the TaskPreempted raise across a critical section (e.g. a
+        checkpoint save); re-raised on exit if a notice arrived meanwhile."""
+        self._shield_depth += 1
+        try:
+            yield self
+        finally:
+            self._shield_depth -= 1
+            if self._shield_depth == 0 and self._pending_exc is not None:
+                exc = self._pending_exc
+                self._pending_exc = None
+                if sys.exc_info()[0] is None:
+                    raise exc
+                # the body is already unwinding with its own exception —
+                # don't replace the real error with a clean-looking
+                # preemption (requested stays set for callers to inspect)
+
+
+class PreemptionMonitor(object):
+    """Sidecar body: poll the metadata endpoint, signal the task on TRUE."""
+
+    def __init__(self, task_pid, metadata_url=None, poll_secs=POLL_SECS):
+        self.task_pid = task_pid
+        self.metadata_url = metadata_url or os.environ.get(
+            "TPUFLOW_SPOT_METADATA_URL", DEFAULT_METADATA_URL
+        )
+        self.poll_secs = poll_secs
+
+    def preempted(self):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.metadata_url, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                return resp.read().decode("utf-8", "replace").strip().upper() == "TRUE"
+        except Exception:
+            return False  # metadata server unreachable ≠ preempted
+
+    def run(self):
+        while True:
+            if self.preempted():
+                try:
+                    notify_preemption(self.task_pid)
+                except ProcessLookupError:
+                    return 0
+                return 0  # one notice is enough; the handler does the rest
+            # exit when the task is gone (don't outlive it)
+            try:
+                os.kill(self.task_pid, 0)
+            except ProcessLookupError:
+                return 0
+            time.sleep(self.poll_secs)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="preemption-monitor")
+    parser.add_argument("--task-pid", type=int, default=os.getppid())
+    parser.add_argument("--metadata-url", default=None)
+    parser.add_argument("--poll-secs", type=float, default=POLL_SECS)
+    args = parser.parse_args()
+    raise SystemExit(
+        PreemptionMonitor(
+            args.task_pid, args.metadata_url, args.poll_secs
+        ).run()
+    )
+
+
+if __name__ == "__main__":
+    main()

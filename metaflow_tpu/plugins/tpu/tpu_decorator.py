@@ -51,6 +51,9 @@ class TpuDecorator(StepDecorator):
         "topology": None,
         "mesh": None,
         "require_tpu": False,
+        # spot/preemptible capacity: start the preemption-monitor sidecar
+        # (GCE metadata poll → SIGTERM → checkpoint-resume on retry)
+        "spot": False,
     }
 
     def step_init(self, flow, graph, step_name, decorators, environment,
@@ -118,3 +121,27 @@ class TpuDecorator(StepDecorator):
                 )
             }
         )
+        self._spot_monitor = None
+        if self.attributes["spot"] or os.environ.get(
+            "TPUFLOW_SPOT_METADATA_URL"
+        ):
+            import subprocess
+            import sys
+
+            args = [sys.executable, "-m",
+                    "metaflow_tpu.plugins.tpu.preemption",
+                    "--task-pid", str(os.getpid())]
+            url = os.environ.get("TPUFLOW_SPOT_METADATA_URL")
+            if url:
+                args += ["--metadata-url", url]
+            self._spot_monitor = subprocess.Popen(args)
+
+    def task_finished(self, step_name, flow, graph, is_task_ok, retry_count,
+                      max_user_code_retries):
+        monitor = getattr(self, "_spot_monitor", None)
+        if monitor is not None and monitor.poll() is None:
+            monitor.terminate()
+            try:
+                monitor.wait(timeout=5)
+            except Exception:
+                monitor.kill()

@@ -33,6 +33,7 @@ class TpuParallelDecorator(ParallelDecorator):
             # on a real TPU pod slice jax discovers the coordinator and
             # world from the TPU metadata — no explicit rendezvous needed
             jax.distributed.initialize()
+            self._reinstall_preemption_handler()
             return
         coordinator = "%s:%d" % (p.main_ip, p.coordinator_port)
         jax.distributed.initialize(
@@ -40,6 +41,19 @@ class TpuParallelDecorator(ParallelDecorator):
             num_processes=p.num_nodes,
             process_id=p.node_index,
         )
+        self._reinstall_preemption_handler()
+
+    @staticmethod
+    def _reinstall_preemption_handler():
+        """jax.distributed.initialize registers XLA's own C++ SIGTERM
+        notifier, silently replacing the task's PreemptionHandler — put
+        ours back so a spot reclaim still raises TaskPreempted."""
+        from ...current import current
+
+        handler = getattr(current, "preemption", None)
+        if handler is not None:
+            handler._installed = False
+            handler.install()
 
     def teardown_distributed_env(self, flow):
         from ...current import current

@@ -14,7 +14,7 @@ import traceback
 
 from .current import current
 from .datastore.task_datastore import TaskDataStore
-from .exception import TpuFlowException, MetaflowInternalError
+from .exception import TaskPreempted, TpuFlowException, MetaflowInternalError
 from .metadata.metadata import MetaDatum
 from .unbounded_foreach import UBF_CONTROL, UBF_TASK
 from .util import get_username
@@ -262,6 +262,15 @@ class MetaflowTask(object):
         if is_join:
             inputs_obj = Inputs([InputDataStore(ds) for ds in input_stores])
 
+        # preemption is the TPU-fleet norm: every task converts SIGTERM
+        # (spot reclaim notice, delivered directly or via the monitor
+        # sidecar) into a retryable TaskPreempted failure; user code can
+        # shield critical sections via current.preemption
+        from .plugins.tpu.preemption import PreemptionHandler
+
+        preemption = PreemptionHandler().install()
+        current._update_env({"preemption": preemption})
+
         exception = None
         suppressed = False
         try:
@@ -307,6 +316,17 @@ class MetaflowTask(object):
             exception = ex
             tb = traceback.format_exc()
             self.console_logger(tb)
+            if isinstance(ex, TaskPreempted) and preemption.spot_notice:
+                # record the preemption as queryable task metadata (the
+                # reference's spot sidecar writes the same kind of marker).
+                # Only for a REAL spot notice (monitor marker): a routine
+                # teardown SIGTERM (gang control killing workers after a
+                # rank failure) must not masquerade as capacity reclaim.
+                self.metadata.register_metadata(
+                    run_id, step_name, task_id,
+                    [MetaDatum("preempted", "true", "preemption",
+                               ["attempt_id:%d" % retry_count])],
+                )
             for deco in decorators:
                 if deco.task_exception(
                     ex, step_name, flow, graph, retry_count, max_user_code_retries
@@ -315,6 +335,7 @@ class MetaflowTask(object):
             flow._task_ok = suppressed
             flow._exception_str = "%s: %s" % (type(ex).__name__, ex)
         finally:
+            preemption.uninstall()
             if node.type != "end" and flow._transition is None and (
                 exception is None or suppressed
             ):

@@ -166,9 +166,15 @@ class ArgoSimulator(object):
         script = self._subst(cmd[2], [pod_scope, dag_scope])
         script = script.replace(ARGO_OUTPUT_DIR, self.output_dir)
 
+        pod_env = dict(self.env)
+        for entry in template["container"].get("env", []):
+            pod_env[entry["name"]] = self._subst(
+                entry["value"], [pod_scope, dag_scope]
+            )
+
         shutil.rmtree(self.output_dir, ignore_errors=True)
         proc = subprocess.run(
-            ["bash", "-c", script], env=self.env, cwd=self.cwd,
+            ["bash", "-c", script], env=pod_env, cwd=self.cwd,
             capture_output=True, text=True, timeout=300,
         )
         if proc.returncode != 0:

@@ -1,0 +1,79 @@
+"""Gang preemption semantics: one rank of a gang receives SIGTERM mid-step
+(a spot reclaim notice), the attempt fails retryably, the control retry
+tears down and re-forks the WHOLE gang, and every rank resumes from the
+shared checkpoint (VERDICT round-1 item #3's 'done' criterion)."""
+
+import os
+import signal
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.decorators import make_step_decorator
+from metaflow_tpu.plugins import STEP_DECORATORS
+
+# plain-gang variant: no jax.distributed (collectives are covered by
+# test_gang_jax_distributed_training); this test is about preemption
+tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
+
+
+class PreemptGangFlow(FlowSpec):
+    @step
+    def start(self):
+        self.total_steps = 6
+        self.next(self.train, num_parallel=3)
+
+    @tpu_parallel(jax_distributed=False)
+    @metaflow_tpu.retry(times=2, minutes_between_retries=0)
+    @metaflow_tpu.checkpoint
+    @step
+    def train(self):
+        rank = current.parallel.node_index
+        ckpt = current.checkpoint
+
+        start_step = 0
+        restored = ckpt.load()
+        if restored is not None:
+            start_step = int(restored["step"]) + 1
+        self.resumed_from = start_step
+        self.rank = rank
+
+        value = float(restored["value"]) if restored is not None else 0.0
+        for i in range(start_step, self.total_steps):
+            value += 1.0
+            # rank 0 owns the (shared-scope) checkpoint in this local gang
+            if rank == 0:
+                with current.preemption.shield():
+                    ckpt.save({"value": value, "step": i}, step=i)
+            if (
+                i == 2
+                and rank == 1
+                and current.retry_count == 0
+            ):
+                # spot reclaim notice hits THIS rank only (marker + SIGTERM,
+                # exactly what the monitor sidecar delivers)
+                from metaflow_tpu.plugins.tpu.preemption import (
+                    notify_preemption,
+                )
+
+                notify_preemption(os.getpid())
+        self.value = value
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.values = sorted(inp.value for inp in inputs)
+        self.resumed = sorted(inp.resumed_from for inp in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        # every rank reached the target; the retried gang resumed from the
+        # shared checkpoint (resumed_from > 0 on attempt 1 — never a cold
+        # restart from zero)
+        assert self.values == [6.0, 6.0, 6.0], self.values
+        assert all(r > 0 for r in self.resumed), self.resumed
+        print("gang preemption resume ok:", self.resumed)
+
+
+if __name__ == "__main__":
+    PreemptGangFlow()

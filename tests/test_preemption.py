@@ -1,0 +1,149 @@
+"""Spot/preemption handling (VERDICT round-1 item #3).
+
+Three layers, mirroring the reference's spot-monitor coverage philosophy
+(metaflow/plugins/aws/batch/spot_monitor_sidecar.py polls IMDS; here the
+GCE metadata endpoint is faked with a local HTTP server):
+
+  1. PreemptionHandler unit semantics (SIGTERM → TaskPreempted, shield()).
+  2. PreemptionMonitor sidecar against a fake metadata server.
+  3. Gang e2e: SIGTERM one rank mid-step → whole-gang teardown → retry
+     resumes from the shared checkpoint.
+"""
+
+import http.server
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from metaflow_tpu.exception import TaskPreempted
+from metaflow_tpu.plugins.tpu.preemption import (
+    PreemptionHandler,
+    PreemptionMonitor,
+)
+
+FLOWS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "flows")
+
+
+class TestPreemptionHandler:
+    def test_sigterm_raises_task_preempted(self):
+        handler = PreemptionHandler().install()
+        try:
+            with pytest.raises(TaskPreempted):
+                os.kill(os.getpid(), signal.SIGTERM)
+                # the handler raises on return from the syscall; this line
+                # only runs if the signal was somehow not delivered
+                time.sleep(1)
+        finally:
+            handler.uninstall()
+        assert handler.requested.is_set()
+
+    def test_shield_defers_the_raise(self):
+        handler = PreemptionHandler().install()
+        try:
+            entered = False
+            with pytest.raises(TaskPreempted):
+                with handler.shield():
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    time.sleep(0.05)
+                    entered = True  # no raise inside the shield
+            assert entered
+            assert handler.requested.is_set()
+        finally:
+            handler.uninstall()
+
+    def test_nested_shields(self):
+        handler = PreemptionHandler().install()
+        try:
+            with pytest.raises(TaskPreempted):
+                with handler.shield():
+                    with handler.shield():
+                        os.kill(os.getpid(), signal.SIGTERM)
+                        time.sleep(0.05)
+                    time.sleep(0.05)  # still shielded by the outer level
+        finally:
+            handler.uninstall()
+
+
+class _FakeMetadata(http.server.BaseHTTPRequestHandler):
+    preempted = "FALSE"
+
+    def do_GET(self):
+        body = self.preempted.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def fake_metadata_server():
+    _FakeMetadata.preempted = "FALSE"
+    server = http.server.HTTPServer(("127.0.0.1", 0), _FakeMetadata)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield "http://127.0.0.1:%d/preempted" % server.server_port
+    server.shutdown()
+
+
+class TestPreemptionMonitor:
+    def test_signals_task_on_preemption_notice(self, fake_metadata_server):
+        sleeper = subprocess.Popen([sys.executable, "-c",
+                                    "import time; time.sleep(60)"])
+        try:
+            _FakeMetadata.preempted = "TRUE"
+            monitor = PreemptionMonitor(
+                sleeper.pid, fake_metadata_server, poll_secs=0.05
+            )
+            assert monitor.run() == 0
+            assert sleeper.wait(timeout=10) == -signal.SIGTERM
+        finally:
+            if sleeper.poll() is None:
+                sleeper.kill()
+
+    def test_exits_when_task_gone(self, fake_metadata_server):
+        sleeper = subprocess.Popen([sys.executable, "-c", "pass"])
+        sleeper.wait()
+        monitor = PreemptionMonitor(
+            sleeper.pid, fake_metadata_server, poll_secs=0.05
+        )
+        assert monitor.run() == 0  # returns instead of polling forever
+
+    def test_unreachable_metadata_is_not_preemption(self):
+        monitor = PreemptionMonitor(
+            os.getpid(), "http://127.0.0.1:1/nope", poll_secs=0.05
+        )
+        assert monitor.preempted() is False
+
+
+class TestGangPreemptionE2E:
+    def test_rank_sigterm_then_checkpoint_resume(self, run_flow, tpuflow_root):
+        # one rank of a 3-rank gang receives SIGTERM mid-step (attempt 0);
+        # the gang fails as a unit, @retry re-forks it, @checkpoint resumes
+        proc = run_flow(os.path.join(FLOWS, "preempt_gang_flow.py"), "run")
+        out = proc.stdout + proc.stderr
+        assert "gang preemption resume ok" in out, out
+
+        # the preempted worker recorded its marker in task metadata
+        import glob
+        import json as _json
+
+        hits = []
+        for path in glob.glob(
+            os.path.join(tpuflow_root, "PreemptGangFlow", "**", "*.json"),
+            recursive=True,
+        ):
+            try:
+                with open(path) as f:
+                    if "preempted" in f.read():
+                        hits.append(path)
+            except OSError:
+                pass
+        assert hits, "no preemption metadata recorded"
