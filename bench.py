@@ -134,6 +134,76 @@ def bench_step_launch():
     }
 
 
+def bench_data_path():
+    """gsop engine throughput vs a loopback fake GCS server: measures the
+    client machinery's ceiling (HTTP framing, threading, pwrite fan-in) —
+    the real-NIC number is this capped by wire bandwidth. The reference
+    ships the harness without stored numbers (BASELINE.md); we store ours."""
+    import contextlib
+    import subprocess
+    import tempfile
+
+    from metaflow_tpu.gsop import GSClient
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    # the fake server gets its OWN process (and GIL): in-process it halves
+    # apparent client throughput by contending with the client threads
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(here, "tests", "fake_gcs.py")],
+        stdout=subprocess.PIPE, text=True,
+    )
+    endpoint = server.stdout.readline().strip()
+    if not endpoint.startswith("http://127.0.0.1:"):
+        server.terminate()
+        raise SystemExit(
+            "fake GCS server failed to start (got %r) — refusing to fall "
+            "back to the real GCS endpoint" % endpoint
+        )
+
+    n_objects, obj_mb = 8, 32
+    blob = os.urandom(obj_mb << 20)
+    # tmpfs destinations: measure the engine, not this box's disk (the
+    # on-disk number is disk-bound at ~180 MB/s here)
+    tmp_root = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    with contextlib.ExitStack() as stack:
+        stack.callback(server.terminate)
+        tmp = stack.enter_context(tempfile.TemporaryDirectory(dir=tmp_root))
+        client = GSClient(endpoint=endpoint)
+
+        srcs = []
+        for i in range(n_objects):
+            path = os.path.join(tmp, "src-%d" % i)
+            with open(path, "wb") as f:
+                f.write(blob)
+            srcs.append(("obj-%d" % i, path))
+        t0 = time.perf_counter()
+        client.put_many("bench", srcs)
+        put_dt = time.perf_counter() - t0
+
+        pairs = [("obj-%d" % i, os.path.join(tmp, "dst-%d" % i))
+                 for i in range(n_objects)]
+        total_mb = n_objects * obj_mb
+        client.get_many("bench", pairs)  # warmup: allocator + page cache
+        rates = []
+        for _ in range(3):  # median: single-GIL fake server is noisy
+            t0 = time.perf_counter()
+            client.get_many("bench", pairs)
+            rates.append(total_mb / (time.perf_counter() - t0))
+        get_mbps = statistics.median(rates)
+        return {
+            "metric": "gsop_get_many_throughput",
+            "value": round(get_mbps, 1),
+            "unit": "MB/s",
+            "vs_baseline": _vs_baseline(get_mbps),
+            "extra": {
+                "put_mb_per_s": round(total_mb / put_dt, 1),
+                "objects": n_objects,
+                "object_mb": obj_mb,
+                "transport": "loopback_fake_gcs",
+            },
+        }
+
+
 def _vs_baseline(value):
     base = os.environ.get("BENCH_BASELINE")
     if base:
@@ -227,6 +297,8 @@ if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "launch":
         result = bench_step_launch()
+    elif mode == "data":
+        result = bench_data_path()
     else:
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             backend = _wait_for_tpu()

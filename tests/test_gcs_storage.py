@@ -1,109 +1,136 @@
-"""GCSStorage logic against an in-memory fake bucket (no cloud access:
-exercises key mapping, thread-pooled batching, CloseAfterUse cleanup)."""
+"""GCSStorage against the fake GCS HTTP server (tests/fake_gcs.py): the
+full gs:// datastore backend — key mapping, batched save/load, overwrite
+semantics, CloseAfterUse cleanup — exercised over real HTTP round-trips
+through the gsop engine (no cloud access; the reference's MinIO pattern)."""
 
 import io
 import os
+import sys
 
 import pytest
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fake_gcs import FakeGCSServer
 from metaflow_tpu.datastore.storage import GCSStorage
-
-
-class FakeBlob:
-    def __init__(self, bucket, name):
-        self._bucket = bucket
-        self.name = name
-
-    def exists(self):
-        return self.name in self._bucket.objects
-
-    def upload_from_string(self, data):
-        self._bucket.objects[self.name] = data
-
-    def upload_from_file(self, fileobj):
-        self._bucket.objects[self.name] = fileobj.read()
-
-    def download_to_filename(self, path):
-        if self.name not in self._bucket.objects:
-            raise KeyError(self.name)
-        with open(path, "wb") as f:
-            f.write(self._bucket.objects[self.name])
-
-    def delete(self):
-        self._bucket.objects.pop(self.name, None)
-
-
-class FakeBucket:
-    def __init__(self):
-        self.objects = {}
-
-    def blob(self, name):
-        return FakeBlob(self, name)
-
-    def get_blob(self, name):
-        if name in self.objects:
-            blob = FakeBlob(self, name)
-            blob.size = len(self.objects[name])
-            blob.metadata = None
-            return blob
-        return None
+from metaflow_tpu.gsop import GSClient
 
 
 @pytest.fixture()
-def gcs(monkeypatch):
-    storage = GCSStorage("gs://test-bucket/prefix")
-    fake = FakeBucket()
-    # monkeypatch auto-restores the real lazy-client property afterwards
-    monkeypatch.setattr(GCSStorage, "bucket", property(lambda self: fake))
-    yield storage, fake
+def server():
+    with FakeGCSServer() as srv:
+        yield srv
 
 
-def test_key_prefixing(gcs):
-    storage, fake = gcs
-    storage.save_bytes([("a/b.bin", b"data")], overwrite=True)
-    assert "prefix/a/b.bin" in fake.objects
+@pytest.fixture()
+def gcs(server, monkeypatch):
+    monkeypatch.setenv("TPUFLOW_GS_ENDPOINT", server.endpoint)
+    return GCSStorage("gs://test-bucket/data/prefix")
+
+
+def _raw(server):
+    return server.state.bucket("test-bucket")
+
+
+def test_key_prefixing(gcs, server):
+    gcs.save_bytes([("a/b.txt", b"hi")], overwrite=True)
+    assert "data/prefix/a/b.txt" in _raw(server)
 
 
 def test_save_load_roundtrip(gcs):
-    storage, fake = gcs
-    items = [("k%d" % i, b"v%d" % i) for i in range(10)]
-    storage.save_bytes(iter(items), overwrite=True)
-    locals_seen = []
-    with storage.load_bytes([k for k, _ in items]) as loaded:
-        out = {}
-        for key, local, _meta in loaded:
-            locals_seen.append(local)
+    gcs.save_bytes(
+        [("x/1.bin", b"one"), ("x/2.bin", (io.BytesIO(b"two"), None))],
+        overwrite=True,
+    )
+    with gcs.load_bytes(["x/1.bin", "x/2.bin"]) as loaded:
+        got = {}
+        for path, local, _meta in loaded:
             with open(local, "rb") as f:
-                out[key] = f.read()
-    assert out == dict(items)
-    # CloseAfterUse removed the temp files on exit
-    assert all(not os.path.exists(p) for p in locals_seen)
+                got[path] = f.read()
+    assert got == {"x/1.bin": b"one", "x/2.bin": b"two"}
+
+
+def test_load_cleanup_removes_tmpdir(gcs):
+    gcs.save_bytes([("k", b"v")], overwrite=True)
+    cm = gcs.load_bytes(["k"])
+    with cm as loaded:
+        locals_ = [local for _p, local, _m in loaded]
+    assert all(not os.path.exists(p) for p in locals_)
 
 
 def test_no_overwrite_skips_existing(gcs):
-    storage, fake = gcs
-    storage.save_bytes([("k", b"old")], overwrite=True)
-    storage.save_bytes([("k", b"new")], overwrite=False)
-    assert fake.objects["prefix/k"] == b"old"
-    storage.save_bytes([("k", b"new")], overwrite=True)
-    assert fake.objects["prefix/k"] == b"new"
+    gcs.save_bytes([("k", b"first")], overwrite=True)
+    gcs.save_bytes([("k", b"second")], overwrite=False)
+    with gcs.load_bytes(["k"]) as loaded:
+        for _p, local, _m in loaded:
+            with open(local, "rb") as f:
+                assert f.read() == b"first"
 
 
 def test_missing_paths_yield_none(gcs):
-    storage, fake = gcs
-    with storage.load_bytes(["nope"]) as loaded:
-        rows = list(loaded)
-    assert rows == [("nope", None, None)]
+    gcs.save_bytes([("real", b"x")], overwrite=True)
+    with gcs.load_bytes(["real", "ghost"]) as loaded:
+        results = {p: local for p, local, _m in loaded}
+    assert results["real"] is not None
+    assert results["ghost"] is None
+
+
+def test_collision_prone_names_stay_distinct(gcs):
+    # 'a/b_c' and 'a_b/c' collided under the old '/'->'_' local naming
+    gcs.save_bytes([("a/b_c", b"AAA"), ("a_b/c", b"BBB")], overwrite=True)
+    with gcs.load_bytes(["a/b_c", "a_b/c"]) as loaded:
+        got = {}
+        for path, local, _m in loaded:
+            with open(local, "rb") as f:
+                got[path] = f.read()
+    assert got == {"a/b_c": b"AAA", "a_b/c": b"BBB"}
 
 
 def test_is_file_and_size(gcs):
-    storage, fake = gcs
-    storage.save_bytes([("x", b"12345")], overwrite=True)
-    assert storage.is_file(["x", "y"]) == [True, False]
-    assert storage.size_file("x") == 5
+    gcs.save_bytes([("f1", b"12345")], overwrite=True)
+    assert gcs.is_file(["f1", "f2"]) == [True, False]
+    assert gcs.size_file("f1") == 5
+    assert gcs.size_file("f2") is None
 
 
-def test_file_like_payload(gcs):
-    storage, fake = gcs
-    storage.save_bytes([("f", io.BytesIO(b"stream"))], overwrite=True)
-    assert fake.objects["prefix/f"] == b"stream"
+def test_info_file(gcs):
+    gcs.save_bytes([("f1", b"12345")], overwrite=True)
+    exists, meta = gcs.info_file("f1")
+    assert exists and isinstance(meta, dict)
+    exists, meta = gcs.info_file("missing")
+    assert not exists and meta is None
+
+
+def test_list_content_one_level(gcs):
+    gcs.save_bytes(
+        [("d/a", b"1"), ("d/b", b"2"), ("d/sub/c", b"3"), ("other/e", b"4")],
+        overwrite=True,
+    )
+    entries = gcs.list_content(["d"])
+    assert ("d/a", True) in entries
+    assert ("d/b", True) in entries
+    assert ("d/sub", False) in entries
+    assert all(not name.startswith("other") for name, _ in entries)
+
+
+def test_delete(gcs):
+    gcs.save_bytes([("k1", b"1"), ("k2", b"2")], overwrite=True)
+    gcs.delete(["k1", "missing"])
+    assert gcs.is_file(["k1", "k2"]) == [False, True]
+
+
+def test_large_blob_ranged_roundtrip(server, monkeypatch):
+    """A multi-part-sized artifact goes through the ranged GET / composed
+    PUT paths inside the datastore backend."""
+    monkeypatch.setenv("TPUFLOW_GS_ENDPOINT", server.endpoint)
+    storage = GCSStorage("gs://test-bucket/big")
+    storage._gsclient = GSClient(
+        endpoint=server.endpoint, part_size=64 * 1024,
+        ranged_threshold=128 * 1024,
+    )
+    blob = os.urandom(400 * 1024)
+    storage.save_bytes([("model.ckpt", blob)], overwrite=True)
+    with storage.load_bytes(["model.ckpt"]) as loaded:
+        for _p, local, _m in loaded:
+            with open(local, "rb") as f:
+                assert f.read() == blob
