@@ -33,15 +33,24 @@ class DeployedFlow(object):
 
 
 class ArgoWorkflowsDeployer(object):
-    def __init__(self, deployer, image=None, k8s_namespace="default"):
+    def __init__(self, deployer, image=None, k8s_namespace="default",
+                 datastore=None, datastore_root=None):
         self._deployer = deployer
         self._image = image
         self._namespace = k8s_namespace
+        self._datastore = datastore
+        self._datastore_root = datastore_root
 
     def create(self, do_package=False):
+        top = []
+        if self._datastore:
+            top += ["--datastore", self._datastore]
+        if self._datastore_root:
+            top += ["--datastore-root", self._datastore_root]
         args = [
             sys.executable,
             self._deployer.flow_file,
+        ] + top + [
             "argo-workflows",
             "create",
             "--only-json",
@@ -76,6 +85,9 @@ class Deployer(object):
         merged.update({k: str(v) for k, v in self.env.items()})
         return merged
 
-    def argo_workflows(self, image=None, k8s_namespace="default"):
+    def argo_workflows(self, image=None, k8s_namespace="default",
+                       datastore=None, datastore_root=None):
         return ArgoWorkflowsDeployer(self, image=image,
-                                     k8s_namespace=k8s_namespace)
+                                     k8s_namespace=k8s_namespace,
+                                     datastore=datastore,
+                                     datastore_root=datastore_root)

@@ -369,9 +369,11 @@ class NativeRuntime(object):
         return str(self._task_index)
 
     def _queue_task(self, task):
-        self._metadata.register_task_id(
-            self.run_id, task.step, task.task_id, 0
-        )
+        # task-id registration happens at LAUNCH (not queue) time: a queued
+        # task may still be satisfied by a resume clone under a different
+        # (origin) task id, and registering the provisional id first would
+        # leave a ghost task in metadata/the datastore tree that client
+        # listings then trip over
         # determine retry budget from decorators
         user_retries, error_retries = 0, 0
         step_func = getattr(self._flow, task.step)
@@ -551,6 +553,9 @@ class NativeRuntime(object):
     # ------------------------------------------------------------------
 
     def _launch_worker(self, task, sel):
+        self._metadata.register_task_id(
+            self.run_id, task.step, task.task_id, 0
+        )
         if self._can_fork(task):
             proc = self._fork_worker(task)
         else:

@@ -59,15 +59,72 @@ GRAPHS = {
     ],
 }
 
-# execution contexts: CLI/env variations every graph must survive
+# execution contexts: CLI/env/provider variations every graph must survive.
+# kind 'plain' needs no services; 'gs' runs against a fake GCS server (the
+# whole artifact path rides HTTP); 'service' points metadata at the REST
+# reference service (reference: test/core/contexts.json varies datastore and
+# metadata providers the same way)
 CONTEXTS = {
-    "default": {"args": [], "env": {}},
-    "exec_workers": {"args": [], "env": {"TPUFLOW_FORK_WORKERS": "0"}},
+    "default": {"kind": "plain", "args": [], "env": {}},
+    "exec_workers": {"kind": "plain", "args": [],
+                     "env": {"TPUFLOW_FORK_WORKERS": "0"}},
     "with_retry": {
+        "kind": "plain",
         "args": ["--with", "retry:times=1,minutes_between_retries=0"],
         "env": {},
     },
+    "gs_storage": {"kind": "gs", "args": [], "env": {}},
+    "service_metadata": {"kind": "service", "args": [], "env": {}},
 }
+
+
+class ActiveContext(object):
+    """Starts whatever servers a context needs; yields run args/env and the
+    matching client-side env so the checker reads through the same
+    providers the flow wrote through."""
+
+    def __init__(self, name, tpuflow_root):
+        self.name = name
+        self.spec = CONTEXTS[name]
+        self.root = tpuflow_root
+        self.args = list(self.spec["args"])
+        self.env = dict(self.spec["env"])
+        self.client_env = {}
+        self._cleanups = []
+
+    def __enter__(self):
+        kind = self.spec["kind"]
+        if kind == "gs":
+            from fake_gcs import FakeGCSServer
+
+            srv = FakeGCSServer().__enter__()
+            self._cleanups.append(lambda: srv.__exit__(None, None, None))
+            self.args += ["--datastore", "gs",
+                          "--datastore-root", "gs://harness-bucket/root"]
+            self.env["TPUFLOW_GS_ENDPOINT"] = srv.endpoint
+            self.client_env = {
+                "TPUFLOW_GS_ENDPOINT": srv.endpoint,
+                "TPUFLOW_DEFAULT_DATASTORE": "gs",
+                "TPUFLOW_DATASTORE_SYSROOT_GS": "gs://harness-bucket/root",
+            }
+        elif kind == "service":
+            from metaflow_tpu.metadata import MetadataService
+
+            svc = MetadataService(self.root)
+            svc.start()
+            self._cleanups.append(svc.stop)
+            self.args += ["--metadata", "service"]
+            self.env["TPUFLOW_SERVICE_URL"] = svc.url
+            self.client_env = {
+                "TPUFLOW_SERVICE_URL": svc.url,
+                "TPUFLOW_DEFAULT_METADATA": "service",
+            }
+        return self
+
+    def __exit__(self, *exc):
+        for fn in reversed(self._cleanups):
+            fn()
+        return False
 
 
 def expected_task_counts(graph):
@@ -153,11 +210,18 @@ def _innermost_split(graph, join_name):
     return result.get(join_name)
 
 
-def generate_flow(graph, flow_name):
+def generate_flow(graph, flow_name, fail_step=None):
     """Emit a runnable flow file for a graph template. Each task appends its
-    step name to a 'trace' artifact; joins merge traces."""
+    step name to a 'trace' artifact; joins merge traces.
+
+    fail_step: that step raises while env FAIL_ONCE=1 (resume tests). In a
+    gang step only rank 1 fails — so the first run leaves the gang
+    partially done (other ranks wrote their datastores) and `resume` must
+    re-run it as a unit."""
     lines = [
-        "from metaflow_tpu import FlowSpec, step",
+        "import os",
+        "",
+        "from metaflow_tpu import FlowSpec, current, step",
         "",
         "",
         "class %s(FlowSpec):" % flow_name,
@@ -167,6 +231,18 @@ def generate_flow(graph, flow_name):
         args = "(self, inputs)" if spec.get("join") else "(self)"
         lines.append("    @step")
         lines.append("    def %s%s:" % (name, args))
+        if name == fail_step:
+            in_gang = any(
+                name in s.get("next", []) and s.get("num_parallel")
+                for s in graph
+            )
+            cond = "os.environ.get('FAIL_ONCE') == '1'"
+            if in_gang:
+                cond += " and current.parallel.node_index == 1"
+            lines.append("        if %s:" % cond)
+            lines.append(
+                "            raise Exception('induced failure in %s')" % name
+            )
         if spec.get("join"):
             lines.append(
                 "        self.trace = sorted(set(sum((i.trace for i in "

@@ -141,7 +141,11 @@ class TestArgoCompile:
     def test_manifest_structure(self, run_flow, flows_dir, tpuflow_root):
         proc = run_flow(
             os.path.join(flows_dir, "tpu_deploy_flow.py"),
+            "--datastore", "gs",
             "argo-workflows", "create",
+            env_extra={
+                "TPUFLOW_DATASTORE_SYSROOT_GS": "gs://deploy-bucket/root"
+            },
         )
         docs = proc.stdout
         assert "kind: WorkflowTemplate" in docs
@@ -170,7 +174,9 @@ class TestDeployerAPI:
                 ),
             },
         )
-        deployed = dep.argo_workflows().create()
+        deployed = dep.argo_workflows(
+            datastore="local", datastore_root=tpuflow_root
+        ).create()
         assert "WorkflowTemplate" in deployed.manifests
         assert deployed.name
 

@@ -1,53 +1,129 @@
-"""Generative graphs × contexts matrix (reference: test/core pattern)."""
+"""Generative graphs × contexts matrix (reference: test/core pattern),
+including storage (gs over a fake server) and metadata (REST service)
+provider contexts, plus generative resume_* tests."""
 
+import contextlib
 import itertools
 import os
 
 import pytest
 
-from harness import CONTEXTS, GRAPHS, expected_task_counts, generate_flow
+from harness import (
+    ActiveContext,
+    CONTEXTS,
+    GRAPHS,
+    expected_task_counts,
+    generate_flow,
+)
 
 # full matrix is graphs × contexts; keep the cross product lean by running
-# every graph in the default context and every context on two probe graphs
+# every graph in the default context and every context on probe graphs
+# (foreach/branch for CLI variants; foreach/branch/gang for the provider
+# contexts, which exercise different persistence paths)
 MATRIX = [(g, "default") for g in GRAPHS] + [
     (g, c)
     for g, c in itertools.product(("foreach", "branch"), CONTEXTS)
-    if c != "default"
+    if c not in ("default", "gs_storage", "service_metadata")
+] + [
+    (g, c)
+    for g, c in itertools.product(
+        ("foreach", "branch", "gang"), ("gs_storage", "service_metadata")
+    )
 ]
+
+
+@contextlib.contextmanager
+def _client_env(extra):
+    saved = {k: os.environ.get(k) for k in extra}
+    os.environ.update(extra)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _check_run(flow_name, graph, tpuflow_root, client_env):
+    """Client-side checker: every step ran with the expected cardinality,
+    read back through the same providers the flow wrote through."""
+    os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+    with _client_env(client_env):
+        from metaflow_tpu import client
+
+        client.namespace(None)
+        run = client.Flow(flow_name).latest_run
+        assert run.successful
+        expected = expected_task_counts(graph)
+        for step_name, count in expected.items():
+            tasks = list(run[step_name].tasks())
+            assert len(tasks) == count, (
+                "%s/%s: expected %d tasks, found %d"
+                % (flow_name, step_name, count, len(tasks))
+            )
+        # the end task saw every step that executed (unchosen switch
+        # branches never run)
+        trace = run.data.trace
+        assert set(trace) == {n for n, c in expected.items() if c > 0}, trace
 
 
 @pytest.mark.parametrize("graph_name,context_name", MATRIX)
 def test_generated_flow(graph_name, context_name, run_flow, tpuflow_root,
                         tmp_path):
     graph = GRAPHS[graph_name]
-    context = CONTEXTS[context_name]
     flow_name = "Gen%s%sFlow" % (
-        graph_name.title().replace("_", ""), context_name.title().replace("_", ""),
+        graph_name.title().replace("_", ""),
+        context_name.title().replace("_", ""),
     )
     src = generate_flow(graph, flow_name)
     flow_file = str(tmp_path / ("%s.py" % flow_name))
     with open(flow_file, "w") as f:
         f.write(src)
 
-    proc = run_flow(flow_file, *(context["args"] + ["run"]),
-                    env_extra=context["env"])
+    with ActiveContext(context_name, tpuflow_root) as ctx:
+        proc = run_flow(flow_file, *(ctx.args + ["run"]), env_extra=ctx.env)
+        assert "TRACE:" in proc.stdout
+        _check_run(flow_name, graph, tpuflow_root, ctx.client_env)
+
+
+# resume: fail a mid-graph step on the first run, resume, verify the clone
+# + re-execution boundary (reference: test/core resume_* tests). The gang
+# case resumes INTO a partially-done gang: only rank 1 failed, other ranks'
+# task datastores are complete, and resume must re-run the gang as a unit.
+RESUME_CASES = [
+    ("linear", "b"),
+    ("foreach", "body"),
+    ("nested_foreach", "leaf"),
+    ("branch", "j"),
+    ("gang", "train"),
+]
+
+
+@pytest.mark.parametrize("graph_name,fail_step", RESUME_CASES)
+def test_generated_resume(graph_name, fail_step, run_flow, tpuflow_root,
+                          tmp_path):
+    graph = GRAPHS[graph_name]
+    flow_name = "Res%s%sFlow" % (
+        graph_name.title().replace("_", ""), fail_step.title()
+    )
+    src = generate_flow(graph, flow_name, fail_step=fail_step)
+    flow_file = str(tmp_path / ("%s.py" % flow_name))
+    with open(flow_file, "w") as f:
+        f.write(src)
+
+    proc = run_flow(flow_file, "run", env_extra={"FAIL_ONCE": "1"},
+                    expect_fail=True)
+    assert "induced failure" in proc.stdout + proc.stderr
+
+    proc = run_flow(flow_file, "resume")
+    out = proc.stdout + proc.stderr
     assert "TRACE:" in proc.stdout
+    # a NONZERO clone count: steps before the failure must clone, not re-run
+    import re
 
-    # client-side checker: every step ran with the expected cardinality
-    os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
-    from metaflow_tpu import client
+    m = re.search(r"\((\d+) tasks? run, (\d+) cloned\)", out)
+    assert m and int(m.group(2)) > 0, out
 
-    client.namespace(None)
-    run = client.Flow(flow_name).latest_run
-    assert run.successful
-    expected = expected_task_counts(graph)
-    for step_name, count in expected.items():
-        tasks = list(run[step_name].tasks())
-        assert len(tasks) == count, (
-            "%s/%s: expected %d tasks, found %d"
-            % (flow_name, step_name, count, len(tasks))
-        )
-    # the end task saw every step that executed (unchosen switch branches
-    # never run)
-    trace = run.data.trace
-    assert set(trace) == {n for n, c in expected.items() if c > 0}, trace
+    _check_run(flow_name, graph, tpuflow_root, {})
