@@ -19,27 +19,10 @@ from .unbounded_foreach import UnboundedForeachInput
 from .decorators import make_step_decorator, make_flow_decorator
 from .plugins import STEP_DECORATORS, FLOW_DECORATORS
 
-# generate user-facing decorator callables from the registry
-retry = make_step_decorator(STEP_DECORATORS["retry"])
-catch = make_step_decorator(STEP_DECORATORS["catch"])
-timeout = make_step_decorator(STEP_DECORATORS["timeout"])
-environment = make_step_decorator(STEP_DECORATORS["environment"])
-resources = make_step_decorator(STEP_DECORATORS["resources"])
-parallel = make_step_decorator(STEP_DECORATORS["parallel"])
-tpu = make_step_decorator(STEP_DECORATORS["tpu"])
-tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
-checkpoint = make_step_decorator(STEP_DECORATORS["checkpoint"])
-secrets = make_step_decorator(STEP_DECORATORS["secrets"])
-card = make_step_decorator(STEP_DECORATORS["card"])
-pypi = make_step_decorator(STEP_DECORATORS["pypi"])
-conda = make_step_decorator(STEP_DECORATORS["conda"])
-uv = make_step_decorator(STEP_DECORATORS["uv"])
-
-project = make_flow_decorator(FLOW_DECORATORS["project"])
-schedule = make_flow_decorator(FLOW_DECORATORS["schedule"])
-trigger = make_flow_decorator(FLOW_DECORATORS["trigger"])
-trigger_on_finish = make_flow_decorator(FLOW_DECORATORS["trigger_on_finish"])
-exit_hook = make_flow_decorator(FLOW_DECORATORS["exit_hook"])
+# User-facing decorator callables (retry, catch, tpu, ...) resolve lazily
+# through module __getattr__ below, straight from the live registries — so
+# an extension that overrides a core decorator wins for BOTH
+# `from metaflow_tpu import retry` and `--with retry`.
 
 # client API (lazy-ish: import is cheap, no jax involved)
 from .client import (  # noqa: E402
@@ -56,13 +39,39 @@ from .client import (  # noqa: E402
 
 from .runner import Runner, Deployer  # noqa: E402
 
+# cache keyed by (name, class) so wrapper identity is stable while the
+# registry entry is unchanged, but removal/override invalidates naturally
+_deco_cache = {}
+
 
 def __getattr__(name):
     if name == "NBRunner":
         from .runner.nbrun import NBRunner
 
+        globals()[name] = NBRunner
         return NBRunner
+    # decorators contributed by extensions are importable like core ones:
+    # `from metaflow_tpu import my_ext_decorator`
+    if name in STEP_DECORATORS:
+        key = (name, STEP_DECORATORS[name])
+        if key not in _deco_cache:
+            _deco_cache[key] = make_step_decorator(STEP_DECORATORS[name])
+        return _deco_cache[key]
+    if name in FLOW_DECORATORS:
+        key = (name, FLOW_DECORATORS[name])
+        if key not in _deco_cache:
+            _deco_cache[key] = make_flow_decorator(FLOW_DECORATORS[name])
+        return _deco_cache[key]
     raise AttributeError("module 'metaflow_tpu' has no attribute %r" % name)
+
+
+# merge metaflow_tpu_extensions.* namespace packages into the registries
+# (reference: metaflow/extension_support/plugins.py — extensions load at
+# `import metaflow` time, before any CLI is built). Must run AFTER
+# __getattr__ exists: extensions may `from metaflow_tpu import retry`.
+from . import extension_support as _ext  # noqa: E402
+
+_ext.load_extensions()
 
 __version__ = "0.1.0"
 

@@ -881,6 +881,12 @@ def main(flow, args=None):
             mflog.format_merged([data]).decode("utf-8", errors="replace")
         )
 
+    # commands contributed by metaflow_tpu_extensions.* packages
+    from .extension_support import CLI_COMMANDS as _ext_commands
+
+    for _cmd in _ext_commands:
+        start.add_command(_cmd)
+
     try:
         start(args=args, standalone_mode=False, obj=state)
     except click.exceptions.ClickException as ex:

@@ -1,0 +1,197 @@
+"""Namespace-package extension discovery.
+
+Reference behavior: metaflow/extension_support/plugins.py:15,140 — any
+installed distribution providing a `metaflow_extensions.*` package can add
+or override plugins in every category at import time. Here the extension
+root is the PEP-420 namespace package ``metaflow_tpu_extensions``: multiple
+distributions may each ship ``metaflow_tpu_extensions/<name>/`` (no
+``__init__.py`` at the root), and every such subpackage is discovered and
+merged when ``metaflow_tpu`` is imported.
+
+An extension subpackage contributes via a ``plugins`` submodule (preferred)
+or its own ``__init__``, exporting any of:
+
+    STEP_DECORATORS    list of StepDecorator subclasses (merged by ``.name``)
+    FLOW_DECORATORS    list of FlowDecorator subclasses (merged by ``.name``)
+    STORAGE_BACKENDS   dict  name -> DataStoreStorage subclass
+    METADATA_PROVIDERS dict  name -> MetadataProvider subclass
+    CLI_COMMANDS       list of click commands added to every flow CLI
+    register(api)      callable for imperative registration; ``api`` is this
+                       module (use api.add_step_decorator(cls) etc.)
+
+Merged entries *override* core entries with the same name, mirroring the
+reference's extension-wins semantics. Set ``TPUFLOW_DISABLE_EXTENSIONS=1``
+to skip discovery. A broken extension is reported to stderr and skipped —
+it never takes core down with it.
+"""
+
+import importlib
+import os
+import pkgutil
+import sys
+import traceback
+
+EXT_PKG = "metaflow_tpu_extensions"
+
+# click commands contributed by extensions; cli.main() adds these to every
+# flow's command group after the core commands.
+CLI_COMMANDS = []
+
+_loaded = False
+_loaded_extensions = []
+_failed_extensions = {}
+
+
+def add_step_decorator(cls):
+    from . import plugins
+
+    return plugins.register_step_decorator(cls)
+
+
+def add_flow_decorator(cls):
+    from . import plugins
+
+    return plugins.register_flow_decorator(cls)
+
+
+def add_storage_backend(name, cls):
+    from .datastore.storage import STORAGE_BACKENDS
+
+    STORAGE_BACKENDS[name] = cls
+    return cls
+
+
+def add_metadata_provider(name, cls):
+    from .metadata import METADATA_PROVIDERS
+
+    METADATA_PROVIDERS[name] = cls
+    return cls
+
+
+def add_cli_command(cmd):
+    CLI_COMMANDS.append(cmd)
+    return cmd
+
+
+def _merge(mod):
+    for cls in getattr(mod, "STEP_DECORATORS", []):
+        add_step_decorator(cls)
+    for cls in getattr(mod, "FLOW_DECORATORS", []):
+        add_flow_decorator(cls)
+    for name, cls in dict(getattr(mod, "STORAGE_BACKENDS", {})).items():
+        add_storage_backend(name, cls)
+    for name, cls in dict(getattr(mod, "METADATA_PROVIDERS", {})).items():
+        add_metadata_provider(name, cls)
+    for cmd in getattr(mod, "CLI_COMMANDS", []):
+        add_cli_command(cmd)
+    reg = getattr(mod, "register", None)
+    if callable(reg):
+        reg(sys.modules[__name__])
+
+
+def loaded_extensions():
+    """Names of successfully merged extension subpackages."""
+    return list(_loaded_extensions)
+
+
+def failed_extensions():
+    """Map of extension name -> one-line error for broken extensions."""
+    return dict(_failed_extensions)
+
+
+def _registry_snapshot():
+    from . import plugins
+    from .datastore.storage import STORAGE_BACKENDS
+    from .metadata import METADATA_PROVIDERS
+
+    return (
+        dict(plugins.STEP_DECORATORS),
+        dict(plugins.FLOW_DECORATORS),
+        dict(STORAGE_BACKENDS),
+        dict(METADATA_PROVIDERS),
+        list(CLI_COMMANDS),
+    )
+
+
+def _registry_restore(snap):
+    from . import plugins
+    from .datastore.storage import STORAGE_BACKENDS
+    from .metadata import METADATA_PROVIDERS
+
+    steps, flows, storage, metadata, clis = snap
+    plugins.STEP_DECORATORS.clear()
+    plugins.STEP_DECORATORS.update(steps)
+    plugins.FLOW_DECORATORS.clear()
+    plugins.FLOW_DECORATORS.update(flows)
+    STORAGE_BACKENDS.clear()
+    STORAGE_BACKENDS.update(storage)
+    METADATA_PROVIDERS.clear()
+    METADATA_PROVIDERS.update(metadata)
+    CLI_COMMANDS[:] = clis
+
+
+def load_extensions(force=False):
+    """Discover and merge all metaflow_tpu_extensions.* subpackages.
+
+    Idempotent per-process unless force=True, which re-scans sys.path and
+    re-merges every discovered extension (for tests that install an
+    extension after import). A partially-merged broken extension is rolled
+    back so "skipped" really means no trace in the registries.
+    """
+    global _loaded
+    if _loaded and not force:
+        return list(_loaded_extensions)
+    _loaded = True
+    if os.environ.get("TPUFLOW_DISABLE_EXTENSIONS", "").lower() in (
+        "1",
+        "true",
+    ):
+        return []
+    if force:
+        # pick up extension roots added to sys.path after first import,
+        # and re-merge everything (registries may have been reset by tests)
+        importlib.invalidate_caches()
+        sys.modules.pop(EXT_PKG, None)
+        for modname in [
+            m for m in sys.modules if m.startswith(EXT_PKG + ".")
+        ]:
+            sys.modules.pop(modname, None)
+        del _loaded_extensions[:]
+        _failed_extensions.clear()
+        # extension CLI commands re-merge below; dict registries re-merge
+        # idempotently but this list would otherwise accumulate duplicates
+        del CLI_COMMANDS[:]
+    try:
+        ext_pkg = importlib.import_module(EXT_PKG)
+    except ImportError:
+        return list(_loaded_extensions)
+    for _finder, name, _ispkg in pkgutil.iter_modules(
+        list(getattr(ext_pkg, "__path__", []))
+    ):
+        full = "%s.%s" % (EXT_PKG, name)
+        if full in _loaded_extensions:
+            continue
+        snap = _registry_snapshot()
+        try:
+            mod = importlib.import_module(full)
+            try:
+                plug = importlib.import_module(full + ".plugins")
+            except ModuleNotFoundError as ex:
+                # only fall back when the plugins submodule itself is absent;
+                # an import error *inside* plugins.py must surface as broken
+                if ex.name != full + ".plugins":
+                    raise
+                plug = mod
+            _merge(plug)
+            _loaded_extensions.append(full)
+            _failed_extensions.pop(full, None)
+        except Exception as ex:
+            _registry_restore(snap)
+            _failed_extensions[full] = "%s: %s" % (type(ex).__name__, ex)
+            sys.stderr.write(
+                "[extensions] skipping broken extension %s (%s)\n"
+                % (full, _failed_extensions[full])
+            )
+            if os.environ.get("TPUFLOW_DEBUG"):
+                traceback.print_exc()
+    return list(_loaded_extensions)
