@@ -623,9 +623,7 @@ def main(flow, args=None):
         if do_package:
             from .package import MetaflowPackage
 
-            pkg = MetaflowPackage(
-                flow_dir=os.path.dirname(os.path.abspath(sys.argv[0]))
-            )
+            pkg = MetaflowPackage.for_flow(flow)
             package_url, sha = pkg.upload(state.flow_datastore)
             echo("Code package uploaded: %s (sha %s)" % (package_url,
                                                          sha[:12]))

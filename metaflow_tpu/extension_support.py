@@ -40,6 +40,9 @@ CLI_COMMANDS = []
 _loaded = False
 _loaded_extensions = []
 _failed_extensions = {}
+# registries as they were before ANY extension merged — lets a forced
+# re-scan (or a later disable) start from a clean core baseline
+_core_snapshot = None
 
 
 def add_step_decorator(cls):
@@ -138,24 +141,35 @@ def load_extensions(force=False):
     extension after import). A partially-merged broken extension is rolled
     back so "skipped" really means no trace in the registries.
     """
-    global _loaded
+    global _loaded, _core_snapshot
     if _loaded and not force:
         return list(_loaded_extensions)
     _loaded = True
+    if _core_snapshot is None:
+        _core_snapshot = _registry_snapshot()
     if os.environ.get("TPUFLOW_DISABLE_EXTENSIONS", "").lower() in (
         "1",
         "true",
     ):
+        # disabling after a previous load must also UNregister: reset to
+        # the pre-extension baseline, not just report empty
+        if _loaded_extensions:
+            _registry_restore(_core_snapshot)
+        del _loaded_extensions[:]
+        _failed_extensions.clear()
         return []
     if force:
         # pick up extension roots added to sys.path after first import,
-        # and re-merge everything (registries may have been reset by tests)
+        # and re-merge everything from the clean core baseline (registries
+        # may have been mutated by tests or earlier scans)
         importlib.invalidate_caches()
         sys.modules.pop(EXT_PKG, None)
         for modname in [
             m for m in sys.modules if m.startswith(EXT_PKG + ".")
         ]:
             sys.modules.pop(modname, None)
+        if _loaded_extensions:
+            _registry_restore(_core_snapshot)
         del _loaded_extensions[:]
         _failed_extensions.clear()
         # extension CLI commands re-merge below; dict registries re-merge

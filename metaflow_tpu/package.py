@@ -19,11 +19,15 @@ MAX_FILE_BYTES = 1 << 20  # skip giant files by default
 
 class MetaflowPackage(object):
     def __init__(self, flow_dir=None, suffixes=DEFAULT_SUFFIXES,
-                 max_file_bytes=MAX_FILE_BYTES, extra_info=None):
+                 max_file_bytes=MAX_FILE_BYTES, extra_info=None,
+                 extra_files=None):
         self.flow_dir = os.path.abspath(flow_dir or os.getcwd())
         self.suffixes = tuple(suffixes)
         self.max_file_bytes = max_file_bytes
         self.extra_info = extra_info or {}
+        # (arcname, local path) pairs from decorators' add_to_package —
+        # e.g. @conda lock files (see decorator_package_files)
+        self.extra_files = list(extra_files or [])
         self._blob = None
         self.sha = None
         self.url = None
@@ -83,6 +87,9 @@ class MetaflowPackage(object):
             pkg_root = os.path.dirname(os.path.abspath(__file__))
             for full, arc in self._walk(pkg_root, "metaflow_tpu"):
                 add(full, arc)
+            for arc, full in sorted(self.extra_files, key=lambda p: p[0]):
+                if os.path.exists(full):
+                    add(full, arc)
             # INFO manifest — no timestamps: identical content must hash
             # identically for CAS dedup
             info_bytes = json.dumps(
@@ -105,6 +112,33 @@ class MetaflowPackage(object):
         [(url, sha)] = flow_datastore.save_data([self.blob()])
         self.url, self.sha = url, sha
         return url, sha
+
+    @classmethod
+    def for_flow(cls, flow, flow_dir=None):
+        """The standard package for a run: the flow's directory plus every
+        file its step decorators want shipped (the one construction both
+        the CLI and remote launchers must share)."""
+        import sys
+
+        return cls(
+            flow_dir=flow_dir
+            or os.path.dirname(os.path.abspath(sys.argv[0])),
+            extra_files=cls.decorator_package_files(flow),
+        )
+
+    @staticmethod
+    def decorator_package_files(flow):
+        """Collect (arcname, path) pairs every step decorator wants shipped
+        (reference: decorators' add_to_package feeding MetaflowPackage)."""
+        files = []
+        seen = set()
+        for step_func in flow:
+            for deco in getattr(step_func, "decorators", []):
+                for pair in deco.add_to_package() or []:
+                    if pair[0] not in seen:
+                        seen.add(pair[0])
+                        files.append(tuple(pair))
+        return files
 
     @staticmethod
     def bootstrap_commands(package_url, workdir="/tmp/mf_package"):

@@ -1,0 +1,122 @@
+"""Conda environments for @conda, backed by micromamba.
+
+Reference behavior: metaflow/plugins/pypi/conda_environment.py:33 — per-step
+conda envs are solved once into a lock, cached content-addressed, and
+re-created from the lock on every host (local or remote) without solving.
+
+Layout under <tpuflow root>/envs/conda/:
+    <id>.lock.json   the solved package-URL list (the portable artifact)
+    <id>/            the materialized environment (host-local)
+
+The id hashes {packages, python, channels}, so the same spec reuses both
+the lock and the env. Remote bootstrap: the lock file is tiny JSON — the
+code package carries it (add_to_package) and the worker-side ensure() sees
+the lock already present, skipping straight to `create` against its local
+package cache (offline-safe).
+"""
+
+import hashlib
+import json
+import os
+
+from .micromamba import Micromamba
+
+
+def conda_env_id(packages, python=None, channels=()):
+    spec = json.dumps(
+        {
+            "backend": "conda",
+            "packages": dict(sorted((packages or {}).items())),
+            "python": python,
+            "channels": list(channels or ()),
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(spec.encode("utf-8")).hexdigest()[:16]
+
+
+class CondaEnvironment(object):
+    def __init__(self, packages, python=None, channels=(), root=None,
+                 micromamba=None):
+        from ...util import get_tpuflow_root
+
+        self.packages = dict(packages or {})
+        self.python = python
+        # normalize before hashing: an empty channel list and an explicit
+        # ('conda-forge',) are the same effective spec — same id, same env
+        self.channels = tuple(channels or ("conda-forge",))
+        self.id = conda_env_id(self.packages, python, self.channels)
+        base = os.path.join(root or get_tpuflow_root(), "envs", "conda")
+        self.root = os.path.join(base, self.id)
+        self.lock_path = os.path.join(base, "%s.lock.json" % self.id)
+        self._micromamba = micromamba
+
+    @property
+    def interpreter(self):
+        return os.path.join(self.root, "bin", "python")
+
+    @property
+    def ready_marker(self):
+        return os.path.join(self.root, ".tpuflow-ready")
+
+    def is_ready(self):
+        return os.path.exists(self.ready_marker)
+
+    def lock(self):
+        """Return the locked package list, solving at most once per spec."""
+        if os.path.exists(self.lock_path):
+            with open(self.lock_path) as f:
+                return json.load(f)["locked"]
+        mm = self._mm()
+        locked = mm.solve(
+            self.packages, python=self.python, channels=self.channels
+        )
+        os.makedirs(os.path.dirname(self.lock_path), exist_ok=True)
+        tmp = self.lock_path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "id": self.id,
+                    "packages": self.packages,
+                    "python": self.python,
+                    "channels": list(self.channels),
+                    "locked": locked,
+                },
+                f,
+                indent=1,
+            )
+        os.replace(tmp, self.lock_path)
+        return locked
+
+    def ensure(self, echo=lambda *_: None):
+        """Idempotently materialize the env; returns its interpreter."""
+        if self.is_ready():
+            return self.interpreter
+        locked = self.lock()
+        echo(
+            "Building conda environment %s (%d locked packages)..."
+            % (self.id, len(locked))
+        )
+        self._mm().create(self.root, locked)
+        with open(self.ready_marker, "w") as f:
+            json.dump({"packages": self.packages}, f)
+        return self.interpreter
+
+    def files_for_package(self):
+        """(archive name, local path) pairs the code package should carry so
+        remote hosts skip the solve (they still need a package cache or
+        channel mirror to create from). The arcname sits under .tpuflow/ —
+        workers untar into their workdir and get_tpuflow_root() defaults to
+        <cwd>/.tpuflow, so the shipped lock lands exactly where worker-side
+        lock() looks for it."""
+        self.lock()
+        return [
+            (os.path.join(".tpuflow", "envs", "conda",
+                          os.path.basename(self.lock_path)),
+             self.lock_path)
+        ]
+
+    def _mm(self):
+        if self._micromamba is None:
+            self._micromamba = Micromamba()
+        return self._micromamba

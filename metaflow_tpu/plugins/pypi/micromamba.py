@@ -1,0 +1,150 @@
+"""Micromamba driver for @conda environments.
+
+Reference behavior: metaflow/plugins/pypi/micromamba.py — solve a package
+spec into an exact list of package URLs with `create --dry-run --json`,
+then materialize environments from those URLs with `--no-deps` so every
+host builds the identical env without re-solving.
+
+TPU-first differences:
+- No auto-download of the micromamba binary (the reference fetches it from
+  micro.mamba.pm): TPU fleets run with zero egress, so the binary comes
+  from the image. Located via $TPUFLOW_MICROMAMBA, then $PATH.
+- The solve result (the "lock") is a plain JSON file the caller persists;
+  conda_environment.py caches it next to the env and ships it to remote
+  hosts through the code package, so workers never solve.
+- Offline create is a first-class mode (TPUFLOW_CONDA_OFFLINE=1 or a
+  populated $TPUFLOW_CONDA_PKGS_DIRS package cache) rather than an
+  accident of a warm cache.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+
+from ...exception import TpuFlowException
+
+
+class MicromambaException(TpuFlowException):
+    headline = "Micromamba error"
+
+
+def find_micromamba():
+    """Locate the micromamba binary; None when not installed.
+
+    An explicitly configured TPUFLOW_MICROMAMBA is returned even if the
+    path does not exist — the operator asked for micromamba, so a typo
+    must surface as an error at use, not a silent fallback to pip."""
+    explicit = os.environ.get("TPUFLOW_MICROMAMBA")
+    if explicit:
+        return explicit
+    return shutil.which("micromamba")
+
+
+class Micromamba(object):
+    def __init__(self, binary=None):
+        self.binary = binary or find_micromamba()
+        if not self.binary:
+            raise MicromambaException(
+                "micromamba binary not found. Install it on the image and/or "
+                "point TPUFLOW_MICROMAMBA at it."
+            )
+        if not os.path.exists(self.binary):
+            raise MicromambaException(
+                "micromamba binary %s (from TPUFLOW_MICROMAMBA) does not "
+                "exist" % self.binary
+            )
+
+    @classmethod
+    def available(cls):
+        return find_micromamba() is not None
+
+    def solve(self, packages, python=None, channels=()):
+        """Resolve a spec to a locked list of package dicts [{'url': ...}].
+
+        The dry-run create returns the full link plan; only the URLs are
+        kept — they are exact (filename encodes name/version/build), which
+        is all `create --no-deps` needs to reproduce the env anywhere.
+        """
+        import tempfile
+
+        specs = [
+            name if version in (None, "", "*") else "%s==%s" % (name, version)
+            for name, version in sorted((packages or {}).items())
+        ]
+        if python:
+            specs.append("python==%s" % python)
+        with tempfile.TemporaryDirectory(prefix="tpuflow-mm-") as tmp:
+            cmd = [
+                "create",
+                "--yes",
+                "--quiet",
+                "--dry-run",
+                "--prefix",
+                os.path.join(tmp, "solve-prefix"),
+            ]
+            for channel in channels or ("conda-forge",):
+                cmd += ["--channel", channel]
+            cmd += specs
+            out = self._call(cmd)
+        try:
+            link = out["actions"]["LINK"]
+        except (KeyError, TypeError):
+            raise MicromambaException(
+                "micromamba solve returned no link plan for: %s"
+                % " ".join(specs)
+            )
+        return [{"url": item["url"]} for item in link if "url" in item]
+
+    def create(self, prefix, locked, offline=False):
+        """Materialize an env at `prefix` from a locked URL list."""
+        cmd = [
+            "create",
+            "--yes",
+            "--quiet",
+            "--no-deps",
+            "--prefix",
+            prefix,
+        ]
+        if offline or os.environ.get("TPUFLOW_CONDA_OFFLINE") == "1":
+            cmd.append("--offline")
+        cmd += [item["url"] for item in locked]
+        self._call(cmd)
+        return prefix
+
+    def _call(self, args, extra_env=None):
+        env = dict(os.environ)
+        # hardlink into the shared package cache when one is configured
+        pkgs_dirs = os.environ.get("TPUFLOW_CONDA_PKGS_DIRS")
+        if pkgs_dirs:
+            env["CONDA_PKGS_DIRS"] = pkgs_dirs
+        if extra_env:
+            env.update(extra_env)
+        try:
+            proc = subprocess.run(
+                [self.binary, "--json"] + list(args),
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=1800,
+            )
+        except subprocess.TimeoutExpired:
+            raise MicromambaException(
+                "micromamba timed out: %s" % " ".join(args[:4])
+            )
+        if proc.returncode != 0:
+            raise MicromambaException(
+                "micromamba %s failed (rc=%d):\n%s"
+                % (
+                    args[0] if args else "",
+                    proc.returncode,
+                    (proc.stderr or proc.stdout).strip()[-1000:],
+                )
+            )
+        if not proc.stdout.strip():
+            return {}
+        try:
+            return json.loads(proc.stdout)
+        except ValueError:
+            # some micromamba subcommands emit non-JSON despite --json
+            return {"stdout": proc.stdout}

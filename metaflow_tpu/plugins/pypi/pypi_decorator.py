@@ -41,18 +41,45 @@ class PyPIStepDecorator(StepDecorator):
 
 
 class CondaStepDecorator(PyPIStepDecorator):
-    """@conda(packages={...}, libraries={...}) — same env machinery; a
-    micromamba-based backend can replace PyPIEnvironment for non-Python
-    dependencies."""
+    """@conda(packages={...}, libraries={...}, channels=(...)) — a real
+    micromamba backend (locked solve, cached env, offline create) when the
+    binary exists; otherwise degrades to the shared venv/pip machinery so
+    pure-Python specs still work on images without micromamba.
+    Reference: metaflow/plugins/pypi/conda_environment.py:33."""
 
     name = "conda"
     defaults = {"packages": {}, "libraries": {}, "python": None,
-                "disabled": False}
+                "channels": (), "disabled": False}
 
-    def _env(self):
+    def _merged_packages(self):
         packages = dict(self.attributes.get("libraries") or {})
         packages.update(self.attributes.get("packages") or {})
-        return PyPIEnvironment(packages, python=self.attributes.get("python"))
+        return packages
+
+    def _env(self):
+        from .micromamba import Micromamba
+
+        if Micromamba.available():
+            from .conda_environment import CondaEnvironment
+
+            return CondaEnvironment(
+                self._merged_packages(),
+                python=self.attributes.get("python"),
+                channels=self.attributes.get("channels") or (),
+            )
+        return PyPIEnvironment(
+            self._merged_packages(), python=self.attributes.get("python")
+        )
+
+    def add_to_package(self):
+        # ship the solved lock in the code package: remote hosts create the
+        # env from exact URLs without solving (offline-safe with a pkgs cache)
+        if self.attributes.get("disabled"):
+            return []
+        env = self._env()
+        if hasattr(env, "files_for_package"):
+            return env.files_for_package()
+        return []
 
 
 class UVStepDecorator(PyPIStepDecorator):

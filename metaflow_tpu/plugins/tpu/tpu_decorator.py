@@ -71,9 +71,7 @@ class TpuDecorator(StepDecorator):
 
         from ...package import MetaflowPackage
 
-        pkg = MetaflowPackage(
-            flow_dir=os.path.dirname(os.path.abspath(sys.argv[0]))
-        )
+        pkg = MetaflowPackage.for_flow(flow)
         url, _sha = pkg.upload(self._flow_datastore)
         os.environ["TPUFLOW_PACKAGE_URL"] = url
 

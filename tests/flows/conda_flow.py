@@ -1,0 +1,20 @@
+from metaflow_tpu import FlowSpec, conda, step
+
+
+class CondaFlow(FlowSpec):
+    @conda(packages={"numpy": "1.26"}, libraries={"zlib": "1.3"})
+    @step
+    def start(self):
+        import numpy as np
+
+        self.ok = int(np.int64(7))
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.ok == 7
+        print("conda ok:", self.ok)
+
+
+if __name__ == "__main__":
+    CondaFlow()

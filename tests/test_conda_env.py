@@ -1,0 +1,186 @@
+"""@conda via micromamba: locked solve, cached env, offline create.
+
+Reference behavior: metaflow/plugins/pypi/{micromamba.py,conda_environment.py}
+— solve once to a lock, create everywhere from the lock with --no-deps.
+Tested against a fake micromamba binary (the repo's fake-gcloud pattern):
+the fake records every invocation, emits a canned link plan for solves, and
+materializes env prefixes as venvs so a @conda flow really executes under
+the environment's interpreter.
+"""
+
+import json
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_MICROMAMBA = textwrap.dedent(
+    """\
+    #!%(python)s
+    import json, os, sys, venv
+
+    with open(os.environ["FAKE_MM_LOG"], "a") as f:
+        f.write(json.dumps(sys.argv[1:]) + "\\n")
+
+    args = sys.argv[1:]
+
+    def flag_value(name):
+        return args[args.index(name) + 1] if name in args else None
+
+    if "--dry-run" in args:
+        specs = [
+            a for a in args[args.index("--prefix") + 2:]
+            if not a.startswith("-") and a != flag_value("--channel")
+        ]
+        link = [
+            {"url": "https://fake.channel/linux-64/%%s.conda"
+                    %% s.replace("==", "-"),
+             "name": s.split("==")[0]}
+            for s in specs
+        ]
+        print(json.dumps({"actions": {"LINK": link}}))
+        sys.exit(0)
+
+    prefix = flag_value("--prefix")
+    if prefix and not os.path.exists(os.path.join(prefix, "bin", "python")):
+        # stand in for a real conda env: a venv that can still import the
+        # launching interpreter's packages (numpy etc.) without network —
+        # link the parent site-packages via .pth, since system-site only
+        # reaches the BASE python when the launcher is itself a venv
+        import glob, site
+        venv.create(prefix, with_pip=False, system_site_packages=True)
+        parents = [p for p in sys.path if p.endswith("site-packages")]
+        try:
+            parents += site.getsitepackages()
+        except Exception:
+            pass
+        for child in glob.glob(
+            os.path.join(prefix, "lib", "python*", "site-packages")
+        ):
+            with open(os.path.join(child, "_parent.pth"), "w") as f:
+                f.write("\\n".join(p for p in parents if os.path.isdir(p)))
+    print(json.dumps({"success": True}))
+    """
+) % {"python": sys.executable}
+
+
+@pytest.fixture
+def fake_mm(tmp_path, monkeypatch):
+    mm = tmp_path / "micromamba"
+    mm.write_text(FAKE_MICROMAMBA)
+    mm.chmod(mm.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "mm_calls.log"
+    log.write_text("")
+    monkeypatch.setenv("TPUFLOW_MICROMAMBA", str(mm))
+    monkeypatch.setenv("FAKE_MM_LOG", str(log))
+    return mm, log
+
+
+def _calls(log):
+    return [json.loads(line) for line in log.read_text().splitlines()]
+
+
+def test_solve_produces_lock_and_caches(fake_mm, tmp_path):
+    from metaflow_tpu.plugins.pypi.conda_environment import CondaEnvironment
+
+    _mm, log = fake_mm
+    env = CondaEnvironment(
+        {"numpy": "1.26", "scipy": None}, python="3.11",
+        root=str(tmp_path / "root"),
+    )
+    locked = env.lock()
+    urls = [item["url"] for item in locked]
+    assert any("numpy-1.26" in u for u in urls)
+    assert any("python-3.11" in u for u in urls)
+    solves = [c for c in _calls(log) if "--dry-run" in c]
+    assert len(solves) == 1
+    # second lock() hits the cached lock file, no new solve
+    env2 = CondaEnvironment(
+        {"numpy": "1.26", "scipy": None}, python="3.11",
+        root=str(tmp_path / "root"),
+    )
+    assert env2.lock() == locked
+    assert len([c for c in _calls(log) if "--dry-run" in c]) == 1
+
+
+def test_ensure_creates_env_from_lock_no_deps(fake_mm, tmp_path):
+    from metaflow_tpu.plugins.pypi.conda_environment import CondaEnvironment
+
+    _mm, log = fake_mm
+    env = CondaEnvironment({"numpy": "1.26"}, root=str(tmp_path / "root"))
+    interp = env.ensure()
+    assert os.path.exists(interp)
+    creates = [
+        c for c in _calls(log) if "--no-deps" in c and "--dry-run" not in c
+    ]
+    assert len(creates) == 1
+    assert any(u.startswith("https://fake.channel/") for u in creates[0])
+    # idempotent: ready marker short-circuits
+    env.ensure()
+    assert len(_calls(log)) == 2  # one solve + one create
+
+
+def test_offline_flag_passed_through(fake_mm, tmp_path, monkeypatch):
+    from metaflow_tpu.plugins.pypi.conda_environment import CondaEnvironment
+
+    _mm, log = fake_mm
+    monkeypatch.setenv("TPUFLOW_CONDA_OFFLINE", "1")
+    env = CondaEnvironment({"numpy": None}, root=str(tmp_path / "root"))
+    env.ensure()
+    creates = [c for c in _calls(log) if "--no-deps" in c]
+    assert creates and "--offline" in creates[0]
+
+
+def test_micromamba_error_surfaces(tmp_path, monkeypatch):
+    from metaflow_tpu.plugins.pypi.micromamba import (
+        Micromamba,
+        MicromambaException,
+    )
+
+    bad = tmp_path / "micromamba"
+    bad.write_text("#!/bin/sh\necho 'solve blew up' >&2\nexit 3\n")
+    bad.chmod(bad.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("TPUFLOW_MICROMAMBA", str(bad))
+    with pytest.raises(MicromambaException) as err:
+        Micromamba().solve({"numpy": "1.26"})
+    assert "solve blew up" in str(err.value)
+
+
+def test_lock_ships_in_code_package(fake_mm, tmp_path, monkeypatch):
+    """The @conda lock file rides the code package for remote bootstrap."""
+    import tarfile
+    import io
+
+    from metaflow_tpu.plugins.pypi.conda_environment import CondaEnvironment
+    from metaflow_tpu.package import MetaflowPackage
+
+    env = CondaEnvironment({"numpy": "1.26"}, root=str(tmp_path / "root"))
+    pkg = MetaflowPackage(
+        flow_dir=str(tmp_path), extra_files=env.files_for_package()
+    )
+    tar = tarfile.open(fileobj=io.BytesIO(pkg.blob()), mode="r:gz")
+    arc = ".tpuflow/envs/conda/%s.lock.json" % env.id
+    lock = json.load(tar.extractfile(arc))
+    assert lock["packages"] == {"numpy": "1.26"}
+    assert lock["locked"]
+
+
+def test_conda_flow_runs_under_fake_micromamba(fake_mm, tmp_path, run_flow):
+    mm, log = fake_mm
+    flow_file = os.path.join(REPO, "tests", "flows", "conda_flow.py")
+    out = run_flow(
+        flow_file,
+        "run",
+        env_extra={
+            "TPUFLOW_MICROMAMBA": str(mm),
+            "FAKE_MM_LOG": str(log),
+        },
+    )
+    assert "conda ok: 7" in out.stdout + out.stderr
+    calls = _calls(log)
+    assert any("--dry-run" in c for c in calls)  # solved
+    assert any("--no-deps" in c for c in calls)  # created from lock
