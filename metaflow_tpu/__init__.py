@@ -45,11 +45,12 @@ _deco_cache = {}
 
 
 def __getattr__(name):
-    if name == "NBRunner":
-        from .runner.nbrun import NBRunner
+    if name in ("NBRunner", "NBDeployer"):
+        from . import runner as _runner
 
-        globals()[name] = NBRunner
-        return NBRunner
+        value = getattr(_runner, name)
+        globals()[name] = value
+        return value
     # decorators contributed by extensions are importable like core ones:
     # `from metaflow_tpu import my_ext_decorator`
     if name in STEP_DECORATORS:

@@ -165,9 +165,10 @@ def _collect_params(flow, kwargs):
     return params, kwargs
 
 
-def main(flow, args=None):
-    state = CliState(flow)
-
+def make_cli(flow, state):
+    """Build the flow's click command group. main() invokes it; the
+    programmatic API (runner/click_api.py) introspects it so Runner kwargs
+    track the CLI surface automatically."""
     from . import metaflow_config as _cfg
 
     @click.group(name=flow.name, invoke_without_command=False)
@@ -884,6 +885,13 @@ def main(flow, args=None):
 
     for _cmd in _ext_commands:
         start.add_command(_cmd)
+
+    return start
+
+
+def main(flow, args=None):
+    state = CliState(flow)
+    start = make_cli(flow, state)
 
     try:
         start(args=args, standalone_mode=False, obj=state)

@@ -1,29 +1,38 @@
 """Programmatic API: Runner shells out to the flow CLI and attaches a client
-Run object (reference behavior: metaflow/runner/metaflow_runner.py:305)."""
+Run object (reference behavior: metaflow/runner/metaflow_runner.py:305).
+
+Kwarg handling reflects the flow's actual click command tree
+(runner/click_api.py), so any option the CLI grows is immediately a valid
+Runner kwarg and typos fail fast. Subprocesses run under an asyncio
+supervisor (runner/subprocess_manager.py): timeouts kill the whole process
+group (TERM→KILL), and logs stream live from files that outlive the
+process.
+"""
 
 import os
-import subprocess
 import sys
 import tempfile
 import time
 
 from ..client import Run
 from ..exception import TpuFlowException
+from .click_api import FlowCLIReflection
 from .deployer import Deployer  # noqa: F401  (public API re-export)
+from .subprocess_manager import SubprocessManager
 
 
 def __getattr__(name):
-    # NBRunner imports lazily: nbrun pulls in Runner machinery that isn't
-    # needed for the common CLI path
-    if name == "NBRunner":
-        from .nbrun import NBRunner
+    # notebook helpers import lazily: they pull in IPython-adjacent
+    # machinery that isn't needed for the common CLI path
+    if name in ("NBRunner", "NBDeployer"):
+        from . import nbrun
 
-        return NBRunner
+        return getattr(nbrun, name)
     raise AttributeError(name)
 
 
 class ExecutingRun(object):
-    """Result of Runner.run(): the subprocess + the client Run object."""
+    """Result of Runner.run(): the finished subprocess + the client Run."""
 
     def __init__(self, command, returncode, run, stdout, stderr):
         self.command = command
@@ -43,6 +52,10 @@ class Runner(object):
         with Runner('flow.py') as runner:
             result = runner.run(alpha=0.5)
             print(result.run.data.x)
+
+    Top-level CLI options (datastore, metadata, decospecs/--with, configs)
+    are Runner kwargs; command options are method kwargs. Both are
+    validated against the flow's real CLI.
     """
 
     def __init__(self, flow_file, show_output=False, env=None, cwd=None,
@@ -54,60 +67,80 @@ class Runner(object):
         self.env = env or {}
         self.cwd = cwd
         self.top_level_kwargs = top_level_kwargs
+        self.api = FlowCLIReflection(self.flow_file)
+        self._manager = SubprocessManager()
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
+        self._manager.cleanup()
         return False
 
-    def _top_level_args(self):
-        args = []
-        for k, v in self.top_level_kwargs.items():
-            key = "--" + k.replace("_", "-")
-            if isinstance(v, bool):
-                if v:
-                    args.append(key)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    args.extend([key, str(item)])
-            else:
-                args.extend([key, str(v)])
-        return args
+    def command_names(self):
+        """Commands the flow's CLI exposes (reflection view)."""
+        return self.api.command_names()
 
-    def _execute(self, command_args, timeout=None):
+    def _subprocess_env(self):
+        env = dict(os.environ)
+        env.update({k: str(v) for k, v in self.env.items()})
+        return env
+
+    def _argv(self, command, kwargs, positional=(), run_id_file=None):
+        argv = (
+            [sys.executable, self.flow_file]
+            + self.api.build_top_level_argv(self.top_level_kwargs)
+            + self.api.build_command_argv(command, kwargs, positional)
+        )
+        if run_id_file:
+            argv += ["--run-id-file", run_id_file]
+        return argv
+
+    def _attach_run(self, run_id_file):
+        if not os.path.exists(run_id_file):
+            return None
+        with open(run_id_file) as f:
+            run_id = f.read().strip()
+        flow_name = self._flow_name()
+        for _attempt in range(3):
+            try:
+                return Run("%s/%s" % (flow_name, run_id),
+                           _namespace_check=False)
+            except Exception:
+                time.sleep(0.2)
+        return None
+
+    def _execute(self, command, kwargs, positional=(), timeout=None):
         with tempfile.TemporaryDirectory() as tmp:
             run_id_file = os.path.join(tmp, "run_id")
-            argv = (
-                [sys.executable, self.flow_file]
-                + self._top_level_args()
-                + command_args
-                + ["--run-id-file", run_id_file]
+            argv = self._argv(command, kwargs, positional, run_id_file)
+            cm = self._manager.spawn_command(
+                argv, env=self._subprocess_env(), cwd=self.cwd
             )
-            env = dict(os.environ)
-            env.update({k: str(v) for k, v in self.env.items()})
-            proc = subprocess.run(
-                argv,
-                env=env,
-                cwd=self.cwd,
-                capture_output=not self.show_output,
-                timeout=timeout,
+            # the deadline is enforced on the loop thread while (optionally)
+            # streaming output live — a 2h run with show_output must show
+            # progress as it happens, not a dump at exit
+            wait_fut = cm.wait_future(timeout=timeout)
+            if self.show_output:
+                for line in cm.stream_log("stdout"):
+                    sys.stdout.write(line)
+            wait_fut.result()
+            stdout = cm.log_contents("stdout")
+            stderr = cm.log_contents("stderr")
+            if self.show_output:
+                sys.stderr.write(stderr)
+            if cm.timeout_expired:
+                raise TpuFlowException(
+                    "Command timed out after %ss: %s"
+                    % (timeout, " ".join(argv))
+                )
+            result = ExecutingRun(
+                argv, cm.returncode, self._attach_run(run_id_file),
+                stdout, stderr,
             )
-            stdout = (proc.stdout or b"").decode("utf-8", errors="replace")
-            stderr = (proc.stderr or b"").decode("utf-8", errors="replace")
-            run = None
-            if os.path.exists(run_id_file):
-                with open(run_id_file) as f:
-                    run_id = f.read().strip()
-                flow_name = self._flow_name()
-                for _attempt in range(3):
-                    try:
-                        run = Run("%s/%s" % (flow_name, run_id),
-                                  _namespace_check=False)
-                        break
-                    except Exception:
-                        time.sleep(0.2)
-            return ExecutingRun(argv, proc.returncode, run, stdout, stderr)
+            self._manager.commands.pop(cm.process.pid, None)
+            cm.cleanup()
+            return result
 
     def _flow_name(self):
         # the flow name is the FlowSpec subclass name in the file
@@ -122,55 +155,50 @@ class Runner(object):
         )
 
     def run(self, timeout=None, **params):
-        args = ["run"]
-        for k, v in params.items():
-            if k in ("max_workers", "max_num_splits", "tags", "namespace"):
-                key = "--" + k.replace("_", "-").rstrip("s" if k == "tags" else "")
-                if isinstance(v, (list, tuple)):
-                    for item in v:
-                        args.extend(["--tag", str(item)])
-                else:
-                    args.extend([key, str(v)])
-            else:
-                args.extend(["--" + k.replace("_", "-"), str(v)])
-        return self._execute(args, timeout=timeout)
+        return self._execute("run", params, timeout=timeout)
 
-    def resume(self, step_to_rerun=None, origin_run_id=None, timeout=None):
-        args = ["resume"]
-        if step_to_rerun:
-            args.append(step_to_rerun)
-        if origin_run_id:
-            args.extend(["--origin-run-id", str(origin_run_id)])
-        return self._execute(args, timeout=timeout)
+    def resume(self, step_to_rerun=None, timeout=None, **params):
+        positional = (step_to_rerun,) if step_to_rerun else ()
+        return self._execute("resume", params, positional, timeout=timeout)
 
-    def async_run(self, **params):
-        """Start the run without blocking; returns an AsyncRun handle."""
-        import tempfile
-
+    def _spawn_async(self, command, params, positional=()):
         tmpdir = tempfile.mkdtemp(prefix="tpuflow_run_")
         run_id_file = os.path.join(tmpdir, "run_id")
-        argv = (
-            [sys.executable, self.flow_file]
-            + self._top_level_args()
-            + ["run", "--run-id-file", run_id_file]
+        argv = self._argv(command, params, positional,
+                          run_id_file=run_id_file)
+        cm = self._manager.spawn_command(
+            argv, env=self._subprocess_env(), cwd=self.cwd
         )
-        for k, v in params.items():
-            argv.extend(["--" + k.replace("_", "-"), str(v)])
-        env = dict(os.environ)
-        env.update({k: str(v) for k, v in self.env.items()})
-        proc = subprocess.Popen(
-            argv, env=env, cwd=self.cwd,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        )
-        return AsyncRun(self, proc, run_id_file, argv)
+        # the AsyncRun owns its process from here: leaving the Runner
+        # context must not kill a deliberately backgrounded run (callers
+        # wait()/terminate() through the handle)
+        self._manager.commands.pop(cm.process.pid, None)
+        return AsyncRun(self, cm, run_id_file, argv)
+
+    def async_run(self, **params):
+        """Start the run without blocking; returns an AsyncRun handle
+        that owns the subprocess (it survives Runner.__exit__)."""
+        return self._spawn_async("run", params)
+
+    def async_resume(self, step_to_rerun=None, **params):
+        positional = (step_to_rerun,) if step_to_rerun else ()
+        return self._spawn_async("resume", params, positional)
 
 
 class AsyncRun(object):
-    def __init__(self, runner, proc, run_id_file, command):
+    """Handle on a live run: id/client access, live log streaming,
+    wait-with-timeout, and kill (TERM→KILL on the process group)."""
+
+    def __init__(self, runner, cm, run_id_file, command):
         self._runner = runner
-        self.proc = proc
+        self._cm = cm
         self._run_id_file = run_id_file
         self.command = command
+
+    @property
+    def proc(self):
+        # back-compat surface: .poll() / .pid work against the supervisor
+        return self._cm.process
 
     @property
     def run_id(self):
@@ -180,7 +208,7 @@ class AsyncRun(object):
             if os.path.exists(self._run_id_file):
                 with open(self._run_id_file) as f:
                     return f.read().strip()
-            if self.proc.poll() is not None:
+            if not self._cm.running:
                 break
             time.sleep(0.1)
         # final re-check: a fast run may exit between poll and file write
@@ -200,23 +228,37 @@ class AsyncRun(object):
         except Exception:
             return None
 
+    def stream_log(self, name="stdout"):
+        """Yield log lines live while the run executes."""
+        return self._cm.stream_log(name)
+
     def wait(self, timeout=None):
-        stdout, stderr = self.proc.communicate(timeout=timeout)
+        """Wait for the run; on timeout the process group is killed and a
+        TpuFlowException raised (same contract as Runner.run(timeout=...))."""
+        self._cm.wait(timeout=timeout)
+        if self._cm.timeout_expired:
+            self._cleanup()
+            raise TpuFlowException(
+                "Run timed out after %ss (process killed): %s"
+                % (timeout, " ".join(self.command))
+            )
         result = ExecutingRun(
             self.command,
-            self.proc.returncode,
+            self._cm.returncode,
             self.run,
-            stdout.decode("utf-8", errors="replace"),
-            stderr.decode("utf-8", errors="replace"),
+            self._cm.log_contents("stdout"),
+            self._cm.log_contents("stderr"),
         )
         self._cleanup()
         return result
 
     def terminate(self):
-        self.proc.terminate()
+        self._cm.kill()
         self._cleanup()
 
     def _cleanup(self):
         import shutil
 
-        shutil.rmtree(os.path.dirname(self._run_id_file), ignore_errors=True)
+        shutil.rmtree(os.path.dirname(self._run_id_file),
+                      ignore_errors=True)
+        self._cm.cleanup()

@@ -1,0 +1,228 @@
+"""Typed reflection of a flow's CLI for the programmatic API.
+
+Reference behavior: metaflow/runner/click_api.py — Runner methods are
+derived from the click command tree, so a new CLI option is immediately a
+valid Runner kwarg and a typo'd kwarg fails fast with the valid choices.
+
+Mechanism here: import the flow file as a module (the `if __name__ ==
+'__main__'` guard keeps the CLI from firing), instantiate its FlowSpec
+subclass with use_cli=False, and build the real click group via
+cli.make_cli — then translate validated kwargs into argv for the
+subprocess. If the flow file cannot be imported in-process (heavy imports,
+import-time side effects), reflection degrades to permissive passthrough:
+kwargs map to --kebab-case options unvalidated, preserving the old Runner
+behavior instead of failing.
+"""
+
+import importlib.util
+import os
+import sys
+import uuid
+
+from ..exception import TpuFlowException
+
+
+class UnknownCLIOption(TpuFlowException):
+    headline = "Unknown option"
+
+
+def load_flow_instance(flow_file):
+    """Import a flow file and return its FlowSpec instance (use_cli=False)."""
+    from ..flowspec import FlowSpec
+
+    modname = "tpuflow_reflected_%s" % uuid.uuid4().hex[:8]
+    spec = importlib.util.spec_from_file_location(modname, flow_file)
+    if spec is None or spec.loader is None:
+        raise TpuFlowException("Cannot import flow file %s" % flow_file)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = module
+    try:
+        spec.loader.exec_module(module)
+    except BaseException:
+        sys.modules.pop(modname, None)
+        raise
+    candidates = [
+        obj
+        for obj in vars(module).values()
+        if isinstance(obj, type)
+        and issubclass(obj, FlowSpec)
+        and obj is not FlowSpec
+        and obj.__module__ == modname
+    ]
+    if not candidates:
+        raise TpuFlowException(
+            "No FlowSpec subclass found in %s" % flow_file
+        )
+    if len(candidates) > 1:
+        raise TpuFlowException(
+            "Multiple FlowSpec subclasses in %s: %s"
+            % (flow_file, ", ".join(c.__name__ for c in candidates))
+        )
+    return candidates[0](use_cli=False)
+
+
+class _ParamSpec(object):
+    def __init__(self, click_param):
+        self.name = click_param.name
+        self.opt = max(click_param.opts, key=len)  # the --long form
+        self.multiple = getattr(click_param, "multiple", False)
+        self.is_flag = getattr(click_param, "is_flag", False)
+        self.nargs = getattr(click_param, "nargs", 1)
+        self.is_argument = click_param.param_type_name == "argument"
+        self.secondary = [
+            o for o in getattr(click_param, "secondary_opts", [])
+        ]
+
+    def to_argv(self, value):
+        if self.is_argument:
+            return [str(value)]
+        if self.is_flag:
+            if value:
+                return [self.opt]
+            if self.secondary:
+                return [max(self.secondary, key=len)]
+            return []
+        values = (
+            list(value)
+            if self.multiple and isinstance(value, (list, tuple))
+            else [value]
+        )
+        argv = []
+        for v in values:
+            if self.nargs > 1:
+                if not isinstance(v, (list, tuple)) or len(v) != self.nargs:
+                    raise UnknownCLIOption(
+                        "Option %s takes %d values per occurrence; got %r"
+                        % (self.opt, self.nargs, v)
+                    )
+                argv += [self.opt] + [str(x) for x in v]
+            else:
+                argv += [self.opt, str(v)]
+        return argv
+
+
+class CommandSpec(object):
+    def __init__(self, click_command):
+        self.name = click_command.name
+        self.params = {}
+        self.arguments = []
+        self.aliases = {}
+        for p in click_command.params:
+            ps = _ParamSpec(p)
+            if ps.is_argument:
+                self.arguments.append(ps)
+            else:
+                self.params[ps.name] = ps
+        # options with a renamed click param ('--namespace', 'user_namespace')
+        # also accept the kwarg spelled like the option itself
+        for ps in self.params.values():
+            opt_name = ps.opt.lstrip("-").replace("-", "_")
+            if opt_name != ps.name and opt_name not in self.params:
+                self.aliases[opt_name] = ps.name
+
+    def build_argv(self, kwargs, positional=()):
+        argv = [str(a) for a in positional]
+        resolved = {
+            self.aliases.get(name, name): value
+            for name, value in kwargs.items()
+        }
+        unknown = sorted(set(resolved) - set(self.params))
+        if unknown:
+            raise UnknownCLIOption(
+                "Unknown option(s) for '%s': %s. Valid options: %s"
+                % (
+                    self.name,
+                    ", ".join(unknown),
+                    ", ".join(sorted(set(self.params) | set(self.aliases))),
+                )
+            )
+        for name, value in resolved.items():
+            if value is None:
+                continue
+            argv += self.params[name].to_argv(value)
+        return argv
+
+
+class FlowCLIReflection(object):
+    """Lazily-built view of a flow file's CLI command tree."""
+
+    def __init__(self, flow_file):
+        self.flow_file = os.path.abspath(flow_file)
+        self._group = None
+        self._failed = None
+
+    def _load(self):
+        if self._group is not None or self._failed is not None:
+            return
+        try:
+            from ..cli import CliState, make_cli
+
+            flow = load_flow_instance(self.flow_file)
+            self._group = make_cli(flow, CliState(flow))
+        except Exception as ex:
+            self._failed = ex
+
+    @property
+    def available(self):
+        self._load()
+        return self._group is not None
+
+    def command_names(self):
+        self._load()
+        if not self._group:
+            return []
+        return sorted(self._group.commands)
+
+    def top_level(self):
+        self._load()
+        return CommandSpec(self._group) if self._group else None
+
+    def command(self, name):
+        self._load()
+        if not self._group:
+            return None
+        # nested groups ('tag add', 'argo-workflows create') via space-path
+        node = self._group
+        for part in name.split():
+            cmd = node.commands.get(part) if hasattr(node, "commands") else None
+            if cmd is None:
+                return None
+            node = cmd
+        return CommandSpec(node)
+
+    def build_command_argv(self, command, kwargs, positional=()):
+        """Validated argv for `command` (without interpreter/flow file);
+        permissive passthrough when reflection is unavailable."""
+        spec = self.command(command) if self.available else None
+        if spec is None:
+            return (
+                list(command.split())
+                + [str(a) for a in positional]
+                + _permissive_argv(kwargs)
+            )
+        return list(command.split()) + spec.build_argv(kwargs, positional)
+
+    def build_top_level_argv(self, kwargs):
+        spec = self.top_level() if self.available else None
+        if spec is None:
+            return _permissive_argv(kwargs)
+        return spec.build_argv(kwargs)
+
+
+def _permissive_argv(kwargs):
+    """Unvalidated kwargs → --kebab-case argv (reflection-unavailable
+    fallback, the pre-reflection Runner behavior)."""
+    argv = []
+    for k, v in kwargs.items():
+        if v is None:
+            continue
+        key = "--" + k.replace("_", "-")
+        if isinstance(v, bool):
+            if v:
+                argv.append(key)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                argv += [key, str(item)]
+        else:
+            argv += [key, str(v)]
+    return argv

@@ -17,25 +17,32 @@ from . import Runner
 DEFAULT_PRELUDE = "import metaflow_tpu\nfrom metaflow_tpu import *\n"
 
 
+def materialize_flow(flow_cls, prelude=None):
+    """Write a notebook-defined flow class to a runnable .py file; returns
+    (tempdir, flow_file)."""
+    try:
+        source = inspect.getsource(flow_cls)
+    except (OSError, TypeError):
+        raise TpuFlowException(
+            "Could not get the source of %r — define the flow class in "
+            "its own cell." % flow_cls
+        )
+    tmpdir = tempfile.mkdtemp(prefix="tpuflow_nb_")
+    flow_file = os.path.join(tmpdir, "%s.py" % flow_cls.__name__)
+    with open(flow_file, "w") as f:
+        f.write(prelude or DEFAULT_PRELUDE)
+        f.write("\n")
+        f.write(source)
+        f.write(
+            "\n\nif __name__ == '__main__':\n    %s()\n"
+            % flow_cls.__name__
+        )
+    return tmpdir, flow_file
+
+
 class NBRunner(object):
     def __init__(self, flow_cls, prelude=None, env=None, **top_level_kwargs):
-        try:
-            source = inspect.getsource(flow_cls)
-        except (OSError, TypeError):
-            raise TpuFlowException(
-                "Could not get the source of %r — define the flow class in "
-                "its own cell." % flow_cls
-            )
-        self._dir = tempfile.mkdtemp(prefix="tpuflow_nb_")
-        flow_file = os.path.join(self._dir, "%s.py" % flow_cls.__name__)
-        with open(flow_file, "w") as f:
-            f.write(prelude or DEFAULT_PRELUDE)
-            f.write("\n")
-            f.write(source)
-            f.write(
-                "\n\nif __name__ == '__main__':\n    %s()\n"
-                % flow_cls.__name__
-            )
+        self._dir, flow_file = materialize_flow(flow_cls, prelude)
         self._runner = Runner(flow_file, env=env, **top_level_kwargs)
 
     def run(self, **params):
@@ -43,6 +50,28 @@ class NBRunner(object):
 
     def async_run(self, **params):
         return self._runner.async_run(**params)
+
+    def cleanup(self):
+        import shutil
+
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class NBDeployer(object):
+    """Deploy a notebook-defined flow to a production orchestrator
+    (reference: metaflow/runner/nbdeploy.py):
+
+        NBDeployer(MyFlow).argo_workflows(image=...).create()
+    """
+
+    def __init__(self, flow_cls, prelude=None, env=None, **kwargs):
+        from .deployer import Deployer
+
+        self._dir, flow_file = materialize_flow(flow_cls, prelude)
+        self._deployer = Deployer(flow_file, env=env, **kwargs)
+
+    def argo_workflows(self, **kwargs):
+        return self._deployer.argo_workflows(**kwargs)
 
     def cleanup(self):
         import shutil

@@ -1,0 +1,256 @@
+"""Async subprocess supervision for the programmatic API.
+
+Reference behavior: metaflow/runner/subprocess_manager.py — every Runner
+subprocess is owned by a manager that can await it with a timeout, stream
+its logs live, and kill it with TERM→KILL escalation; logs always land in
+files so they survive the process and can be tailed after the fact.
+
+Implementation: asyncio (create_subprocess_exec) on a dedicated daemon
+event-loop thread, so both `async` callers and plain synchronous code get
+the same supervision. Log files live under a per-command temp dir.
+"""
+
+import asyncio
+import os
+import shutil
+import signal
+import tempfile
+import threading
+import time
+
+
+class _LoopThread(object):
+    """A single background asyncio loop shared by all managers in-process."""
+
+    _lock = threading.Lock()
+    _instance = None
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self.loop.run_forever, name="tpuflow-subproc", daemon=True
+        )
+        self.thread.start()
+
+    @classmethod
+    def get(cls):
+        with cls._lock:
+            if cls._instance is None or not cls._instance.thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def submit(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout=timeout)
+
+
+class CommandManager(object):
+    """One supervised command: spawn, wait, stream logs, kill."""
+
+    def __init__(self, command, env=None, cwd=None):
+        self.command = [str(c) for c in command]
+        self.env = env
+        self.cwd = cwd
+        self.process = None
+        self.returncode = None
+        self.timeout_expired = False
+        self.log_dir = tempfile.mkdtemp(prefix="tpuflow_cmd_")
+        self.log_files = {
+            "stdout": os.path.join(self.log_dir, "stdout.log"),
+            "stderr": os.path.join(self.log_dir, "stderr.log"),
+        }
+        self._pumps = []
+
+    # -- async core ---------------------------------------------------------
+
+    async def start(self):
+        if self.process is not None:
+            raise RuntimeError("command already started")
+        self.process = await asyncio.create_subprocess_exec(
+            *self.command,
+            env=self.env,
+            cwd=self.cwd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+            start_new_session=True,  # own process group: kill() reaps children
+        )
+        for name in ("stdout", "stderr"):
+            self._pumps.append(
+                asyncio.ensure_future(self._pump(name))
+            )
+        return self.process.pid
+
+    async def _pump(self, name):
+        stream = getattr(self.process, name)
+        with open(self.log_files[name], "ab", buffering=0) as sink:
+            while True:
+                chunk = await stream.read(64 * 1024)
+                if not chunk:
+                    break
+                sink.write(chunk)
+
+    async def wait_async(self, timeout=None):
+        """Wait for exit; on timeout, kill (TERM→KILL) and mark expired."""
+        try:
+            await asyncio.wait_for(self.process.wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            self.timeout_expired = True
+            await self.kill_async()
+        await asyncio.gather(*self._pumps, return_exceptions=True)
+        self.returncode = self.process.returncode
+        return self.returncode
+
+    async def kill_async(self, termination_timeout=5):
+        """SIGTERM the process group; escalate to SIGKILL after the grace."""
+        if self.process is None or self.process.returncode is not None:
+            return
+        try:
+            os.killpg(os.getpgid(self.process.pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        try:
+            await asyncio.wait_for(
+                self.process.wait(), timeout=termination_timeout
+            )
+        except asyncio.TimeoutError:
+            try:
+                os.killpg(os.getpgid(self.process.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            await self.process.wait()
+
+    async def stream_log_async(self, name="stdout", poll=0.1):
+        """Async-iterate log lines live until the process exits and the
+        file is fully drained (including a final unterminated line)."""
+        path = self.log_files[name]
+        pos = 0
+
+        def read_from(pos, final):
+            lines = []
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    for line in f:
+                        if line.endswith(b"\n") or final:
+                            pos += len(line)
+                            lines.append(
+                                line.decode("utf-8", errors="replace")
+                            )
+                        else:
+                            break  # partial line; re-read next poll
+            return pos, lines
+
+        while True:
+            running = (
+                self.process is not None
+                and self.process.returncode is None
+            )
+            pos, lines = read_from(pos, final=False)
+            for line in lines:
+                yield line
+            if not running:
+                break
+            await asyncio.sleep(poll)
+        # the child exited, but the pump tasks may still be flushing the
+        # last pipe chunks into the file — wait for them, then drain fully
+        if self._pumps:
+            await asyncio.gather(*self._pumps, return_exceptions=True)
+        _pos, lines = read_from(pos, final=True)
+        for line in lines:
+            yield line
+
+    # -- sync facade --------------------------------------------------------
+
+    def run(self, timeout=None):
+        """Start + wait synchronously; returns the exit code."""
+        loop = _LoopThread.get()
+        loop.submit(self.start())
+        return loop.submit(self.wait_async(timeout=timeout))
+
+    def spawn(self):
+        """Start without waiting; returns the pid."""
+        return _LoopThread.get().submit(self.start())
+
+    def wait_future(self, timeout=None):
+        """Begin waiting (with timeout-kill semantics) without blocking;
+        returns a concurrent.futures.Future of the exit code — lets a
+        caller stream logs while the deadline is enforced."""
+        loop = _LoopThread.get()
+        return asyncio.run_coroutine_threadsafe(
+            self.wait_async(timeout=timeout), loop.loop
+        )
+
+    def wait(self, timeout=None):
+        # wait_async owns timeout handling (incl. kill); no outer deadline
+        return _LoopThread.get().submit(
+            self.wait_async(timeout=timeout)
+        )
+
+    def kill(self, termination_timeout=5):
+        return _LoopThread.get().submit(
+            self.kill_async(termination_timeout=termination_timeout)
+        )
+
+    def stream_log(self, name="stdout", poll=0.1):
+        """Synchronous generator over live log lines."""
+        agen = self.stream_log_async(name, poll=poll)
+        loop = _LoopThread.get()
+        while True:
+            try:
+                yield loop.submit(agen.__anext__())
+            except StopAsyncIteration:
+                return
+
+    def log_contents(self, name="stdout"):
+        path = self.log_files[name]
+        if not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode("utf-8", errors="replace")
+
+    @property
+    def running(self):
+        return (
+            self.process is not None and self.process.returncode is None
+        )
+
+    def cleanup(self):
+        shutil.rmtree(self.log_dir, ignore_errors=True)
+
+
+class SubprocessManager(object):
+    """Owns a set of CommandManagers; kills them all on exit/cleanup."""
+
+    def __init__(self):
+        self.commands = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.cleanup()
+        return False
+
+    def run_command(self, command, env=None, cwd=None, timeout=None):
+        cm = self.spawn_command(command, env=env, cwd=cwd)
+        cm.wait(timeout=timeout)
+        return cm
+
+    def spawn_command(self, command, env=None, cwd=None):
+        cm = CommandManager(command, env=env, cwd=cwd)
+        pid = cm.spawn()
+        self.commands[pid] = cm
+        return cm
+
+    def get(self, pid):
+        return self.commands.get(pid)
+
+    def cleanup(self, kill_running=True):
+        for cm in list(self.commands.values()):
+            if kill_running and cm.running:
+                try:
+                    cm.kill()
+                except Exception:
+                    pass
+            cm.cleanup()
+        self.commands.clear()
