@@ -54,14 +54,12 @@ def reference_attention(q, k, v, causal=True, scale=None):
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
-                      block_k, seq_len):
-    # blocks carry a leading size-1 (batch*head) dim:
-    # q_ref: [1, BLOCK_Q, D]; k_ref/v_ref: [1, S, D]
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    block_q, D = q.shape
-
+def _online_softmax_loop(q_scaled, k_ref, v_ref, qi, causal, block_k,
+                         seq_len):
+    """The flash online-softmax inner loop shared by the normalized
+    (single-device) and unnormalized (ring block) forward kernels.
+    q_scaled: [block_q, D] f32 already scaled. Returns (m, l, acc)."""
+    block_q, D = q_scaled.shape
     m = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((block_q, 1), dtype=jnp.float32)
     acc = jnp.zeros((block_q, D), dtype=jnp.float32)
@@ -76,7 +74,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         m, l, acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = jnp.dot(q_scaled, k.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0
@@ -94,7 +92,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
         )
         return m_new, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, (m, l, acc))
+    return jax.lax.fori_loop(0, num_kb_live, body, (m, l, acc))
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+                      block_k, seq_len):
+    # blocks carry a leading size-1 (batch*head) dim:
+    # q_ref: [1, BLOCK_Q, D]; k_ref/v_ref: [1, S, D]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q = q.shape[0]
+    m, l, acc = _online_softmax_loop(q, k_ref, v_ref, qi, causal, block_k,
+                                     seq_len)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # lse layout is [1, 8, S]: sublane dim padded to the fp32 tile minimum,
     # each q-block program writes its sequence slice (row 0 is the payload)
@@ -246,64 +255,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_backward_pallas(q, k, v, g, out, lse, causal, scale, interpret):
-    """Pallas backward: dq grid over q blocks, dk/dv grid over k blocks."""
-    BH, S, D = q.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
+    """Pallas backward via the shared blockwise kernels (flash_block_bwd):
+    dq grid over q blocks, dk/dv grid over k blocks."""
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [BH, S]
-    # pad stats to the fp32 (8, 128) tile shape: [BH, 8, S], row 0 is live
-    lse_t = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
-    delta_t = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
-
-    stats_spec = pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0))
-    full_spec = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
-
-    dq = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dq_kernel, causal=causal, scale=scale,
-            block_k=block_k, seq_len=S,
-        ),
-        grid=(BH, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
-            full_spec,                                              # k
-            full_spec,                                              # v
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # g
-            stats_spec,                                             # lse
-            stats_spec,                                             # delta
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        interpret=interpret,
-    )(q, k, v, g, lse_t, delta_t)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _flash_bwd_dkv_kernel, causal=causal, scale=scale,
-            block_q=block_q, seq_len=S,
-        ),
-        grid=(BH, S // block_k),
-        in_specs=[
-            full_spec,                                              # q
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # k
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),  # v
-            full_spec,                                              # g
-            stats_spec,                                             # lse
-            stats_spec,                                             # delta
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
-        ],
-        interpret=interpret,
-    )(q, k, v, g, lse_t, delta_t)
-    return dq, dk, dv
+    dq, dk, dv = flash_block_bwd(q, k, v, g, lse, delta, scale, causal,
+                                 interpret)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _flash_attention_bwd(causal, scale, interpret, res, g):
@@ -389,6 +348,141 @@ def flash_attention(q, k, v, causal=True, scale=None, interpret=False):
     qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
     out = _flash_attention(qf, kf, vf, causal, scale, interpret)
     return _unfold_heads(out, B, H)
+
+
+# ---------------------------------------------------------------------------
+# blockwise building blocks for ring attention (ops/ring_attention.py)
+# ---------------------------------------------------------------------------
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-axes (vma) annotation —
+    required for pallas_call outputs under shard_map with check_vma."""
+    try:
+        vma = jax.typeof(like).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_block_fwd_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
+                            causal, scale, block_k, seq_len):
+    """Flash forward WITHOUT final normalization, emitting the online-softmax
+    stats (m, l) — the ring combiner merges contributions across ring hops.
+    causal=True means the same-offset diagonal mask (q and k blocks are the
+    same sequence shard); causal=False means every k position contributes."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q = q.shape[0]
+    m, l, acc = _online_softmax_loop(q, k_ref, v_ref, qi, causal, block_k,
+                                     seq_len)
+    acc_ref[0] = acc
+    m_ref[0, :, pl.ds(qi * block_q, block_q)] = jnp.broadcast_to(
+        m.reshape(1, -1), (8, block_q)
+    )
+    l_ref[0, :, pl.ds(qi * block_q, block_q)] = jnp.broadcast_to(
+        l.reshape(1, -1), (8, block_q)
+    )
+
+
+def flash_block_fwd(q, k, v, scale, causal_diag, interpret=False):
+    """One ring step's unnormalized contribution.
+
+    q, k, v: [BH, S, D] (heads folded). Returns (acc f32 [BH,S,D],
+    m f32 [BH,S], l f32 [BH,S])."""
+    BH, S, D = q.shape
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    acc, m, l = pl.pallas_call(
+        functools.partial(
+            _flash_block_fwd_kernel,
+            causal=causal_diag,
+            scale=scale,
+            block_k=block_k,
+            seq_len=S,
+        ),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            _sds((BH, S, D), jnp.float32, q),
+            _sds((BH, 8, S), jnp.float32, q),
+            _sds((BH, 8, S), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return acc, m[:, 0, :], l[:, 0, :]
+
+
+def flash_block_bwd(q, k, v, g, lse, delta, scale, causal_diag,
+                    interpret=False):
+    """One ring step's gradient contribution given the GLOBAL lse/delta.
+
+    Same kernels as the single-device flash backward — the global stats make
+    each blockwise p exact, so contributions just sum across ring hops.
+    Returns (dq, dk, dv) in f32, shapes [BH, S, D]."""
+    BH, S, D = q.shape
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    lse_t = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
+    delta_t = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
+    stats_spec = pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0))
+    full_spec = pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal_diag, scale=scale,
+            block_k=block_k, seq_len=S,
+        ),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            full_spec,
+            full_spec,
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            stats_spec,
+            stats_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=_sds((BH, S, D), jnp.float32, q),
+        interpret=interpret,
+    )(q, k, v, g, lse_t, delta_t)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, causal=causal_diag, scale=scale,
+            block_q=block_q, seq_len=S,
+        ),
+        grid=(BH, S // block_k),
+        in_specs=[
+            full_spec,
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            full_spec,
+            stats_spec,
+            stats_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            _sds((BH, S, D), jnp.float32, q),
+            _sds((BH, S, D), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse_t, delta_t)
+    return dq, dk, dv
 
 
 def attention(q, k, v, causal=True, scale=None, impl="auto"):

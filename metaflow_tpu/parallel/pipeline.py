@@ -118,3 +118,162 @@ def pipelined_forward(model_layer_fn, params_layers, x, mesh,
     return pipeline_apply(
         model_layer_fn, params_layers, x, mesh, num_microbatches, axis_name
     )
+
+
+def pipeline_train_1f1b(layer_fn, loss_fn, stage_params, x, y, mesh,
+                        num_microbatches, axis_name="pipeline"):
+    """1F1B training schedule: loss + per-stage parameter gradients.
+
+    Unlike differentiating through the GPipe loop (which holds every
+    microbatch's activations until the flush), the one-forward-one-backward
+    schedule starts each microbatch's backward as soon as the last stage
+    finishes its forward, so live activation memory is bounded by the
+    pipeline DEPTH (≈2S in-flight stage inputs), independent of the
+    microbatch count M. Backward recomputes the stage forward from the
+    saved stage input (activation checkpointing), the standard
+    remat-in-pipeline trade.
+
+    Lockstep formulation (one SPMD program): each cycle c has an F slot and
+    a B slot. Stage i forwards microbatch c-i and backwards microbatch
+    c-(2S-2-i); activations hop i→i+1 and cotangents hop i→i-1 via
+    lax.ppermute each cycle. Total cycles M + 2(S-1); bubble matches
+    non-interleaved 1F1B.
+
+    layer_fn: (carry, layer_params) -> carry (scanned over the stage's
+        local layers).
+    loss_fn: (stage_output, targets) -> scalar mean loss (applied by the
+        last stage per microbatch).
+    stage_params: pytree, leaves stacked [n_layers, ...], sharded on
+        `axis_name`.
+    x: [B, ...] inputs, y: [B, ...] targets, both replicated over the
+        pipeline axis; B % num_microbatches == 0.
+    Returns (mean_loss, param_grads) with param_grads sharded like
+    stage_params.
+    """
+    n_stages = dict(mesh.shape).get(axis_name, 1)
+    M = num_microbatches
+    if M < 1:
+        raise ValueError("num_microbatches must be >= 1")
+
+    if n_stages == 1:
+        # degenerate pipeline: plain microbatched loss/grad, no collectives
+        # (size-1 mesh axes are dropped by MeshSpec)
+        def full_loss(params):
+            mbs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            ybs = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+
+            def body(acc, mb_yb):
+                mb, yb = mb_yb
+                out, _ = jax.lax.scan(
+                    lambda c, lp: (layer_fn(c, lp), None), mb, params
+                )
+                return acc + loss_fn(out.astype(jnp.float32), yb), None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                    (mbs, ybs))
+            return total / M
+
+        return jax.value_and_grad(full_loss)(stage_params)
+
+    def local(x_local, y_local, params_local):
+        stage = jax.lax.axis_index(axis_name)
+        S = n_stages
+        B = x_local.shape[0]
+        mb_size = B // M
+        mbs = x_local.reshape((M, mb_size) + x_local.shape[1:])
+        ybs = y_local.reshape((M, mb_size) + y_local.shape[1:])
+
+        def run_stage(act, params):
+            out, _ = jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp), None), act, params
+            )
+            return out
+
+        L = min(M, 2 * (S - 1) + 1) if S > 1 else 1  # live-input slots
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+        perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def var(z):
+            # mark as varying over the pipeline axis; no-op if already so
+            # (zeros_like(params) inherits the params' annotation)
+            try:
+                if axis_name in jax.typeof(z).vma:
+                    return z
+            except (AttributeError, TypeError):
+                pass
+            return jax.lax.pcast(z, (axis_name,), to="varying")
+
+        act_shape = (mb_size,) + x_local.shape[1:]
+        state = dict(
+            saved=var(jnp.zeros((L,) + act_shape, x_local.dtype)),
+            fwd_buf=var(jnp.zeros(act_shape, x_local.dtype)),
+            grad_buf=var(jnp.zeros(act_shape, jnp.float32)),
+            pgrads=jax.tree.map(
+                lambda p: var(jnp.zeros_like(p, jnp.float32)), params_local
+            ),
+            loss=var(jnp.zeros((), jnp.float32)),
+        )
+
+        def cycle(c, state):
+            # ---- F slot: stage forwards microbatch c - stage ----
+            m_f = c - stage
+            f_active = jnp.logical_and(m_f >= 0, m_f < M)
+            m_f_idx = jnp.clip(m_f, 0, M - 1)
+            a_in = jnp.where(stage == 0, mbs[m_f_idx], state["fwd_buf"])
+            slot = jnp.mod(m_f_idx, L)
+            saved = jnp.where(
+                f_active,
+                state["saved"].at[slot].set(a_in),
+                state["saved"],
+            )
+            a_out = run_stage(a_in, params_local)
+            fwd_buf = jax.lax.ppermute(a_out, axis_name, perm_fwd)
+
+            # ---- B slot: stage backwards microbatch c - (2S-2-stage) ----
+            m_b = c - (2 * S - 2 - stage)
+            b_active = jnp.logical_and(m_b >= 0, m_b < M)
+            m_b_idx = jnp.clip(m_b, 0, M - 1)
+            a_saved = saved[jnp.mod(m_b_idx, L)]
+            out, pullback = jax.vjp(
+                lambda a, p: run_stage(a, p), a_saved, params_local
+            )
+            # cotangent source: the last stage seeds from the loss, every
+            # other stage consumes the cotangent arriving from stage+1
+            loss_val, dloss_dout = jax.value_and_grad(loss_fn)(
+                out.astype(jnp.float32), ybs[m_b_idx]
+            )
+            cot = jnp.where(
+                stage == S - 1,
+                dloss_dout.astype(out.dtype),
+                state["grad_buf"].astype(out.dtype),
+            )
+            da, dp = pullback(cot)
+            pgrads = jax.tree.map(
+                lambda acc, g: acc
+                + jnp.where(b_active, g.astype(jnp.float32), 0.0),
+                state["pgrads"],
+                dp,
+            )
+            loss = state["loss"] + jnp.where(
+                jnp.logical_and(b_active, stage == S - 1), loss_val, 0.0
+            )
+            grad_buf = jax.lax.ppermute(
+                da.astype(jnp.float32), axis_name, perm_bwd
+            )
+            return dict(saved=saved, fwd_buf=fwd_buf, grad_buf=grad_buf,
+                        pgrads=pgrads, loss=loss)
+
+        n_cycles = M + 2 * (S - 1)
+        state = jax.lax.fori_loop(0, n_cycles, cycle, state)
+        # only the last stage accumulated loss; share it with every stage
+        mean_loss = jax.lax.psum(state["loss"], axis_name) / M
+        pgrads = jax.tree.map(lambda g: g / M, state["pgrads"])
+        return mean_loss, pgrads
+
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = _shard_map(
+        local, mesh,
+        in_specs=(P(), P(), param_specs),
+        out_specs=(P(), param_specs),
+    )
+    return fn(x, y, stage_params)

@@ -129,18 +129,17 @@ class Runner(object):
             stderr = cm.log_contents("stderr")
             if self.show_output:
                 sys.stderr.write(stderr)
+            self._manager.commands.pop(cm.process.pid, None)
+            cm.cleanup()
             if cm.timeout_expired:
                 raise TpuFlowException(
                     "Command timed out after %ss: %s"
                     % (timeout, " ".join(argv))
                 )
-            result = ExecutingRun(
+            return ExecutingRun(
                 argv, cm.returncode, self._attach_run(run_id_file),
                 stdout, stderr,
             )
-            self._manager.commands.pop(cm.process.pid, None)
-            cm.cleanup()
-            return result
 
     def _flow_name(self):
         # the flow name is the FlowSpec subclass name in the file
@@ -197,8 +196,21 @@ class AsyncRun(object):
 
     @property
     def proc(self):
-        # back-compat surface: .poll() / .pid work against the supervisor
-        return self._cm.process
+        # back-compat shim over the asyncio Process: Popen-style
+        # .pid/.returncode/.poll() (asyncio's Process has no poll())
+        cm = self._cm
+
+        class _ProcShim(object):
+            pid = cm.process.pid if cm.process else None
+
+            @property
+            def returncode(self):
+                return cm.process.returncode if cm.process else None
+
+            def poll(self):
+                return self.returncode
+
+        return _ProcShim()
 
     @property
     def run_id(self):

@@ -38,27 +38,31 @@ def load_flow_instance(flow_file):
     sys.modules[modname] = module
     try:
         spec.loader.exec_module(module)
-    except BaseException:
+        candidates = [
+            obj
+            for obj in vars(module).values()
+            if isinstance(obj, type)
+            and issubclass(obj, FlowSpec)
+            and obj is not FlowSpec
+            and obj.__module__ == modname
+        ]
+        if not candidates:
+            raise TpuFlowException(
+                "No FlowSpec subclass found in %s" % flow_file
+            )
+        if len(candidates) > 1:
+            raise TpuFlowException(
+                "Multiple FlowSpec subclasses in %s: %s"
+                % (flow_file, ", ".join(c.__name__ for c in candidates))
+            )
+        # instantiate while still registered: graph building inspects the
+        # class source, which resolves through sys.modules
+        return candidates[0](use_cli=False)
+    finally:
+        # reflection only needs the built flow object; leaving the uuid
+        # name in sys.modules would leak one flow module per Runner for
+        # the life of the process
         sys.modules.pop(modname, None)
-        raise
-    candidates = [
-        obj
-        for obj in vars(module).values()
-        if isinstance(obj, type)
-        and issubclass(obj, FlowSpec)
-        and obj is not FlowSpec
-        and obj.__module__ == modname
-    ]
-    if not candidates:
-        raise TpuFlowException(
-            "No FlowSpec subclass found in %s" % flow_file
-        )
-    if len(candidates) > 1:
-        raise TpuFlowException(
-            "Multiple FlowSpec subclasses in %s: %s"
-            % (flow_file, ", ".join(c.__name__ for c in candidates))
-        )
-    return candidates[0](use_cli=False)
 
 
 class _ParamSpec(object):

@@ -70,6 +70,64 @@ def test_pipeline_matches_sequential(num_microbatches):
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+@pytest.mark.parametrize("num_microbatches", [2, 4, 8])
+def test_pipeline_1f1b_loss_and_grads_match(num_microbatches):
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from metaflow_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    mesh = create_mesh(MeshSpec({"pipeline": 4}), n_devices=4)
+    n_layers, F, B = 8, 16, 8
+    Ws = jax.random.normal(jax.random.PRNGKey(0), (n_layers, F, F)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, F))
+    y = jax.random.normal(jax.random.PRNGKey(2), (B, F))
+    layer = lambda h, W: jnp.tanh(h @ W)
+    loss_fn = lambda out, tgt: jnp.mean((out - tgt) ** 2)
+
+    def ref_loss(Ws):
+        h = x
+        for i in range(n_layers):
+            h = layer(h, Ws[i])
+        return loss_fn(h, y)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(Ws)
+
+    Ws_sharded = jax.device_put(Ws, NamedSharding(mesh, P("pipeline")))
+    loss, grads = pipeline_train_1f1b(
+        layer, loss_fn, Ws_sharded, x, y, mesh,
+        num_microbatches=num_microbatches,
+    )
+    np.testing.assert_allclose(loss, ref_l, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), ref_g, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_pipeline_1f1b_single_stage_degenerate():
+    import jax.numpy as jnp
+    from metaflow_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    mesh = create_mesh(MeshSpec({"pipeline": 1}), n_devices=1)
+    Ws = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    y = jax.random.normal(jax.random.PRNGKey(2), (4, 8))
+    layer = lambda h, W: jnp.tanh(h @ W)
+    loss_fn = lambda out, tgt: jnp.mean((out - tgt) ** 2)
+
+    def ref_loss(Ws):
+        h = x
+        for i in range(2):
+            h = layer(h, Ws[i])
+        return loss_fn(h, y)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(Ws)
+    loss, grads = pipeline_train_1f1b(
+        layer, loss_fn, Ws, x, y, mesh, num_microbatches=2
+    )
+    np.testing.assert_allclose(loss, ref_l, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), ref_g, atol=1e-5,
+                               rtol=1e-4)
+
+
 def test_tree_shardings_places_params():
     mesh = create_mesh(MeshSpec.fsdp())
     log = {"w": ("embed", "mlp"), "b": ("embed",)}
