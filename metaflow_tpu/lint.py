@@ -1,8 +1,19 @@
 """Static graph checks run before every execution.
 
-Reference behavior: metaflow/lint.py (22 checks, lint.py:50-505). Checks are
+Reference behavior: metaflow/lint.py (21 checks, lint.py:50-530). Checks are
 registered on a FlowLinter and run in order; each raises LintWarn with the
 user's source line when violated.
+
+Reference checks deliberately absent here:
+- check_nested_foreach: nested foreaches are a SUPPORTED feature of this
+  framework (tests/flows/nested_foreach_flow.py), not an error.
+- check_annotation_name_conflict: @step(start=True) aliases don't exist
+  here; start/end are identified by name only.
+- check_parallel_step_after_next / check_parallel_foreach_calls_parallel
+  _step: impossible by construction — graph.py infers parallel_step from
+  the num_parallel transition and the CLI auto-attaches the gang decorator,
+  so the two can never disagree (the remaining structural rule lives in
+  check_parallel_rules).
 """
 
 from .exception import TpuFlowException
@@ -59,6 +70,22 @@ def check_basic_steps(graph):
             raise LintWarn(
                 "Add %s step in your flow: a flow must have a step named "
                 "*%s* decorated with @step." % (prefix, prefix)
+            )
+
+
+@linter.check
+def check_start_end_degree(graph):
+    """start takes no incoming transitions; end emits none (reference:
+    lint.py check_start_end_degree). Recursion via switch may target any
+    step EXCEPT start — re-running start would re-resolve parameters."""
+    if "start" in graph:
+        incoming = [n.name for n in graph if "start" in n.out_funcs]
+        if incoming:
+            _err(
+                "The *start* step has incoming transitions from %s. A start "
+                "step must have no incoming transitions."
+                % ", ".join(sorted(incoming)),
+                graph["start"],
             )
 
 
@@ -294,6 +321,28 @@ def check_switch_rules(graph):
                     % node.name,
                     node,
                 )
+
+
+@linter.check
+def check_ambiguous_joins(graph):
+    """A switch branch must not lead straight into a join (reference:
+    lint.py check_ambiguous_joins:505): the join's input arity would depend
+    on the condition. An unconditional step must sit on that path."""
+    for node in graph:
+        if node.type != "join":
+            continue
+        switch_parents = [
+            p for p in node.in_funcs
+            if p in graph and graph[p].type == "split-switch"
+        ]
+        if switch_parents:
+            _err(
+                "A conditional (switch) path cannot lead directly to the "
+                "join step *%s* (from %s). Add an intermediate step on that "
+                "branch before joining."
+                % (node.name, ", ".join(sorted(switch_parents))),
+                node,
+            )
 
 
 @linter.check

@@ -17,13 +17,14 @@ wrapper + save_logs (metaflow/metaflow_environment.py:192,
 metaflow/mflog/save_logs.py), as one supervising process instead of shell
 redirection.
 
-Flush cadence: logs are (re)persisted every FLUSH_SECS while the child runs,
-with the reference's sigmoid-style backoff idea simplified to a linear ramp
-(frequent early, settling at 30s) so a killed pod loses at most the last
-window of output (ref: metaflow/mflog/__init__.py:69-81).
+Flush cadence: logs are (re)persisted on a sigmoid schedule over task age —
+sub-second-ish early (when a user is most likely watching a fresh task) and
+settling at 30s for long runs, so a killed pod loses at most the last window
+of output (ref: metaflow/mflog/__init__.py:69-81 uses the same curve shape).
 """
 
 import argparse
+import math
 import os
 import selectors
 import subprocess
@@ -36,11 +37,16 @@ from .datastore.storage import STORAGE_BACKENDS
 
 MIN_FLUSH_SECS = 1.0
 MAX_FLUSH_SECS = 30.0
+# sigmoid midpoint/steepness: ~MIN for the first few minutes, ~half-range
+# at 10 minutes, ~MAX from 20 minutes on
+_HALFWAY_SECS = 600.0
+_RAMP_SECS = 150.0
 
 
-def _flush_delay(uploads_done):
-    """Start at 1s, ramp to 30s by the 10th upload."""
-    return min(MAX_FLUSH_SECS, MIN_FLUSH_SECS + 3.0 * uploads_done)
+def _flush_delay(secs_since_start):
+    s = 1.0 / (1.0 + math.exp((_HALFWAY_SECS - secs_since_start)
+                              / _RAMP_SECS))
+    return MIN_FLUSH_SECS + s * (MAX_FLUSH_SECS - MIN_FLUSH_SECS)
 
 
 def capture(args, child_argv):
@@ -98,15 +104,15 @@ def capture(args, child_argv):
         except Exception as ex:  # a failed upload must not kill the task
             sys.stderr.write("mflog_capture: log upload failed: %s\n" % ex)
 
-    uploads = 0
-    next_flush = time.time() + _flush_delay(0)
+    start = time.time()
+    next_flush = start + _flush_delay(0)
     while open_streams:
         for key, _ in sel.select(timeout=1.0):
             drain(key.fileobj, key.data)
-        if time.time() >= next_flush:
+        now = time.time()
+        if now >= next_flush:
             persist()
-            uploads += 1
-            next_flush = time.time() + _flush_delay(uploads)
+            next_flush = now + _flush_delay(now - start)
     rc = proc.wait()
     for name in partial:
         if partial[name]:

@@ -313,9 +313,9 @@ def ring_attention_sharded(mesh, axis_name="sequence", causal=True,
     over `axis_name` (batch over data axes when present).
 
     impl: 'auto' | 'flash' | 'flash_interpret' | 'xla' (or env
-    TPUFLOW_RING_IMPL). 'flash' needs the per-device sequence shard to be a
-    multiple of the %d pallas block.
-    """ % BLOCK_Q
+    TPUFLOW_RING_IMPL). 'flash' needs the per-device sequence shard to be
+    a multiple of the pallas block size (BLOCK_Q, 128).
+    """
     try:
         from jax import shard_map
     except ImportError:  # older jax

@@ -1,12 +1,22 @@
-"""@card: per-task HTML report.
+"""@card: per-task HTML report, with realtime refresh during the task.
 
 Reference behavior: metaflow/plugins/cards/card_decorator.py:45 +
-card_datastore.py. User code appends components via `current.card`; at
-task_finished the card renders to a self-contained HTML file in the
-datastore under <flow>/mf.cards/<run>/<step>/<task>/<type>.html. The default
-card always includes task info + user artifacts.
+card_datastore.py + card_creator.py. User code appends components via
+`current.card`; at task_finished the card renders to a self-contained HTML
+file in the datastore under <flow>/mf.cards/<run>/<step>/<task>/<type>.html.
+The default card always includes task info + user artifacts.
+
+Realtime: `current.card.refresh()` marks the card dirty; a background
+renderer thread re-renders and persists it on a throttle, so a browser
+pointed at the card (via `card server`) watches it update live — the
+mid-task renders carry a meta-refresh tag, the final render does not.
+The reference runs an async render SUBPROCESS (card_creator.py) because
+its renders can be heavy JS bundles; here a daemon thread suffices — the
+HTML render is cheap and the storage put is the only latency, which must
+not block user code either way.
 """
 
+import threading
 import time
 
 from ...current import current
@@ -19,12 +29,58 @@ from .components import (
     render_page,
 )
 
+REFRESH_MIN_INTERVAL = 1.0  # throttle for realtime re-renders
+LIVE_RELOAD_SECS = 2  # meta-refresh cadence embedded in mid-task renders
+
+
+class _AsyncRenderer(threading.Thread):
+    """Daemon thread: re-renders the card whenever marked dirty, at most
+    once per REFRESH_MIN_INTERVAL (reference: card_creator.py's async
+    render process)."""
+
+    def __init__(self, render_fn):
+        super().__init__(name="tpuflow-card-render", daemon=True)
+        self._render_fn = render_fn
+        self._dirty = threading.Event()
+        self._stopped = threading.Event()
+        # serializes live renders against the final render so a slow
+        # in-flight live save can never clobber the finished card
+        self.render_lock = threading.Lock()
+
+    def run(self):
+        last = 0.0
+        while not self._stopped.is_set():
+            self._dirty.wait(timeout=0.2)
+            if not self._dirty.is_set():
+                continue
+            wait = REFRESH_MIN_INTERVAL - (time.time() - last)
+            if wait > 0:
+                if self._stopped.wait(timeout=wait):
+                    break
+            self._dirty.clear()
+            try:
+                with self.render_lock:
+                    if self._stopped.is_set():
+                        break  # final render owns the card from here
+                    self._render_fn()
+            except Exception:
+                pass  # a card failure must never fail the task
+            last = time.time()
+
+    def mark(self):
+        self._dirty.set()
+
+    def stop(self):
+        self._stopped.set()
+        self._dirty.set()
+
 
 class CardCollector(object):
-    """`current.card`: list-like component collector."""
+    """`current.card`: list-like component collector with live refresh."""
 
-    def __init__(self):
+    def __init__(self, renderer=None):
         self._components = []
+        self._renderer = renderer
 
     def append(self, component):
         if not isinstance(component, CardComponent):
@@ -37,6 +93,13 @@ class CardCollector(object):
 
     def clear(self):
         self._components = []
+
+    def refresh(self):
+        """Re-render and persist the card now-ish (throttled, async): a
+        training loop can call this every step and a browser on the card
+        server watches the card update live."""
+        if self._renderer is not None:
+            self._renderer.mark()
 
     def __iter__(self):
         return iter(self._components)
@@ -68,40 +131,77 @@ class CardDecorator(StepDecorator):
         self._step_name = step_name
         self._task_id = task_id
         self._start = time.time()
-        self._collector = CardCollector()
+        self._flow = flow
+        self._retry_count = retry_count
+        self._renderer = _AsyncRenderer(
+            lambda: self._render(flow, None, retry_count, live=True)
+        )
+        self._collector = CardCollector(renderer=self._renderer)
+        self._renderer.start()
         current._update_env({"card": self._collector})
 
     def task_finished(self, step_name, flow, graph, is_task_ok, retry_count,
                       max_user_code_retries):
         try:
-            self._render(flow, is_task_ok, retry_count)
+            self._renderer.stop()
+            # taking the lock waits out any in-flight live save, and the
+            # stopped flag keeps new ones from starting — the final render
+            # is guaranteed to be the last write
+            with self._renderer.render_lock:
+                self._render(flow, is_task_ok, retry_count)
+            self._renderer.join(timeout=5)
         except Exception:
             # a card failure must never fail the task
             pass
 
-    def _render(self, flow, is_task_ok, retry_count):
+    def task_exception(self, exception, step_name, flow, graph, retry_count,
+                       max_user_code_retries):
+        # stop the realtime thread even on failure; the final render comes
+        # from task_finished with is_task_ok=False
+        try:
+            self._renderer.stop()
+        except Exception:
+            pass
+
+    def _render(self, flow, is_task_ok, retry_count, live=False):
         fds = self._task_datastore._flow_datastore
         pathspec = "%s/%s/%s/%s" % (
             fds.flow_name, self._run_id, self._step_name, self._task_id,
         )
+        if live:
+            status = "running"
+        else:
+            status = "ok" if is_task_ok else "failed"
         components = [
             Markdown("# %s" % pathspec),
             Table.from_dict({
-                "status": "ok" if is_task_ok else "failed",
+                "status": status,
                 "attempt": retry_count,
                 "duration_s": round(time.time() - self._start, 2),
-                "finished_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                ("updated_at" if live else "finished_at"):
+                    time.strftime("%Y-%m-%d %H:%M:%S"),
             }),
         ]
         components.extend(self._collector)
-        artifacts = {
-            k: v for k, v in flow.__dict__.items()
-            if not k.startswith("_") and k not in ("name",)
-        }
+        # the live renderer races user code assigning artifacts; snapshot
+        # with retries rather than dying on 'dict changed size'
+        artifacts = {}
+        for _attempt in range(3):
+            try:
+                artifacts = {
+                    k: v for k, v in list(flow.__dict__.items())
+                    if not k.startswith("_") and k not in ("name",)
+                }
+                break
+            except RuntimeError:
+                continue
         if artifacts:
             components.append(Markdown("## Artifacts"))
             components.append(Table.from_dict(artifacts))
-        page = render_page(pathspec, pathspec, components)
+        page = render_page(
+            pathspec, pathspec, components,
+            auto_refresh=LIVE_RELOAD_SECS if live else 0,
+        )
         path = card_path(
             fds.storage, fds.flow_name, self._run_id, self._step_name,
             self._task_id, self.attributes["id"] or self.attributes["type"],

@@ -210,7 +210,18 @@ h1 {{ border-bottom: 2px solid #4a90d9; padding-bottom: 4px; }}
 """
 
 
-def render_page(title, pathspec, components):
+def render_page(title, pathspec, components, auto_refresh=0):
+    """auto_refresh > 0 embeds a meta-refresh (seconds): a card rendered
+    mid-task reloads itself in the browser until the final render (which
+    omits the tag) replaces it."""
     body = "\n".join(c.render() for c in components)
-    return PAGE_TEMPLATE.format(title=html.escape(title), body=body,
+    page = PAGE_TEMPLATE.format(title=html.escape(title), body=body,
                                 pathspec=html.escape(pathspec))
+    if auto_refresh:
+        page = page.replace(
+            "<head>",
+            '<head><meta http-equiv="refresh" content="%d">'
+            % int(auto_refresh),
+            1,
+        )
+    return page

@@ -55,9 +55,12 @@ def load_flow_instance(flow_file):
                 "Multiple FlowSpec subclasses in %s: %s"
                 % (flow_file, ", ".join(c.__name__ for c in candidates))
             )
-        # instantiate while still registered: graph building inspects the
-        # class source, which resolves through sys.modules
-        return candidates[0](use_cli=False)
+        # instantiate AND force the graph build while still registered:
+        # graph construction inspects the class source, which resolves
+        # through sys.modules — after the pop it would raise TypeError
+        flow = candidates[0](use_cli=False)
+        flow._graph  # noqa: B018 — builds + caches the AST graph
+        return flow
     finally:
         # reflection only needs the built flow object; leaving the uuid
         # name in sys.modules would leak one flow module per Runner for

@@ -1,0 +1,49 @@
+import os
+import time
+
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.plugins.cards import Markdown, ProgressBar
+
+import metaflow_tpu
+
+
+class RealtimeCardFlow(FlowSpec):
+    @metaflow_tpu.card
+    @step
+    def start(self):
+        from metaflow_tpu.plugins.cards.card_decorator import card_path
+
+        current.card.append(Markdown("## live training"))
+        bar = ProgressBar(max=3, value=0, label="steps")
+        current.card.append(bar)
+        current.card.refresh()
+
+        # the async renderer should persist a LIVE card while the task runs
+        ds = self._datastore._flow_datastore
+        path = card_path(ds.storage, ds.flow_name, current.run_id,
+                         current.step_name, current.task_id)
+        live_html = None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with ds.storage.load_bytes([path]) as loaded:
+                for _key, local_file, _meta in loaded:
+                    if local_file:
+                        with open(local_file) as f:
+                            live_html = f.read()
+            if live_html:
+                break
+            time.sleep(0.25)
+        assert live_html is not None, "no live card appeared mid-task"
+        self.live_had_refresh_tag = 'http-equiv="refresh"' in live_html
+        self.live_status_running = "running" in live_html
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.live_had_refresh_tag, "mid-task card missing reload tag"
+        assert self.live_status_running, "mid-task card not marked running"
+        print("realtime card ok")
+
+
+if __name__ == "__main__":
+    RealtimeCardFlow()

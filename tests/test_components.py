@@ -182,6 +182,38 @@ class TestDeployerAPI:
 
 
 class TestCliExtras:
+    def test_mflog_flush_cadence_sigmoid(self):
+        from metaflow_tpu.mflog_capture import (
+            MAX_FLUSH_SECS,
+            MIN_FLUSH_SECS,
+            _flush_delay,
+        )
+
+        # frequent early, settled late, monotonic in between
+        assert _flush_delay(0) < MIN_FLUSH_SECS + 1.0
+        assert _flush_delay(3600) > MAX_FLUSH_SECS - 1.0
+        samples = [_flush_delay(t) for t in range(0, 3600, 60)]
+        assert samples == sorted(samples)
+        assert all(MIN_FLUSH_SECS <= s <= MAX_FLUSH_SECS for s in samples)
+
+    def test_realtime_card_refresh(self, run_flow, flows_dir, tpuflow_root):
+        """current.card.refresh() persists a live card mid-task (with the
+        reload tag + running status); the final render drops both."""
+        flow = os.path.join(flows_dir, "realtime_card_flow.py")
+        run_flow(flow, "run")
+        # final card: no meta-refresh, status not 'running'
+        run_id = open(
+            os.path.join(tpuflow_root, "RealtimeCardFlow", "latest_run")
+        ).read().strip()
+        card_file = os.path.join(
+            tpuflow_root, "RealtimeCardFlow", "mf.cards", run_id, "start",
+            "1", "default.html",
+        )
+        final = open(card_file).read()
+        assert 'http-equiv="refresh"' not in final
+        assert ">ok<" in final or "ok" in final
+        assert "running" not in final.split("Artifacts")[0]
+
     def test_card_and_spin_and_tag(self, run_flow, flows_dir, tpuflow_root):
         flow = os.path.join(flows_dir, "card_secrets_flow.py")
         run_flow(flow, "run")

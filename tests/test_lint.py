@@ -159,6 +159,51 @@ def test_gang_must_be_joined():
     assert "join" in msg
 
 
+def test_start_must_have_no_incoming():
+    class BackToStart(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.a)
+
+        @step
+        def a(self):
+            self.next({"again": self.start, "done": self.end},
+                      condition="flag")
+
+        @step
+        def end(self):
+            pass
+
+    assert "incoming" in _lint_error(BackToStart)
+
+
+def test_switch_cannot_feed_join_directly():
+    class SwitchToJoin(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.a, self.b)
+
+        @step
+        def a(self):
+            self.next({"x": self.joiner, "y": self.joiner},
+                      condition="flag")
+
+        @step
+        def b(self):
+            self.next(self.joiner)
+
+        @step
+        def joiner(self, inputs):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    assert "switch" in _lint_error(SwitchToJoin).lower() or \
+        "conditional" in _lint_error(SwitchToJoin).lower()
+
+
 def test_valid_flows_pass():
     class Good(FlowSpec):
         @step
