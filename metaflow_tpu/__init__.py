@@ -18,6 +18,7 @@ from .exception import TpuFlowException, MetaflowException
 from .unbounded_foreach import UnboundedForeachInput
 from .decorators import make_step_decorator, make_flow_decorator
 from .plugins import STEP_DECORATORS, FLOW_DECORATORS
+from .user_decorators import USER_SKIP_STEP, user_step_decorator
 
 # User-facing decorator callables (retry, catch, tpu, ...) resolve lazily
 # through module __getattr__ below, straight from the live registries — so
@@ -119,4 +120,6 @@ __all__ = [
     "default_namespace",
     "Runner",
     "Deployer",
+    "user_step_decorator",
+    "USER_SKIP_STEP",
 ]

@@ -68,6 +68,13 @@ class _AsyncRenderer(threading.Thread):
             last = time.time()
 
     def mark(self):
+        # lazy start: the common non-realtime @card task never pays for the
+        # renderer thread — it spawns on the first refresh()
+        if not self.is_alive() and not self._stopped.is_set():
+            try:
+                self.start()
+            except RuntimeError:
+                pass  # already started concurrently
         self._dirty.set()
 
     def stop(self):
@@ -131,13 +138,10 @@ class CardDecorator(StepDecorator):
         self._step_name = step_name
         self._task_id = task_id
         self._start = time.time()
-        self._flow = flow
-        self._retry_count = retry_count
         self._renderer = _AsyncRenderer(
             lambda: self._render(flow, None, retry_count, live=True)
         )
         self._collector = CardCollector(renderer=self._renderer)
-        self._renderer.start()
         current._update_env({"card": self._collector})
 
     def task_finished(self, step_name, flow, graph, is_task_ok, retry_count,
@@ -149,7 +153,8 @@ class CardDecorator(StepDecorator):
             # is guaranteed to be the last write
             with self._renderer.render_lock:
                 self._render(flow, is_task_ok, retry_count)
-            self._renderer.join(timeout=5)
+            if self._renderer.is_alive():
+                self._renderer.join(timeout=5)
         except Exception:
             # a card failure must never fail the task
             pass

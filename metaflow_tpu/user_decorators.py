@@ -1,0 +1,210 @@
+"""User-defined step decorators: wrap step execution with a generator.
+
+Reference behavior: metaflow/user_decorators/user_step_decorator.py:585 —
+`@user_step_decorator` turns a generator function into a full step
+decorator:
+
+    @user_step_decorator
+    def timing(step_name, flow, inputs):
+        t0 = time.time()
+        yield
+        flow.step_duration = time.time() - t0
+
+    class MyFlow(FlowSpec):
+        @timing
+        @step
+        def start(self):
+            ...
+
+Protocol:
+- code before the yield runs pre-step; code after runs post-step and may
+  read/write artifacts on `flow`;
+- `yield` (None) executes the original step;
+- `yield callable` replaces the step body — the callable receives
+  (flow,) or (flow, inputs) for joins; returning True asks the framework
+  to perform the step's normal static transition afterwards;
+- finishing without yielding (or yielding USER_SKIP_STEP / a dict) SKIPS
+  the step body; the framework performs the step's static transition,
+  with a yielded dict forwarded as self.next(**kwargs) overrides;
+- an exception raised by the step surfaces at the yield point — catching
+  it (not re-raising) marks the step successful.
+
+The generator takes (step_name, flow, inputs) or (step_name, flow,
+inputs, attributes); `attributes` receives kwargs from parameterized use
+(`@timing(tag='x')`). Each user decorator also registers in
+STEP_DECORATORS under the generator function's name, so `--with timing`
+works like any built-in.
+"""
+
+import functools
+import inspect
+
+from .decorators import StepDecorator, make_step_decorator
+from .exception import TpuFlowException
+
+# sentinel: yield this (or any dict) to skip the wrapped step body
+USER_SKIP_STEP = {}
+
+
+class UserStepDecoratorException(TpuFlowException):
+    headline = "User step decorator error"
+
+
+def _default_transition(flow, graph, step_name, next_kwargs=None):
+    """Perform the step's static self.next() on its behalf (skip path)."""
+    node = graph[step_name] if graph and step_name in graph else None
+    if node is None or node.type == "end":
+        return
+    if node.type not in ("linear", "join"):
+        raise UserStepDecoratorException(
+            "A user decorator skipped step *%s*, but its %s transition "
+            "cannot be replayed automatically — only linear transitions "
+            "can be skipped over." % (step_name, node.type)
+        )
+    targets = [getattr(flow, name) for name in node.out_funcs]
+    flow.next(*targets, **(next_kwargs or {}))
+
+
+class UserStepDecoratorBase(StepDecorator):
+    """Base for generator-backed user decorators (subclasses are built by
+    @user_step_decorator; `gen_fn` is the user's generator function)."""
+
+    gen_fn = None
+    defaults = {}
+
+    def __init__(self, attributes=None, statically_defined=False):
+        # unlike built-ins, user decorators accept arbitrary kwargs — they
+        # flow through verbatim as the generator's `attributes` argument
+        self.attributes = dict(attributes or {})
+        self.statically_defined = statically_defined
+
+    def task_decorate(self, step_func, flow, graph, retry_count,
+                      max_user_code_retries, ubf_context):
+        gen_fn = type(self).gen_fn
+        attributes = dict(self.attributes or {})
+        step_name = getattr(step_func, "__name__", None) or getattr(
+            step_func, "name", "?"
+        )
+        wants_attrs = len(inspect.signature(gen_fn).parameters) >= 4
+        if attributes and not wants_attrs:
+            raise UserStepDecoratorException(
+                "@%s was given attributes %r but its generator takes only "
+                "(step_name, flow, inputs) — add a 4th `attributes` "
+                "parameter to receive them."
+                % (type(self).name, sorted(attributes))
+            )
+
+        @functools.wraps(step_func)
+        def wrapped(*call_args):
+            inputs = call_args[0] if call_args else None
+            gen_args = (step_name, flow, inputs)
+            if wants_attrs:
+                gen_args += (attributes,)
+            gen = gen_fn(*gen_args)
+
+            # ---- pre-step: run to the yield ----
+            try:
+                yielded = next(gen)
+            except StopIteration as stop:
+                # never yielded → skip the step body entirely
+                retval = getattr(stop, "value", None)
+                if retval is not None and not isinstance(retval, dict):
+                    raise UserStepDecoratorException(
+                        "User decorator %r skipped the step but returned "
+                        "%r — a skip may only return None or a dict of "
+                        "self.next overrides."
+                        % (getattr(gen_fn, "__name__", gen_fn), retval)
+                    )
+                _default_transition(flow, graph, step_name, retval)
+                return
+
+            if isinstance(yielded, dict):
+                # explicit skip (USER_SKIP_STEP or self.next overrides)
+                _default_transition(flow, graph, step_name, yielded or None)
+                self._finish(gen)
+                return
+
+            body = yielded if callable(yielded) else step_func
+            try:
+                if yielded is not None and callable(yielded):
+                    ret = body(flow, *call_args) \
+                        if call_args else body(flow)
+                    if ret is True:
+                        _default_transition(flow, graph, step_name)
+                else:
+                    body(*call_args)
+            except BaseException as ex:
+                # surface the step's exception at the yield point; the
+                # generator catching it makes the step succeed
+                try:
+                    gen.throw(ex)
+                except StopIteration:
+                    return  # swallowed → success
+                except BaseException:
+                    raise  # re-raised (same exception or a replacement)
+                # generator caught it AND yielded again: not supported
+                raise UserStepDecoratorException(
+                    "User decorator %r yielded more than once."
+                    % getattr(gen_fn, "__name__", gen_fn)
+                )
+            self._finish(gen)
+
+        return wrapped
+
+    @staticmethod
+    def _finish(gen):
+        """Run the post-yield section to completion."""
+        try:
+            next(gen)
+        except StopIteration:
+            return
+        raise UserStepDecoratorException(
+            "A user step decorator generator must yield at most once."
+        )
+
+
+def user_step_decorator(fn=None):
+    """Turn a generator function into a reusable step decorator (see the
+    module docstring for the full protocol)."""
+
+    def build(gen_fn):
+        if not inspect.isgeneratorfunction(gen_fn):
+            raise UserStepDecoratorException(
+                "@user_step_decorator requires a generator function "
+                "(it must contain a yield)."
+            )
+        n_params = len(inspect.signature(gen_fn).parameters)
+        if n_params not in (3, 4):
+            raise UserStepDecoratorException(
+                "A user step decorator generator takes (step_name, flow, "
+                "inputs) or (step_name, flow, inputs, attributes); %r "
+                "takes %d argument(s)." % (gen_fn.__name__, n_params)
+            )
+
+        from .plugins import STEP_DECORATORS, register_step_decorator
+
+        existing = STEP_DECORATORS.get(gen_fn.__name__)
+        if existing is not None and not issubclass(
+            existing, UserStepDecoratorBase
+        ):
+            raise UserStepDecoratorException(
+                "@user_step_decorator %r collides with the built-in step "
+                "decorator of the same name — rename the generator."
+                % gen_fn.__name__
+            )
+
+        decotype = type(
+            "UserStepDecorator_%s" % gen_fn.__name__,
+            (UserStepDecoratorBase,),
+            {
+                "name": gen_fn.__name__,
+                "gen_fn": staticmethod(gen_fn),
+                "__doc__": gen_fn.__doc__,
+            },
+        )
+        register_step_decorator(decotype)
+        return make_step_decorator(decotype)
+
+    if fn is not None:
+        return build(fn)
+    return build

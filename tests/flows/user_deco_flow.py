@@ -1,0 +1,102 @@
+import time
+
+from metaflow_tpu import FlowSpec, step, user_step_decorator
+
+
+@user_step_decorator
+def timing(step_name, flow, inputs):
+    t0 = time.time()
+    yield
+    flow.timed_step = step_name
+    flow.step_duration = time.time() - t0
+
+
+@user_step_decorator
+def tagger(step_name, flow, inputs, attributes):
+    yield
+    flow.tag_seen = attributes.get("tag", "none")
+
+
+@user_step_decorator
+def swallow_errors(step_name, flow, inputs):
+    try:
+        yield
+    except RuntimeError as ex:
+        flow.swallowed = str(ex)
+        flow.next(flow.end)
+
+
+@user_step_decorator
+def skipper(step_name, flow, inputs):
+    flow.skipped_body = True
+    if False:
+        yield  # never reached: the step body is skipped
+
+
+@user_step_decorator
+def replacer(step_name, flow, inputs):
+    def body(flow):
+        flow.replaced = True
+        return True  # framework performs the static transition
+
+    yield body
+
+
+class UserDecoFlow(FlowSpec):
+    @timing
+    @step
+    def start(self):
+        self.x = 1
+        self.next(self.tagged)
+
+    @tagger(tag="gold")
+    @step
+    def tagged(self):
+        self.next(self.failing)
+
+    @swallow_errors
+    @step
+    def failing(self):
+        if True:
+            raise RuntimeError("boom-but-fine")
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.timed_step == "start"
+        assert self.step_duration >= 0
+        assert self.tag_seen == "gold"
+        assert self.swallowed == "boom-but-fine"
+        print("user decorators ok")
+
+
+class SkipReplaceFlow(FlowSpec):
+    @skipper
+    @step
+    def start(self):
+        self.never_ran = True  # must not execute
+        self.next(self.middle)
+
+    @replacer
+    @step
+    def middle(self):
+        self.also_never_ran = True
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert not hasattr(self, "never_ran")
+        assert self.skipped_body
+        assert not hasattr(self, "also_never_ran")
+        assert self.replaced
+        print("skip/replace ok")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--skipflow" in sys.argv:
+        sys.argv.remove("--skipflow")
+        SkipReplaceFlow()
+    else:
+        UserDecoFlow()

@@ -211,7 +211,7 @@ class TestCliExtras:
         )
         final = open(card_file).read()
         assert 'http-equiv="refresh"' not in final
-        assert ">ok<" in final or "ok" in final
+        assert ">ok<" in final
         assert "running" not in final.split("Artifacts")[0]
 
     def test_card_and_spin_and_tag(self, run_flow, flows_dir, tpuflow_root):

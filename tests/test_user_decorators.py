@@ -1,0 +1,68 @@
+"""@user_step_decorator: the generator wrapper API.
+
+Reference behavior: metaflow/user_decorators/user_step_decorator.py:585 —
+pre/post sections around the yield, exception capture at the yield point,
+step replacement via a yielded callable, skip via a yield-less generator,
+and --with registration under the generator's name.
+"""
+
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOW = os.path.join(REPO, "tests", "flows", "user_deco_flow.py")
+
+
+def test_timing_attributes_and_exception_capture(run_flow):
+    out = run_flow(FLOW, "run")
+    assert "user decorators ok" in out.stdout + out.stderr
+
+
+def test_skip_and_replace(run_flow):
+    out = run_flow(FLOW, "--skipflow", "run")
+    assert "skip/replace ok" in out.stdout + out.stderr
+
+
+def test_with_spec_uses_user_decorator(run_flow, tmp_path):
+    # a user decorator registers under its function name: `--with` works
+    flow_file = tmp_path / "with_user_deco.py"
+    flow_file.write_text(
+        "from metaflow_tpu import FlowSpec, step, user_step_decorator\n"
+        "\n"
+        "@user_step_decorator\n"
+        "def stamp(step_name, flow, inputs):\n"
+        "    yield\n"
+        "    flow.stamps = getattr(flow, 'stamps', []) + [step_name]\n"
+        "\n"
+        "class WithUserDecoFlow(FlowSpec):\n"
+        "    @step\n"
+        "    def start(self):\n"
+        "        self.next(self.end)\n"
+        "    @step\n"
+        "    def end(self):\n"
+        "        print('STAMPS=%s' % ','.join(self.stamps))\n"
+        "if __name__ == '__main__':\n"
+        "    WithUserDecoFlow()\n"
+    )
+    # end's own stamp lands post-body, so the print inside `end` sees only
+    # start's — enough to show --with applied the decorator to every step
+    out = run_flow(str(flow_file), "--with", "stamp", "run")
+    assert "STAMPS=start" in out.stdout + out.stderr
+
+
+def test_non_generator_rejected():
+    from metaflow_tpu.user_decorators import (
+        UserStepDecoratorException,
+        user_step_decorator,
+    )
+
+    with pytest.raises(UserStepDecoratorException):
+        @user_step_decorator
+        def not_a_generator(step_name, flow, inputs):
+            return 1
+
+    with pytest.raises(UserStepDecoratorException):
+        @user_step_decorator
+        def wrong_arity(step_name):
+            yield
