@@ -194,6 +194,16 @@ def make_cli(flow, state):
         state.flow_datastore = FlowDataStore(
             flow.name, storage_impl, ds_root=datastore_root
         )
+        if datastore != "local" and os.environ.get(
+            "TPUFLOW_BLOB_CACHE", "1"
+        ) != "0":
+            # task-side reads share the host-local blob cache too — CAS
+            # blobs are immutable, so N tasks on one host download each
+            # input artifact once, not N times (reference gap:
+            # client/filecache.py was client-only)
+            from .client.filecache import FileCache
+
+            state.flow_datastore.ca_store.set_blob_cache(FileCache())
         state.metadata = METADATA_PROVIDERS[metadata](flow=flow)
         # raw selections, re-emitted into compiled (Argo) container commands
         state.datastore_type = datastore

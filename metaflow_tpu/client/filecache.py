@@ -29,10 +29,25 @@ class FileCache(object):
         try:
             with open(path, "rb") as f:
                 data = f.read()
-            os.utime(path)  # LRU touch
-            return data
         except OSError:
             return None
+        # the key IS the blob's sha256: verify before trusting — the cache
+        # dir may be shared (e.g. /tmp), and these bytes feed pickle in
+        # task processes. A mismatch (corruption or poisoning) is evicted
+        # and treated as a miss.
+        import hashlib
+
+        if hashlib.sha256(data).hexdigest() != key:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        return data
 
     def store_key(self, key, blob):
         path = self._path(key)

@@ -60,8 +60,12 @@ class ContentAddressedStore(object):
                                  len_hint=len(to_save))
         return results
 
-    def load_blobs(self, keys, force_raw=False):
-        """Yield (key, bytes) for each key (order not guaranteed)."""
+    def load_blobs(self, keys, force_raw=False, missing_ok=False):
+        """Yield (key, bytes) for each key (order not guaranteed).
+
+        missing_ok=True skips absent keys instead of raising — for
+        opportunistic prefetch, where a missing blob should surface (or
+        not) at the actual read."""
         remaining = []
         for key in keys:
             if self._blob_cache is not None:
@@ -77,6 +81,8 @@ class ContentAddressedStore(object):
             for path, local, _meta in loaded:
                 key = paths[path]
                 if local is None:
+                    if missing_ok:
+                        continue
                     raise KeyError(
                         "Content-addressed blob %s not found in datastore"
                         % key

@@ -32,6 +32,7 @@ Also a CLI for host-level data movement:
     python -m metaflow_tpu.gsop put src gs://bucket/key
 """
 
+import http.client
 import io
 import json
 import os
@@ -226,7 +227,10 @@ class GSClient(object):
                     % (method, path, resp.status, data[:200])
                 )
             except (socket.error, ConnectionError, GSTransientError,
-                    TimeoutError) as ex:
+                    TimeoutError, http.client.HTTPException) as ex:
+                # HTTPException covers stale keep-alive races the socket
+                # layer doesn't surface as ConnectionError (BadStatusLine,
+                # ResponseNotReady)
                 if isinstance(ex, GSNotFound):
                     raise
                 last_err = ex
@@ -239,15 +243,33 @@ class GSClient(object):
 
     # ---------------- metadata ops ----------------
 
+    def _request_json(self, method, path):
+        """_request + JSON decode, retrying the request when a reused
+        connection hands back an empty/garbled 200 body (observed as a
+        keep-alive race against threaded servers)."""
+        last_err = None
+        for attempt in range(MAX_RETRIES):
+            if attempt:
+                time.sleep(min(BACKOFF_BASE * (2 ** (attempt - 1)), 5.0))
+            _, data = self._request(method, path)
+            try:
+                return json.loads(data)
+            except ValueError as ex:
+                last_err = ex
+                self._drop_conn()
+                self.retries_performed += 1
+        raise GSTransientError(
+            "unparseable JSON response for %s (%s)" % (path, last_err)
+        )
+
     def stat(self, bucket, obj):
         """Object metadata dict, or None when absent."""
         try:
-            _, data = self._request(
+            return self._request_json(
                 "GET", "/storage/v1/b/%s/o/%s" % (bucket, self._opath(obj))
             )
         except GSNotFound:
             return None
-        return json.loads(data)
 
     def exists(self, bucket, obj):
         return self.stat(bucket, obj) is not None
@@ -266,12 +288,11 @@ class GSClient(object):
                 params["delimiter"] = delimiter
             if page_token:
                 params["pageToken"] = page_token
-            _, data = self._request(
+            payload = self._request_json(
                 "GET",
                 "/storage/v1/b/%s/o?%s"
                 % (bucket, urllib.parse.urlencode(params)),
             )
-            payload = json.loads(data)
             files += [
                 (item["name"], int(item["size"]))
                 for item in payload.get("items", [])

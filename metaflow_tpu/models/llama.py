@@ -175,6 +175,14 @@ def _layer(cfg, cos, sin, x, layer_params, mesh=None):
         attn = ring_attention(q, k, v, mesh, causal=True)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    # named for remat_policy='attn_out': saving this tensor across the layer
+    # checkpoint boundary means the backward pass never re-runs the
+    # attention forward (the flash custom_vjp already recomputes its own
+    # blockwise internals from the saved LSE — re-running the kernel on top
+    # of that is pure waste)
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(B, S, H * Hd) @ layer_params["wo"]
 
     h = rms_norm(x, layer_params["ffn_norm"], cfg.norm_eps)
@@ -200,6 +208,12 @@ def hidden_states(params, tokens, cfg, mesh=None):
         policy = None
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "attn_out":
+            # costs L x [B,S,D] bf16 of HBM, saves a full attention forward
+            # per layer in the backward pass
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            )
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
 

@@ -260,6 +260,10 @@ class MetaflowTask(object):
 
         inputs_obj = None
         if is_join:
+            if len(input_stores) > 1:
+                # one batched fetch instead of N x M sequential gets; only
+                # does work when a blob cache is attached (remote roots)
+                self.flow_datastore.prefetch_task_artifacts(input_stores)
             inputs_obj = Inputs([InputDataStore(ds) for ds in input_stores])
 
         # preemption is the TPU-fleet norm: every task converts SIGTERM

@@ -85,7 +85,7 @@ class UserStepDecoratorBase(StepDecorator):
         step_name = getattr(step_func, "__name__", None) or getattr(
             step_func, "name", "?"
         )
-        wants_attrs = len(inspect.signature(gen_fn).parameters) >= 4
+        wants_attrs = _positional_arity(gen_fn) >= 4
         if attributes and not wants_attrs:
             raise UserStepDecoratorException(
                 "@%s was given attributes %r but its generator takes only "
@@ -123,16 +123,26 @@ class UserStepDecoratorBase(StepDecorator):
                 _default_transition(flow, graph, step_name, yielded or None)
                 self._finish(gen)
                 return
+            if yielded is not None and not callable(yielded):
+                # `yield True` / `yield "skip"` would otherwise silently
+                # run the step — the opposite of what the author meant
+                raise UserStepDecoratorException(
+                    "User decorator %r yielded %r — yield None (run the "
+                    "step), a callable (replace it), or a dict / "
+                    "USER_SKIP_STEP (skip it)."
+                    % (getattr(gen_fn, "__name__", gen_fn), yielded)
+                )
 
-            body = yielded if callable(yielded) else step_func
+            # past the guard, yielded is None (run the step) or a callable
+            # (replace the body)
             try:
-                if yielded is not None and callable(yielded):
-                    ret = body(flow, *call_args) \
-                        if call_args else body(flow)
+                if yielded is not None:
+                    ret = yielded(flow, *call_args) \
+                        if call_args else yielded(flow)
                     if ret is True:
                         _default_transition(flow, graph, step_name)
                 else:
-                    body(*call_args)
+                    step_func(*call_args)
             except BaseException as ex:
                 # surface the step's exception at the yield point; the
                 # generator catching it makes the step succeed
@@ -163,6 +173,19 @@ class UserStepDecoratorBase(StepDecorator):
         )
 
 
+def _positional_arity(gen_fn):
+    """Count plainly-positional parameters; -1 when the signature has
+    var-args/var-kwargs/keyword-only params (unsupported — the generator
+    is always called with 3 or 4 positionals)."""
+    arity = 0
+    for p in inspect.signature(gen_fn).parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            arity += 1
+        else:
+            return -1
+    return arity
+
+
 def user_step_decorator(fn=None):
     """Turn a generator function into a reusable step decorator (see the
     module docstring for the full protocol)."""
@@ -173,12 +196,12 @@ def user_step_decorator(fn=None):
                 "@user_step_decorator requires a generator function "
                 "(it must contain a yield)."
             )
-        n_params = len(inspect.signature(gen_fn).parameters)
-        if n_params not in (3, 4):
+        if _positional_arity(gen_fn) not in (3, 4):
             raise UserStepDecoratorException(
-                "A user step decorator generator takes (step_name, flow, "
-                "inputs) or (step_name, flow, inputs, attributes); %r "
-                "takes %d argument(s)." % (gen_fn.__name__, n_params)
+                "A user step decorator generator takes exactly "
+                "(step_name, flow, inputs) or (step_name, flow, inputs, "
+                "attributes) as plain positional parameters; %r does not."
+                % gen_fn.__name__
             )
 
         from .plugins import STEP_DECORATORS, register_step_decorator

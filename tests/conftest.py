@@ -39,6 +39,10 @@ def run_flow(tpuflow_root):
     def _run(flow_file, *args, expect_fail=False, env_extra=None):
         env = dict(os.environ)
         env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+        # hermetic per-test blob cache (the default /tmp/tpuflow_cache is
+        # shared machine-wide, which is right in production but couples
+        # tests through cache hits)
+        env["TPUFLOW_CLIENT_CACHE"] = os.path.join(tpuflow_root, "blobcache")
         # CPU-only subprocesses: drop the axon TPU plugin site dir entirely.
         # Initializing the axon backend from test processes both serializes
         # on the single tunnel slot (a hung test wedges the chip for every

@@ -211,3 +211,47 @@ class TestTaskDataStore:
             ds.done()
         assert set(flow_ds.list_steps("9")) == {"start", "train"}
         assert set(flow_ds.list_tasks("9", "train")) == {"2", "3"}
+
+
+class TestPrefetch:
+    def test_prefetch_warms_blob_cache_in_one_pass(self, flow_ds):
+        # two "foreach split" tasks each persist artifacts
+        for tid in ("t1", "t2"):
+            ds = flow_ds.get_task_datastore("9", "body", tid, attempt=0,
+                                            mode="w")
+            ds.init_task()
+            ds.save_artifacts([("x", tid), ("big", np.arange(100))])
+            ds.done()
+
+        class CountingCache:
+            def __init__(self):
+                self.blobs = {}
+                self.stores = 0
+
+            def load_key(self, key):
+                return self.blobs.get(key)
+
+            def store_key(self, key, blob):
+                self.stores += 1
+                self.blobs[key] = blob
+
+        cache = CountingCache()
+        flow_ds.ca_store.set_blob_cache(cache)
+        readers = [
+            flow_ds.get_task_datastore("9", "body", t) for t in ("t1", "t2")
+        ]
+        n = flow_ds.prefetch_task_artifacts(readers)
+        assert n == len(cache.blobs) and n >= 3  # x:t1, x:t2, big (deduped)
+        # subsequent artifact loads are pure cache hits: no new stores
+        before = cache.stores
+        assert readers[0]["x"] == "t1" and readers[1]["x"] == "t2"
+        assert cache.stores == before
+
+    def test_prefetch_noop_without_cache(self, flow_ds):
+        ds = flow_ds.get_task_datastore("8", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts([("x", 1)])
+        ds.done()
+        assert flow_ds.prefetch_task_artifacts(
+            [flow_ds.get_task_datastore("8", "s", "t")]
+        ) == 0
