@@ -682,6 +682,26 @@ def make_cli(flow, state):
         echo("Run %s/%s (user %s, tags: %s)"
              % (flow.name, run_id, info.get("user"),
                 ", ".join(info.get("tags", [])) or "-"))
+        # live scheduler snapshot, when one was persisted (runtime.py
+        # _persist_runstate): shows in-flight state metadata can't
+        try:
+            rs = state.flow_datastore.load_runstate(run_id)
+        except Exception:
+            rs = None
+        if rs:
+            import time as _time
+
+            echo(
+                "  scheduler: %d queued, %d active, %d done"
+                " (snapshot %.0fs ago)%s"
+                % (
+                    len(rs.get("queued", [])),
+                    len(rs.get("active", [])),
+                    rs.get("finished_tasks", 0),
+                    max(0, _time.time() - rs.get("ts", 0)),
+                    " FAILED" if rs.get("failed") else "",
+                )
+            )
         for step_name in state.flow_datastore.list_steps(run_id):
             for task_id in sorted(
                 state.flow_datastore.list_tasks(run_id, step_name)

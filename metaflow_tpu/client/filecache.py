@@ -50,6 +50,10 @@ class FileCache(object):
         return data
 
     def store_key(self, key, blob):
+        # a blob near the cache cap would evict everything else on store
+        # and often itself too — pass it through uncached
+        if len(blob) * 4 > self._max_size:
+            return
         path = self._path(key)
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)

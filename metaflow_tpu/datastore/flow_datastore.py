@@ -49,6 +49,35 @@ class FlowDataStore(object):
             allow_not_done=allow_not_done,
         )
 
+    RUNSTATE_FILE = "_runstate.json"
+
+    def save_runstate(self, run_id, snapshot):
+        """Persist the scheduler's live-state snapshot for a run (the
+        counterpart reader is load_runstate; see runtime._persist_runstate
+        for the shape)."""
+        import json
+
+        path = self.storage.path_join(
+            self.flow_name, str(run_id), self.RUNSTATE_FILE
+        )
+        self.storage.save_bytes(
+            [(path, json.dumps(snapshot).encode("utf-8"))], overwrite=True
+        )
+
+    def load_runstate(self, run_id):
+        """The latest scheduler snapshot for a run, or None."""
+        import json
+
+        path = self.storage.path_join(
+            self.flow_name, str(run_id), self.RUNSTATE_FILE
+        )
+        with self.storage.load_bytes([path]) as loaded:
+            for _key, local, _meta in loaded:
+                if local:
+                    with open(local) as f:
+                        return json.load(f)
+        return None
+
     def prefetch_task_artifacts(self, datastores, names=None,
                                 max_bytes=256 << 20):
         """Warm the blob cache with the (requested) artifacts of many task
