@@ -263,6 +263,7 @@ class NativeRuntime(object):
         # on identical re-uploads
         self._runstate_last = 0.0
         self._runstate_prev = None
+        self._runstate_thread = None
 
         # resume support: index the origin run's finished tasks
         self._origin_index = {}
@@ -425,13 +426,32 @@ class NativeRuntime(object):
         }
         if snap == self._runstate_prev and not force:
             return  # hour-long steps must not re-upload identical snapshots
-        self._runstate_prev = snap
-        try:
-            self._flow_datastore.save_runstate(
-                self.run_id, dict(snap, ts=now)
-            )
-        except Exception:
-            pass  # observability must never fail the run
+
+        def save(payload=dict(snap, ts=now)):
+            try:
+                self._flow_datastore.save_runstate(self.run_id, payload)
+                # only a successful save suppresses the next upload — a
+                # failed one retries as soon as the poll loop comes back
+                self._runstate_prev = snap
+            except Exception:
+                pass  # observability must never fail the run
+
+        if force:
+            # crash/exit path: the process may be about to die. Join any
+            # in-flight background upload first so a slower, older snapshot
+            # can't land after (and clobber) this final one.
+            if self._runstate_thread is not None:
+                self._runstate_thread.join(timeout=10)
+            save()
+            return
+        # a degraded storage backend must not stall the poll loop (pipes
+        # fill, heartbeats stall) — upload off-thread, latest-wins
+        if self._runstate_thread is not None and self._runstate_thread.is_alive():
+            return  # still uploading an older snapshot; retry next poll
+        import threading
+
+        self._runstate_thread = threading.Thread(target=save, daemon=True)
+        self._runstate_thread.start()
 
     def _task_finished(self, worker, returncode):
         task = worker.task

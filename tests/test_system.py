@@ -83,17 +83,45 @@ class TestTracing:
 
 class TestFileCache:
     def test_store_load_evict(self, tmp_path):
+        import hashlib
+
         from metaflow_tpu.client.filecache import FileCache
 
-        cache = FileCache(cache_dir=str(tmp_path / "c"), max_size=100)
-        key1 = "a" * 64
-        key2 = "b" * 64
-        cache.store_key(key1, b"x" * 80)
-        assert cache.load_key(key1) == b"x" * 80
+        # keys are the blobs' sha256 (load_key verifies content before
+        # trusting a shared cache dir)
+        blob1, blob2 = b"x" * 80, b"y" * 80
+        key1 = hashlib.sha256(blob1).hexdigest()
+        key2 = hashlib.sha256(blob2).hexdigest()
+
+        cache = FileCache(cache_dir=str(tmp_path / "c"), max_size=400)
+        cache.store_key(key1, blob1)
+        assert cache.load_key(key1) == blob1
         assert cache.load_key("f" * 64) is None
-        cache.store_key(key2, b"y" * 80)  # exceeds cap → evict oldest
-        assert cache.load_key(key2) == b"y" * 80
-        assert cache.load_key(key1) is None
+
+        # a blob big enough to evict everything on store passes through
+        big = b"z" * 200
+        cache.store_key(hashlib.sha256(big).hexdigest(), big)
+        assert cache.load_key(hashlib.sha256(big).hexdigest()) is None
+
+        # corrupted entry (content != key) is evicted and treated as a miss
+        import os
+
+        poisoned = cache._path(key2)
+        os.makedirs(os.path.dirname(poisoned), exist_ok=True)
+        with open(poisoned, "wb") as f:
+            f.write(b"not the real bytes")
+        assert cache.load_key(key2) is None
+        assert not os.path.exists(poisoned)
+
+        # exceeding the cap evicts the oldest entry
+        os.utime(cache._path(key1), (1, 1))  # force key1 oldest
+        filler = []
+        for i in range(5):
+            b = ("f%d" % i).encode() * 40  # 80 bytes each
+            filler.append(hashlib.sha256(b).hexdigest())
+            cache.store_key(filler[-1], b)
+        assert cache.load_key(key1) is None  # evicted
+        assert cache.load_key(filler[-1]) is not None
 
 
 class TestHybridMesh:

@@ -1,0 +1,51 @@
+"""Episode 04: failure is a feature — @retry, @catch, and resume.
+
+The flow fails the first time (a flaky step), then you resume it and it
+picks up where it left off, cloning every finished task instead of
+re-running it.
+
+Run:    python resume.py run            # fails in `flaky` on attempt 0
+Fix:    nothing to fix — @retry already re-ran it; see the logs
+Resume: python resume.py resume        # if you Ctrl-C'd mid-run
+
+Try breaking it harder: set BREAK_ALWAYS=1 so @retry runs out, watch
+@catch record the failure instead of killing the run, then inspect it:
+    python -c "from metaflow_tpu import Flow; \
+print(Flow('ResumeFlow').latest_run['flaky'].task.data.compute_failed)"
+"""
+
+import os
+
+from metaflow_tpu import FlowSpec, catch, retry, step
+
+
+class ResumeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.values = list(range(10))
+        self.next(self.flaky)
+
+    @catch(var="compute_failed")
+    @retry(times=2)
+    @step
+    def flaky(self):
+        # attempt 0 dies; @retry's attempt 1 succeeds — unless BREAK_ALWAYS,
+        # in which case @catch stores the exception and the flow continues
+        import metaflow_tpu
+
+        attempt = metaflow_tpu.current.retry_count
+        if attempt == 0 or os.environ.get("BREAK_ALWAYS"):
+            raise RuntimeError("transient failure on attempt %d" % attempt)
+        self.total = sum(self.values)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        if getattr(self, "compute_failed", None):
+            print("compute failed but the run finished:", self.compute_failed)
+        else:
+            print("total:", self.total)
+
+
+if __name__ == "__main__":
+    ResumeFlow()

@@ -1,0 +1,45 @@
+"""Episode 05: per-step environments — @pypi / @conda / @uv.
+
+Each step can pin its own dependencies; the framework builds a
+content-addressed venv layered over the shared TPU stack (so jax and
+friends are inherited, not re-downloaded) and swaps the interpreter for
+just that step. Identical pin-sets share one cached env.
+
+Run:  python environments.py run
+
+Offline clusters: point TPUFLOW_WHEELHOUSE at a directory of wheels and
+installs never touch the network. @conda uses micromamba when available
+(locked solve, cached by lock hash) and falls back to a venv otherwise.
+"""
+
+from metaflow_tpu import FlowSpec, pypi, step
+
+
+class EnvironmentsFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.pinned)
+
+    # this step runs inside its own venv with the pinned package version;
+    # the flow's other steps never see it
+    @pypi(packages={"tabulate": "0.9.0"})
+    @step
+    def pinned(self):
+        import tabulate
+
+        self.table = tabulate.tabulate(
+            [["v5e", 197], ["v5p", 459]],
+            headers=["chip", "peak bf16 TFLOP/s"],
+        )
+        self.tabulate_version = tabulate.__version__
+        self.next(self.end)
+
+    @step
+    def end(self):
+        # the artifact crossed the env boundary; the import need not
+        assert self.tabulate_version == "0.9.0"
+        print(self.table)
+
+
+if __name__ == "__main__":
+    EnvironmentsFlow()

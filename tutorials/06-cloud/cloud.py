@@ -1,0 +1,50 @@
+"""Episode 06: the same flow, on real TPUs — @tpu and @resources.
+
+Decorators request hardware; nothing else changes. Locally this runs as
+plain processes (the decorators are inert without a launcher), so you can
+develop the exact flow you deploy.
+
+Local:  python cloud.py run
+Cloud:  export TPUFLOW_TPU_LAUNCHER=gcloud   # provision/reuse TPU VMs
+        python cloud.py run --with tpu:topology=v5litepod-8
+
+The @tpu decorator exposes slice topology at runtime via current.tpu
+(topology, device count, device kind) and the gcloud launcher trampolines
+each gang rank onto one TPU-VM worker with jax.distributed pre-wired
+(plugins/tpu/launcher.py). Add spot=True and the preemption-monitor
+sidecar checkpoints and exits cleanly when GCE reclaims the slice.
+"""
+
+from metaflow_tpu import FlowSpec, current, resources, step, tpu
+
+
+class CloudFlow(FlowSpec):
+    @step
+    def start(self):
+        self.shards = list(range(4))
+        self.next(self.embed, foreach="shards")
+
+    @resources(cpu=2, memory=8192)
+    @tpu(topology="v5litepod-8")
+    @step
+    def embed(self):
+        # on a slice: one real chip set per worker; locally: cpu jax
+        import jax
+
+        self.shard = self.input
+        self.n_devices = len(jax.devices())
+        self.topology = current.tpu.topology if current.tpu else None
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.device_counts = {i.shard: i.n_devices for i in inputs}
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("devices per shard:", self.device_counts)
+
+
+if __name__ == "__main__":
+    CloudFlow()

@@ -1,0 +1,29 @@
+"""The flow episode 07 drives: computes a curve and renders it to a card."""
+
+from metaflow_tpu import FlowSpec, Parameter, card, current, step
+
+
+class CardDemoFlow(FlowSpec):
+    alpha = Parameter("alpha", default=0.5, type=float)
+
+    @card
+    @step
+    def start(self):
+        self.curve = [
+            round(self.alpha * x * x, 3) for x in range(20)
+        ]
+        from metaflow_tpu.plugins.cards import Markdown, VegaChart
+
+        current.card.append(Markdown("# Loss curve (alpha=%s)" % self.alpha))
+        current.card.append(VegaChart.line(
+            list(range(20)), self.curve, x_label="step", y_label="loss",
+        ))
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("curve tail:", self.curve[-3:])
+
+
+if __name__ == "__main__":
+    CardDemoFlow()

@@ -1,0 +1,44 @@
+"""Episode 07: exploring results — the client API, cards, and the Runner.
+
+Every run's artifacts, logs, and lineage stay queryable forever. This
+episode runs a flow programmatically (the Runner), then walks its results
+with the client API and renders a card you can open in a browser.
+
+Run:  python client.py
+View: python card_demo.py card server   # then open the printed URL
+"""
+
+from metaflow_tpu import Flow
+from metaflow_tpu.runner import Runner
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    # 1. run a flow from python (same CLI underneath; kwargs are validated
+    #    against the flow's real command tree)
+    with Runner(os.path.join(HERE, "card_demo.py")) as runner:
+        result = runner.run(alpha=0.5)
+        print("run finished:", result.run.pathspec, result.status)
+
+    # 2. walk the results: Flow → Run → Step → Task → DataArtifact
+    run = Flow("CardDemoFlow").latest_run
+    print("tags:", sorted(run.tags))
+    for step_obj in run:
+        for task in step_obj:
+            has_curve = "curve" in task.data
+            print(
+                task.pathspec,
+                "ok" if task.successful else "failed",
+                "has curve" if has_curve else "",
+            )
+
+    # 3. lineage: which tasks fed the end step?
+    end = run["end"].task
+    print("end consumed:", [t.pathspec for t in end.parent_tasks])
+
+
+if __name__ == "__main__":
+    main()

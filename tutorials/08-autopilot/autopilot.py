@@ -1,0 +1,38 @@
+"""Episode 08: production — schedule it, trigger it, ship it to Argo.
+
+@schedule puts the flow on a cron; @project namespaces deployments so
+staging and prod coexist; @trigger lets one flow's completion (or an
+external event) start another. `argo-workflows create` compiles the whole
+graph — foreach fan-outs, gang steps as multi-host TPU slices, retries,
+exit hooks — into an Argo WorkflowTemplate for GKE.
+
+Compile: python autopilot.py --datastore gs \
+             argo-workflows create --only-json
+         (pods need a SHARED datastore — the compiler refuses --datastore
+          local, which would strand artifacts on each pod's own disk)
+Deploy:  ... argo-workflows create | kubectl apply -f -
+Local:   python autopilot.py run   # the same flow, no cluster needed
+
+Event wiring: NightlyTrainFlow below starts whenever this flow finishes
+(@trigger_on_finish); on Argo that compiles to an Events sensor, locally
+the event bus in metaflow_tpu/events.py delivers it.
+"""
+
+from metaflow_tpu import FlowSpec, project, schedule, step
+
+
+@project(name="tutorials")
+@schedule(daily=True)
+class AutopilotFlow(FlowSpec):
+    @step
+    def start(self):
+        self.dataset_version = "v1"
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("published dataset", self.dataset_version)
+
+
+if __name__ == "__main__":
+    AutopilotFlow()
