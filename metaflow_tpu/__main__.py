@@ -108,5 +108,86 @@ def stubs(out_dir):
     click.echo("wrote %s" % generate(out_dir))
 
 
+@main.group(help="Local full-stack dev harness: fake GCS + metadata "
+                 "service (the reference's metaflow-dev, containerless).")
+def devstack():
+    pass
+
+
+@devstack.command(name="up", help="Start the stack and serve until Ctrl-C.")
+@click.option("--gs-port", default=0, help="fake GCS port (0 = ephemeral)")
+@click.option("--metadata-port", default=0,
+              help="metadata service port (0 = ephemeral)")
+@click.option("--root", default=None,
+              help="data directory (default: $TMPDIR/tpuflow_devstack_data)")
+def devstack_up(gs_port, metadata_port, root):
+    from . import devtools
+
+    if devtools.read_state() is not None:
+        raise click.ClickException(
+            "a devstack is already running (devstack status / down)"
+        )
+    stack = devtools.DevStack(
+        gs_port=gs_port, metadata_port=metadata_port, root=root
+    ).start()
+    stack.write_state()
+    click.echo("devstack up:", err=True)
+    click.echo("  fake GCS:  %s" % stack.gs_endpoint, err=True)
+    click.echo("  metadata:  %s" % stack.metadata_url, err=True)
+    click.echo("in another shell:", err=True)
+    click.echo('  eval "$(python -m metaflow_tpu devstack env)"', err=True)
+    click.echo("  python myflow.py run", err=True)
+    import signal as _signal
+    import threading
+
+    done = threading.Event()
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, lambda *a: done.set())
+    try:
+        done.wait()
+    finally:
+        stack.stop()
+        try:
+            os.unlink(devtools.STATE_FILE)
+        except OSError:
+            pass
+        click.echo("devstack stopped", err=True)
+
+
+@devstack.command(name="env",
+                  help="Print `export` lines for the running stack.")
+def devstack_env():
+    from . import devtools
+
+    state = devtools.read_state()
+    if state is None:
+        raise click.ClickException("no devstack running (devstack up)")
+    for key, value in state["env"].items():
+        click.echo("export %s=%s" % (key, value))
+
+
+@devstack.command(name="status")
+def devstack_status():
+    from . import devtools
+
+    state = devtools.read_state()
+    if state is None:
+        click.echo("devstack: not running")
+    else:
+        click.echo("devstack: running (pid %d)" % state["pid"])
+        for key, value in state["env"].items():
+            click.echo("  %s=%s" % (key, value))
+
+
+@devstack.command(name="down", help="Stop a running stack.")
+def devstack_down():
+    from . import devtools
+
+    if devtools.stop_running():
+        click.echo("devstack stopped")
+    else:
+        click.echo("no devstack running")
+
+
 if __name__ == "__main__":
     main()

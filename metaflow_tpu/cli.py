@@ -668,6 +668,21 @@ def make_cli(flow, state):
 
     argo_create.params.extend(_param_options(flow))
 
+    @start.command(name="argo-exit-hook", hidden=True,
+                   help="Run @exit_hook callables (Argo onExit handler).")
+    @click.option("--status", required=True,
+                  help="Argo {{workflow.status}}: Succeeded/Failed/Error.")
+    @click.option("--run-id", required=True)
+    @click.pass_obj
+    def argo_exit_hook(state, status, run_id):
+        success = status == "Succeeded"
+        for decos in getattr(flow, "_flow_decorators", {}).values():
+            for deco in decos:
+                if hasattr(deco, "run_hooks"):
+                    deco.run_hooks(
+                        success, "%s/%s" % (flow.name, run_id), echo
+                    )
+
     @start.command(help="Show the live status of a run (heartbeats, "
                         "attempts, durations).")
     @click.option("--run-id", default=None)

@@ -1,8 +1,11 @@
 """Type stub (.pyi) generator for the public API.
 
 Reference behavior: metaflow/cmd/develop/stub_generator.py (walks live
-modules, emits a stubs package for IDE/type-checker support). Minimal
-equivalent: introspect signatures + docstrings of the public surface.
+modules, emits a stubs package with full docstrings for IDE/type-checker
+support). This walks the public surface — the top-level package plus the
+user-facing submodules — and emits one .pyi per module, mirroring the
+package layout, with signatures (annotations preserved) and complete
+docstring blocks so editor hover shows real documentation.
 
     python -m metaflow_tpu.cmd.stubgen [out_dir]
 """
@@ -11,90 +14,158 @@ import inspect
 import os
 import sys
 
+# module name (import path suffix) -> emitted .pyi path inside the stubs dir
+PUBLIC_MODULES = [
+    ("", "__init__.pyi"),
+    ("client", os.path.join("client", "__init__.pyi")),
+    ("runner", os.path.join("runner", "__init__.pyi")),
+    ("plugins.cards", os.path.join("plugins", "cards", "__init__.pyi")),
+    ("training", os.path.join("training", "__init__.pyi")),
+    ("parallel", os.path.join("parallel", "__init__.pyi")),
+    ("ops.attention", os.path.join("ops", "attention.pyi")),
+    ("ops.ring_attention", os.path.join("ops", "ring_attention.pyi")),
+    ("models.llama", os.path.join("models", "llama.pyi")),
+    ("devtools", os.path.join("devtools", "__init__.pyi")),
+]
 
-def _fmt_signature(obj):
+
+def _fmt_annotation(ann):
+    if ann is inspect.Parameter.empty:
+        return None
+    if isinstance(ann, type):
+        return ann.__name__
+    return str(ann).replace("typing.", "")
+
+
+def _fmt_signature(obj, drop_first=False):
     try:
         sig = inspect.signature(obj)
     except (ValueError, TypeError):
-        return "(*args, **kwargs)"
+        return "(*args: Any, **kwargs: Any) -> Any"
     parts = []
-    for p in sig.parameters.values():
+    params = list(sig.parameters.values())
+    for i, p in enumerate(params):
         s = p.name
         if p.kind == p.VAR_POSITIONAL:
             s = "*" + s
         elif p.kind == p.VAR_KEYWORD:
             s = "**" + s
-        elif p.default is not p.empty:
-            s += "=..."
+        ann = _fmt_annotation(p.annotation)
+        if ann and not (drop_first and i == 0):
+            s += ": %s" % ann
+        if p.default is not p.empty:
+            s += " = ..."
         parts.append(s)
-    return "(%s)" % ", ".join(parts)
+    ret = _fmt_annotation(sig.return_annotation)
+    return "(%s)%s" % (", ".join(parts), " -> %s" % ret if ret else "")
 
 
-def _doc_line(obj):
+def _doc_block(obj, indent="    "):
+    """The full docstring as an indented triple-quoted block ('' if none)."""
     doc = inspect.getdoc(obj)
     if not doc:
         return ""
-    first = doc.split("\n", 1)[0].replace('"""', "'''")
-    return '\n    """%s"""' % first
+    doc = doc.replace('"""', "'''")
+    if "\n" in doc:
+        body = ("\n" + indent).join(doc.split("\n"))
+        return '%s"""%s\n%s"""' % (indent, body, indent)
+    return '%s"""%s"""' % (indent, doc)
+
+
+def _fn_stub(name, fn, indent="", deco=None, drop_first=None):
+    """drop_first: suppress the first parameter's annotation (self/cls);
+    defaults to 'is a class member' except for staticmethods, whose first
+    parameter is a real argument."""
+    if drop_first is None:
+        drop_first = bool(indent) and deco != "@staticmethod"
+    lines = []
+    if deco:
+        lines.append(indent + deco)
+    sig = _fmt_signature(fn, drop_first=drop_first)
+    doc = _doc_block(fn, indent + "    ")
+    if doc:
+        lines.append("%sdef %s%s:" % (indent, name, sig))
+        lines.append(doc)
+        lines.append(indent + "    ...")
+    else:
+        lines.append("%sdef %s%s: ..." % (indent, name, sig))
+    return lines
 
 
 def _class_stub(name, cls):
     lines = ["class %s:" % name]
-    doc = _doc_line(cls)
+    doc = _doc_block(cls)
     if doc:
-        lines[0] += doc.replace("\n    ", "\n    ", 1)
+        lines.append(doc)
     members = []
     for attr_name, attr in sorted(vars(cls).items()):
         if attr_name.startswith("_") and attr_name != "__init__":
             continue
         if isinstance(attr, property):
-            members.append("    @property")
-            members.append("    def %s(self): ..." % attr_name)
+            members.extend(_fn_stub(attr_name, attr.fget or (lambda s: None),
+                                    indent="    ", deco="@property"))
         elif inspect.isfunction(attr):
-            members.append(
-                "    def %s%s: ..." % (attr_name, _fmt_signature(attr))
-            )
+            members.extend(_fn_stub(attr_name, attr, indent="    "))
         elif isinstance(attr, (staticmethod, classmethod)):
-            fn = attr.__func__
-            deco = ("    @staticmethod" if isinstance(attr, staticmethod)
-                    else "    @classmethod")
-            members.append(deco)
-            members.append(
-                "    def %s%s: ..." % (attr_name, _fmt_signature(fn))
-            )
+            deco = ("@staticmethod" if isinstance(attr, staticmethod)
+                    else "@classmethod")
+            members.extend(_fn_stub(attr_name, attr.__func__, indent="    ",
+                                    deco=deco))
     if not members:
         members = ["    ..."]
     return "\n".join(lines + members)
 
 
-def generate(out_dir):
-    import metaflow_tpu
-
-    blocks = [
-        '"""Auto-generated type stubs for metaflow_tpu '
-        '(python -m metaflow_tpu.cmd.stubgen)."""',
-        "from typing import Any",
-        "",
-    ]
-    for name in sorted(metaflow_tpu.__all__):
-        obj = getattr(metaflow_tpu, name)
+def _module_stub(module):
+    names = getattr(module, "__all__", None)
+    if names is None:
+        names = [n for n in sorted(vars(module))
+                 if not n.startswith("_")
+                 and getattr(getattr(module, n), "__module__", "").startswith(
+                     "metaflow_tpu")]
+    blocks = []
+    mdoc = _doc_block(module, indent="")
+    blocks.append(mdoc or '"""Auto-generated stubs."""')
+    blocks.append("from typing import Any")
+    blocks.append("")
+    for name in names:
+        try:
+            obj = getattr(module, name)
+        except AttributeError:
+            continue
         if inspect.isclass(obj):
             blocks.append(_class_stub(name, obj))
-        elif callable(obj):
-            doc = _doc_line(obj)
-            if doc:
-                blocks.append("def %s%s:%s\n    ..."
-                              % (name, _fmt_signature(obj), doc))
-            else:
-                blocks.append("def %s%s: ..." % (name, _fmt_signature(obj)))
+        elif inspect.isfunction(obj) or callable(obj):
+            fn = obj if inspect.isfunction(obj) else getattr(
+                obj, "__call__", obj)
+            blocks.append("\n".join(_fn_stub(name, fn)))
         else:
             blocks.append("%s: Any" % name)
         blocks.append("")
-    os.makedirs(out_dir, exist_ok=True)
-    out_path = os.path.join(out_dir, "__init__.pyi")
-    with open(out_path, "w") as f:
-        f.write("\n".join(blocks))
-    return out_path
+    return "\n".join(blocks)
+
+
+def generate(out_dir):
+    import importlib
+
+    import metaflow_tpu
+
+    written = []
+    for suffix, rel_path in PUBLIC_MODULES:
+        mod_name = "metaflow_tpu" + ("." + suffix if suffix else "")
+        try:
+            module = importlib.import_module(mod_name)
+        except Exception:
+            continue  # optional deps may be absent; stub what imports
+        out_path = os.path.join(out_dir, rel_path)
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            f.write(_module_stub(module))
+        written.append(out_path)
+    # a py.typed-style marker naming the generator
+    with open(os.path.join(out_dir, "GENERATED"), "w") as f:
+        f.write("python -m metaflow_tpu.cmd.stubgen\n")
+    return out_dir if len(written) > 1 else (written and written[0] or out_dir)
 
 
 if __name__ == "__main__":

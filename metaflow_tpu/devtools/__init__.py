@@ -1,0 +1,116 @@
+"""Local full-stack development harness ("devstack").
+
+The reference ships a docker-compose devtools stack (metaflow-dev: minio +
+metadata service + UI) so flows can exercise the production code paths —
+remote datastore, REST metadata — without cloud access. This is the
+TPU-native equivalent, with no containers: one process hosts
+
+  - a fake GCS server (`devtools/fake_gcs.py`, the full gs:// JSON-API
+    slice gsop speaks), and
+  - the reference metadata service (`metadata/service.py`, same REST
+    shape as Metaflow's), backed by a directory on disk.
+
+`python -m metaflow_tpu devstack up` starts both, writes a state file,
+and prints the exports; any shell that sources them runs every flow
+against the stack:
+
+    eval "$(python -m metaflow_tpu devstack env)"
+    python myflow.py run          # --datastore gs --metadata service
+
+Reference: metaflow-dev / devtools (SURVEY.md §2.10 devtools stack).
+"""
+
+import json
+import os
+import signal
+import tempfile
+
+
+STATE_FILE = os.path.join(tempfile.gettempdir(), "tpuflow_devstack.json")
+DEFAULT_BUCKET = "devstack"
+
+
+class DevStack(object):
+    """In-process composition of the fake GCS server + metadata service."""
+
+    def __init__(self, gs_port=0, metadata_port=0, root=None,
+                 bucket=DEFAULT_BUCKET):
+        self.root = root or os.path.join(
+            tempfile.gettempdir(), "tpuflow_devstack_data"
+        )
+        self.bucket = bucket
+        self._gs_port = gs_port
+        self._md_port = metadata_port
+        self.gs_endpoint = None
+        self.metadata_url = None
+        self._gcs = None
+        self._md = None
+
+    def start(self):
+        from ..metadata.service import MetadataService
+        from .fake_gcs import FakeGCSServer
+
+        os.makedirs(self.root, exist_ok=True)
+        self._gcs = FakeGCSServer(port=self._gs_port)
+        self._gcs.__enter__()
+        self.gs_endpoint = self._gcs.endpoint
+        self._md = MetadataService(
+            os.path.join(self.root, "metadata"), port=self._md_port
+        )
+        self.metadata_url = self._md.start()
+        return self
+
+    def stop(self):
+        if self._gcs is not None:
+            self._gcs.__exit__(None, None, None)
+            self._gcs = None
+        if self._md is not None:
+            self._md.stop()
+            self._md = None
+
+    # ------------------------------------------------------------------
+
+    def env(self):
+        """The exports a shell needs to run flows against this stack."""
+        return {
+            "TPUFLOW_GS_ENDPOINT": self.gs_endpoint,
+            "TPUFLOW_DATASTORE_SYSROOT_GS": "gs://%s/tpuflow" % self.bucket,
+            "TPUFLOW_DEFAULT_DATASTORE": "gs",
+            "TPUFLOW_DEFAULT_METADATA": "service",
+            "TPUFLOW_SERVICE_URL": self.metadata_url,
+        }
+
+    def write_state(self, path=STATE_FILE):
+        with open(path, "w") as f:
+            json.dump({"pid": os.getpid(), "env": self.env()}, f)
+        return path
+
+
+def read_state(path=STATE_FILE):
+    """State of a running devstack, or None (missing file / dead pid)."""
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return None
+    try:
+        os.kill(state["pid"], 0)
+    except (OSError, KeyError):
+        return None
+    return state
+
+
+def stop_running(path=STATE_FILE):
+    """SIGTERM a running devstack; returns True if one was signalled."""
+    state = read_state(path)
+    if state is None:
+        return False
+    try:
+        os.kill(state["pid"], signal.SIGTERM)
+    except OSError:
+        return False
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return True

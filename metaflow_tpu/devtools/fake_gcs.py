@@ -1,0 +1,246 @@
+"""In-process fake GCS server: the MinIO trick without a binary.
+
+Implements the slice of the GCS JSON API that metaflow_tpu.gsop speaks —
+object get (with Range), media upload, compose, stat, list (prefix +
+delimiter + paging), delete — backed by an in-memory dict. Tests point
+TPUFLOW_GS_ENDPOINT at it and the ENTIRE gs:// stack (gsop, GCSStorage,
+datastores, flow-level gs contexts) runs for real with no cloud access
+(reference pattern: .github/workflows/metaflow.s3_tests.minio.yml).
+"""
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeGCSState(object):
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.buckets = {}  # bucket -> {object_name: bytes}
+        self.generations = {}  # (bucket, object_name) -> int
+        self.request_count = 0
+        self._gen_counter = 0
+
+    def bucket(self, name):
+        return self.buckets.setdefault(name, {})
+
+    def bump_generation(self, bucket_name, obj):
+        # caller holds self.lock
+        self._gen_counter += 1
+        self.generations[(bucket_name, obj)] = self._gen_counter
+        return self._gen_counter
+
+    def generation(self, bucket_name, obj):
+        return self.generations.get((bucket_name, obj), 1)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state = None  # injected
+
+    # ------------- helpers -------------
+
+    def _send(self, status, body=b"", content_type="application/json",
+              extra_headers=None):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, status, payload):
+        self._send(status, json.dumps(payload).encode("utf-8"))
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(length)
+
+    def log_message(self, *args):
+        pass
+
+    # ------------- routes -------------
+
+    def do_GET(self):
+        with self.state.lock:
+            self.state.request_count += 1
+        parsed = urllib.parse.urlparse(self.path)
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+
+        m = re.match(r"^/download/storage/v1/b/([^/]+)/o/([^/]+)$",
+                     parsed.path)
+        if m and params.get("alt") == "media":
+            return self._download(m.group(1),
+                                  urllib.parse.unquote(m.group(2)),
+                                  params=params)
+
+        m = re.match(r"^/storage/v1/b/([^/]+)/o/([^/]+)$", parsed.path)
+        if m:
+            return self._stat(m.group(1), urllib.parse.unquote(m.group(2)))
+
+        m = re.match(r"^/storage/v1/b/([^/]+)/o$", parsed.path)
+        if m:
+            return self._list(m.group(1), params)
+
+        self._json(404, {"error": "no route %s" % parsed.path})
+
+    def do_POST(self):
+        with self.state.lock:
+            self.state.request_count += 1
+        parsed = urllib.parse.urlparse(self.path)
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+
+        m = re.match(r"^/upload/storage/v1/b/([^/]+)/o$", parsed.path)
+        if m and params.get("uploadType") == "media":
+            bucket_name = m.group(1)
+            bucket = self.state.bucket(bucket_name)
+            name = params["name"]
+            data = self._body()
+            with self.state.lock:
+                bucket[name] = data
+                gen = self.state.bump_generation(bucket_name, name)
+            return self._json(200, {"name": name, "size": str(len(data)),
+                                    "generation": str(gen)})
+
+        m = re.match(r"^/storage/v1/b/([^/]+)/o/([^/]+)/compose$",
+                     parsed.path)
+        if m:
+            return self._compose(m.group(1),
+                                 urllib.parse.unquote(m.group(2)))
+
+        self._json(404, {"error": "no route %s" % parsed.path})
+
+    def do_DELETE(self):
+        with self.state.lock:
+            self.state.request_count += 1
+        m = re.match(r"^/storage/v1/b/([^/]+)/o/([^/]+)$",
+                     urllib.parse.urlparse(self.path).path)
+        if not m:
+            return self._json(404, {"error": "no route"})
+        bucket = self.state.bucket(m.group(1))
+        name = urllib.parse.unquote(m.group(2))
+        with self.state.lock:
+            if name not in bucket:
+                return self._json(404, {"error": "not found"})
+            del bucket[name]
+        self._send(204)
+
+    # ------------- handlers -------------
+
+    def _download(self, bucket_name, obj, params=None):
+        bucket = self.state.bucket(bucket_name)
+        with self.state.lock:
+            data = bucket.get(obj)
+            gen = self.state.generation(bucket_name, obj)
+        if data is None:
+            return self._json(404, {"error": "not found"})
+        want_gen = (params or {}).get("generation")
+        if want_gen and want_gen != str(gen):
+            # GCS returns 404 for a generation that no longer exists
+            return self._json(404, {"error": "generation %s gone" % want_gen})
+        rng = self.headers.get("Range")
+        if rng:
+            m = re.match(r"bytes=(\d+)-(\d+)$", rng)
+            start, end = int(m.group(1)), min(int(m.group(2)),
+                                              len(data) - 1)
+            return self._send(
+                206, data[start:end + 1],
+                content_type="application/octet-stream",
+                extra_headers={
+                    "Content-Range": "bytes %d-%d/%d"
+                    % (start, end, len(data))
+                },
+            )
+        self._send(200, data, content_type="application/octet-stream")
+
+    def _stat(self, bucket_name, obj):
+        bucket = self.state.bucket(bucket_name)
+        with self.state.lock:
+            data = bucket.get(obj)
+        if data is None:
+            return self._json(404, {"error": "not found"})
+        with self.state.lock:
+            gen = self.state.generation(bucket_name, obj)
+        self._json(200, {"name": obj, "bucket": bucket_name,
+                         "size": str(len(data)),
+                         "generation": str(gen)})
+
+    def _list(self, bucket_name, params):
+        bucket = self.state.bucket(bucket_name)
+        prefix = params.get("prefix", "")
+        delimiter = params.get("delimiter")
+        with self.state.lock:
+            names = sorted(n for n in bucket if n.startswith(prefix))
+        items, prefixes = [], set()
+        for name in names:
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    prefixes.add(
+                        prefix + rest.split(delimiter)[0] + delimiter
+                    )
+                    continue
+            with self.state.lock:
+                items.append({"name": name,
+                              "size": str(len(bucket[name]))})
+        self._json(200, {"items": items, "prefixes": sorted(prefixes)})
+
+    def _compose(self, bucket_name, dest):
+        bucket = self.state.bucket(bucket_name)
+        payload = json.loads(self._body())
+        parts = []
+        with self.state.lock:
+            for src in payload["sourceObjects"]:
+                data = bucket.get(src["name"])
+                if data is None:
+                    return self._json(404,
+                                      {"error": "missing %s" % src["name"]})
+                parts.append(data)
+            bucket[dest] = b"".join(parts)
+            size = len(bucket[dest])
+            gen = self.state.bump_generation(bucket_name, dest)
+        self._json(200, {"name": dest, "size": str(size),
+                         "generation": str(gen)})
+
+
+class FakeGCSServer(object):
+    """Context manager: `with FakeGCSServer() as srv: ... srv.endpoint`."""
+
+    def __init__(self, port=0):
+        self.state = FakeGCSState()
+        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.endpoint = "http://127.0.0.1:%d" % self.server.server_port
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+        return False
+
+
+def main():
+    """Run standalone (separate process): prints the endpoint, serves until
+    killed. Benchmarks use this so client and server don't share a GIL."""
+    import sys
+
+    srv = FakeGCSServer()
+    print(srv.endpoint, flush=True)
+    srv._thread.start()
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

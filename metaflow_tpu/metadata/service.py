@@ -11,6 +11,7 @@ the local JSON layout) used by tests and small deployments.
 """
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -259,6 +260,13 @@ class MetadataService(object):
                 return {"version": "tpuflow-service/1"}, 200
             if parts[0] != "flows":
                 return {"error": "not found"}, 404
+            if parts == ["flows"]:  # GET /flows: all flows in the root
+                if not os.path.isdir(self._root):
+                    return [], 200
+                return sorted(
+                    name for name in os.listdir(self._root)
+                    if os.path.isdir(os.path.join(self._root, name))
+                ), 200
             flow = parts[1]
             p = self._provider(flow)
             rest = parts[2:]

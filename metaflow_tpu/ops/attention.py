@@ -17,8 +17,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-BLOCK_Q = 128
-BLOCK_K = 128
+import os
+
+# 128 is the MXU tile floor; the defaults are overridable for tuning
+# sweeps (bench) and odd shapes. Combinations where one block size
+# divides the other keep the causal live-block arithmetic exact.
+BLOCK_Q = int(os.environ.get("TPUFLOW_FLASH_BLOCK_Q", "128"))
+BLOCK_K = int(os.environ.get("TPUFLOW_FLASH_BLOCK_K", "128"))
 NEG_INF = -1e30
 
 
@@ -54,12 +59,17 @@ def reference_attention(q, k, v, causal=True, scale=None):
 # ---------------------------------------------------------------------------
 
 
-def _online_softmax_loop(q_scaled, k_ref, v_ref, qi, causal, block_k,
-                         seq_len):
+def _online_softmax_loop(q, k_ref, v_ref, qi, causal, block_k, seq_len,
+                         scale):
     """The flash online-softmax inner loop shared by the normalized
     (single-device) and unnormalized (ring block) forward kernels.
-    q_scaled: [block_q, D] f32 already scaled. Returns (m, l, acc)."""
-    block_q, D = q_scaled.shape
+
+    q: [block_q, D] in the INPUT dtype (bf16) — every MXU dot keeps bf16
+    operands with f32 accumulation (the fp32 MXU path on TPU is several
+    times slower, and the XLA reference computes the same bf16×bf16→f32
+    contraction). The scale is applied to the f32 scores, not to q, so no
+    precision is lost to a bf16 pre-scale. Returns (m, l, acc) in f32."""
+    block_q, D = q.shape
     m = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
     l = jnp.zeros((block_q, 1), dtype=jnp.float32)
     acc = jnp.zeros((block_q, D), dtype=jnp.float32)
@@ -72,9 +82,9 @@ def _online_softmax_loop(q_scaled, k_ref, v_ref, qi, causal, block_k,
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q_scaled, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0
@@ -88,7 +98,7 @@ def _online_softmax_loop(q_scaled, k_ref, v_ref, qi, causal, block_k,
         correction = jnp.exp(m - m_new)
         l = l * correction + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * correction + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         return m_new, l, acc
 
@@ -100,10 +110,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     # blocks carry a leading size-1 (batch*head) dim:
     # q_ref: [1, BLOCK_Q, D]; k_ref/v_ref: [1, S, D]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     block_q = q.shape[0]
     m, l, acc = _online_softmax_loop(q, k_ref, v_ref, qi, causal, block_k,
-                                     seq_len)
+                                     seq_len, scale)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # lse layout is [1, 8, S]: sublane dim padded to the fp32 tile minimum,
     # each q-block program writes its sequence slice (row 0 is the payload)
@@ -121,11 +131,12 @@ except ImportError:  # pragma: no cover
     HAS_PALLAS = False
 
 
-def _flash_forward(q, k, v, causal, scale, interpret=False):
+def _flash_forward(q, k, v, causal, scale, interpret=False,
+                   block_q=None, block_k=None):
     """q,k,v: [BH, S, D] (heads folded into batch). Returns (out, lse)."""
     BH, S, D = q.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
+    block_q = min(block_q or BLOCK_Q, S)
+    block_k = min(block_k or BLOCK_K, S)
     grid = (BH, S // block_q)
 
     kernel = functools.partial(
@@ -182,8 +193,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                          dq_ref, *, causal, scale, block_k, seq_len):
     """dq for one q block: iterate k blocks (≤ diagonal when causal)."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    g = g_ref[0]
     block_q, D = q.shape
     lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
     delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
@@ -194,9 +205,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         num_kb = seq_len // block_k
 
     def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+        # all MXU dots take bf16 operands with f32 accumulation; softmax
+        # statistics and ds stay f32 on the VPU (see _online_softmax_loop)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                             s.shape, 0)
@@ -206,7 +219,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        return dq + jnp.dot(ds.astype(k.dtype), k,
+                            preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
         0, num_kb, body, jnp.zeros((block_q, D), jnp.float32)
@@ -219,19 +233,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                           seq_len):
     """dk/dv for one k block: iterate q blocks (≥ diagonal when causal)."""
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     block_k, D = k.shape
     num_qb = seq_len // block_q
     first_qb = (ki * block_k) // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        s = jnp.dot(q * scale, k.T, preferred_element_type=jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32,
                                                             s.shape, 0)
@@ -239,9 +253,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                                                             s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jnp.dot(p.T, g, preferred_element_type=jnp.float32)
+        pb = p.astype(g.dtype)
+        dv = dv + jnp.dot(pb.T, g, preferred_element_type=jnp.float32)
         dp = jnp.dot(g, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -335,12 +350,20 @@ def flash_attention(q, k, v, causal=True, scale=None, interpret=False):
             "use attention(impl='auto') for an XLA fallback"
         )
     B, S, H, D = q.shape
-    block = min(BLOCK_Q, S)
-    if S % block or S % min(BLOCK_K, S):
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    if S % block_q or S % block_k:
         raise ValueError(
-            "flash_attention requires seq len divisible by the %d block "
-            "size (got %d); use attention(impl='auto') for a fallback"
-            % (BLOCK_Q, S)
+            "flash_attention requires seq len divisible by the %d/%d block "
+            "sizes (got %d); use attention(impl='auto') for a fallback"
+            % (BLOCK_Q, BLOCK_K, S)
+        )
+    if block_q % block_k and block_k % block_q:
+        # the causal live-block arithmetic in the kernels is exact only
+        # when one block size divides the other (see _online_softmax_loop)
+        raise ValueError(
+            "flash attention block sizes must divide one another (got "
+            "q=%d, k=%d via TPUFLOW_FLASH_BLOCK_Q/K)" % (block_q, block_k)
         )
     k = _broadcast_gqa(k, H)
     v = _broadcast_gqa(v, H)
@@ -374,10 +397,10 @@ def _flash_block_fwd_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
     causal=True means the same-offset diagonal mask (q and k blocks are the
     same sequence shard); causal=False means every k position contributes."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     block_q = q.shape[0]
     m, l, acc = _online_softmax_loop(q, k_ref, v_ref, qi, causal, block_k,
-                                     seq_len)
+                                     seq_len, scale)
     acc_ref[0] = acc
     m_ref[0, :, pl.ds(qi * block_q, block_q)] = jnp.broadcast_to(
         m.reshape(1, -1), (8, block_q)
@@ -490,7 +513,12 @@ def attention(q, k, v, causal=True, scale=None, impl="auto"):
     if impl == "auto":
         S, D = q.shape[1], q.shape[3]
         on_tpu = jax.default_backend() == "tpu"
-        aligned = S % BLOCK_Q == 0 and D % 128 == 0 and S >= BLOCK_Q
+        bq, bk = min(BLOCK_Q, S), min(BLOCK_K, S)
+        aligned = (
+            S % bq == 0 and S % bk == 0 and D % 128 == 0 and S >= bq
+            # kernels require one block size to divide the other
+            and (bq % bk == 0 or bk % bq == 0)
+        )
         impl = "flash" if (HAS_PALLAS and on_tpu and aligned) else "xla"
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -312,10 +312,7 @@ class ArgoWorkflows(object):
         return res, node_selector
 
     def _container_env(self, node):
-        env = []
-        if self.metadata == "service" and self.service_url:
-            env.append({"name": "TPUFLOW_SERVICE_URL",
-                        "value": self.service_url})
+        env = self._base_env()
         if node.name == "start":
             for pname in self._param_names():
                 env.append({
@@ -495,7 +492,54 @@ class ArgoWorkflows(object):
                 ],
             },
         }
+        exit_template = self._exit_hook_template()
+        if exit_template is not None:
+            # Argo runs the onExit handler after the DAG regardless of
+            # outcome, passing {{workflow.status}} — the same contract the
+            # local runtime's _run_exit_hooks has (reference:
+            # argo_workflows.py exit-hook templates)
+            manifest["spec"]["onExit"] = exit_template["name"]
+            manifest["spec"]["templates"].append(exit_template)
         return manifest
+
+    def _exit_hook_template(self):
+        """onExit handler template running the flow's @exit_hook callables
+        in-container, or None when the flow declares none."""
+        from ...package import MetaflowPackage
+
+        decos = getattr(self.flow, "_flow_decorators", {}).get("exit_hook")
+        if not decos:
+            return None
+        cmds = []
+        if self.package_url:
+            cmds += MetaflowPackage.bootstrap_commands(self.package_url)
+        cmds.append(
+            "python %s %s argo-exit-hook --status '{{workflow.status}}' "
+            "--run-id %s"
+            % (self.flow.script_name, self._top_level_flags(), RUN_ID)
+        )
+        template = {
+            "name": "exit-hook",
+            "container": {
+                "image": self.image,
+                "command": ["bash", "-c", " && ".join(cmds)],
+            },
+        }
+        # the handler needs the same non-step env as pods (notably
+        # TPUFLOW_SERVICE_URL when metadata is the REST service — the
+        # command carries '--metadata service')
+        env = self._base_env()
+        if env:
+            template["container"]["env"] = env
+        return template
+
+    def _base_env(self):
+        """Container env every pod needs, independent of the step."""
+        env = []
+        if self.metadata == "service" and self.service_url:
+            env.append({"name": "TPUFLOW_SERVICE_URL",
+                        "value": self.service_url})
+        return env
 
     def _deployed_name(self):
         from ...current import current

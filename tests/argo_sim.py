@@ -94,25 +94,55 @@ class ArgoSimulator(object):
         succeeded = set()
         not_run = set()  # Skipped + Omitted
         pending = dict(dag_tasks)
-        while pending:
-            resolved = succeeded | not_run
-            ready = [
-                t for t in pending.values()
-                if all(d in resolved for d in self._deps_of(t))
-            ]
-            if not ready:
-                raise ArgoSimError(
-                    "Deadlocked DAG: pending=%s" % sorted(pending)
-                )
-            for task in sorted(ready, key=lambda t: t["name"]):
-                if not self._depends_true(task, succeeded):
-                    not_run.add(task["name"])      # Omitted
-                elif self._when_false(task):
-                    not_run.add(task["name"])      # Skipped
-                else:
-                    self._run_task(task)
-                    succeeded.add(task["name"])
-                del pending[task["name"]]
+        try:
+            while pending:
+                resolved = succeeded | not_run
+                ready = [
+                    t for t in pending.values()
+                    if all(d in resolved for d in self._deps_of(t))
+                ]
+                if not ready:
+                    raise ArgoSimError(
+                        "Deadlocked DAG: pending=%s" % sorted(pending)
+                    )
+                for task in sorted(ready, key=lambda t: t["name"]):
+                    if not self._depends_true(task, succeeded):
+                        not_run.add(task["name"])      # Omitted
+                    elif self._when_false(task):
+                        not_run.add(task["name"])      # Skipped
+                    else:
+                        self._run_task(task)
+                        succeeded.add(task["name"])
+                    del pending[task["name"]]
+        except ArgoSimError:
+            self._run_on_exit("Failed")
+            raise
+        self._run_on_exit("Succeeded")
+
+    def _run_on_exit(self, status):
+        """The controller runs spec.onExit after the workflow finishes,
+        whatever the outcome, with {{workflow.status}} available."""
+        handler = self.spec.get("onExit")
+        if not handler:
+            return
+        template = self.templates[handler]
+        cmd = template["container"]["command"]
+        assert cmd[:2] == ["bash", "-c"], cmd
+        script = self._subst(
+            cmd[2], [{"workflow.status": status}, self._dag_scope()]
+        )
+        proc = subprocess.run(
+            ["bash", "-c", script], env=self.env, cwd=self.cwd,
+            capture_output=True, text=True, timeout=300,
+        )
+        if proc.returncode != 0:
+            raise ArgoSimError(
+                "onExit handler failed rc=%d\nscript: %s\nstdout:\n%s\n"
+                "stderr:\n%s"
+                % (proc.returncode, script, proc.stdout[-4000:],
+                   proc.stderr[-4000:])
+            )
+        self.pods_run.append((handler, None))
 
     def _depends_true(self, task, succeeded):
         expr = task.get("depends", "")

@@ -1,0 +1,38 @@
+"""Exit hooks: on_success/on_error callables run after the run ends —
+locally by the scheduler, on Argo by the compiled onExit handler."""
+
+import os
+
+from metaflow_tpu import FlowSpec, exit_hook, step
+
+
+def notify_ok(run_pathspec):
+    path = os.environ.get("EXIT_HOOK_MARKER")
+    if path:
+        with open(path, "w") as f:
+            f.write("success %s" % run_pathspec)
+
+
+def notify_fail(run_pathspec):
+    path = os.environ.get("EXIT_HOOK_MARKER")
+    if path:
+        with open(path, "w") as f:
+            f.write("failure %s" % run_pathspec)
+
+
+@exit_hook(on_success=[notify_ok], on_error=[notify_fail])
+class ExitHookFlow(FlowSpec):
+    @step
+    def start(self):
+        if os.environ.get("MAKE_IT_FAIL"):
+            raise RuntimeError("boom")
+        self.x = 1
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    ExitHookFlow()

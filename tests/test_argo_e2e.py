@@ -122,6 +122,28 @@ class TestArgoE2E:
         run = client("BranchFlow")["argo-wf-br"]
         assert run.successful
 
+    def test_exit_hook_runs_as_onexit_handler(self, tpuflow_root, tmp_path,
+                                              client, monkeypatch):
+        marker = tmp_path / "exit-marker"
+        monkeypatch.setenv("EXIT_HOOK_MARKER", str(marker))
+        sim = _simulate("exit_hook_flow.py", tpuflow_root, tmp_path,
+                        "wf-exit")
+        # the onExit handler ran after the DAG, with Succeeded status
+        assert sim.pods_run[-1][0] == "exit-hook"
+        assert marker.read_text() == "success ExitHookFlow/argo-wf-exit"
+
+    def test_exit_hook_on_error_status(self, tpuflow_root, tmp_path, client,
+                                       monkeypatch):
+        from argo_sim import ArgoSimError
+
+        marker = tmp_path / "exit-marker"
+        monkeypatch.setenv("EXIT_HOOK_MARKER", str(marker))
+        monkeypatch.setenv("MAKE_IT_FAIL", "1")
+        with pytest.raises(ArgoSimError):
+            _simulate("exit_hook_flow.py", tpuflow_root, tmp_path,
+                      "wf-exitf")
+        assert marker.read_text() == "failure ExitHookFlow/argo-wf-exitf"
+
     def test_gang_control_and_join(self, tpuflow_root, tmp_path, client):
         # the control pod runs the whole gang (local fork mode stands in for
         # a multi-host slice); the join re-derives its inputs from the

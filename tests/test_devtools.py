@@ -1,0 +1,67 @@
+"""Devstack: the containerless local full-stack harness
+(devtools/__init__.py) — fake GCS + metadata service composed in-process.
+The flow-level gs×service context is exercised by the generative harness;
+this covers the stack's own lifecycle and the CLI state handshake."""
+
+import json
+import os
+
+import pytest
+
+
+def test_devstack_lifecycle(tmp_path):
+    from metaflow_tpu.devtools import DevStack, read_state
+
+    stack = DevStack(root=str(tmp_path / "data")).start()
+    try:
+        env = stack.env()
+        assert env["TPUFLOW_GS_ENDPOINT"].startswith("http://127.0.0.1:")
+        assert env["TPUFLOW_SERVICE_URL"].startswith("http://127.0.0.1:")
+        assert env["TPUFLOW_DEFAULT_DATASTORE"] == "gs"
+        assert env["TPUFLOW_DEFAULT_METADATA"] == "service"
+
+        # both servers actually answer
+        import urllib.request
+
+        with urllib.request.urlopen(
+            env["TPUFLOW_GS_ENDPOINT"]
+            + "/storage/v1/b/devstack/o?prefix=", timeout=5
+        ) as resp:
+            assert resp.status == 200
+        with urllib.request.urlopen(
+            env["TPUFLOW_SERVICE_URL"] + "/flows", timeout=5
+        ) as resp:
+            assert resp.status == 200
+
+        # state file round-trip (live pid)
+        state_file = str(tmp_path / "state.json")
+        stack.write_state(state_file)
+        state = read_state(state_file)
+        assert state is not None
+        assert state["env"] == env
+    finally:
+        stack.stop()
+
+
+def test_read_state_dead_pid(tmp_path):
+    from metaflow_tpu.devtools import read_state
+
+    state_file = str(tmp_path / "state.json")
+    with open(state_file, "w") as f:
+        json.dump({"pid": 2 ** 22 + os.getpid(), "env": {}}, f)
+    assert read_state(state_file) is None
+
+
+def test_gsop_against_packaged_fake(tmp_path):
+    """The moved fake server still serves the gsop engine."""
+    from metaflow_tpu.devtools.fake_gcs import FakeGCSServer
+    from metaflow_tpu.gsop import GSClient
+
+    with FakeGCSServer() as srv:
+        client = GSClient(endpoint=srv.endpoint)
+        src = tmp_path / "blob"
+        src.write_bytes(b"devstack" * 1000)
+        client.put_many("bkt", [("obj", str(src))])
+        dst = tmp_path / "out"
+        client.get_many("bkt", [("obj", str(dst))])
+        assert dst.read_bytes() == src.read_bytes()

@@ -1,18 +1,35 @@
-"""Stub generator: output parses and covers the public API."""
+"""Stub generator: output parses, mirrors the package layout, and carries
+docstrings (the reference's stub_generator embeds full doc blocks)."""
 
 import ast
+import glob
+import os
 
 
 def test_stubs_generate_and_parse(tmp_path):
     from metaflow_tpu.cmd.stubgen import generate
 
-    out = generate(str(tmp_path / "stubs"))
-    src = open(out).read()
-    ast.parse(src)  # valid python/pyi
+    out_dir = generate(str(tmp_path / "stubs"))
+    stub_files = glob.glob(os.path.join(out_dir, "**", "*.pyi"),
+                           recursive=True)
+    assert len(stub_files) >= 8  # top-level + the public submodules
+    for path in stub_files:
+        ast.parse(open(path).read())  # every stub is valid python/pyi
+
+    src = open(os.path.join(out_dir, "__init__.pyi")).read()
     import metaflow_tpu
 
-    # every public symbol appears in the stubs
+    # every public symbol appears in the top-level stub
     for name in metaflow_tpu.__all__:
         assert name in src, name
     assert "class FlowSpec" in src
     assert "def step" in src
+    # full docstring blocks survive (not just signatures)
+    assert "merge_artifacts" in src
+    assert "Reference semantics" in src
+
+    # submodules mirror the package layout
+    assert os.path.exists(
+        os.path.join(out_dir, "client", "__init__.pyi"))
+    assert os.path.exists(
+        os.path.join(out_dir, "models", "llama.pyi"))
