@@ -104,30 +104,48 @@ def bench_tokens_per_sec():
 
 def bench_step_launch():
     """p50 latency from scheduler queue → task attempt marker (the reference
-    instruments this via metaflow_profile from_start markers)."""
+    instruments this via metaflow_profile from_start markers).
+
+    BENCH_DAEMON=1 measures launches through the persistent scheduler
+    daemon (metaflow_tpu/daemon.py): runs fork from a warm interpreter
+    instead of paying the cold start."""
+    import contextlib
     import subprocess
     import tempfile
 
-    flow = os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "tests", "flows", "linear_flow.py",
-    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    flow = os.path.join(here, "tests", "flows", "linear_flow.py")
+    use_daemon = os.environ.get("BENCH_DAEMON") == "1"
     latencies = []
-    with tempfile.TemporaryDirectory() as root:
+    with tempfile.TemporaryDirectory() as root, contextlib.ExitStack() as st:
         env = dict(os.environ)
         env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = root
-        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = here
+        if use_daemon:
+            env["TPUFLOW_DAEMON_SOCKET"] = os.path.join(root, "d.sock")
+            daemon = subprocess.Popen(
+                [sys.executable, "-m", "metaflow_tpu.daemon", "start"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            st.callback(daemon.terminate)
+            deadline = time.time() + 30
+            while not os.path.exists(env["TPUFLOW_DAEMON_SOCKET"]):
+                if time.time() > deadline:
+                    raise SystemExit("daemon never came up")
+                time.sleep(0.1)
+            cmd = [sys.executable, "-m", "metaflow_tpu.daemon", "run",
+                   flow, "run"]
+        else:
+            cmd = [sys.executable, flow, "run"]
         for _ in range(5):
             t0 = time.perf_counter()
-            subprocess.run(
-                [sys.executable, flow, "run"],
-                env=env, capture_output=True, check=True,
-            )
+            subprocess.run(cmd, env=env, capture_output=True, check=True)
             # 3 tasks per run → per-task latency
             latencies.append((time.perf_counter() - t0) / 3)
     p50 = statistics.median(latencies)
     return {
-        "metric": "step_launch_p50",
+        "metric": "step_launch_p50%s" % ("_daemon" if use_daemon else ""),
         "value": round(p50 * 1000, 1),
         "unit": "ms",
         "vs_baseline": 1.0,

@@ -676,12 +676,9 @@ def make_cli(flow, state):
     @click.pass_obj
     def argo_exit_hook(state, status, run_id):
         success = status == "Succeeded"
-        for decos in getattr(flow, "_flow_decorators", {}).values():
-            for deco in decos:
-                if hasattr(deco, "run_hooks"):
-                    deco.run_hooks(
-                        success, "%s/%s" % (flow.name, run_id), echo
-                    )
+        decos = getattr(flow, "_flow_decorators", {}).get("exit_hook", [])
+        for deco in decos:
+            deco.run_hooks(success, "%s/%s" % (flow.name, run_id), echo)
 
     @start.command(help="Show the live status of a run (heartbeats, "
                         "attempts, durations).")

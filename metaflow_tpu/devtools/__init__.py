@@ -26,7 +26,9 @@ import signal
 import tempfile
 
 
-STATE_FILE = os.path.join(tempfile.gettempdir(), "tpuflow_devstack.json")
+STATE_FILE = os.path.join(
+    tempfile.gettempdir(), "tpuflow_devstack-%d.json" % os.getuid()
+)
 DEFAULT_BUCKET = "devstack"
 
 

@@ -131,12 +131,13 @@ except ImportError:  # pragma: no cover
     HAS_PALLAS = False
 
 
-def _flash_forward(q, k, v, causal, scale, interpret=False,
-                   block_q=None, block_k=None):
-    """q,k,v: [BH, S, D] (heads folded into batch). Returns (out, lse)."""
+def _flash_forward(q, k, v, causal, scale, interpret=False):
+    """q,k,v: [BH, S, D] (heads folded into batch). Returns (out, lse).
+    Block sizes come from the module-level BLOCK_Q/BLOCK_K (env-tunable);
+    flash_attention validates them before any kernel runs."""
     BH, S, D = q.shape
-    block_q = min(block_q or BLOCK_Q, S)
-    block_k = min(block_k or BLOCK_K, S)
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
     grid = (BH, S // block_q)
 
     kernel = functools.partial(

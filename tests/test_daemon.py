@@ -1,0 +1,141 @@
+"""Persistent scheduler daemon (metaflow_tpu/daemon.py): warm launches
+over a unix socket with fd passing — runs behave as if executed in the
+client (stdio, exit code, env)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOWS = os.path.join(REPO, "tests", "flows")
+
+
+@pytest.fixture()
+def daemon(tmp_path, tpuflow_root):
+    sock = str(tmp_path / "d.sock")
+    env = dict(os.environ)
+    env["TPUFLOW_DAEMON_SOCKET"] = sock
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "metaflow_tpu.daemon", "start"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+    )
+    deadline = time.time() + 30
+    while not os.path.exists(sock):
+        if proc.poll() is not None or time.time() > deadline:
+            raise RuntimeError(
+                "daemon failed to start: %s" % proc.stderr.read())
+        time.sleep(0.05)
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _run(sock, argv, extra_env=None, cwd=None):
+    """Launch via the programmatic client, capturing stdout+stderr."""
+    from metaflow_tpu.daemon import run_via_daemon
+
+    r, w = os.pipe()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.update(extra_env or {})
+    code = run_via_daemon(argv, sock_path=sock, cwd=cwd or FLOWS,
+                          env=env, stdio=(0, w, w))
+    os.close(w)
+    with os.fdopen(r) as f:
+        out = f.read()
+    return code, out
+
+
+def test_run_and_artifacts(daemon, tpuflow_root):
+    code, out = _run(
+        daemon,
+        [os.path.join(FLOWS, "linear_flow.py"), "run", "--alpha", "2.0"],
+    )
+    assert code == 0, out
+    assert "Done!" in out
+
+    from metaflow_tpu import Flow, namespace
+
+    namespace(None)
+    run = Flow("LinearFlow").latest_run
+    assert run.successful
+    assert run["middle"].task["scaled"].data == 20.0
+
+
+def test_failure_exit_code_propagates(daemon, tpuflow_root, tmp_path):
+    marker = tmp_path / "marker"
+    code, out = _run(
+        daemon,
+        [os.path.join(FLOWS, "exit_hook_flow.py"), "run"],
+        extra_env={"MAKE_IT_FAIL": "1", "EXIT_HOOK_MARKER": str(marker)},
+    )
+    assert code != 0
+    # the child ran with the CLIENT's env (exit hook saw the marker path)
+    assert marker.read_text().startswith("failure")
+
+
+def test_ping_and_unavailable(daemon):
+    from metaflow_tpu.daemon import DaemonUnavailable, ping, run_via_daemon
+
+    assert ping(sock_path=daemon)
+    assert not ping(sock_path=daemon + ".nope")
+    with pytest.raises(DaemonUnavailable):
+        run_via_daemon(["x.py"], sock_path=daemon + ".nope")
+
+
+def test_sigterm_forwarded_kills_run(daemon, tpuflow_root, tmp_path):
+    """Killing the client kills the daemon-forked run (the child must not
+    inherit the daemon's SIGTERM handler)."""
+    flow_file = tmp_path / "sleepy_flow.py"
+    flow_file.write_text(
+        "from metaflow_tpu import FlowSpec, step\n"
+        "import sys, time\n"
+        "class SleepyFlow(FlowSpec):\n"
+        "    @step\n"
+        "    def start(self):\n"
+        "        print('sleeping', flush=True)\n"
+        "        time.sleep(120)\n"
+        "        self.next(self.end)\n"
+        "    @step\n"
+        "    def end(self): pass\n"
+        "if __name__ == '__main__': SleepyFlow()\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO,
+               TPUFLOW_DAEMON_SOCKET=daemon)
+    client = subprocess.Popen(
+        [sys.executable, "-m", "metaflow_tpu.daemon", "run",
+         str(flow_file), "run"],
+        env=env, cwd=FLOWS, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    # wait for the task to be mid-sleep, then kill the client
+    deadline = time.time() + 60
+    while "sleeping" not in (client.stdout.readline() or ""):
+        assert time.time() < deadline, "flow never reached the sleep"
+    client.terminate()
+    code = client.wait(timeout=30)
+    assert code != 0  # the run died with the client, not after 120s
+
+
+def test_concurrent_runs(daemon, tpuflow_root):
+    """Launches don't serialize: two overlapping runs both finish."""
+    import threading
+
+    results = {}
+
+    def go(tag):
+        results[tag] = _run(
+            daemon, [os.path.join(FLOWS, "linear_flow.py"), "run"]
+        )
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert set(results) == {0, 1}
+    assert all(code == 0 for code, _ in results.values())
