@@ -26,11 +26,13 @@ itself is re-imported fresh in every child.
 
 import json
 import os
+import runpy
 import signal
 import socket
 import sys
 import tempfile
 import threading
+import traceback
 
 
 def default_socket_path():
@@ -162,9 +164,8 @@ class SchedulerDaemon(object):
         threading.Thread(target=reap, daemon=True).start()
 
     def _child(self, req, fds, conn):
-        import runpy
-        import traceback
-
+        # no imports here: the fork child may inherit a held import lock
+        # from the reaper threads, which nothing will ever release
         code = 1
         try:
             # shed the daemon's signal handlers — the run must die on the

@@ -119,6 +119,9 @@ class ParallelDecorator(StepDecorator):
         if base_argv and base_argv[0].endswith(".py"):
             base_argv = [sys.executable] + base_argv
 
+        from ..util import preexec_die_with_parent
+
+        rank_preexec = preexec_die_with_parent(os.getpid())
         mapper_task_ids = [str(control_task_id)]
         procs = []
         for node_index in range(1, num_parallel):
@@ -136,6 +139,10 @@ class ParallelDecorator(StepDecorator):
                     env=env,
                     stdout=sys.stdout,
                     stderr=sys.stderr,
+                    # SIGKILLed control task ⇒ kernel reaps the ranks too
+                    # (a rank wedged in a collective outlives any
+                    # Python-level cleanup)
+                    preexec_fn=rank_preexec,
                 )
             )
 

@@ -25,7 +25,11 @@ from .datastore.task_datastore import MAX_ATTEMPTS
 from .exception import TpuFlowException
 from .metadata.metadata import MetaDatum
 from .unbounded_foreach import UBF_CONTROL
-from .util import compress_list, write_latest_run_id
+from .util import (
+    compress_list,
+    preexec_die_with_parent,
+    write_latest_run_id,
+)
 
 PROGRESS_LINE = "[%s/%s (pid %s)] %s"
 
@@ -631,7 +635,10 @@ class NativeRuntime(object):
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
                 bufsize=0,
-                start_new_session=True,
+                # session leader (group kills) + kernel reap on scheduler
+                # death — a SIGKILLed scheduler must never orphan tasks
+                preexec_fn=preexec_die_with_parent(os.getpid(),
+                                                   setsid=True),
             )
             proc.terminate = _group_killer(proc, 15)  # SIGTERM
             proc.kill = _group_killer(proc, 9)        # SIGKILL
@@ -675,10 +682,14 @@ class NativeRuntime(object):
     def _fork_worker(self, task):
         r_out, w_out = os.pipe()
         r_err, w_err = os.pipe()
+        # build the preexec BEFORE forking — the fork child must not
+        # import (an inherited held import lock would deadlock it)
+        die_with_scheduler = preexec_die_with_parent(os.getpid())
         pid = os.fork()
         if pid == 0:
             # ---- child: become the task ----
             try:
+                die_with_scheduler()
                 os.close(r_out)
                 os.close(r_err)
                 os.dup2(w_out, 1)

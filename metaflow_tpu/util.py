@@ -10,6 +10,50 @@ from functools import wraps
 from .exception import MetaflowUnknownUser  # noqa: F401  (re-export site)
 
 
+# resolved ONCE at import time (main thread, pre-fork): fork children must
+# not import — a thread holding the import lock at the fork instant would
+# deadlock the child before exec
+try:
+    import ctypes as _ctypes
+
+    _prctl = _ctypes.CDLL(None, use_errno=True).prctl
+except Exception:  # non-Linux / restricted: hardening becomes a no-op
+    _prctl = None
+
+_PR_SET_PDEATHSIG = 1
+
+
+def preexec_die_with_parent(expected_ppid=None, sig=9, setsid=False):
+    """A Popen preexec_fn arming PR_SET_PDEATHSIG: the kernel signals the
+    child the instant its parent dies — no matter how the parent died
+    (SIGKILL, OOM, crash), which Python-level cleanup can never cover.
+
+    sig defaults to SIGKILL, deliberately: this is the last-resort edge,
+    and a Python-level SIGTERM handler (every task installs the
+    preemption handler) only runs at a bytecode boundary — a rank wedged
+    inside an XLA collective would never reach one and would hold the
+    chips forever. Graceful paths (spot preemption, scheduler teardown)
+    signal explicitly; the kernel edge must actually kill.
+
+    expected_ppid closes the inherent race: if the parent died before the
+    prctl took effect, the child was already reparented, so exit at once
+    (checked on every platform — only the prctl itself is Linux-only).
+    setsid=True additionally makes the child a session leader (the
+    scheduler's process-group kills rely on it)."""
+
+    def preexec():
+        # only already-resolved calls here: the fork child may hold
+        # inherited locks no other thread will ever release
+        if setsid:
+            os.setsid()
+        if _prctl is not None:
+            _prctl(_PR_SET_PDEATHSIG, sig, 0, 0, 0)
+        if expected_ppid is not None and os.getppid() != expected_ppid:
+            os._exit(1)  # parent already gone
+
+    return preexec
+
+
 def get_username():
     """Resolve the current user for namespacing and tags."""
     for var in ("METAFLOW_USER", "TPUFLOW_USER", "SUDO_USER", "USERNAME", "USER"):

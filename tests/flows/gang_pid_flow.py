@@ -1,0 +1,34 @@
+"""Gang whose ranks record their PID then sleep — used by teardown tests
+to prove that killed controllers never orphan rank processes."""
+
+import os
+import time
+
+from metaflow_tpu import FlowSpec, current, step
+
+
+class GangPidFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.work, num_parallel=3)
+
+    @step
+    def work(self):
+        pid_dir = os.environ["GANG_PID_DIR"]
+        rank = current.parallel.node_index
+        with open(os.path.join(pid_dir, "rank-%d" % rank), "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(int(os.environ.get("GANG_SLEEP", "60")))
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    GangPidFlow()

@@ -1,0 +1,85 @@
+"""Gang teardown hardening: a SIGKILLed control task must never orphan
+rank processes (PR_SET_PDEATHSIG — kernel-level, covers deaths Python
+cleanup can't: SIGKILL, OOM)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOWS = os.path.join(REPO, "tests", "flows")
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def test_preexec_die_with_parent_stale_ppid():
+    """The race guard: child whose parent died before prctl exits at once."""
+    from metaflow_tpu.util import preexec_die_with_parent
+
+    # expected_ppid deliberately wrong → the preexec path must _exit(1)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "print('should never run')"],
+        preexec_fn=preexec_die_with_parent(expected_ppid=1),
+        stdout=subprocess.PIPE,
+    )
+    assert proc.wait(timeout=10) == 1
+    assert proc.stdout.read() == b""
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pdeathsig is Linux-only")
+def test_sigkilled_control_reaps_ranks(tpuflow_root, tmp_path):
+    pid_dir = tmp_path / "pids"
+    pid_dir.mkdir()
+    env = dict(os.environ)
+    env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GANG_PID_DIR"] = str(pid_dir)
+    env["GANG_SLEEP"] = "120"
+    scheduler = subprocess.Popen(
+        [sys.executable, os.path.join(FLOWS, "gang_pid_flow.py"), "run"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        # wait for all 3 ranks to be mid-sleep
+        deadline = time.time() + 120
+        while len(os.listdir(pid_dir)) < 3:
+            assert time.time() < deadline, "gang never assembled"
+            assert scheduler.poll() is None, "flow exited early"
+            time.sleep(0.2)
+        pids = {
+            name: int((pid_dir / name).read_text())
+            for name in os.listdir(pid_dir)
+        }
+        assert all(_alive(p) for p in pids.values())
+
+        # SIGKILL the control task (rank 0): Python cleanup is impossible
+        os.kill(pids["rank-0"], signal.SIGKILL)
+
+        deadline = time.time() + 15
+        while any(_alive(p) for n, p in pids.items() if n != "rank-0"):
+            assert time.time() < deadline, (
+                "orphaned rank processes survived control SIGKILL: %s"
+                % {n: _alive(p) for n, p in pids.items()}
+            )
+            time.sleep(0.2)
+    finally:
+        if scheduler.poll() is None:
+            scheduler.kill()
+        scheduler.wait(timeout=30)
+        # defensive: never leave sleepers behind on a failed assertion
+        for name in os.listdir(pid_dir):
+            try:
+                os.kill(int((pid_dir / name).read_text()), signal.SIGKILL)
+            except OSError:
+                pass
