@@ -42,18 +42,50 @@ class ContentAddressedStore(object):
     def _path(self, key):
         return self._storage.path_join(self._prefix, key[:2], key)
 
+    # once a persist has streamed this much hash+gzip work, the REMAINING
+    # blobs are fanned over forked workers (multicore.parallel_map —
+    # reference behavior: metaflow/multicore_utils.py on the persist
+    # path). The prefix stays streaming so small persists never buffer
+    # and big ones only materialize the parallel tail.
+    PARALLEL_PACK_MIN_BYTES = 8 << 20
+    PARALLEL_PACK_MIN_BLOBS = 4
+    PARALLEL_PACK_WORKERS = None  # None = multicore's cpu-count default
+
+    def _pack_blob(self, blob, raw):
+        sha = hashlib.sha256(blob).hexdigest()
+        if raw or len(blob) > self.COMPRESS_MAX:
+            packed = self.FMT_RAW + blob
+        else:
+            packed = self.FMT_GZIP + gzip.compress(blob, compresslevel=3)
+        return sha, packed
+
     def save_blobs(self, blob_iter, raw=False, len_hint=0):
         """Save blobs; returns list of (uri, key) in input order."""
+        packed_all = []
+        it = iter(blob_iter)
+        count = 0
+        total = 0
+        tail = None
+        for blob in it:
+            count += 1
+            total += len(blob)
+            packed_all.append(self._pack_blob(blob, raw))
+            if (count >= self.PARALLEL_PACK_MIN_BLOBS
+                    and total >= self.PARALLEL_PACK_MIN_BYTES):
+                tail = list(it)
+                break
+        if tail:
+            from ..multicore import parallel_map
+
+            packed_all.extend(parallel_map(
+                lambda b: self._pack_blob(b, raw), tail,
+                max_parallel=self.PARALLEL_PACK_WORKERS, min_chunk=2,
+            ))
         results = []
         to_save = []
-        for blob in blob_iter:
-            sha = hashlib.sha256(blob).hexdigest()
+        for sha, packed in packed_all:
             path = self._path(sha)
             results.append((self._storage.full_uri(path), sha))
-            if raw or len(blob) > self.COMPRESS_MAX:
-                packed = self.FMT_RAW + blob
-            else:
-                packed = self.FMT_GZIP + gzip.compress(blob, compresslevel=3)
             to_save.append((path, packed))
         # overwrite=False: content-addressed ⇒ existing key has same bytes
         self._storage.save_bytes(iter(to_save), overwrite=False,

@@ -1,0 +1,92 @@
+"""Fork-based multicore map for CPU-bound artifact work.
+
+Reference behavior: metaflow/multicore_utils.py parallel_map — fan
+CPU-bound work (hash/compress of artifact blobs) across forked workers.
+Fork, not a spawn pool: the mapped fn may be a closure over live objects,
+and the items stay in the parent's copy-on-write memory image instead of
+being pickled in. Results come back as one pickle per worker via a
+temporary file; a worker that dies fails the whole map loudly.
+
+Forked children never import (an inherited held import lock would
+deadlock them) — everything they touch is resolved at module import.
+"""
+
+import os
+import pickle
+import tempfile
+import traceback
+
+
+class WorkerFailed(Exception):
+    pass
+
+
+def parallel_map(fn, items, max_parallel=None, min_chunk=4):
+    """[fn(x) for x in items], fanned over forked workers.
+
+    Sequential when the input is small (< min_chunk items), when only one
+    worker would run, or on platforms without fork.
+    """
+    items = list(items)
+    max_parallel = max_parallel or min(os.cpu_count() or 1, 8)
+    n_workers = min(max_parallel, max(1, len(items) // max(min_chunk, 1)))
+    if n_workers <= 1 or len(items) < min_chunk or not hasattr(os, "fork"):
+        return [fn(x) for x in items]
+
+    # round-robin keeps big and small items spread across workers
+    chunks = [items[i::n_workers] for i in range(n_workers)]
+    workers = []  # (pid, chunk_index, result_path)
+    per_chunk = [None] * n_workers
+    failed = []
+    try:
+        # spawning stays inside the try: a mid-loop mkstemp/fork failure
+        # (ENOSPC, EAGAIN) must still reap the workers already forked —
+        # unreaped children would be zombies for the life of a long-lived
+        # parent like the scheduler daemon
+        for idx, chunk in enumerate(chunks):
+            fd, path = tempfile.mkstemp(prefix="mfmap-")
+            os.close(fd)
+            pid = os.fork()
+            if pid == 0:
+                code = 1
+                try:
+                    out = [fn(x) for x in chunk]
+                    with open(path, "wb") as f:
+                        pickle.dump(out, f,
+                                    protocol=pickle.HIGHEST_PROTOCOL)
+                    code = 0
+                except BaseException:
+                    try:
+                        traceback.print_exc()
+                    except Exception:
+                        pass
+                finally:
+                    os._exit(code)
+            workers.append((pid, idx, path))
+    finally:
+        for pid, idx, path in workers:
+            _, status = os.waitpid(pid, 0)
+            if os.waitstatus_to_exitcode(status) != 0:
+                failed.append(idx)
+                continue
+            try:
+                with open(path, "rb") as f:
+                    per_chunk[idx] = pickle.load(f)
+            except (OSError, pickle.UnpicklingError, EOFError):
+                failed.append(idx)
+        for _, _, path in workers:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    if failed:
+        raise WorkerFailed(
+            "parallel_map worker(s) %s died; see their traceback above"
+            % sorted(failed)
+        )
+
+    # inverse of the round-robin split
+    results = [None] * len(items)
+    for idx, chunk_result in enumerate(per_chunk):
+        results[idx::n_workers] = chunk_result
+    return results
