@@ -122,26 +122,134 @@ def _npy_load(data):
     ).reshape(header["shape"]).copy()
 
 
+class ArtifactSerializer(object):
+    """One pluggable artifact format (reference shape:
+    metaflow/datastore/artifacts/serializer.py). Subclass, set a unique
+    `type_tag` and a `priority` (lower runs earlier; the pickle fallback
+    sits at 9999), and register with `register_serializer` — directly or
+    from an extension package's `SERIALIZERS` list."""
+
+    type_tag = None
+    priority = 100
+
+    def can_serialize(self, obj):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def serialize(self, obj):  # -> bytes
+        raise NotImplementedError
+
+    def deserialize(self, payload):  # -> object
+        raise NotImplementedError
+
+
+_SERIALIZERS = []  # kept sorted by (priority, registration order)
+_BY_TAG = {}
+
+
+def register_serializer(serializer):
+    """Register (or override, by type_tag) an ArtifactSerializer INSTANCE.
+    Returns the instance so it can be used as a decorator on a class via
+    ``register_serializer(MySerializer())``-style calls in extensions."""
+    if not serializer.type_tag:
+        raise ValueError("serializer needs a non-empty type_tag")
+    existing = _BY_TAG.get(serializer.type_tag)
+    if existing is not None:
+        _SERIALIZERS.remove(existing)
+    _BY_TAG[serializer.type_tag] = serializer
+    _SERIALIZERS.append(serializer)
+    _SERIALIZERS.sort(key=lambda s: s.priority)
+    return serializer
+
+
 def serialize(obj):
-    """Return (payload_bytes, type_tag)."""
-    if isinstance(obj, np.ndarray) and _tensor_dtype_ok(obj.dtype):
-        return _npy_bytes(obj), TYPE_TENSOR
-    if _is_jax_array(obj):
-        return _npy_bytes(_to_host(obj)), TYPE_TENSOR
-    if type(obj) in (dict, list, tuple) and _tree_only_arrays(obj):
-        return _pytree_bytes(obj), TYPE_PYTREE
-    return pickle.dumps(_pickle_safe(obj), protocol=pickle.HIGHEST_PROTOCOL), TYPE_PICKLE
+    """Return (payload_bytes, type_tag) via the first serializer that
+    accepts obj (priority order; pickle always accepts)."""
+    for s in _SERIALIZERS:
+        if s.can_serialize(obj):
+            return s.serialize(obj), s.type_tag
+    raise RuntimeError("no serializer accepted %r" % type(obj))
 
 
 def deserialize(payload, type_tag):
-    if type_tag == TYPE_TENSOR:
+    s = _BY_TAG.get(type_tag)
+    if s is None:
+        raise ValueError(
+            "artifact has unknown type_tag %r — written by a newer version "
+            "or by an extension serializer that isn't installed here"
+            % type_tag
+        )
+    return s.deserialize(payload)
+
+
+class _TensorSerializer(ArtifactSerializer):
+    """jax.Array / np.ndarray → header + raw bytes (one device→host copy,
+    TPU dtypes included — see _npy_bytes)."""
+
+    type_tag = TYPE_TENSOR
+    priority = 10
+
+    def can_serialize(self, obj):
+        if isinstance(obj, np.ndarray):
+            return _tensor_dtype_ok(obj.dtype)
+        return _is_jax_array(obj)
+
+    def serialize(self, obj):
+        return _npy_bytes(_to_host(obj))
+
+    def deserialize(self, payload):
         return _npy_load(payload)
-    if type_tag == TYPE_NPY:
-        # legacy artifacts written as real .npy by earlier versions
-        return np.load(io.BytesIO(payload), allow_pickle=False)
-    if type_tag == TYPE_PYTREE:
+
+
+class _PytreeSerializer(ArtifactSerializer):
+    """Exact dict/list/tuple trees of arrays → treedef + packed tensors."""
+
+    type_tag = TYPE_PYTREE
+    priority = 20
+
+    def can_serialize(self, obj):
+        return type(obj) in (dict, list, tuple) and _tree_only_arrays(obj)
+
+    def serialize(self, obj):
+        return _pytree_bytes(obj)
+
+    def deserialize(self, payload):
         return _pytree_load(payload)
-    return pickle.loads(payload)
+
+
+class _LegacyNpySerializer(ArtifactSerializer):
+    """Read-only: artifacts written as real .npy by earlier versions."""
+
+    type_tag = TYPE_NPY
+    priority = 10_000  # never chosen for writes
+
+    def can_serialize(self, obj):
+        return False
+
+    def deserialize(self, payload):
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+class _PickleSerializer(ArtifactSerializer):
+    """The universal fallback (device arrays moved to host first)."""
+
+    type_tag = TYPE_PICKLE
+    priority = 9999
+
+    def can_serialize(self, obj):
+        return True
+
+    def serialize(self, obj):
+        return pickle.dumps(
+            _pickle_safe(obj), protocol=pickle.HIGHEST_PROTOCOL
+        )
+
+    def deserialize(self, payload):
+        return pickle.loads(payload)
+
+
+for _s in (_TensorSerializer(), _PytreeSerializer(), _LegacyNpySerializer(),
+           _PickleSerializer()):
+    register_serializer(_s)
 
 
 def _pickle_safe(obj):

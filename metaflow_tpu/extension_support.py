@@ -16,6 +16,8 @@ or its own ``__init__``, exporting any of:
     STORAGE_BACKENDS   dict  name -> DataStoreStorage subclass
     METADATA_PROVIDERS dict  name -> MetadataProvider subclass
     CLI_COMMANDS       list of click commands added to every flow CLI
+    SERIALIZERS        list of ArtifactSerializer INSTANCES (merged by
+                       ``.type_tag``; priority orders them vs. built-ins)
     register(api)      callable for imperative registration; ``api`` is this
                        module (use api.add_step_decorator(cls) etc.)
 
@@ -76,6 +78,12 @@ def add_cli_command(cmd):
     return cmd
 
 
+def add_serializer(serializer):
+    from .datastore.serializers import register_serializer
+
+    return register_serializer(serializer)
+
+
 def _merge(mod):
     for cls in getattr(mod, "STEP_DECORATORS", []):
         add_step_decorator(cls)
@@ -87,6 +95,8 @@ def _merge(mod):
         add_metadata_provider(name, cls)
     for cmd in getattr(mod, "CLI_COMMANDS", []):
         add_cli_command(cmd)
+    for serializer in getattr(mod, "SERIALIZERS", []):
+        add_serializer(serializer)
     reg = getattr(mod, "register", None)
     if callable(reg):
         reg(sys.modules[__name__])
@@ -104,6 +114,7 @@ def failed_extensions():
 
 def _registry_snapshot():
     from . import plugins
+    from .datastore import serializers
     from .datastore.storage import STORAGE_BACKENDS
     from .metadata import METADATA_PROVIDERS
 
@@ -113,15 +124,17 @@ def _registry_snapshot():
         dict(STORAGE_BACKENDS),
         dict(METADATA_PROVIDERS),
         list(CLI_COMMANDS),
+        list(serializers._SERIALIZERS),
     )
 
 
 def _registry_restore(snap):
     from . import plugins
+    from .datastore import serializers
     from .datastore.storage import STORAGE_BACKENDS
     from .metadata import METADATA_PROVIDERS
 
-    steps, flows, storage, metadata, clis = snap
+    steps, flows, storage, metadata, clis, serials = snap
     plugins.STEP_DECORATORS.clear()
     plugins.STEP_DECORATORS.update(steps)
     plugins.FLOW_DECORATORS.clear()
@@ -131,6 +144,9 @@ def _registry_restore(snap):
     METADATA_PROVIDERS.clear()
     METADATA_PROVIDERS.update(metadata)
     CLI_COMMANDS[:] = clis
+    serializers._SERIALIZERS[:] = serials
+    serializers._BY_TAG.clear()
+    serializers._BY_TAG.update({s.type_tag: s for s in serials})
 
 
 def load_extensions(force=False):

@@ -1,12 +1,14 @@
 """Deployer: programmatic deployment to production orchestrators.
 
 Reference behavior: metaflow/runner/deployer.py:99 —
-`Deployer('flow.py').argo_workflows().create()` returns a DeployedFlow.
-Compilation happens via the flow's own CLI (`argo-workflows create
---only-json`); applying to a cluster is the caller's `kubectl apply` (no
-cluster access is assumed here).
+`Deployer('flow.py').argo_workflows().create()` returns a DeployedFlow and
+`.trigger()` a TriggeredRun. Compilation happens via the flow's own CLI
+(`argo-workflows create --only-json`); cluster interaction goes through
+kubectl (override the binary with TPUFLOW_KUBECTL — tests use a fake, the
+same pattern as the gcloud TPU launcher).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -14,21 +16,95 @@ import sys
 from ..exception import TpuFlowException
 
 
+def _kubectl():
+    return os.environ.get("TPUFLOW_KUBECTL", "kubectl")
+
+
+class TriggeredRun(object):
+    """A workflow submitted from a deployed template."""
+
+    def __init__(self, name, workflow_name, namespace):
+        self.name = name
+        self.workflow_name = workflow_name
+        self.namespace = namespace
+        # the Argo compiler derives every pod's run id this way (RUN_ID)
+        self.run_id = "argo-%s" % workflow_name
+
+    def status(self):
+        proc = subprocess.run(
+            [_kubectl(), "get", "workflow", self.workflow_name,
+             "-n", self.namespace, "-o", "json"],
+            capture_output=True, text=True, stdin=subprocess.DEVNULL,
+        )
+        if proc.returncode != 0:
+            raise TpuFlowException(
+                "kubectl get workflow failed:\n%s" % proc.stderr)
+        return json.loads(proc.stdout).get("status", {}).get(
+            "phase", "Unknown")
+
+
 class DeployedFlow(object):
-    def __init__(self, name, manifests_yaml):
+    def __init__(self, name, manifests_yaml, namespace="default",
+                 parameters=None):
         self.name = name
         self.manifests = manifests_yaml
+        self.namespace = namespace
+        self._parameters = parameters or {}
 
     def save(self, path):
         with open(path, "w") as f:
             f.write(self.manifests)
         return path
 
-    def trigger(self, **kwargs):
-        raise TpuFlowException(
-            "Triggering needs cluster access: kubectl apply the manifests "
-            "and submit via 'argo submit --from workflowtemplate/%s'."
-            % self.name
+    def apply(self):
+        """kubectl-apply the compiled manifests to the cluster."""
+        proc = subprocess.run(
+            [_kubectl(), "apply", "-n", self.namespace, "-f", "-"],
+            input=self.manifests, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise TpuFlowException("kubectl apply failed:\n%s" % proc.stderr)
+        return self
+
+    def trigger_manifest(self, **parameters):
+        """The submittable Workflow referencing the deployed template —
+        usable directly (`... | kubectl create -f -`) without this API."""
+        params = dict(self._parameters)
+        params.update(parameters)
+        manifest = {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {"generateName": "%s-" % self.name,
+                         "namespace": self.namespace},
+            "spec": {"workflowTemplateRef": {"name": self.name}},
+        }
+        if params:
+            manifest["spec"]["arguments"] = {"parameters": [
+                {"name": k.replace("_", "-"), "value": json.dumps(v)}
+                for k, v in params.items()
+            ]}
+        return manifest
+
+    def trigger(self, **parameters):
+        """Submit one run of the deployed template; returns a TriggeredRun.
+
+        Needs kubectl + cluster access (point TPUFLOW_KUBECTL elsewhere to
+        fake it); without them, use trigger_manifest() and submit however
+        your cluster is reached."""
+        manifest = self.trigger_manifest(**parameters)
+        proc = subprocess.run(
+            [_kubectl(), "create", "-n", self.namespace, "-f", "-",
+             "-o", "json"],
+            input=json.dumps(manifest), capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise TpuFlowException(
+                "workflow submit failed (is kubectl configured? "
+                "TPUFLOW_KUBECTL overrides the binary):\n%s" % proc.stderr
+            )
+        created = json.loads(proc.stdout)
+        return TriggeredRun(
+            self.name, created["metadata"]["name"], self.namespace
         )
 
 
@@ -70,7 +146,8 @@ class ArgoWorkflowsDeployer(object):
         for line in proc.stdout.split("\n"):
             if line.strip().startswith("name:") and name is None:
                 name = line.split(":", 1)[1].strip()
-        return DeployedFlow(name or "unknown", proc.stdout)
+        return DeployedFlow(name or "unknown", proc.stdout,
+                            namespace=self._namespace)
 
 
 class Deployer(object):

@@ -44,9 +44,30 @@ EXT_PLUGINS = textwrap.dedent(
         click.echo("hello-from-extension")
 
 
+    from metaflow_tpu.datastore.serializers import ArtifactSerializer
+
+
+    class Rot13Serializer(ArtifactSerializer):
+        # a custom artifact format contributed by the extension
+        type_tag = "rot13"
+        priority = 5  # ahead of every built-in
+
+        def can_serialize(self, obj):
+            return isinstance(obj, str) and obj.startswith("rot13:")
+
+        def serialize(self, obj):
+            import codecs
+            return codecs.encode(obj, "rot13").encode("utf-8")
+
+        def deserialize(self, payload):
+            import codecs
+            return codecs.decode(payload.decode("utf-8"), "rot13")
+
+
     STEP_DECORATORS = [TraceMeDecorator]
     STORAGE_BACKENDS = {"shadow": ShadowStorage}
     CLI_COMMANDS = [ext_hello]
+    SERIALIZERS = [Rot13Serializer()]
 
 
     def register(api):
@@ -97,6 +118,16 @@ def test_load_extensions_merges_all_categories(ext_dir):
         assert any(
             getattr(c, "name", "") == "ext-hello" for c in ext.CLI_COMMANDS
         )
+        # the extension's serializer takes priority for its objects and
+        # round-trips through the tag registry
+        from metaflow_tpu.datastore import serializers
+
+        payload, tag = serializers.serialize("rot13:secret")
+        assert tag == "rot13"
+        assert serializers.deserialize(payload, tag) == "rot13:secret"
+        # everything else still routes to the built-ins
+        _, tag = serializers.serialize("plain string")
+        assert tag == serializers.TYPE_PICKLE
         # importable like a core decorator
         import metaflow_tpu
 
@@ -106,6 +137,11 @@ def test_load_extensions_merges_all_categories(ext_dir):
         plugins.STEP_DECORATORS.pop("traceme", None)
         STORAGE_BACKENDS.pop("shadow", None)
         ext.CLI_COMMANDS.clear()
+        from metaflow_tpu.datastore import serializers as _s
+
+        rot = _s._BY_TAG.pop("rot13", None)
+        if rot is not None:
+            _s._SERIALIZERS.remove(rot)
 
 
 def test_broken_extension_is_skipped_not_fatal(tmp_path):

@@ -278,3 +278,60 @@ class TestNBDeploy:
             deployed.manifests
         assert "WorkflowTemplate" in deployed.manifests
         dep.cleanup()
+
+
+class TestDeployerTrigger:
+    """trigger(): Workflow-from-template submission through kubectl
+    (faked here — the gcloud-launcher test pattern)."""
+
+    @pytest.fixture
+    def fake_kubectl(self, tmp_path, monkeypatch):
+        log = tmp_path / "kubectl.log"
+        script = tmp_path / "kubectl"
+        script.write_text(
+            "#!/bin/bash\n"
+            "echo \"$@\" >> %s\n"
+            "stdin=$(cat)\n"
+            "echo \"$stdin\" >> %s\n"
+            "if [ \"$1\" = create ]; then\n"
+            "  echo '{\"metadata\": {\"name\": \"linearflow-abc12\"}}'\n"
+            "elif [ \"$1\" = get ]; then\n"
+            "  echo '{\"status\": {\"phase\": \"Succeeded\"}}'\n"
+            "else\n"
+            "  echo applied\n"
+            "fi\n" % (log, log)
+        )
+        script.chmod(0o755)
+        monkeypatch.setenv("TPUFLOW_KUBECTL", str(script))
+        return log
+
+    def test_create_apply_trigger_status(self, runner_env, fake_kubectl):
+        from metaflow_tpu.runner import Deployer
+
+        deployed = Deployer(
+            os.path.join(FLOWS, "linear_flow.py")
+        ).argo_workflows(datastore_root="/srv/shared/tpuflow").create()
+        assert "WorkflowTemplate" in deployed.manifests
+
+        deployed.apply()
+        run = deployed.trigger(alpha=2.5)
+        assert run.workflow_name == "linearflow-abc12"
+        assert run.run_id == "argo-linearflow-abc12"
+        assert run.status() == "Succeeded"
+
+        logged = fake_kubectl.read_text()
+        assert "workflowTemplateRef" in logged
+        assert '"alpha"' in logged and "2.5" in logged
+
+    def test_trigger_manifest_without_kubectl(self, runner_env):
+        from metaflow_tpu.runner import Deployer
+
+        deployed = Deployer(
+            os.path.join(FLOWS, "linear_flow.py")
+        ).argo_workflows(datastore_root="/srv/shared/tpuflow").create()
+        m = deployed.trigger_manifest(alpha=1.5)
+        assert m["kind"] == "Workflow"
+        assert m["spec"]["workflowTemplateRef"]["name"] == deployed.name
+        assert m["spec"]["arguments"]["parameters"] == [
+            {"name": "alpha", "value": "1.5"}
+        ]
