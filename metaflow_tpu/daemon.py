@@ -24,15 +24,46 @@ cache, so edits to *framework* code need a daemon restart; the flow file
 itself is re-imported fresh in every child.
 """
 
+import hashlib
 import json
 import os
 import runpy
 import signal
 import socket
+import struct
 import sys
 import tempfile
 import threading
 import traceback
+
+# Handshake: every request carries the protocol version and a token hashed
+# over the whole package's source, so a stale client from an older
+# checkout cannot silently drive a newer daemon — and a daemon whose
+# warm-imported modules predate a git pull cannot silently serve a newer
+# client. On mismatch the daemon refuses loudly and the `run` CLI falls
+# back to a cold in-process launch.
+PROTO_VERSION = 1
+
+
+def checkout_token():
+    """Hash of every .py file in the package (not just this file): the
+    daemon warm-imports runtime/task/cli, so staleness anywhere in the
+    framework must flip the token."""
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    try:
+        for root, dirs, files in sorted(os.walk(pkg_dir)):
+            dirs.sort()
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(root, name)
+                h.update(os.path.relpath(path, pkg_dir).encode())
+                with open(path, "rb") as f:
+                    h.update(f.read())
+    except OSError:
+        return "unknown"
+    return h.hexdigest()[:16]
 
 
 def default_socket_path():
@@ -57,6 +88,9 @@ class SchedulerDaemon(object):
         self.sock_path = sock_path or default_socket_path()
         self._listener = None
         self._shutdown = threading.Event()
+        # hashed at construction: reflects the code this daemon is running,
+        # not whatever lands on disk later
+        self._token = checkout_token()
 
     def _warm_imports(self):
         """Pay the heavy imports once, before the first fork. Module
@@ -79,6 +113,9 @@ class SchedulerDaemon(object):
         self._warm_imports()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.sock_path)
+        # the daemon executes client-supplied argv as this user: never let
+        # a permissive umask open that to other local users
+        os.chmod(self.sock_path, 0o600)
         self._listener.listen(16)
         with open(_pidfile(self.sock_path), "w") as f:
             f.write(str(os.getpid()))
@@ -114,6 +151,29 @@ class SchedulerDaemon(object):
         """One launch request. Forks on the accept (main) thread; a reaper
         thread per child waits and reports the exit code."""
         fds = []
+
+        def refuse(err):
+            for fd in fds:  # received via SCM_RIGHTS: never leak them
+                os.close(fd)
+            try:
+                conn.sendall(
+                    (json.dumps({"error": err}) + "\n").encode())
+            except OSError:
+                pass
+            conn.close()
+
+        try:
+            _, uid, _ = struct.unpack(
+                "3i", conn.getsockopt(socket.SOL_SOCKET,
+                                      socket.SO_PEERCRED,
+                                      struct.calcsize("3i")))
+        except OSError:
+            uid = None
+        if uid != os.getuid():
+            # socket mode 0600 already gates this; the peercred check
+            # holds even if the socket was created under an older umask
+            refuse("peer uid %r != %d" % (uid, os.getuid()))
+            return
         try:
             # a hung client must not wedge the accept loop: bound the
             # header read (forks stay on this thread by design)
@@ -127,14 +187,29 @@ class SchedulerDaemon(object):
             conn.close()
             return
         if req.get("op") == "ping":
-            conn.sendall(b'{"ok": true}\n')
-            conn.close()
             for fd in fds:
                 os.close(fd)
+            try:
+                # a client that timed out and hung up must not unwind the
+                # accept loop (serve() has no per-connection guard)
+                conn.sendall((json.dumps(
+                    {"ok": True, "proto": PROTO_VERSION,
+                     "token": self._token}
+                ) + "\n").encode())
+            except OSError:
+                pass
+            conn.close()
+            return
+        if (req.get("proto") != PROTO_VERSION
+                or req.get("token") != self._token):
+            refuse(
+                "handshake mismatch (client proto=%r token=%r, daemon "
+                "proto=%r token=%r): restart the daemon from this checkout"
+                % (req.get("proto"), req.get("token"),
+                   PROTO_VERSION, self._token))
             return
         if len(fds) != 3:
-            conn.sendall(b'{"error": "need stdin/stdout/stderr fds"}\n')
-            conn.close()
+            refuse("need stdin/stdout/stderr fds")
             return
 
         pid = os.fork()
@@ -225,6 +300,8 @@ def run_via_daemon(argv, sock_path=None, cwd=None, env=None,
             "metaflow_tpu.daemon start)" % sock_path
         ) from ex
     req = {
+        "proto": PROTO_VERSION,
+        "token": checkout_token(),
         "argv": list(argv),
         "cwd": cwd or os.getcwd(),
         "env": dict(env if env is not None else os.environ),
@@ -336,8 +413,14 @@ def main(argv):
         try:
             return run_via_daemon(rest)
         except DaemonUnavailable as ex:
-            print(str(ex), file=sys.stderr)
-            return 1
+            # no daemon, or a handshake mismatch: cold launch instead of
+            # failing the run (the warm path is an optimization, never a
+            # requirement)
+            print("%s; falling back to a cold launch" % ex,
+                  file=sys.stderr)
+            import subprocess
+
+            return subprocess.run([sys.executable] + list(rest)).returncode
     print("unknown daemon command %r" % cmd)
     return 2
 

@@ -13,6 +13,7 @@ deadlock them) — everything they touch is resolved at module import.
 
 import os
 import pickle
+import sys
 import tempfile
 import traceback
 
@@ -46,6 +47,10 @@ def parallel_map(fn, items, max_parallel=None, min_chunk=4):
         for idx, chunk in enumerate(chunks):
             fd, path = tempfile.mkstemp(prefix="mfmap-")
             os.close(fd)
+            # parent-buffered output would be duplicated into every
+            # worker's stream on its exit otherwise
+            sys.stdout.flush()
+            sys.stderr.flush()
             pid = os.fork()
             if pid == 0:
                 code = 1

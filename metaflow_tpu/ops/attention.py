@@ -411,14 +411,45 @@ def _flash_block_fwd_kernel(q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref, *,
     )
 
 
+def blocks_aligned(S):
+    """True when seq len S satisfies the flash-kernel contract with the
+    effective block sizes: S divisible by both blocks (a fori_loop bound
+    of seq_len // block_k silently drops the k tail otherwise) and mutual
+    block divisibility (the causal live-block count is exact only then).
+    Single source of truth for both the kernels and the auto-dispatchers
+    here and in ring_attention."""
+    bq, bk = min(BLOCK_Q, S), min(BLOCK_K, S)
+    return (S % bq == 0 and S % bk == 0
+            and (bq % bk == 0 or bk % bq == 0))
+
+
+def _check_blocks(S):
+    """Effective (block_q, block_k) for seq len S; raises on a
+    blocks_aligned violation — raising beats returning wrong attention
+    output with no error."""
+    block_q = min(BLOCK_Q, S)
+    block_k = min(BLOCK_K, S)
+    if S % block_q or S % block_k:
+        raise ValueError(
+            "flash block kernels require seq len divisible by the %d/%d "
+            "block sizes (got %d); use the xla impl or pad the sequence"
+            % (BLOCK_Q, BLOCK_K, S)
+        )
+    if block_q % block_k and block_k % block_q:
+        raise ValueError(
+            "flash attention block sizes must divide one another (got "
+            "q=%d, k=%d via TPUFLOW_FLASH_BLOCK_Q/K)" % (block_q, block_k)
+        )
+    return block_q, block_k
+
+
 def flash_block_fwd(q, k, v, scale, causal_diag, interpret=False):
     """One ring step's unnormalized contribution.
 
     q, k, v: [BH, S, D] (heads folded). Returns (acc f32 [BH,S,D],
     m f32 [BH,S], l f32 [BH,S])."""
     BH, S, D = q.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
+    block_q, block_k = _check_blocks(S)
     acc, m, l = pl.pallas_call(
         functools.partial(
             _flash_block_fwd_kernel,
@@ -456,8 +487,7 @@ def flash_block_bwd(q, k, v, g, lse, delta, scale, causal_diag,
     each blockwise p exact, so contributions just sum across ring hops.
     Returns (dq, dk, dv) in f32, shapes [BH, S, D]."""
     BH, S, D = q.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
+    block_q, block_k = _check_blocks(S)
     lse_t = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
     delta_t = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
     stats_spec = pl.BlockSpec((1, 8, S), lambda b, i: (b, 0, 0))
@@ -514,12 +544,7 @@ def attention(q, k, v, causal=True, scale=None, impl="auto"):
     if impl == "auto":
         S, D = q.shape[1], q.shape[3]
         on_tpu = jax.default_backend() == "tpu"
-        bq, bk = min(BLOCK_Q, S), min(BLOCK_K, S)
-        aligned = (
-            S % bq == 0 and S % bk == 0 and D % 128 == 0 and S >= bq
-            # kernels require one block size to divide the other
-            and (bq % bk == 0 or bk % bq == 0)
-        )
+        aligned = blocks_aligned(S) and D % 128 == 0
         impl = "flash" if (HAS_PALLAS and on_tpu and aligned) else "xla"
     if impl == "flash":
         return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -32,11 +32,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .attention import (
+    BLOCK_K,
     BLOCK_Q,
     HAS_PALLAS,
     _broadcast_gqa,
     _fold_heads,
     _unfold_heads,
+    blocks_aligned,
     flash_block_bwd,
     flash_block_fwd,
 )
@@ -291,18 +293,20 @@ def _ring_attention_local_flash(q, k, v, axis_name, causal=True, scale=None,
 def _resolve_impl(impl, S_local):
     if impl == "auto":
         impl = os.environ.get("TPUFLOW_RING_IMPL", "auto")
+    # same predicate flash_block_fwd/bwd enforce — single source of truth
+    aligned = blocks_aligned(S_local)
     if impl == "auto":
-        aligned = S_local % BLOCK_Q == 0
         on_tpu = jax.default_backend() == "tpu"
         impl = "flash" if (HAS_PALLAS and on_tpu and aligned) else "xla"
-    if impl in ("flash", "flash_interpret") and S_local > BLOCK_Q \
-            and S_local % BLOCK_Q != 0:
+    if impl in ("flash", "flash_interpret") and not aligned:
         # an explicitly requested flash impl must not silently drop the
         # unaligned tail (grid floor-division would leave rows unwritten)
         raise ValueError(
             "ring flash attention needs the per-device sequence shard "
-            "(%d) to be a multiple of the %d block; use impl='xla' or "
-            "pad the sequence" % (S_local, BLOCK_Q)
+            "(%d) to be a multiple of both block sizes (q=%d, k=%d via "
+            "TPUFLOW_FLASH_BLOCK_Q/K), with one block dividing the "
+            "other; use impl='xla' or pad the sequence"
+            % (S_local, min(BLOCK_Q, S_local), min(BLOCK_K, S_local))
         )
     return impl
 

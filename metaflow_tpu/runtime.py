@@ -268,6 +268,7 @@ class NativeRuntime(object):
         self._runstate_last = 0.0
         self._runstate_prev = None
         self._runstate_thread = None
+        self._runstate_gen = 0
 
         # resume support: index the origin run's finished tasks
         self._origin_index = {}
@@ -431,7 +432,15 @@ class NativeRuntime(object):
         if snap == self._runstate_prev and not force:
             return  # hour-long steps must not re-upload identical snapshots
 
-        def save(payload=dict(snap, ts=now)):
+        self._runstate_gen += 1
+        gen = self._runstate_gen
+
+        def save(payload=dict(snap, ts=now), gen=gen):
+            if gen != self._runstate_gen:
+                # superseded while queued/stalled: a slow upload of an
+                # older snapshot must not clobber a newer one (the final
+                # crash snapshot in particular)
+                return
             try:
                 self._flow_datastore.save_runstate(self.run_id, payload)
                 # only a successful save suppresses the next upload — a
@@ -443,7 +452,11 @@ class NativeRuntime(object):
         if force:
             # crash/exit path: the process may be about to die. Join any
             # in-flight background upload first so a slower, older snapshot
-            # can't land after (and clobber) this final one.
+            # can't land after (and clobber) this final one; if the join
+            # times out, the generation check stops a stale thread that
+            # hasn't entered save_runstate yet (one already inside a
+            # stalled backend call can still land late — unavoidable
+            # without backend-side versioning).
             if self._runstate_thread is not None:
                 self._runstate_thread.join(timeout=10)
             save()

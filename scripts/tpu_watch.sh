@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the TPU tunnel until it responds, then capture one on-chip bench.
+# Appends to BENCH_HISTORY.jsonl (bench.py does that at measurement time)
+# and writes .tpu_status so the interactive session can see progress.
+cd /root/repo
+STATUS=.tpu_status
+echo "watch_start $(date -u +%FT%TZ)" > "$STATUS"
+n=0
+while true; do
+  n=$((n+1))
+  if timeout 120 python -c "import jax; print(jax.default_backend())" 2>/dev/null | grep -q tpu; then
+    echo "alive $(date -u +%FT%TZ) probe=$n" >> "$STATUS"
+    # one full on-chip bench; bench.py probes again (fast when alive) and
+    # appends BENCH_HISTORY.jsonl itself
+    BENCH_TUNNEL_WAIT=300 timeout 1800 python bench.py >> "$STATUS" 2>&1
+    echo "bench_done $(date -u +%FT%TZ) rc=$?" >> "$STATUS"
+    exit 0
+  fi
+  echo "probe $n unresponsive $(date -u +%FT%TZ)" >> "$STATUS"
+  sleep 180
+done

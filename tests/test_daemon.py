@@ -121,6 +121,53 @@ def test_sigterm_forwarded_kills_run(daemon, tpuflow_root, tmp_path):
     assert code != 0  # the run died with the client, not after 120s
 
 
+def test_stale_client_handshake_rejected(daemon):
+    """A client from a different checkout (wrong token) or speaking an
+    older protocol is refused loudly instead of silently driven."""
+    import socket as socket_mod
+
+    from metaflow_tpu.daemon import (
+        PROTO_VERSION,
+        DaemonUnavailable,
+        checkout_token,
+        run_via_daemon,
+    )
+
+    def attempt(req):
+        sock = socket_mod.socket(socket_mod.AF_UNIX,
+                                 socket_mod.SOCK_STREAM)
+        sock.connect(daemon)
+        r, w = os.pipe()
+        try:
+            socket_mod.send_fds(
+                sock, [json.dumps(req).encode()], [0, w, w])
+            return json.loads(sock.makefile("r").readline())
+        finally:
+            os.close(r)
+            os.close(w)
+            sock.close()
+
+    base = {"argv": ["x.py"], "cwd": FLOWS, "env": {}}
+    stale_token = attempt(dict(base, proto=PROTO_VERSION, token="stale"))
+    assert "handshake mismatch" in stale_token.get("error", "")
+    old_proto = attempt(dict(base, proto=0, token=checkout_token()))
+    assert "handshake mismatch" in old_proto.get("error", "")
+    # a pre-handshake client that sends neither field is refused too
+    legacy = attempt(base)
+    assert "error" in legacy
+    # the daemon survives all three refusals and still serves pings
+    from metaflow_tpu.daemon import ping
+
+    assert ping(sock_path=daemon)
+
+
+def test_socket_permissions(daemon):
+    """The daemon executes client argv as this user: the socket must not
+    be writable by anyone else regardless of umask."""
+    mode = os.stat(daemon).st_mode & 0o777
+    assert mode == 0o600, oct(mode)
+
+
 def test_concurrent_runs(daemon, tpuflow_root):
     """Launches don't serialize: two overlapping runs both finish."""
     import threading
