@@ -152,6 +152,10 @@ def _write_argo_outputs(state, out_dir, run_id, step_name, task_id):
         next_step = transition[0][0]
     with open(os.path.join(out_dir, "num-splits"), "w") as f:
         json.dump(list(range(int(num_splits))), f)
+    with open(os.path.join(out_dir, "num-parallel"), "w") as f:
+        # gang cardinality as a scalar: substituted into the JobSet
+        # manifest's completions/parallelism by the gang resource template
+        f.write(str(int(num_splits) or 1))
     with open(os.path.join(out_dir, "next-step"), "w") as f:
         f.write(next_step)
 

@@ -163,15 +163,18 @@ class SchedulerDaemon(object):
             conn.close()
 
         try:
+            # Linux-only (macOS/BSD spell it LOCAL_PEERCRED): when
+            # unavailable the 0600 socket mode is the sole gate, which is
+            # still a same-uid guarantee on any sane filesystem
             _, uid, _ = struct.unpack(
                 "3i", conn.getsockopt(socket.SOL_SOCKET,
                                       socket.SO_PEERCRED,
                                       struct.calcsize("3i")))
-        except OSError:
+        except (OSError, AttributeError):
             uid = None
-        if uid != os.getuid():
-            # socket mode 0600 already gates this; the peercred check
-            # holds even if the socket was created under an older umask
+        if uid is not None and uid != os.getuid():
+            # belt to the 0600 braces: holds even if the socket was
+            # created under an older checkout/umask
             refuse("peer uid %r != %d" % (uid, os.getuid()))
             return
         try:

@@ -351,21 +351,7 @@ def flash_attention(q, k, v, causal=True, scale=None, interpret=False):
             "use attention(impl='auto') for an XLA fallback"
         )
     B, S, H, D = q.shape
-    block_q = min(BLOCK_Q, S)
-    block_k = min(BLOCK_K, S)
-    if S % block_q or S % block_k:
-        raise ValueError(
-            "flash_attention requires seq len divisible by the %d/%d block "
-            "sizes (got %d); use attention(impl='auto') for a fallback"
-            % (BLOCK_Q, BLOCK_K, S)
-        )
-    if block_q % block_k and block_k % block_q:
-        # the causal live-block arithmetic in the kernels is exact only
-        # when one block size divides the other (see _online_softmax_loop)
-        raise ValueError(
-            "flash attention block sizes must divide one another (got "
-            "q=%d, k=%d via TPUFLOW_FLASH_BLOCK_Q/K)" % (block_q, block_k)
-        )
+    block_q, block_k = _check_blocks(S)
     k = _broadcast_gqa(k, H)
     v = _broadcast_gqa(v, H)
     scale = scale or (1.0 / math.sqrt(D))
@@ -426,16 +412,18 @@ def blocks_aligned(S):
 def _check_blocks(S):
     """Effective (block_q, block_k) for seq len S; raises on a
     blocks_aligned violation — raising beats returning wrong attention
-    output with no error."""
+    output with no error. The decision is blocks_aligned itself (one
+    predicate for dispatchers and kernels); only the message is derived
+    here."""
     block_q = min(BLOCK_Q, S)
     block_k = min(BLOCK_K, S)
-    if S % block_q or S % block_k:
-        raise ValueError(
-            "flash block kernels require seq len divisible by the %d/%d "
-            "block sizes (got %d); use the xla impl or pad the sequence"
-            % (BLOCK_Q, BLOCK_K, S)
-        )
-    if block_q % block_k and block_k % block_q:
+    if not blocks_aligned(S):
+        if S % block_q or S % block_k:
+            raise ValueError(
+                "flash block kernels require seq len divisible by the "
+                "%d/%d block sizes (got %d); use the xla impl or pad the "
+                "sequence" % (BLOCK_Q, BLOCK_K, S)
+            )
         raise ValueError(
             "flash attention block sizes must divide one another (got "
             "q=%d, k=%d via TPUFLOW_FLASH_BLOCK_Q/K)" % (block_q, block_k)

@@ -26,16 +26,25 @@ compile-shaped):
     each branch guards on it with a `when` expression.
 
 TPU-first differences from the reference's K8s compilation:
-  - @tpu steps request `google.com/tpu` resources and set the
+  - @tpu steps request `google.com/tpu` resources (chips-per-host derived
+    from the topology) and set the
     `cloud.google.com/gke-tpu-accelerator`/`-topology` node selectors GKE
     uses to schedule onto TPU slices.
-  - gang (num_parallel) steps compile to a single control task whose pod
-    lands on a multi-host TPU slice: the slice IS the gang, host 0 is the
-    control (SURVEY.md §2.9), so no JobSet indirection is needed —
-    jax.distributed discovers peers from the TPU metadata.
+  - gang (num_parallel) steps compile to an Argo RESOURCE template that
+    creates a JobSet (jobset.x-k8s.io, the GKE-required mechanism for
+    multi-host TPU) with ONE Indexed Job of N completions — one pod per
+    rank, co-scheduled, with stable per-pod DNS via the JobSet's headless
+    service. Rank comes from JOB_COMPLETION_INDEX; the jax.distributed
+    coordinator is rank 0's pod hostname. The reference reaches the same
+    shape through KubernetesArgoJobSet
+    (metaflow/plugins/argo/argo_workflows.py:2646-2727,
+    kubernetes_jobsets.py:480 — control+worker ReplicatedJobs); here a
+    single replicated job with index-derived roles keeps every pod
+    identical.
 """
 
 import json
+import re
 import shlex
 
 from ...exception import TpuFlowException
@@ -56,17 +65,8 @@ def _argo_name(name):
     """Argo template/task names must be DNS-1123-ish."""
     return name.lower().replace("_", "-")
 
-TPU_TOPOLOGY_SELECTORS = {
-    # topology → (accelerator type, gke topology, hosts)
-    "v5p-8": ("tpu-v5p-slice", "2x2x1", 1),
-    "v5p-16": ("tpu-v5p-slice", "2x2x2", 2),
-    "v5p-32": ("tpu-v5p-slice", "2x2x4", 4),
-    "v5p-64": ("tpu-v5p-slice", "2x4x4", 8),
-    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 1),
-    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 1),
-    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 2),
-    "v5e-256": ("tpu-v5-lite-podslice", "16x16", 32),
-}
+from ..tpu.topologies import TPU_TOPOLOGY_SELECTORS  # noqa: E402 — shared
+# with the runtime guards in plugins/tpu (single source for host/chip math)
 
 
 class ArgoWorkflows(object):
@@ -158,17 +158,37 @@ class ArgoWorkflows(object):
         flags += " --metadata %s" % self.metadata
         return flags
 
-    def _step_command(self, node):
+    def _step_command(self, node, gang=False):
         """The container command: bootstrap the code package, then run the
         same `step` command the local runtime uses — wrapped in the mflog
-        capture supervisor so pod logs land in the shared datastore."""
+        capture supervisor so pod logs land in the shared datastore.
+
+        gang=True builds the per-rank command of an Indexed Job pod: every
+        pod is identical, so role (control vs worker), task id and split
+        index derive from JOB_COMPLETION_INDEX in shell."""
         from ...package import MetaflowPackage
+        from ...unbounded_foreach import UBF_CONTROL, UBF_TASK
 
         cmds = []
         if self.package_url:
             cmds += MetaflowPackage.bootstrap_commands(self.package_url)
 
         task_id = "{{inputs.parameters.task-id}}"
+        if gang:
+            # worker task ids follow the `{control}-node-{i}` contract the
+            # local fork path and the parallel decorator's external-gang
+            # registration both use
+            cmds.append(
+                'IDX="${JOB_COMPLETION_INDEX:?JOB_COMPLETION_INDEX unset '
+                '- gang pods must run in an Indexed Job}"'
+            )
+            cmds.append(
+                'if [ "$IDX" = "0" ]; then TASK_ID=%(ctl)s; UBF=%(c)s; '
+                'else TASK_ID=%(ctl)s-node-$IDX; UBF=%(w)s; fi'
+                % {"ctl": task_id, "c": UBF_CONTROL, "w": UBF_TASK}
+            )
+            cmds.append('export MF_PARALLEL_NODE_INDEX="$IDX"')
+            task_id = '"$TASK_ID"'
         retries = "{{retries}}" if self._retries_for(node) else "0"
         step_opts = [
             "--run-id %s" % RUN_ID,
@@ -210,11 +230,8 @@ class ArgoWorkflows(object):
             step_opts.append(
                 "--split-index '{{inputs.parameters.split-index}}'"
             )
-        if node.parallel_step:
-            from ...unbounded_foreach import UBF_CONTROL
-
-            step_opts += ["--ubf-context %s" % UBF_CONTROL,
-                          "--split-index 0"]
+        if gang:
+            step_opts += ['--ubf-context "$UBF"', '--split-index "$IDX"']
         if node.type in ("foreach", "split-switch", "split-parallel"):
             step_opts.append("--argo-output-dir %s" % ARGO_OUTPUT_DIR)
 
@@ -303,12 +320,13 @@ class ArgoWorkflows(object):
                             "Unknown TPU topology %r; known: %s"
                             % (topo, ", ".join(sorted(TPU_TOPOLOGY_SELECTORS)))
                         )
-                    acc, gke_topo, _hosts = TPU_TOPOLOGY_SELECTORS[topo]
+                    acc, gke_topo, _hosts, chips = \
+                        TPU_TOPOLOGY_SELECTORS[topo]
                     node_selector = {
                         "cloud.google.com/gke-tpu-accelerator": acc,
                         "cloud.google.com/gke-tpu-topology": gke_topo,
                     }
-                    res["limits"]["google.com/tpu"] = "4"
+                    res["limits"]["google.com/tpu"] = str(chips)
         return res, node_selector
 
     def _container_env(self, node):
@@ -352,6 +370,13 @@ class ArgoWorkflows(object):
                     },
                 },
                 {
+                    "name": "num-parallel",
+                    "valueFrom": {
+                        "path": "%s/num-parallel" % ARGO_OUTPUT_DIR,
+                        "default": "1",
+                    },
+                },
+                {
                     "name": "next-step",
                     "valueFrom": {
                         "path": "%s/next-step" % ARGO_OUTPUT_DIR,
@@ -366,13 +391,166 @@ class ArgoWorkflows(object):
                 "limit": retries,
                 "retryPolicy": "Always",
             }
-        if node.parallel_step:
-            # gang pods land on one multi-host slice; completions/parallelism
-            # follow the slice's host count via the TPU topology selector
-            template.setdefault("metadata", {}).setdefault("labels", {})[
-                "tpuflow/gang"
-            ] = "true"
         return template
+
+    # ---------------- gang (num_parallel) resource template ----------------
+
+    # placeholder for spots where Argo must substitute an INTEGER into the
+    # JobSet manifest (yaml dumping would quote a literal {{...}} string)
+    _NUMPAR_INT = "TPUFLOW_NUMPAR_INT"
+
+    def _gang_template(self, node):
+        """An Argo resource template creating a JobSet for a gang step:
+        one Indexed Job, completions == parallelism == num_parallel, one
+        pod per rank. The JobSet's headless service gives every pod a
+        stable DNS name; rank 0's (`<js>-gang-0-0.<js>`) is the
+        jax.distributed coordinator address.
+
+        Reference shape: KubernetesArgoJobSet embedded in the Argo
+        template (metaflow/plugins/argo/argo_workflows.py:2646-2727); the
+        one-replicated-job/index-derived-role layout keeps every pod
+        identical instead of splitting control/worker jobs."""
+        import yaml
+
+        resources, node_selector = self._resources_for(node)
+        retries = self._retries_for(node)
+        self._validate_gang_hosts(node)
+        # unique per (workflow, step, attempt): a retried resource
+        # template must not collide with the JobSet it created last time.
+        # Argo only defines {{retries}} inside templates that have a
+        # retryStrategy — bake a literal 0 otherwise.
+        attempt = "{{retries}}" if retries else "0"
+        js_name = "{{workflow.name}}-%s-r%s" % (_argo_name(node.name),
+                                                attempt)
+        container = {
+            "name": "main",
+            "image": self.image,
+            "command": self._step_command(node, gang=True),
+            "resources": resources,
+            "env": self._gang_env(node, js_name),
+        }
+        pod_spec = {
+            "restartPolicy": "Never",
+            # JobSet sets subdomain to the headless service it manages
+            "containers": [container],
+        }
+        if node_selector:
+            pod_spec["nodeSelector"] = node_selector
+        manifest = {
+            "apiVersion": "jobset.x-k8s.io/v1alpha2",
+            "kind": "JobSet",
+            "metadata": {
+                "name": js_name,
+                "namespace": self.namespace,
+                "labels": {"tpuflow/gang": "true"},
+            },
+            "spec": {
+                # per-pod DNS hostnames via the JobSet-managed headless svc
+                "network": {"enableDNSHostnames": True},
+                # rank failure fails the whole gang; retry is the Argo
+                # template's retryStrategy recreating the JobSet, so the
+                # gang re-rendezvouses from scratch
+                "failurePolicy": {"maxRestarts": 0},
+                "replicatedJobs": [{
+                    "name": "gang",
+                    "replicas": 1,
+                    "template": {"spec": {
+                        "completions": self._NUMPAR_INT,
+                        "parallelism": self._NUMPAR_INT,
+                        "completionMode": "Indexed",
+                        "backoffLimit": 0,
+                        "template": {"spec": pod_spec},
+                    }},
+                }],
+            },
+        }
+        text = yaml.safe_dump(manifest, sort_keys=False)
+        # completions/parallelism must substitute UNQUOTED (they are ints
+        # after Argo fills the parameter in)
+        text = re.sub(
+            r"'?%s'?" % self._NUMPAR_INT,
+            "{{inputs.parameters.num-parallel}}",
+            text,
+        )
+        template = {
+            "name": _argo_name(node.name),
+            "inputs": {"parameters": [
+                {"name": "input-paths", "value": ""},
+                {"name": "num-parallel", "value": "1"},
+                {"name": "task-id", "value": node.name},
+            ]},
+            "resource": {
+                "action": "create",
+                "setOwnerReference": True,
+                "successCondition": "status.terminalState == Completed",
+                "failureCondition": "status.terminalState == Failed",
+                "manifest": text,
+            },
+        }
+        if retries:
+            template["retryStrategy"] = {
+                "limit": retries,
+                "retryPolicy": "Always",
+            }
+        return template
+
+    def _validate_gang_hosts(self, node):
+        """A multi-host slice needs exactly ONE pod per host: when both
+        the gang size and the @tpu topology are static, a mismatch is a
+        compile error here instead of a JobSet that can never schedule
+        (or a jax.distributed hang waiting for hosts that don't exist)."""
+        from ..tpu.topologies import hosts_for
+
+        topo = next(
+            (deco.attributes.get("topology")
+             for deco in getattr(self.flow, node.name).decorators
+             if deco.name == "tpu" and deco.attributes.get("topology")),
+            None,
+        )
+        if not topo:
+            return
+        hosts = hosts_for(topo)
+        split_parent = next(
+            (f for f in node.in_funcs
+             if self.graph[f].type == "split-parallel"), None)
+        literal_n = (self.graph[split_parent].num_parallel
+                     if split_parent else 0)
+        if hosts and literal_n and literal_n != hosts:
+            raise TpuFlowException(
+                "Step *%s*: num_parallel=%d but topology %r has %d hosts "
+                "— a gang must run exactly one pod per host of its slice "
+                "(GKE schedules one pod per TPU host)."
+                % (node.name, literal_n, topo, hosts)
+            )
+
+    def _gang_env(self, node, js_name):
+        """Env for every gang pod. JOB_COMPLETION_INDEX is injected by
+        Kubernetes (Indexed Job); the node index export happens in the
+        command after the rank branch."""
+        env = list(self._base_env())
+        has_tpu_topology = any(
+            deco.name == "tpu" and deco.attributes.get("topology")
+            for deco in getattr(self.flow, node.name).decorators
+        )
+        if has_tpu_topology:
+            # a real multi-host slice: jax.distributed discovers peers
+            # from the TPU runtime metadata GKE injects
+            env.append({"name": "MF_PARALLEL_REMOTE", "value": "1"})
+        else:
+            # CPU/GPU gang: explicit rendezvous on rank 0's pod DNS name
+            env.append({"name": "MF_PARALLEL_EXTERNAL", "value": "1"})
+        env += [
+            {"name": "MF_PARALLEL_NUM_NODES",
+             "value": "{{inputs.parameters.num-parallel}}"},
+            {"name": "MF_PARALLEL_CONTROL_TASK_ID",
+             "value": "{{inputs.parameters.task-id}}"},
+            # first pod of the first (only) job of the `gang` replicated
+            # job, resolved via the JobSet headless service
+            {"name": "MF_PARALLEL_MAIN_IP",
+             "value": "%s-gang-0-0.%s" % (js_name, js_name)},
+            {"name": "MF_PARALLEL_COORDINATOR_PORT", "value": "9379"},
+        ]
+        return env
 
     # ---------------- DAG wiring ----------------
 
@@ -421,6 +599,19 @@ class ArgoWorkflows(object):
                 params.append({
                     "name": "input-paths",
                     "value": self._input_paths_value(node),
+                })
+
+            if node.parallel_step:
+                # gang cardinality: the split-parallel parent recorded
+                # num_parallel as an output parameter
+                split_parent = next(
+                    f for f in node.in_funcs
+                    if self.graph[f].type == "split-parallel"
+                )
+                params.append({
+                    "name": "num-parallel",
+                    "value": "{{tasks.%s.outputs.parameters.num-parallel}}"
+                    % _argo_name(split_parent),
                 })
 
             if is_child:
@@ -487,7 +678,9 @@ class ArgoWorkflows(object):
                 "templates": [
                     {"name": "dag", "dag": {"tasks": self._dag_tasks()}}
                 ] + [
-                    self._container_template(self.graph[name])
+                    (self._gang_template(self.graph[name])
+                     if self.graph[name].parallel_step
+                     else self._container_template(self.graph[name]))
                     for name in self.graph.sorted_nodes()
                 ],
             },

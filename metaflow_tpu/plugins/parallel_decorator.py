@@ -70,10 +70,18 @@ class ParallelDecorator(StepDecorator):
 
     def task_decorate(self, step_func, flow, graph, retry_count,
                       max_user_code_retries, ubf_context):
-        if (
-            ubf_context == UBF_CONTROL
-            and os.environ.get("MF_PARALLEL_REMOTE", "0") != "1"
-        ):
+        # Two externally-launched rank modes (the launcher — an Indexed
+        # Job/JobSet on Argo, gcloud on TPU-VM — starts one process per
+        # rank, so the control task must NOT fork):
+        #   MF_PARALLEL_REMOTE=1    real TPU slice; jax discovers peers
+        #                           from the TPU metadata
+        #   MF_PARALLEL_EXTERNAL=1  explicit rendezvous from MF_PARALLEL_*
+        #                           (coordinator addr/port env)
+        external = (
+            os.environ.get("MF_PARALLEL_REMOTE", "0") == "1"
+            or os.environ.get("MF_PARALLEL_EXTERNAL", "0") == "1"
+        )
+        if ubf_context == UBF_CONTROL and not external:
             # local gang: the control task is responsible for forking the
             # workers, running rank 0 itself, and reaping the children
             return lambda: self._local_multinode_control_task_step_func(
@@ -81,6 +89,13 @@ class ParallelDecorator(StepDecorator):
             )
 
         def wrapped():
+            if ubf_context == UBF_CONTROL:
+                # rank 0 of an external gang: record the membership the
+                # join and _finalize_control_task need (the local fork
+                # path does this after forking; external launchers derive
+                # task ids instead of assigning them, so the contract is
+                # reconstructed here)
+                self._register_external_gang(flow)
             self.setup_distributed_env(flow)
             try:
                 step_func()
@@ -89,6 +104,34 @@ class ParallelDecorator(StepDecorator):
 
         wrapped.__name__ = step_func.__name__
         return wrapped
+
+    def _register_external_gang(self, flow):
+        """Record _control_mapper_tasks for an externally-launched gang:
+        worker task ids follow the same `{control}-node-{i}` naming the
+        local fork path and every launcher use."""
+        num_nodes = int(os.environ.get("MF_PARALLEL_NUM_NODES", "1"))
+        control_task_id = str(self._task_id)
+        mapper_task_ids = [control_task_id] + [
+            "%s-node-%d" % (control_task_id, i)
+            for i in range(1, num_nodes)
+        ]
+        flow._control_mapper_tasks = [
+            "/".join((self._run_id, self._step_name, task_id))
+            for task_id in mapper_task_ids
+        ]
+        self._metadata.register_metadata(
+            self._run_id,
+            self._step_name,
+            control_task_id,
+            [
+                MetaDatum(
+                    "control-mapper-tasks",
+                    json.dumps(flow._control_mapper_tasks),
+                    "control-mapper-tasks",
+                    [],
+                )
+            ],
+        )
 
     def _local_multinode_control_task_step_func(self, flow, graph, step_func,
                                                 retry_count):

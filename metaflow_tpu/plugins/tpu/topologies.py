@@ -1,0 +1,28 @@
+"""TPU slice topology facts shared by the Argo compiler and the runtime
+guards: GKE node-selector values, host counts, and chips-per-host.
+
+GKE requires a pod on a TPU host to request ALL of that host's chips
+(`google.com/tpu` == chips-per-host): every v5p host has 4 chips; v5e
+hosts have 4 or 8 depending on the slice shape. A multi-host slice needs
+exactly one pod per host, so a gang's num_parallel must equal the host
+count (validated at Argo compile time when the literal is known, and at
+task start otherwise).
+"""
+
+TPU_TOPOLOGY_SELECTORS = {
+    # topology → (accelerator type, gke topology, hosts, chips per host)
+    "v5p-8": ("tpu-v5p-slice", "2x2x1", 1, 4),
+    "v5p-16": ("tpu-v5p-slice", "2x2x2", 2, 4),
+    "v5p-32": ("tpu-v5p-slice", "2x2x4", 4, 4),
+    "v5p-64": ("tpu-v5p-slice", "2x4x4", 8, 4),
+    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 1, 4),
+    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 1, 8),
+    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 2, 8),
+    "v5e-256": ("tpu-v5-lite-podslice", "16x16", 32, 8),
+}
+
+
+def hosts_for(topology):
+    """Host count of a known topology, or None when unknown."""
+    entry = TPU_TOPOLOGY_SELECTORS.get(topology)
+    return entry[2] if entry else None

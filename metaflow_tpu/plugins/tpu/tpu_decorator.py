@@ -108,6 +108,23 @@ class TpuDecorator(StepDecorator):
                 "@tpu(require_tpu=True) on step *%s* but no TPU devices are "
                 "attached (found: %s)." % (step_name, ", ".join(sorted(kinds)))
             )
+        # runtime twin of the Argo compiler's static check (compile time
+        # only sees a literal num_parallel): a gang on a multi-host slice
+        # must be one process per host, or jax.distributed waits forever
+        # for hosts that don't exist
+        topo = self.attributes["topology"]
+        num_nodes = int(os.environ.get("MF_PARALLEL_NUM_NODES", "1"))
+        if topo and num_nodes > 1:
+            from .topologies import hosts_for
+
+            hosts = hosts_for(topo)
+            if hosts and num_nodes != hosts:
+                raise TpuFlowException(
+                    "Step *%s*: gang of %d processes on topology %r, "
+                    "which has %d hosts — num_parallel must equal the "
+                    "slice's host count." % (step_name, num_nodes, topo,
+                                             hosts)
+                )
         current._update_env(
             {
                 "tpu": TpuInfo(

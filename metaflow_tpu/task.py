@@ -406,20 +406,37 @@ class MetaflowTask(object):
 
     def _finalize_control_task(self, output):
         """Validate that all gang worker tasks completed (reference:
-        task.py:_finalize_control_task:535)."""
+        task.py:_finalize_control_task:535).
+
+        Externally-launched gangs (Indexed Job / gcloud: one process per
+        rank, nothing for the control to wait() on) leave a window where
+        rank 0 exits its last collective while workers are still
+        persisting artifacts — poll for their done markers instead of
+        failing on the race. The local fork path reaped its children
+        already, so the first poll succeeds immediately there."""
         mapper_tasks = self.flow.__dict__.get("_control_mapper_tasks")
         if not mapper_tasks:
             raise MetaflowInternalError(
                 "Control task did not record _control_mapper_tasks: the gang "
                 "step must register its worker task pathspecs."
             )
+        deadline = time.time() + float(
+            os.environ.get("TPUFLOW_GANG_FINALIZE_TIMEOUT", "300")
+        )
         for pathspec in mapper_tasks:
             parts = pathspec.split("/")
             run, step, task = parts[-3], parts[-2], parts[-1]
             if task == output.task_id:
                 continue  # the control task itself: its DONE is written next
-            ds = self.flow_datastore.get_task_datastore(run, step, task, mode="d")
-            if not ds.is_done():
-                raise TaskFailedException(
-                    "Gang worker task %s did not finish successfully." % pathspec
+            while True:
+                ds = self.flow_datastore.get_task_datastore(
+                    run, step, task, mode="d"
                 )
+                if ds.is_done():
+                    break
+                if time.time() > deadline:
+                    raise TaskFailedException(
+                        "Gang worker task %s did not finish successfully."
+                        % pathspec
+                    )
+                time.sleep(1)

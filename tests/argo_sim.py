@@ -191,6 +191,9 @@ class ArgoSimulator(object):
         for pname, pval in params.items():
             pod_scope["inputs.parameters.%s" % pname] = pval
 
+        if "resource" in template:
+            return self._run_resource(task, template, pod_scope, dag_scope)
+
         cmd = template["container"]["command"]
         assert cmd[:2] == ["bash", "-c"], cmd
         script = self._subst(cmd[2], [pod_scope, dag_scope])
@@ -233,3 +236,99 @@ class ArgoSimulator(object):
                 )
         if item is None:
             self.task_outputs[task["name"]] = outs
+
+    # ---------------- resource templates (gang JobSets) ----------------
+
+    def _run_resource(self, task, template, pod_scope, dag_scope):
+        """Execute a `resource: {action: create}` template holding a
+        JobSet manifest the way the JobSet + Job controllers would: launch
+        one pod process per completion index, CONCURRENTLY (a gang
+        rendezvous blocks until all ranks arrive), with
+        JOB_COMPLETION_INDEX injected like an Indexed Job. Cluster-infra
+        substitutions (pod DNS, fixed coordinator port) are mapped to
+        loopback equivalents."""
+        import socket
+        import yaml
+
+        res = template["resource"]
+        if res.get("action") != "create":
+            raise ArgoSimError(
+                "Unsupported resource action %r" % res.get("action"))
+        for cond in ("successCondition", "failureCondition"):
+            if "status.terminalState" not in res.get(cond, ""):
+                raise ArgoSimError(
+                    "Resource template %s: %s must watch the JobSet "
+                    "terminalState" % (task["name"], cond))
+        manifest = yaml.safe_load(
+            self._subst(res["manifest"], [pod_scope, dag_scope]))
+        if manifest.get("kind") != "JobSet":
+            raise ArgoSimError(
+                "Resource template %s: expected a JobSet manifest, got %r"
+                % (task["name"], manifest.get("kind")))
+        rjobs = manifest["spec"]["replicatedJobs"]
+        if len(rjobs) != 1:
+            raise ArgoSimError("Expected ONE replicated job, got %d"
+                               % len(rjobs))
+        job_spec = rjobs[0]["template"]["spec"]
+        n = int(job_spec["completions"])
+        if job_spec.get("completionMode") != "Indexed":
+            raise ArgoSimError(
+                "Gang Job must be Indexed (rank = JOB_COMPLETION_INDEX)")
+        if int(job_spec["parallelism"]) != n:
+            raise ArgoSimError(
+                "Gang Job parallelism %s != completions %s: ranks would "
+                "not be co-scheduled"
+                % (job_spec["parallelism"], job_spec["completions"]))
+        pod = job_spec["template"]["spec"]
+        container = pod["containers"][0]
+        cmd = container["command"]
+        assert cmd[:2] == ["bash", "-c"], cmd
+        script = cmd[2].replace(ARGO_OUTPUT_DIR, self.output_dir)
+
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        base_env = dict(self.env)
+        for entry in container.get("env", []):
+            base_env[entry["name"]] = entry["value"]
+        # the sim has no cluster DNS or TPU metadata: rendezvous on
+        # loopback with an explicit coordinator (the MF_PARALLEL_EXTERNAL
+        # contract); a free port per gang keeps concurrent tests apart
+        base_env["MF_PARALLEL_MAIN_IP"] = "127.0.0.1"
+        base_env["MF_PARALLEL_COORDINATOR_PORT"] = str(port)
+        base_env.pop("MF_PARALLEL_REMOTE", None)
+        base_env["MF_PARALLEL_EXTERNAL"] = "1"
+
+        shutil.rmtree(self.output_dir, ignore_errors=True)
+        procs = []
+        for rank in range(n):
+            env = dict(base_env)
+            env["JOB_COMPLETION_INDEX"] = str(rank)
+            procs.append(subprocess.Popen(
+                ["bash", "-c", script], env=env, cwd=self.cwd,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            ))
+        failed = []
+        outs = []
+        for rank, proc in enumerate(procs):
+            try:
+                out, err = proc.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, err = proc.communicate()
+                failed.append((rank, "timeout"))
+                outs.append((out, err))
+                continue
+            if proc.returncode != 0:
+                failed.append((rank, proc.returncode))
+            outs.append((out, err))
+        if failed:
+            rank, why = failed[0]
+            out, err = outs[rank]
+            raise ArgoSimError(
+                "Gang %s: rank %d failed (%s) of %d\nscript: %s\n"
+                "stdout:\n%s\nstderr:\n%s"
+                % (task["name"], rank, why, n, script, out[-4000:],
+                   err[-4000:]))
+        for rank in range(n):
+            self.pods_run.append((task["name"], rank))

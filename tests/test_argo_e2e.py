@@ -144,17 +144,38 @@ class TestArgoE2E:
                       "wf-exitf")
         assert marker.read_text() == "failure ExitHookFlow/argo-wf-exitf"
 
-    def test_gang_control_and_join(self, tpuflow_root, tmp_path, client):
-        # the control pod runs the whole gang (local fork mode stands in for
-        # a multi-host slice); the join re-derives its inputs from the
+    def test_gang_runs_one_pod_per_rank(self, tpuflow_root, tmp_path,
+                                        client):
+        # the gang compiles to a JobSet resource template: the sim plays
+        # Indexed-Job controller and launches N concurrent pods, rank from
+        # JOB_COMPLETION_INDEX; the join re-derives its inputs from the
         # control task's recorded _control_mapper_tasks
         sim = _simulate("parallel_flow.py", tpuflow_root, tmp_path, "wf-gang")
-        ran = [n for n, _ in sim.pods_run]
-        assert ran.count("train") == 1  # ONE control pod, not N
+        gang_pods = sorted(i for n, i in sim.pods_run if n == "train")
+        assert gang_pods == [0, 1, 2]  # one pod per rank, not one control
         run = client("ParallelFlow")["argo-wf-gang"]
         assert run.successful
         # the join saw every rank's task
         assert len(list(run["train"])) == 3
+        ranks = sorted(run["join"].task["ranks"].data)
+        assert ranks == [0, 1, 2]
+
+    def test_gang_jax_distributed_rendezvous(self, tpuflow_root, tmp_path,
+                                             client):
+        """The north-star path through Argo: a 2-rank gang whose pods are
+        separate OS processes doing a REAL jax.distributed rendezvous
+        (coordinator = rank 0), training a sharded model with identical
+        losses on every rank."""
+        sim = _simulate("train_gang_flow.py", tpuflow_root, tmp_path,
+                        "wf-jax")
+        gang_pods = sorted(i for n, i in sim.pods_run if n == "train")
+        assert gang_pods == [0, 1]
+        run = client("TrainGangFlow")["argo-wf-jax"]
+        assert run.successful
+        # both ranks saw the global device view (2 procs x their devices)
+        devices = run["join"].task["devices"].data
+        assert set(devices) == {0, 1}
+        assert len(set(devices.values())) == 1
 
     def test_switch_runs_only_taken_branch(self, tpuflow_root, tmp_path,
                                            client):

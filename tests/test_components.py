@@ -156,6 +156,78 @@ class TestArgoCompile:
         assert "withParam" in docs
         assert "train-shard" in docs  # template names are DNS-sanitized
         assert "template: train-shard" in docs
+        # chips-per-host derives from the topology table, not a constant:
+        # v5e-4 is a single-host 2x2 slice with 4 chips
+        assert "google.com/tpu: '4'" in docs
+
+    def test_gang_compiles_to_indexed_jobset(self, run_flow, flows_dir,
+                                             tpuflow_root):
+        """A num_parallel step becomes a resource template creating a
+        JobSet: one Indexed Job, one pod per rank, rank from
+        JOB_COMPLETION_INDEX, coordinator on rank 0's stable DNS name."""
+        import yaml
+
+        proc = run_flow(
+            os.path.join(flows_dir, "parallel_flow.py"),
+            "--datastore", "gs",
+            "argo-workflows", "create",
+            env_extra={
+                "TPUFLOW_DATASTORE_SYSROOT_GS": "gs://deploy-bucket/root"
+            },
+        )
+        manifest = next(iter(yaml.safe_load_all(proc.stdout)))
+        gang = next(t for t in manifest["spec"]["templates"]
+                    if t["name"] == "train")
+        res = gang["resource"]
+        assert res["action"] == "create"
+        assert "status.terminalState" in res["successCondition"]
+        text = res["manifest"]
+        # completions/parallelism substitute UNQUOTED (integers post-subst)
+        assert "completions: {{inputs.parameters.num-parallel}}" in text
+        assert "parallelism: {{inputs.parameters.num-parallel}}" in text
+        assert "completionMode: Indexed" in text
+        assert "JOB_COMPLETION_INDEX" in text
+        assert "MF_PARALLEL_MAIN_IP" in text
+        # the DAG passes the gang size from the split parent's output
+        dag = manifest["spec"]["templates"][0]["dag"]["tasks"]
+        train = next(t for t in dag if t["name"] == "train")
+        numpar = next(p for p in train["arguments"]["parameters"]
+                      if p["name"] == "num-parallel")
+        assert "outputs.parameters.num-parallel" in numpar["value"]
+
+    def test_gang_topology_host_mismatch_is_compile_error(
+            self, run_flow, tmp_path, tpuflow_root):
+        """num_parallel != the @tpu topology's host count can never
+        schedule (one pod per host): refuse at compile time."""
+        flow_file = tmp_path / "bad_gang_flow.py"
+        flow_file.write_text(
+            "import metaflow_tpu\n"
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class BadGangFlow(FlowSpec):\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        self.next(self.train, num_parallel=4)\n"
+            "    @metaflow_tpu.tpu(topology='v5p-64')\n"
+            "    @step\n"
+            "    def train(self):\n"
+            "        self.next(self.join)\n"
+            "    @step\n"
+            "    def join(self, inputs):\n"
+            "        self.next(self.end)\n"
+            "    @step\n"
+            "    def end(self): pass\n"
+            "if __name__ == '__main__': BadGangFlow()\n"
+        )
+        proc = run_flow(
+            str(flow_file),
+            "--datastore", "gs",
+            "argo-workflows", "create",
+            expect_fail=True,
+            env_extra={
+                "TPUFLOW_DATASTORE_SYSROOT_GS": "gs://deploy-bucket/root"
+            },
+        )
+        assert "8 hosts" in proc.stderr and "num_parallel=4" in proc.stderr
 
 
 class TestDeployerAPI:
