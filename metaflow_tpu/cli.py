@@ -329,10 +329,11 @@ def make_cli(flow, state):
                        "(the step after alternative switch branches — only "
                        "the taken branch's task is in the datastore).")
     @click.option("--join-inputs", default=None,
-                  help="Join inputs as '<run>/<step>:<json index list>' — "
-                       "expands to that step's deterministic per-split task "
-                       "ids (used by compiled Argo workflows, where the "
-                       "scheduler isn't around to enumerate arrivals).")
+                  help="Join inputs as '<run>/<step>/<task-id base>:<json "
+                       "index list>' — expands to that step's deterministic "
+                       "per-split task ids (used by compiled Argo workflows, "
+                       "where the scheduler isn't around to enumerate "
+                       "arrivals).")
     @click.option("--join-inputs-control", default=None,
                   help="Gang-join inputs: pathspec of the control task; its "
                        "recorded _control_mapper_tasks become the inputs.")
@@ -386,10 +387,13 @@ def make_cli(flow, state):
                 )
             paths += existing
         if join_inputs:
+            # '<run>/<step>/<task-id base>:<json index list>' — the base
+            # carries the enclosing foreach's compound split path for
+            # nested fan-outs ('leaf-2' joins leaf-2-0, leaf-2-1, ...)
             prefix, _, indices = join_inputs.rpartition(":")
-            j_run, _, j_step = prefix.partition("/")
+            j_run, j_step, j_base = prefix.split("/")
             paths += [
-                "%s/%s/%s-%d" % (j_run, j_step, j_step, int(i))
+                "%s/%s/%s-%d" % (j_run, j_step, j_base, int(i))
                 for i in json.loads(indices)
             ]
         if join_inputs_control:
@@ -683,6 +687,21 @@ def make_cli(flow, state):
         decos = getattr(flow, "_flow_decorators", {}).get("exit_hook", [])
         for deco in decos:
             deco.run_hooks(success, "%s/%s" % (flow.name, run_id), echo)
+        # the onExit handler is also where a deployed run announces its
+        # completion (reference: argo_events publish from the workflow's
+        # final templates) — webhook when TPUFLOW_ARGO_EVENTS_URL is set,
+        # local JSONL bus otherwise
+        if success:
+            from .events import publish_run_finished
+
+            publish_run_finished(flow, run_id)
+
+    @start.command(name="list-triggers", hidden=True,
+                   help="Print the event names this flow subscribes to.")
+    def list_triggers():
+        from .events import subscribed_event_names
+
+        print(json.dumps(subscribed_event_names(flow)))
 
     @start.command(help="Show the live status of a run (heartbeats, "
                         "attempts, durations).")

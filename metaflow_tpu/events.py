@@ -90,8 +90,131 @@ def publish_event(name, payload=None):
     return ArgoEvent(name).publish(payload=payload)
 
 
-def list_events(since=None):
-    path = os.path.join(get_tpuflow_root(), "_events", "events.jsonl")
+def run_finished_event_names(flow):
+    """Event names announcing a successful run of `flow`: the plain flow
+    name, plus the @project-namespaced variant when one is active
+    (reference: argo_events.py publishes both forms so
+    @trigger_on_finish works across and within projects)."""
+    names = ["run-finished.%s" % flow.name]
+    from .current import current
+
+    project_flow = getattr(current, "project_flow_name", None)
+    if project_flow:
+        names.append("run-finished.%s" % project_flow)
+    return names
+
+
+def publish_run_finished(flow, run_id):
+    """Emit run-finished events at run completion — local JSONL bus
+    always, Argo Events webhook when TPUFLOW_ARGO_EVENTS_URL is set.
+    Publishing is observability: it must never fail the run."""
+    import sys
+
+    records = []
+    for name in run_finished_event_names(flow):
+        try:
+            records.append(publish_event(name, payload={
+                "flow": flow.name,
+                "run_id": str(run_id),
+                "status": "successful",
+            }))
+        except Exception as ex:
+            print("warning: could not publish %s: %s" % (name, ex),
+                  file=sys.stderr)
+    return records
+
+
+def subscribed_event_names(flow):
+    """Event names a flow's @trigger/@trigger_on_finish subscribe to —
+    the single derivation shared by the Argo sensor compiler and the
+    local trigger listener."""
+    names = []
+    for decos in getattr(flow, "_flow_decorators", {}).values():
+        for deco in decos:
+            if deco.name == "trigger":
+                names += [t["name"] for t in deco.triggers]
+            if deco.name == "trigger_on_finish":
+                names += ["run-finished." + f for f in deco.triggers]
+    return names
+
+
+class LocalTriggerListener(object):
+    """Drive @trigger / @trigger_on_finish without a cluster: watch the
+    local JSONL bus and `run` any registered flow whose subscriptions
+    match a newly published event.
+
+    In production this role belongs to the compiled Argo Events Sensor
+    (plugins/argo compile_sensor); locally this listener IS the sensor.
+    Consumed events ride to the run in TPUFLOW_TRIGGER_EVENTS, which
+    task.py surfaces as `current.trigger`.
+    """
+
+    def __init__(self, env=None, run_args=None):
+        self._flows = []  # [(script_path, [subscribed event names])]
+        self._env = dict(env if env is not None else os.environ)
+        self._run_args = list(run_args or [])
+        # watch the bus the LAUNCHED flows will publish to (the root in
+        # `env`), not necessarily this process's own
+        self._root = self._env.get("TPUFLOW_DATASTORE_SYSROOT_LOCAL")
+        self._seen = len(list_events(root=self._root))
+
+    def register(self, flow_script):
+        """Register a flow file; returns the event names it subscribes to
+        (via the flow's hidden `list-triggers` command, so decorators are
+        evaluated in the flow's own interpreter, not guessed from AST)."""
+        import subprocess
+        import sys
+
+        out = subprocess.check_output(
+            [sys.executable, flow_script, "list-triggers"],
+            env=self._env, timeout=120,
+        )
+        names = json.loads(out.decode().strip().splitlines()[-1])
+        self._flows.append((flow_script, names))
+        return names
+
+    def poll_once(self, wait=True, timeout=600):
+        """Match new bus events against registered subscriptions and launch
+        one `run` per matched flow. Returns [(script, returncode|Popen|
+        exception, matched_events)]; with wait=True runs complete before
+        returning. A failing launch is reported in the result instead of
+        raised, so one broken subscriber can't starve the others of their
+        events."""
+        import subprocess
+        import sys
+
+        events = list_events(root=self._root)[self._seen:]
+        self._seen += len(events)
+        launched = []
+        for script, names in self._flows:
+            matched = [e for e in events if e.get("name") in names]
+            if not matched:
+                continue
+            env = dict(self._env)
+            env["TPUFLOW_TRIGGER_EVENTS"] = json.dumps(
+                [
+                    {
+                        "name": e["name"],
+                        "payload": e.get("payload"),
+                        "timestamp": e.get("timestamp"),
+                    }
+                    for e in matched
+                ]
+            )
+            try:
+                proc = subprocess.Popen(
+                    [sys.executable, script, "run"] + self._run_args, env=env
+                )
+                result = proc.wait(timeout=timeout) if wait else proc
+            except Exception as ex:
+                result = ex
+            launched.append((script, result, matched))
+        return launched
+
+
+def list_events(since=None, root=None):
+    path = os.path.join(root or get_tpuflow_root(), "_events",
+                        "events.jsonl")
     if not os.path.exists(path):
         return []
     out = []

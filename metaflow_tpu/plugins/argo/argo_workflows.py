@@ -44,6 +44,7 @@ TPU-first differences from the reference's K8s compilation:
 """
 
 import json
+import os
 import re
 import shlex
 
@@ -98,14 +99,29 @@ class ArgoWorkflows(object):
                 "datastore would strand every pod's artifacts on its own "
                 "ephemeral disk."
             )
+        # generated template names must not collide with step templates
+        reserved = {"dag", "exit-hook"}
+        reserved.update(
+            self._body_name(n) for n in self.graph.sorted_nodes()
+            if self.graph[n].type == "foreach"
+        )
+        for name in self.graph.sorted_nodes():
+            if _argo_name(name) in reserved:
+                raise TpuFlowException(
+                    "Step *%s*: its Argo template name %r collides with a "
+                    "generated template (reserved: dag, exit-hook, "
+                    "<foreach>-body). Rename the step." % (name,
+                                                           _argo_name(name))
+                )
         for name in self.graph.sorted_nodes():
             node = self.graph[name]
-            if (node.type in ("foreach", "split-parallel")
+            if (node.type == "split-parallel"
                     and self._foreach_parent_of(name)):
                 raise TpuFlowException(
-                    "Step *%s*: a foreach/num_parallel fan-out nested inside "
-                    "a foreach is not supported on Argo Workflows yet — "
-                    "flatten the loops or run locally." % name
+                    "Step *%s*: a num_parallel gang nested inside a foreach "
+                    "is not supported on Argo Workflows yet — the JobSet "
+                    "names of concurrent gang instances would collide. Run "
+                    "locally or lift the gang out of the loop." % name
                 )
             if node.type == "split-switch":
                 for target in node.out_funcs:
@@ -208,9 +224,16 @@ class ArgoWorkflows(object):
             join_mode = self._join_input_mode(node)
             if join_mode == "foreach":
                 child = sorted(node.in_funcs)[0]
+                # the joined children live one scope deeper: their task ids
+                # are <child>-<this scope's split path>-<i>
+                my_path = self._scope_path_expr(
+                    self._foreach_parent_of(node.name)
+                )
+                base = child if not my_path else "%s-%s" % (child, my_path)
                 step_opts.append(
-                    "--join-inputs '%s/%s:{{inputs.parameters.num-splits}}'"
-                    % (RUN_ID, child)
+                    "--join-inputs '%s/%s/%s:"
+                    "{{inputs.parameters.num-splits}}'"
+                    % (RUN_ID, child, base)
                 )
             elif join_mode == "gang":
                 ctl = sorted(node.in_funcs)[0]
@@ -226,7 +249,7 @@ class ArgoWorkflows(object):
                     "--input-paths '{{inputs.parameters.input-paths}}'"
                 )
 
-        if self._is_foreach_child(node):
+        if self._is_body_entry(node):
             step_opts.append(
                 "--split-index '{{inputs.parameters.split-index}}'"
             )
@@ -286,14 +309,6 @@ class ArgoWorkflows(object):
             return "gang"
         return None
 
-    def _is_foreach_child(self, node):
-        """True when the step itself fans out per split (inside a foreach,
-        but not the join that collects it)."""
-        return (
-            self._foreach_parent_of(node.name) is not None
-            and self._join_input_mode(node) is None
-        )
-
     def _retries_for(self, node):
         step_func = getattr(self.flow, node.name)
         for deco in step_func.decorators:
@@ -345,6 +360,7 @@ class ArgoWorkflows(object):
         input_params = [
             {"name": "input-paths", "value": ""},
             {"name": "split-index", "value": ""},
+            {"name": "split-path", "value": ""},
             {"name": "num-splits", "value": "[]"},
             {"name": "task-id", "value": node.name},
         ]
@@ -553,37 +569,91 @@ class ArgoWorkflows(object):
         return env
 
     # ---------------- DAG wiring ----------------
+    #
+    # Foreach compiles recursively (the reference's nested-DAGTemplate
+    # shape, metaflow/plugins/argo/argo_workflows.py:1808-1894): every
+    # foreach node F gets a companion `F-body` task fanning a sub-DAG
+    # template out withParam over F's recorded splits. Nodes are grouped
+    # into SCOPES — a node's scope is its innermost enclosing foreach —
+    # and each scope compiles to its own DAG template. Task ids inside a
+    # scope carry the compound split path ("2-0" = outer split 2, inner
+    # split 0), threaded through the `split-path` template parameter, so
+    # instances across sibling splits never collide in the datastore.
+
+    def _scope_path_expr(self, scope):
+        return "" if scope is None else "{{inputs.parameters.split-path}}"
+
+    def _task_id_expr(self, name):
+        """The datastore task id of a step, as an Argo expression valid
+        inside its own scope's DAG template."""
+        path = self._scope_path_expr(self._foreach_parent_of(name))
+        return name if not path else "%s-%s" % (name, path)
+
+    def _body_name(self, foreach_name):
+        return _argo_name(foreach_name) + "-body"
+
+    def _is_body_entry(self, node):
+        """True for the direct child of a foreach (the sub-DAG's entry):
+        the only step that receives a --split-index."""
+        scope = self._foreach_parent_of(node.name)
+        return scope is not None and scope in node.in_funcs
 
     def _input_paths_value(self, node):
-        """Compile-time input paths (run/step/task-id) for steps whose
-        inputs don't need runtime expansion (linear + static joins)."""
-        paths = []
-        for in_func in sorted(node.in_funcs):
-            # datastore pathspecs use REAL step names; only Argo
-            # template/task names are DNS-1123-restricted
-            if self._foreach_parent_of(in_func) and node.type != "join":
-                # linear step inside the foreach: same-split parent
-                paths.append("%s/%s/%s-{{item}}" % (RUN_ID, in_func, in_func))
-            else:
-                paths.append("%s/%s/%s" % (RUN_ID, in_func, in_func))
-        return ",".join(paths)
+        """Input paths (run/step/task-id) for steps whose inputs live in
+        the same scope. Datastore pathspecs use REAL step names; only
+        Argo template/task names are DNS-1123-restricted."""
+        return ",".join(
+            "%s/%s/%s" % (RUN_ID, in_func, self._task_id_expr(in_func))
+            for in_func in sorted(node.in_funcs)
+        )
 
-    def _dag_tasks(self):
+    def _foreach_body_task(self, node, path):
+        """The fan-out task: one body sub-DAG per recorded split index."""
+        argo = _argo_name(node.name)
+        return {
+            "name": self._body_name(node.name),
+            "template": self._body_name(node.name),
+            "depends": "%s.Succeeded" % argo,
+            "withParam": (
+                "{{tasks.%s.outputs.parameters.num-splits}}" % argo
+            ),
+            "arguments": {"parameters": [
+                {"name": "input-paths",
+                 "value": "%s/%s/%s"
+                 % (RUN_ID, node.name, self._task_id_expr(node.name))},
+                {"name": "split-path",
+                 "value": ("%s-{{item}}" % path) if path else "{{item}}"},
+                {"name": "split-index", "value": "{{item}}"},
+            ]},
+        }
+
+    def _scope_dag_tasks(self, scope):
+        """DAG tasks for one scope (scope=None: the top level)."""
+        path = self._scope_path_expr(scope)
         tasks = []
         for name in self.graph.sorted_nodes():
+            if self._foreach_parent_of(name) != scope:
+                continue
             node = self.graph[name]
             argo = _argo_name(name)
-            foreach_parent = self._foreach_parent_of(name)
-            is_child = self._is_foreach_child(node)
-            task_id = "%s-{{item}}" % name if is_child else name
+            is_entry = self._is_body_entry(node)
 
             params = [
-                {"name": "task-id", "value": task_id},
+                {"name": "task-id", "value": self._task_id_expr(name)},
             ]
-            deps = sorted(_argo_name(f) for f in node.in_funcs)
-            if is_child and foreach_parent and _argo_name(foreach_parent) not in deps:
-                # withParam reads the foreach parent's output parameter
-                deps.append(_argo_name(foreach_parent))
+            if path:
+                params.append({"name": "split-path", "value": path})
+
+            deps = set()
+            for f in node.in_funcs:
+                if f == scope:
+                    continue  # body entry: inputs arrive via template params
+                if self._foreach_parent_of(f) == scope:
+                    deps.add(_argo_name(f))
+                else:
+                    # in_func lives inside an inner foreach body: this is
+                    # the join collecting it — depend on the fan-out task
+                    deps.add(self._body_name(self._joined_split(node).name))
 
             join_mode = self._join_input_mode(node)
             if join_mode == "foreach":
@@ -593,9 +663,15 @@ class ArgoWorkflows(object):
                     "value": "{{tasks.%s.outputs.parameters.num-splits}}"
                     % _argo_name(split),
                 })
-                if _argo_name(split) not in deps:
-                    deps.append(_argo_name(split))
-            elif join_mode != "gang" and node.name != "start":
+                deps.add(self._body_name(split))
+            elif join_mode == "gang":
+                pass  # inputs come from the control task's recorded mapper list
+            elif is_entry:
+                params.append({
+                    "name": "input-paths",
+                    "value": "{{inputs.parameters.input-paths}}",
+                })
+            elif node.name != "start":
                 params.append({
                     "name": "input-paths",
                     "value": self._input_paths_value(node),
@@ -614,8 +690,11 @@ class ArgoWorkflows(object):
                     % _argo_name(split_parent),
                 })
 
-            if is_child:
-                params.append({"name": "split-index", "value": "{{item}}"})
+            if is_entry:
+                params.append({
+                    "name": "split-index",
+                    "value": "{{inputs.parameters.split-index}}",
+                })
 
             task = {
                 "name": argo,
@@ -634,12 +713,6 @@ class ArgoWorkflows(object):
                     "%s.Succeeded" % d for d in sorted(deps)
                 )
 
-            if is_child and foreach_parent:
-                task["withParam"] = (
-                    "{{tasks.%s.outputs.parameters.num-splits}}"
-                    % _argo_name(foreach_parent)
-                )
-
             switch_parent = self._switch_parent_of(name)
             if switch_parent:
                 task["when"] = (
@@ -647,7 +720,24 @@ class ArgoWorkflows(object):
                     % (_argo_name(switch_parent), name)
                 )
             tasks.append(task)
+            if node.type == "foreach":
+                tasks.append(self._foreach_body_task(node, path))
         return tasks
+
+    def _body_templates(self):
+        return [
+            {
+                "name": self._body_name(name),
+                "inputs": {"parameters": [
+                    {"name": "input-paths"},
+                    {"name": "split-path"},
+                    {"name": "split-index"},
+                ]},
+                "dag": {"tasks": self._scope_dag_tasks(name)},
+            }
+            for name in self.graph.sorted_nodes()
+            if self.graph[name].type == "foreach"
+        ]
 
     # ---------------- top-level objects ----------------
 
@@ -661,6 +751,10 @@ class ArgoWorkflows(object):
             for name, param in self.flow._get_parameters()
             if not getattr(param, "IS_CONFIG_PARAMETER", False)
         ]
+        for i in range(len(self._subscribed_events())):
+            parameters.append(
+                {"name": "trigger-events-%d" % i, "value": "null"}
+            )
         manifest = {
             "apiVersion": "argoproj.io/v1alpha1",
             "kind": "WorkflowTemplate",
@@ -676,8 +770,9 @@ class ArgoWorkflows(object):
                 "entrypoint": "dag",
                 "arguments": {"parameters": parameters},
                 "templates": [
-                    {"name": "dag", "dag": {"tasks": self._dag_tasks()}}
-                ] + [
+                    {"name": "dag",
+                     "dag": {"tasks": self._scope_dag_tasks(None)}}
+                ] + self._body_templates() + [
                     (self._gang_template(self.graph[name])
                      if self.graph[name].parallel_step
                      else self._container_template(self.graph[name]))
@@ -686,23 +781,22 @@ class ArgoWorkflows(object):
             },
         }
         exit_template = self._exit_hook_template()
-        if exit_template is not None:
-            # Argo runs the onExit handler after the DAG regardless of
-            # outcome, passing {{workflow.status}} — the same contract the
-            # local runtime's _run_exit_hooks has (reference:
-            # argo_workflows.py exit-hook templates)
-            manifest["spec"]["onExit"] = exit_template["name"]
-            manifest["spec"]["templates"].append(exit_template)
+        # Argo runs the onExit handler after the DAG regardless of
+        # outcome, passing {{workflow.status}} — the same contract the
+        # local runtime's _run_exit_hooks has (reference:
+        # argo_workflows.py exit-hook templates). Every workflow gets one:
+        # besides @exit_hook callables it publishes run-finished.<flow>
+        # so @trigger_on_finish chains fire in-cluster (reference:
+        # argo_events.py publish from the workflow's final templates).
+        manifest["spec"]["onExit"] = exit_template["name"]
+        manifest["spec"]["templates"].append(exit_template)
         return manifest
 
     def _exit_hook_template(self):
-        """onExit handler template running the flow's @exit_hook callables
-        in-container, or None when the flow declares none."""
+        """onExit finalizer template: runs the flow's @exit_hook callables
+        (if any) and publishes the run-finished event on success."""
         from ...package import MetaflowPackage
 
-        decos = getattr(self.flow, "_flow_decorators", {}).get("exit_hook")
-        if not decos:
-            return None
         cmds = []
         if self.package_url:
             cmds += MetaflowPackage.bootstrap_commands(self.package_url)
@@ -732,7 +826,31 @@ class ArgoWorkflows(object):
         if self.metadata == "service" and self.service_url:
             env.append({"name": "TPUFLOW_SERVICE_URL",
                         "value": self.service_url})
+        events_url = os.environ.get("TPUFLOW_ARGO_EVENTS_URL")
+        if events_url:
+            # pods publish through the Argo Events webhook; without this
+            # the onExit publisher falls back to a pod-local JSONL file
+            env.append({"name": "TPUFLOW_ARGO_EVENTS_URL",
+                        "value": events_url})
+        subscribed = self._subscribed_events()
+        if subscribed:
+            # the sensor patches each consumed event's body into a
+            # trigger-events-<i> workflow parameter (default "null");
+            # concatenating them yields a JSON array task.py parses
+            # (nulls = dependencies whose body wasn't delivered)
+            env.append({
+                "name": "TPUFLOW_TRIGGER_EVENTS",
+                "value": "[%s]" % ",".join(
+                    "{{workflow.parameters.trigger-events-%d}}" % i
+                    for i in range(len(subscribed))
+                ),
+            })
         return env
+
+    def _subscribed_events(self):
+        from ...events import subscribed_event_names
+
+        return subscribed_event_names(self.flow)
 
     def _deployed_name(self):
         from ...current import current
@@ -765,13 +883,9 @@ class ArgoWorkflows(object):
 
     def compile_sensor(self):
         """Argo Events Sensor for @trigger / @trigger_on_finish."""
-        events = []
-        for decos in getattr(self.flow, "_flow_decorators", {}).values():
-            for deco in decos:
-                if deco.name == "trigger":
-                    events += [t["name"] for t in deco.triggers]
-                if deco.name == "trigger_on_finish":
-                    events += ["run-finished." + f for f in deco.triggers]
+        from ...events import subscribed_event_names
+
+        events = subscribed_event_names(self.flow)
         if not events:
             return None
         return {
@@ -797,10 +911,33 @@ class ArgoWorkflows(object):
                                 "metadata": {
                                     "generateName": self._deployed_name() + "-"
                                 },
-                                "spec": {"workflowTemplateRef": {
-                                    "name": self._deployed_name()
-                                }},
+                                "spec": {
+                                    "workflowTemplateRef": {
+                                        "name": self._deployed_name()
+                                    },
+                                    # patched by the trigger parameters
+                                    # below with each consumed event's
+                                    # body so pods see current.trigger
+                                    "arguments": {"parameters": [
+                                        {"name": "trigger-events-%d" % i,
+                                         "value": "null"}
+                                        for i in range(len(events))
+                                    ]},
+                                },
                             }},
+                            # one parameter per dependency, each patching
+                            # its event body into the matching workflow
+                            # parameter; dest is workflow-relative
+                            # (reference: ArgoWorkflowTrigger.parameters,
+                            # argo_workflows.py:4985)
+                            "parameters": [{
+                                "src": {
+                                    "dependencyName": e.replace(".", "-"),
+                                    "dataKey": "body",
+                                },
+                                "dest": ("spec.arguments."
+                                         "parameters.%d.value" % i),
+                            } for i, e in enumerate(events)],
                         },
                     }
                 }],

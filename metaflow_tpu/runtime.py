@@ -361,6 +361,12 @@ class NativeRuntime(object):
             self._run_exit_hooks(success=not self._failed)
         if self._failed:
             raise TaskFailed("Workflow failed; see task logs above.")
+        # announce completion on the event bus so @trigger_on_finish
+        # subscribers can fire (the Argo path publishes from its onExit
+        # finalizer instead)
+        from .events import publish_run_finished
+
+        publish_run_finished(self._flow, self.run_id)
         self._echo(
             "Done! Flow finished in %.1fs (%d tasks run, %d cloned)."
             % (time.time() - start_time, self._finished_tasks, self._cloned_tasks)

@@ -239,6 +239,27 @@ class MetaflowTask(object):
             is_running=True,
             tags=(),
         )
+        # event-triggered runs carry their consumed events in the
+        # environment (set by the local trigger listener or the Argo
+        # sensor's submit template) — expose them as `current.trigger`
+        # (reference: metaflow/events.py Trigger via metaflow_current)
+        trigger_json = os.environ.get("TPUFLOW_TRIGGER_EVENTS")
+        if trigger_json:
+            try:
+                from .events import Trigger
+
+                events = json.loads(trigger_json)
+                if isinstance(events, dict):
+                    # the Argo sensor patches event bodies in one by one;
+                    # the local listener sends a list
+                    events = [events]
+                # nulls = sensor dependencies whose body wasn't delivered
+                # (or a manual submission of a subscribing flow)
+                events = [e for e in events if e]
+                if events:
+                    current._update_env({"trigger": Trigger(events)})
+            except Exception:
+                pass  # malformed trigger info must not fail the task
 
         start_time = time.time()
         self.metadata.register_metadata(

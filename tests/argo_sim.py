@@ -56,11 +56,18 @@ class ArgoSimulator(object):
 
         return _PARAM_RE.sub(repl, text)
 
-    def _dag_scope(self, item=None):
+    def _dag_scope(self, outputs=None, inputs=None, item=None):
+        """Template-variable scope inside one DAG template: workflow
+        globals + the DAG's own input parameters + its local tasks'
+        outputs (Argo scopes `tasks.*` per template — a nested DAG can't
+        see its parent's tasks)."""
         scope = {"workflow.name": self.workflow_name}
         for pname, pval in self.workflow_params.items():
             scope["workflow.parameters.%s" % pname] = pval
-        for tname, outs in self.task_outputs.items():
+        for pname, pval in (inputs or {}).items():
+            scope["inputs.parameters.%s" % pname] = pval
+        for tname, outs in (outputs if outputs is not None
+                            else self.task_outputs).items():
             for oname, oval in outs.items():
                 scope["tasks.%s.outputs.parameters.%s" % (tname, oname)] = oval
         if item is not None:
@@ -85,39 +92,49 @@ class ArgoSimulator(object):
         ]
 
     def run(self):
-        """Argo `depends` semantics: a task becomes schedulable once every
-        referenced task is resolved (Succeeded/Skipped/Omitted); its depends
-        expression is then evaluated with `X.Succeeded` — false → the task is
-        OMITTED (so omission propagates down an untaken switch branch); a
-        true expression with a false `when` → SKIPPED."""
-        dag_tasks = {t["name"]: t for t in self.templates["dag"]["dag"]["tasks"]}
-        succeeded = set()
-        not_run = set()  # Skipped + Omitted
-        pending = dict(dag_tasks)
         try:
-            while pending:
-                resolved = succeeded | not_run
-                ready = [
-                    t for t in pending.values()
-                    if all(d in resolved for d in self._deps_of(t))
-                ]
-                if not ready:
-                    raise ArgoSimError(
-                        "Deadlocked DAG: pending=%s" % sorted(pending)
-                    )
-                for task in sorted(ready, key=lambda t: t["name"]):
-                    if not self._depends_true(task, succeeded):
-                        not_run.add(task["name"])      # Omitted
-                    elif self._when_false(task):
-                        not_run.add(task["name"])      # Skipped
-                    else:
-                        self._run_task(task)
-                        succeeded.add(task["name"])
-                    del pending[task["name"]]
+            self.task_outputs = self._run_dag(
+                self.templates["dag"], inputs={}, inherited_item=None
+            )
         except ArgoSimError:
             self._run_on_exit("Failed")
             raise
         self._run_on_exit("Succeeded")
+
+    def _run_dag(self, dag_template, inputs, inherited_item):
+        """Execute one DAG template (the entrypoint or a foreach-body
+        sub-DAG) and return its tasks' outputs.
+
+        Argo `depends` semantics: a task becomes schedulable once every
+        referenced task is resolved (Succeeded/Skipped/Omitted); its depends
+        expression is then evaluated with `X.Succeeded` — false → the task is
+        OMITTED (so omission propagates down an untaken switch branch); a
+        true expression with a false `when` → SKIPPED."""
+        dag_tasks = {t["name"]: t for t in dag_template["dag"]["tasks"]}
+        outputs = {}  # this DAG's task name -> {param: value}
+        succeeded = set()
+        not_run = set()  # Skipped + Omitted
+        pending = dict(dag_tasks)
+        while pending:
+            resolved = succeeded | not_run
+            ready = [
+                t for t in pending.values()
+                if all(d in resolved for d in self._deps_of(t))
+            ]
+            if not ready:
+                raise ArgoSimError(
+                    "Deadlocked DAG: pending=%s" % sorted(pending)
+                )
+            for task in sorted(ready, key=lambda t: t["name"]):
+                if not self._depends_true(task, succeeded):
+                    not_run.add(task["name"])      # Omitted
+                elif self._when_false(task, outputs, inputs):
+                    not_run.add(task["name"])      # Skipped
+                else:
+                    self._run_task(task, outputs, inputs, inherited_item)
+                    succeeded.add(task["name"])
+                del pending[task["name"]]
+        return outputs
 
     def _run_on_exit(self, status):
         """The controller runs spec.onExit after the workflow finishes,
@@ -161,31 +178,45 @@ class ArgoSimulator(object):
             values.append(name in succeeded)
         return any(values) if "||" in expr else all(values)
 
-    def _when_false(self, task):
+    def _when_false(self, task, outputs, inputs):
         if "when" not in task:
             return False
-        cond = self._subst(task["when"], [self._dag_scope()])
+        cond = self._subst(task["when"], [self._dag_scope(outputs, inputs)])
         left, _, right = cond.partition("==")
         return left.strip() != right.strip()
 
-    def _run_task(self, task):
-        dag_scope = self._dag_scope()
+    def _run_task(self, task, outputs, inputs, inherited_item):
         if "withParam" in task:
-            items = json.loads(self._subst(task["withParam"], [dag_scope]))
+            items = json.loads(self._subst(
+                task["withParam"], [self._dag_scope(outputs, inputs)]
+            ))
             for item in items:
-                self._run_pod(task, item)
+                self._run_unit(task, item, outputs, inputs, inherited_item)
         else:
-            self._run_pod(task, None)
+            self._run_unit(task, None, outputs, inputs, inherited_item)
 
-    def _run_pod(self, task, item):
+    def _run_unit(self, task, item, outputs, inputs, inherited_item):
+        """One instance of a DAG task: a container pod, a resource (gang
+        JobSet), or a nested DAG template (foreach body)."""
         template = self.templates[task["template"]]
+        dag_scope = self._dag_scope(outputs, inputs, item=item)
+        args = {
+            p["name"]: self._subst(p["value"], [dag_scope])
+            for p in task.get("arguments", {}).get("parameters", [])
+        }
+        # a pod's display item: its own withParam item, else the
+        # enclosing body invocation's (keeps depth-1 pods_run stable)
+        eff_item = item if item is not None else inherited_item
+
         params = {
             p["name"]: p.get("value", "")
             for p in template.get("inputs", {}).get("parameters", [])
         }
-        dag_scope = self._dag_scope(item=item)
-        for p in task.get("arguments", {}).get("parameters", []):
-            params[p["name"]] = self._subst(p["value"], [dag_scope])
+        params.update(args)
+
+        if "dag" in template:
+            self._run_dag(template, params, inherited_item=eff_item)
+            return
 
         pod_scope = {"retries": "0", "pod.name": "sim-pod"}
         for pname, pval in params.items():
@@ -193,7 +224,11 @@ class ArgoSimulator(object):
 
         if "resource" in template:
             return self._run_resource(task, template, pod_scope, dag_scope)
+        return self._run_pod(task, template, pod_scope, dag_scope,
+                             eff_item, record=item is None, outputs=outputs)
 
+    def _run_pod(self, task, template, pod_scope, dag_scope, eff_item,
+                 record, outputs):
         cmd = template["container"]["command"]
         assert cmd[:2] == ["bash", "-c"], cmd
         script = self._subst(cmd[2], [pod_scope, dag_scope])
@@ -214,10 +249,10 @@ class ArgoSimulator(object):
             raise ArgoSimError(
                 "Pod %s (item=%r) failed rc=%d\nscript: %s\nstdout:\n%s\n"
                 "stderr:\n%s"
-                % (task["name"], item, proc.returncode, script,
+                % (task["name"], eff_item, proc.returncode, script,
                    proc.stdout[-4000:], proc.stderr[-4000:])
             )
-        self.pods_run.append((task["name"], item))
+        self.pods_run.append((task["name"], eff_item))
 
         outs = {}
         for p in template.get("outputs", {}).get("parameters", []):
@@ -234,8 +269,8 @@ class ArgoSimulator(object):
                     "Pod %s: missing output parameter file %s"
                     % (task["name"], path)
                 )
-        if item is None:
-            self.task_outputs[task["name"]] = outs
+        if record:
+            outputs[task["name"]] = outs
 
     # ---------------- resource templates (gang JobSets) ----------------
 

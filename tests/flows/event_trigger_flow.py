@@ -1,0 +1,22 @@
+"""@trigger consumer: runs when an external `data_ready` event is
+published, payload exposed through `current.trigger`."""
+
+from metaflow_tpu import FlowSpec, current, step, trigger
+
+
+@trigger(event="data_ready")
+class EventTriggerFlow(FlowSpec):
+    @step
+    def start(self):
+        t = current.get("trigger")
+        self.event_name = t.event.name if t else None
+        self.path = (t.event.payload or {}).get("path") if t else None
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    EventTriggerFlow()

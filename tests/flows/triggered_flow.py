@@ -1,0 +1,25 @@
+"""@trigger_on_finish consumer: runs when LinearFlow finishes and records
+the consumed event via `current.trigger`."""
+
+from metaflow_tpu import FlowSpec, current, step, trigger_on_finish
+
+
+@trigger_on_finish(flow="LinearFlow")
+class TriggeredFlow(FlowSpec):
+    @step
+    def start(self):
+        trigger = current.get("trigger")
+        self.event_name = trigger.event.name if trigger else None
+        self.upstream_run = (
+            (trigger.event.payload or {}).get("run_id") if trigger else None
+        )
+        self.n_events = len(trigger.events) if trigger else 0
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    TriggeredFlow()

@@ -82,7 +82,10 @@ class TestArgoE2E:
     def test_linear_flow_round_trips_artifacts(self, tpuflow_root, tmp_path,
                                                client):
         sim = _simulate("linear_flow.py", tpuflow_root, tmp_path, "wf-lin")
-        assert [p[0] for p in sim.pods_run] == ["start", "middle", "end"]
+        # every workflow ends with the onExit finalizer (exit hooks +
+        # run-finished publish)
+        assert [p[0] for p in sim.pods_run] == ["start", "middle", "end",
+                                                "exit-hook"]
 
         run = client("LinearFlow")["argo-wf-lin"]
         assert run.successful
@@ -144,6 +147,38 @@ class TestArgoE2E:
                       "wf-exitf")
         assert marker.read_text() == "failure ExitHookFlow/argo-wf-exitf"
 
+    def test_onexit_publishes_run_finished(self, tpuflow_root, tmp_path,
+                                           client):
+        """The onExit finalizer publishes run-finished.<flow> with the
+        workflow status — the in-cluster half of @trigger_on_finish
+        (VERDICT round-2 item #3)."""
+        from metaflow_tpu.events import list_events
+
+        _simulate("linear_flow.py", tpuflow_root, tmp_path, "wf-ev")
+        events = [e for e in list_events()
+                  if e["name"] == "run-finished.LinearFlow"]
+        assert len(events) == 1
+        assert events[0]["payload"] == {
+            "flow": "LinearFlow",
+            "run_id": "argo-wf-ev",
+            "status": "successful",
+        }
+
+    def test_onexit_failed_workflow_publishes_nothing(self, tpuflow_root,
+                                                      tmp_path, client,
+                                                      monkeypatch):
+        from argo_sim import ArgoSimError
+        from metaflow_tpu.events import list_events
+
+        monkeypatch.setenv("MAKE_IT_FAIL", "1")
+        monkeypatch.setenv("EXIT_HOOK_MARKER",
+                           str(tmp_path / "exit-marker"))
+        with pytest.raises(ArgoSimError):
+            _simulate("exit_hook_flow.py", tpuflow_root, tmp_path,
+                      "wf-evf")
+        assert [e for e in list_events()
+                if e["name"].startswith("run-finished")] == []
+
     def test_gang_runs_one_pod_per_rank(self, tpuflow_root, tmp_path,
                                         client):
         # the gang compiles to a JobSet resource template: the sim plays
@@ -176,6 +211,59 @@ class TestArgoE2E:
         devices = run["join"].task["devices"].data
         assert set(devices) == {0, 1}
         assert len(set(devices.values())) == 1
+
+    def test_sensor_event_payload_reaches_current_trigger(
+            self, tpuflow_root, tmp_path, client):
+        """The compiled Sensor patches the consumed event's body into the
+        workflow's trigger-events parameter; pods surface it as
+        current.trigger — simulate the sensor's patched submission."""
+        manifest = _compile("event_trigger_flow.py", tpuflow_root)
+        event_body = json.dumps({
+            "name": "data_ready",
+            "payload": {"path": "gs://bucket/day=9"},
+            "timestamp": 1.0,
+        })
+        for p in manifest["spec"]["arguments"]["parameters"]:
+            if p["name"] == "trigger-events-0":
+                p["value"] = event_body
+                break
+        else:
+            raise AssertionError("trigger-events-0 parameter not declared")
+        sim = ArgoSimulator(
+            manifest, workflow_name="wf-trig", env=_pod_env(tpuflow_root),
+            cwd=FLOWS, output_dir=str(tmp_path / "argo-outputs"),
+        )
+        sim.run()
+        task = client("EventTriggerFlow")["argo-wf-trig"]["start"].task
+        assert task["event_name"].data == "data_ready"
+        assert task["path"].data == "gs://bucket/day=9"
+
+    def test_nested_foreach(self, tpuflow_root, tmp_path, client):
+        """Nested fan-outs compile to recursive sub-DAG templates
+        (VERDICT round-2 item #5): every (outer, inner) leaf runs as its
+        own pod with a compound task id, and both join levels reduce
+        correctly."""
+        sim = _simulate("nested_foreach_flow.py", tpuflow_root, tmp_path,
+                        "wf-nest")
+        # 2 outer mids, 2x3 leaves, 2 inner joins
+        mids = [i for n, i in sim.pods_run if n == "mid"]
+        assert sorted(mids) == [0, 1]
+        leaves = [i for n, i in sim.pods_run if n == "leaf"]
+        assert sorted(leaves) == [0, 0, 1, 1, 2, 2]
+        inner_joins = [i for n, i in sim.pods_run if n == "inner-join"]
+        assert sorted(inner_joins) == [0, 1]
+
+        run = client("NestedForeachFlow")["argo-wf-nest"]
+        assert run.successful
+        # (10+1 + 10+2 + 10+3) + (20+1 + 20+2 + 20+3) = 102
+        assert run["outer_join"].task["total"].data == 102
+        # every leaf task readable individually, compound ids distinct
+        leaf_tasks = {t.id: t for t in run["leaf"]}
+        assert len(leaf_tasks) == 6
+        vals = sorted(t["val"].data for t in leaf_tasks.values())
+        assert vals == [11, 12, 13, 21, 22, 23]
+        # the foreach stack was visible to user code at full depth
+        assert all(t["stack_depth"].data == 2 for t in leaf_tasks.values())
 
     def test_switch_runs_only_taken_branch(self, tpuflow_root, tmp_path,
                                            client):
@@ -212,16 +300,42 @@ class TestArgoCompileValidation:
         assert proc.returncode != 0
         assert "SHARED datastore" in proc.stderr + proc.stdout
 
-    def test_nested_foreach_refused(self, tpuflow_root):
+    def test_gang_inside_foreach_refused(self, tpuflow_root, tmp_path):
+        flow_file = tmp_path / "gang_in_foreach.py"
+        flow_file.write_text(
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class GangInForeachFlow(FlowSpec):\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        self.items = [1, 2]\n"
+            "        self.next(self.outer, foreach='items')\n"
+            "    @step\n"
+            "    def outer(self):\n"
+            "        self.next(self.train, num_parallel=2)\n"
+            "    @step\n"
+            "    def train(self):\n"
+            "        self.next(self.inner_join)\n"
+            "    @step\n"
+            "    def inner_join(self, inputs):\n"
+            "        self.next(self.outer_join)\n"
+            "    @step\n"
+            "    def outer_join(self, inputs):\n"
+            "        self.next(self.end)\n"
+            "    @step\n"
+            "    def end(self):\n"
+            "        pass\n"
+            "if __name__ == '__main__':\n"
+            "    GangInForeachFlow()\n"
+        )
         proc = subprocess.run(
-            [sys.executable, os.path.join(FLOWS, "nested_foreach_flow.py"),
+            [sys.executable, str(flow_file),
              "--datastore", "local", "--datastore-root", tpuflow_root,
              "argo-workflows", "create"],
             env=_pod_env(tpuflow_root), capture_output=True, text=True,
             timeout=120,
         )
         assert proc.returncode != 0
-        assert "nested" in (proc.stderr + proc.stdout).lower()
+        assert "gang nested" in (proc.stderr + proc.stdout).lower()
 
     def test_recursive_switch_refused(self, tpuflow_root):
         proc = subprocess.run(
