@@ -23,7 +23,7 @@ def bench_tokens_per_sec():
     import jax.numpy as jnp
 
     from metaflow_tpu.models import llama
-    from metaflow_tpu.parallel import MeshSpec, create_mesh
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
     from metaflow_tpu.training import (
         default_optimizer,
         make_trainer,
@@ -220,10 +220,14 @@ def bench_data_path():
     from metaflow_tpu.gsop import GSClient
 
     here = os.path.dirname(os.path.abspath(__file__))
-    # the fake server gets its OWN process (and GIL): in-process it halves
-    # apparent client throughput by contending with the client threads
+    # the fake server gets its OWN processes: a pre-forked SO_REUSEPORT
+    # cluster (state shared via tmpfs) so the measured ceiling is the
+    # gsop ENGINE, not one server process's GIL (round-2 verdict weak #5)
+    server_workers = int(os.environ.get("BENCH_GCS_WORKERS",
+                                        min(8, max(4, os.cpu_count() or 4))))
     server = subprocess.Popen(
-        [sys.executable, os.path.join(here, "tests", "fake_gcs.py")],
+        [sys.executable, os.path.join(here, "tests", "fake_gcs.py"),
+         "--workers", str(server_workers)],
         stdout=subprocess.PIPE, text=True,
     )
     endpoint = server.stdout.readline().strip()
@@ -259,7 +263,7 @@ def bench_data_path():
         total_mb = n_objects * obj_mb
         client.get_many("bench", pairs)  # warmup: allocator + page cache
         rates = []
-        for _ in range(3):  # median: single-GIL fake server is noisy
+        for _ in range(3):  # median: shared-box noise
             t0 = time.perf_counter()
             client.get_many("bench", pairs)
             rates.append(total_mb / (time.perf_counter() - t0))
@@ -273,7 +277,8 @@ def bench_data_path():
                 "put_mb_per_s": round(total_mb / put_dt, 1),
                 "objects": n_objects,
                 "object_mb": obj_mb,
-                "transport": "loopback_fake_gcs",
+                "transport": "loopback_fake_gcs_cluster",
+                "server_workers": server_workers,
             },
         }
 

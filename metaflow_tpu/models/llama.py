@@ -1,7 +1,7 @@
 """Llama-family transformer, TPU-first.
 
 Pure-JAX pytree parameters with a parallel tree of *logical axis* annotations
-(metaflow_tpu.parallel.sharding) — pjit/GSPMD shards the whole model from a
+(metaflow_tpu.spmd.sharding) — pjit/GSPMD shards the whole model from a
 rule table; no framework indirection between the math and the mesh.
 
 Covers the BASELINE.json targets: Llama-3-8B (dense, GQA, RoPE-500k) and the

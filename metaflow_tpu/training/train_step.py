@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ..parallel import sharding as shd
+from ..spmd import sharding as shd
 
 
 def _lr_schedule(lr, warmup_steps, total_steps):
@@ -178,7 +178,7 @@ def shard_batch(batch, mesh):
     [B, S] inputs inside the step)."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..parallel.mesh import data_axes
+    from ..spmd.mesh import data_axes
 
     axes = data_axes(mesh)
     batch_spec = axes if axes else None

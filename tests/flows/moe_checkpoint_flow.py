@@ -20,7 +20,7 @@ class MoeCheckpointFlow(FlowSpec):
         import jax
 
         from metaflow_tpu.models import mixtral
-        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
         from metaflow_tpu.training import (
             default_optimizer,
             make_trainer,

@@ -19,7 +19,7 @@ class TrainGangFlow(FlowSpec):
 
         assert jax.process_count() == 2, jax.process_count()
         from metaflow_tpu.models import llama
-        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
         from metaflow_tpu.training import (
             default_optimizer,
             make_trainer,

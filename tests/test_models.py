@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from metaflow_tpu.models import mixtral, resnet
-from metaflow_tpu.parallel import MeshSpec, create_mesh
+from metaflow_tpu.spmd import MeshSpec, create_mesh
 from metaflow_tpu.training import (
     default_optimizer,
     make_trainer,

@@ -16,7 +16,7 @@ from metaflow_tpu.ops import (
     rms_norm,
     rope_frequencies,
 )
-from metaflow_tpu.parallel import MeshSpec, create_mesh
+from metaflow_tpu.spmd import MeshSpec, create_mesh
 
 
 def _qkv(B=2, S=256, H=4, KV=None, D=64, seed=0):
@@ -167,7 +167,7 @@ class TestMoE:
 
     def test_expert_sharded_run(self):
         mesh = create_mesh(MeshSpec.moe(expert=4))
-        from metaflow_tpu.parallel import rules_for_mesh, spec_for
+        from metaflow_tpu.spmd import rules_for_mesh, spec_for
         from jax.sharding import NamedSharding
 
         B, S, E, F, N = 2, 16, 32, 64, 4

@@ -128,8 +128,8 @@ class TestHybridMesh:
     def test_explicit_slices(self):
         import jax
 
-        from metaflow_tpu.parallel import MeshSpec
-        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+        from metaflow_tpu.spmd import MeshSpec
+        from metaflow_tpu.spmd.mesh import create_hybrid_mesh
 
         mesh = create_hybrid_mesh(
             MeshSpec.fsdp_tp(2), num_slices=2,
@@ -138,8 +138,8 @@ class TestHybridMesh:
         assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2}
 
     def test_single_slice_falls_back(self):
-        from metaflow_tpu.parallel import MeshSpec
-        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+        from metaflow_tpu.spmd import MeshSpec
+        from metaflow_tpu.spmd.mesh import create_hybrid_mesh
 
         mesh = create_hybrid_mesh(MeshSpec.fsdp(), num_slices=1)
         assert "fsdp" in mesh.axis_names
@@ -147,8 +147,8 @@ class TestHybridMesh:
     def test_bad_division(self):
         import jax
 
-        from metaflow_tpu.parallel import MeshSpec
-        from metaflow_tpu.parallel.mesh import create_hybrid_mesh
+        from metaflow_tpu.spmd import MeshSpec
+        from metaflow_tpu.spmd.mesh import create_hybrid_mesh
 
         with pytest.raises(ValueError):
             create_hybrid_mesh(MeshSpec.fsdp(), num_slices=3,
@@ -170,7 +170,7 @@ class TestDataLoader:
         import jax
 
         from metaflow_tpu.models import llama
-        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
         from metaflow_tpu.training import (
             default_optimizer,
             make_trainer,

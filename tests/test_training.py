@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import pytest
 
 from metaflow_tpu.models import llama
-from metaflow_tpu.parallel import MeshSpec, create_mesh
+from metaflow_tpu.spmd import MeshSpec, create_mesh
 from metaflow_tpu.training import (
     default_optimizer,
     make_train_state,

@@ -22,7 +22,7 @@ class TpuTrainFlow(FlowSpec):
         import jax
 
         from metaflow_tpu.models import llama
-        from metaflow_tpu.parallel import MeshSpec, create_mesh
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
         from metaflow_tpu.training import (
             default_optimizer,
             make_trainer,
