@@ -12,6 +12,7 @@ from ..datastore import FlowDataStore, LocalStorage, STORAGE_BACKENDS
 from ..exception import (
     MetaflowNamespaceMismatch,
     MetaflowNotFound,
+    MetaflowTaggingError,
 )
 from ..metadata import LocalMetadataProvider
 from ..util import get_tpuflow_root, get_username
@@ -182,6 +183,53 @@ class Run(MetaflowObject):
     @property
     def system_tags(self):
         return frozenset(self._info.get("system_tags", []))
+
+    # ---- tag mutation (reference: client/core.py Run.add_tag region) ----
+    # same optimistic-concurrency provider path as the `tag` CLI, so
+    # client and CLI mutations compose safely under concurrency
+
+    def _mutate_tags(self, add=(), remove=()):
+        add, remove = list(add), list(remove)  # generators: consume once
+        for t in add + remove:
+            if not isinstance(t, str):
+                raise MetaflowTaggingError(
+                    "Tags must be strings, got %r" % (t,)
+                )
+        info = self._meta.mutate_run_tags(
+            self.flow_name, self.id, add=add, remove=remove
+        )
+        if info is None:
+            raise MetaflowNotFound(
+                "Run %s/%s disappeared while mutating tags"
+                % (self.flow_name, self.id)
+            )
+        self._info = info
+        return self.tags
+
+    def add_tag(self, tag):
+        """Add one user tag to this run."""
+        return self._mutate_tags(add=[tag])
+
+    def add_tags(self, tags):
+        """Add several user tags to this run."""
+        return self._mutate_tags(add=tags)
+
+    def remove_tag(self, tag):
+        """Remove one user tag from this run."""
+        return self._mutate_tags(remove=[tag])
+
+    def remove_tags(self, tags):
+        """Remove several user tags from this run."""
+        return self._mutate_tags(remove=tags)
+
+    def replace_tag(self, tag_to_remove, tag_to_add):
+        """Atomically swap one tag for another (one provider round-trip,
+        so concurrent mutators never observe the intermediate state)."""
+        return self._mutate_tags(add=[tag_to_add], remove=[tag_to_remove])
+
+    def replace_tags(self, tags_to_remove, tags_to_add):
+        """Atomically swap several tags."""
+        return self._mutate_tags(add=tags_to_add, remove=tags_to_remove)
 
     @property
     def created_at(self):

@@ -58,6 +58,10 @@ class MetaflowInvalidPathspec(TpuFlowException):
     headline = "Invalid pathspec"
 
 
+class MetaflowTaggingError(TpuFlowException):
+    headline = "Tag mutation failed"
+
+
 class MetaflowNotFound(TpuFlowException):
     headline = "Object not found"
 

@@ -9,11 +9,12 @@ Reference checks deliberately absent here:
   framework (tests/flows/nested_foreach_flow.py), not an error.
 - check_annotation_name_conflict: @step(start=True) aliases don't exist
   here; start/end are identified by name only.
-- check_parallel_step_after_next / check_parallel_foreach_calls_parallel
-  _step: impossible by construction — graph.py infers parallel_step from
-  the num_parallel transition and the CLI auto-attaches the gang decorator,
-  so the two can never disagree (the remaining structural rule lives in
-  check_parallel_rules).
+The parallel-placement family (check_parallel_step_after_next,
+check_parallel_foreach_calls_parallel_step,
+check_join_followed_by_parallel_step) is implemented at the bottom of
+this file; the inferred-decorator direction is structurally impossible
+here (the CLI auto-attaches the gang decorator from the num_parallel
+transition) but the contracts are asserted anyway.
 """
 
 from .exception import TpuFlowException
@@ -289,14 +290,8 @@ def check_parallel_rules(graph):
                     node,
                 )
         if node.parallel_step:
-            # gang step must be immediately followed by a join
-            for out in node.out_funcs:
-                if out in graph and graph[out].type != "join":
-                    _err(
-                        "Step *%s* is a gang (@parallel) step so it must be "
-                        "followed by a join step." % node.name,
-                        node,
-                    )
+            # followed-by-join is asserted by
+            # check_join_followed_by_parallel_step
             if node.type == "join":
                 _err(
                     "Step *%s* cannot be both a join and a gang (@parallel) "
@@ -354,6 +349,70 @@ def check_empty_foreaches(graph):
                 "attribute name." % node.name,
                 node,
             )
+
+
+@linter.check
+def check_parallel_step_after_next(graph):
+    """Reference parity (lint.py:446-455): every child of a
+    num_parallel transition must be a gang step. In this framework the
+    gang decorator is auto-attached from the transition, so a violation
+    indicates graph-inference breakage rather than user error — but the
+    contract is still asserted."""
+    for node in graph:
+        if node.parallel_foreach and not all(
+            graph[out].parallel_step
+            for out in node.out_funcs if out in graph
+        ):
+            _err(
+                "Step *%s* uses self.next(num_parallel=...) but its "
+                "target is not a gang (@parallel) step." % node.name,
+                node,
+            )
+
+
+@linter.check
+def check_parallel_foreach_calls_parallel_step(graph):
+    """Reference parity (lint.py:475-489): a step carrying an explicit
+    @parallel/@tpu_parallel decorator must be entered via
+    self.next(num_parallel=...) — a gang body reached by a plain
+    transition would silently run un-ganged."""
+    gang_decos = {"parallel", "tpu_parallel"}
+    for node in graph:
+        is_gang = node.parallel_step or any(
+            getattr(d, "name", None) in gang_decos
+            for d in (node.decorators or [])
+        )
+        if not is_gang:
+            continue
+        # EVERY entry into a gang body must be a num_parallel transition
+        # (reference validates all in_funcs of a parallel_step)
+        callers = [
+            n.name for n in graph
+            if node.name in (n.out_funcs or []) and not n.parallel_foreach
+        ]
+        if callers:
+            _err(
+                "Step *%s* is a gang (@parallel) step but is entered from "
+                "%s without self.next(num_parallel=...)."
+                % (node.name, ", ".join(sorted(callers))),
+                node,
+            )
+
+
+@linter.check
+def check_join_followed_by_parallel_step(graph):
+    """Reference parity (lint.py:458-472): the step AFTER a gang must be
+    a join — every rank produced a task, something must collect them."""
+    for node in graph:
+        if node.parallel_step:
+            for out in node.out_funcs:
+                if out in graph and graph[out].type != "join":
+                    _err(
+                        "A gang (@parallel) step must be followed by a "
+                        "join; step *%s* follows gang step *%s* but takes "
+                        "no `inputs` argument." % (out, node.name),
+                        node,
+                    )
 
 
 def lint(graph):

@@ -232,8 +232,9 @@ class LocalMetadataProvider(MetadataProvider):
             if info is None:
                 return None
             tags = set(info.get("tags", []))
-            tags |= set(add or [])
+            # removals BEFORE additions so replace_tag(x, x) keeps x
             tags -= set(remove or [])
+            tags |= set(add or [])
             info["tags"] = sorted(tags)
             self._write_json(path, info)
             return info

@@ -198,10 +198,16 @@ class ServiceMetadataProvider(MetadataProvider):
             return None
 
     def mutate_run_tags(self, flow_name, run_id, add=None, remove=None):
-        return self._request(
-            "PATCH", "/flows/%s/runs/%s/tags" % (flow_name, run_id),
-            {"add": sorted(add or []), "remove": sorted(remove or [])},
-        )
+        try:
+            return self._request(
+                "PATCH", "/flows/%s/runs/%s/tags" % (flow_name, run_id),
+                {"add": sorted(add or []), "remove": sorted(remove or [])},
+            )
+        except ServiceException:
+            # None = run not found, the same contract as get_run_info —
+            # callers (tag CLI, client Run._mutate_tags) turn it into
+            # their own not-found errors
+            return None
 
 
 class MetadataService(object):

@@ -309,3 +309,54 @@ def test_namespace_filtering(run_flow, flows_dir, tpuflow_root):
         c.Flow("LinearFlow").latest_run.successful
     c.namespace(None)
     assert c.Flow("LinearFlow").latest_run is not None
+
+
+def test_client_tag_mutation(run_flow, flows_dir, tpuflow_root):
+    """Run.add_tag/remove_tag/replace_tag through the client API
+    (reference: client/core.py Run tag methods), sharing the tag CLI's
+    optimistic-concurrency provider path."""
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("LinearFlow").latest_run
+    assert run.add_tag("stage:dev") == run.tags
+    assert "stage:dev" in run.tags
+    run.add_tags(["model:llama", "size:7b"])
+    assert {"stage:dev", "model:llama", "size:7b"} <= run.tags
+    run.replace_tag("stage:dev", "stage:prod")
+    assert "stage:prod" in run.tags and "stage:dev" not in run.tags
+    # replace with itself keeps the tag (removal-before-addition order)
+    run.replace_tag("stage:prod", "stage:prod")
+    assert "stage:prod" in run.tags
+    run.remove_tags(["model:llama", "size:7b"])
+    assert run.tags == frozenset({"stage:prod"})
+    # a fresh client object observes the mutations
+    fresh = c.Flow("LinearFlow").latest_run
+    assert fresh.tags == frozenset({"stage:prod"})
+    with pytest.raises(Exception):
+        run.add_tag(42)
+
+
+def test_client_tag_mutation_concurrent(run_flow, flows_dir, tpuflow_root):
+    """Concurrent mutators must not lose tags (the flock-guarded
+    optimistic path): N processes each add a distinct tag."""
+    import subprocess
+    import sys
+
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+    c = _client(tpuflow_root)
+    run = c.Flow("LinearFlow").latest_run
+    script = (
+        "import os, sys\n"
+        "os.environ['TPUFLOW_DATASTORE_SYSROOT_LOCAL'] = %r\n"
+        "from metaflow_tpu.client import Flow, namespace\n"
+        "namespace(None)\n"
+        "Flow('LinearFlow').latest_run.add_tag('worker:%%s' %% sys.argv[1])\n"
+        % tpuflow_root
+    )
+    procs = [
+        subprocess.Popen([sys.executable, "-c", script, str(i)])
+        for i in range(8)
+    ]
+    assert all(p.wait(timeout=120) == 0 for p in procs)
+    fresh = c.Flow("LinearFlow").latest_run
+    assert {"worker:%d" % i for i in range(8)} <= fresh.tags

@@ -224,3 +224,50 @@ def test_valid_flows_pass():
             pass
 
     lint(FlowGraph(Good))  # must not raise
+
+
+def test_parallel_decorator_without_num_parallel():
+    """Reference parity (lint.py:475-489): an explicit @parallel step
+    entered by a plain transition must be refused."""
+    from metaflow_tpu import parallel
+
+    class UnGanged(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.work)
+
+        @parallel
+        @step
+        def work(self):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    msg = _lint_error(UnGanged)
+    assert "num_parallel" in msg and "work" in msg
+
+
+def test_gang_followed_by_non_join_named_check():
+    """Reference parity (lint.py:458-472)."""
+
+    class GangNoJoin(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.train, num_parallel=2)
+
+        @step
+        def train(self):
+            self.next(self.after)
+
+        @step
+        def after(self):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    msg = _lint_error(GangNoJoin)
+    assert "join" in msg.lower()
