@@ -36,7 +36,8 @@ def run_flow(tpuflow_root):
     """Helper: run a flow file as a subprocess against the isolated root."""
     import subprocess
 
-    def _run(flow_file, *args, expect_fail=False, env_extra=None):
+    def _run(flow_file, *args, expect_fail=False, env_extra=None,
+             prefix=None):
         env = dict(os.environ)
         env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = tpuflow_root
         # hermetic per-test blob cache (the default /tmp/tpuflow_cache is
@@ -60,7 +61,7 @@ def run_flow(tpuflow_root):
         if env_extra:
             env.update(env_extra)
         proc = subprocess.run(
-            [sys.executable, flow_file] + list(args),
+            [sys.executable] + list(prefix or []) + [flow_file] + list(args),
             env=env,
             capture_output=True,
             text=True,

@@ -6,6 +6,8 @@ combination, executed through the actual CLI, and checked via the client
 API. This multiplies coverage across the DSL/scheduler/datastore layers.
 """
 
+import os
+
 GRAPHS = {
     "linear": [
         {"name": "start", "next": ["a"]},
@@ -75,6 +77,7 @@ CONTEXTS = {
     },
     "gs_storage": {"kind": "gs", "args": [], "env": {}},
     "service_metadata": {"kind": "service", "args": [], "env": {}},
+    "daemon": {"kind": "daemon", "args": [], "env": {}},
 }
 
 
@@ -90,6 +93,7 @@ class ActiveContext(object):
         self.args = list(self.spec["args"])
         self.env = dict(self.spec["env"])
         self.client_env = {}
+        self.prefix = None  # extra interpreter args before the flow file
         self._cleanups = []
 
     def __enter__(self):
@@ -119,6 +123,51 @@ class ActiveContext(object):
                 "TPUFLOW_SERVICE_URL": svc.url,
                 "TPUFLOW_DEFAULT_METADATA": "service",
             }
+        elif kind == "daemon":
+            # runs ride the warm scheduler daemon over its unix socket:
+            # `python -m metaflow_tpu.daemon run flow.py run ...`
+            import subprocess
+            import sys
+            import time
+
+            os.makedirs(self.root, exist_ok=True)
+            sock = os.path.join(self.root, "daemon.sock")
+            env = dict(os.environ)
+            env["TPUFLOW_DAEMON_SOCKET"] = sock
+            env["TPUFLOW_DATASTORE_SYSROOT_LOCAL"] = self.root
+            env["JAX_PLATFORMS"] = "cpu"
+            env["JAX_PLATFORM_NAME"] = "cpu"
+            env["PYTHONPATH"] = os.pathsep.join(
+                [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+                + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p and "axon_site" not in p]
+            )
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "metaflow_tpu.daemon", "start"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+            def _stop():
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+            self._cleanups.append(_stop)
+            from metaflow_tpu.daemon import ping
+
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if ping(sock_path=sock):
+                    break
+                time.sleep(0.2)
+            else:
+                _stop()
+                raise RuntimeError("scheduler daemon did not come up")
+            self.prefix = ["-m", "metaflow_tpu.daemon", "run"]
+            self.env["TPUFLOW_DAEMON_SOCKET"] = sock
         return self
 
     def __exit__(self, *exc):

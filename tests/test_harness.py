@@ -16,20 +16,10 @@ from harness import (
     generate_flow,
 )
 
-# full matrix is graphs × contexts; keep the cross product lean by running
-# every graph in the default context and every context on probe graphs
-# (foreach/branch for CLI variants; foreach/branch/gang for the provider
-# contexts, which exercise different persistence paths)
-MATRIX = [(g, "default") for g in GRAPHS] + [
-    (g, c)
-    for g, c in itertools.product(("foreach", "branch"), CONTEXTS)
-    if c not in ("default", "gs_storage", "service_metadata")
-] + [
-    (g, c)
-    for g, c in itertools.product(
-        ("foreach", "branch", "gang"), ("gs_storage", "service_metadata")
-    )
-]
+# the FULL graphs × contexts product (reference: test/README.md runs every
+# graph under every valid context); no documented-impossible combos exist —
+# every graph shape must survive every provider/CLI/scheduler variation
+MATRIX = sorted(itertools.product(GRAPHS, CONTEXTS))
 
 
 @contextlib.contextmanager
@@ -83,7 +73,8 @@ def test_generated_flow(graph_name, context_name, run_flow, tpuflow_root,
         f.write(src)
 
     with ActiveContext(context_name, tpuflow_root) as ctx:
-        proc = run_flow(flow_file, *(ctx.args + ["run"]), env_extra=ctx.env)
+        proc = run_flow(flow_file, *(ctx.args + ["run"]), env_extra=ctx.env,
+                        prefix=ctx.prefix)
         assert "TRACE:" in proc.stdout
         _check_run(flow_name, graph, tpuflow_root, ctx.client_env)
 
@@ -100,30 +91,44 @@ RESUME_CASES = [
     ("gang", "train"),
 ]
 
+# resume under every scheduler-execution context: the fork pool (default),
+# no-fork workers, and the warm daemon — clone/re-run boundaries must not
+# depend on HOW tasks are launched
+RESUME_CONTEXTS = ("default", "exec_workers", "daemon")
 
-@pytest.mark.parametrize("graph_name,fail_step", RESUME_CASES)
-def test_generated_resume(graph_name, fail_step, run_flow, tpuflow_root,
-                          tmp_path):
+
+@pytest.mark.parametrize(
+    "graph_name,fail_step,context_name",
+    [(g, s, c) for (g, s) in RESUME_CASES for c in RESUME_CONTEXTS],
+)
+def test_generated_resume(graph_name, fail_step, context_name, run_flow,
+                          tpuflow_root, tmp_path):
     graph = GRAPHS[graph_name]
-    flow_name = "Res%s%sFlow" % (
-        graph_name.title().replace("_", ""), fail_step.title()
+    flow_name = "Res%s%s%sFlow" % (
+        graph_name.title().replace("_", ""), fail_step.title(),
+        context_name.title().replace("_", ""),
     )
     src = generate_flow(graph, flow_name, fail_step=fail_step)
     flow_file = str(tmp_path / ("%s.py" % flow_name))
     with open(flow_file, "w") as f:
         f.write(src)
 
-    proc = run_flow(flow_file, "run", env_extra={"FAIL_ONCE": "1"},
-                    expect_fail=True)
-    assert "induced failure" in proc.stdout + proc.stderr
+    with ActiveContext(context_name, tpuflow_root) as ctx:
+        env = dict(ctx.env)
+        env["FAIL_ONCE"] = "1"
+        proc = run_flow(flow_file, *(ctx.args + ["run"]), env_extra=env,
+                        prefix=ctx.prefix, expect_fail=True)
+        assert "induced failure" in proc.stdout + proc.stderr
 
-    proc = run_flow(flow_file, "resume")
-    out = proc.stdout + proc.stderr
-    assert "TRACE:" in proc.stdout
-    # a NONZERO clone count: steps before the failure must clone, not re-run
-    import re
+        proc = run_flow(flow_file, *(ctx.args + ["resume"]),
+                        env_extra=ctx.env, prefix=ctx.prefix)
+        out = proc.stdout + proc.stderr
+        assert "TRACE:" in proc.stdout
+        # a NONZERO clone count: steps before the failure must clone, not
+        # re-run
+        import re
 
-    m = re.search(r"\((\d+) tasks? run, (\d+) cloned\)", out)
-    assert m and int(m.group(2)) > 0, out
+        m = re.search(r"\((\d+) tasks? run, (\d+) cloned\)", out)
+        assert m and int(m.group(2)) > 0, out
 
-    _check_run(flow_name, graph, tpuflow_root, {})
+        _check_run(flow_name, graph, tpuflow_root, ctx.client_env)
