@@ -21,11 +21,34 @@ PUBLIC_MODULES = [
     ("runner", os.path.join("runner", "__init__.pyi")),
     ("plugins.cards", os.path.join("plugins", "cards", "__init__.pyi")),
     ("training", os.path.join("training", "__init__.pyi")),
-    ("parallel", os.path.join("parallel", "__init__.pyi")),
+    ("spmd", os.path.join("spmd", "__init__.pyi")),
     ("ops.attention", os.path.join("ops", "attention.pyi")),
     ("ops.ring_attention", os.path.join("ops", "ring_attention.pyi")),
     ("models.llama", os.path.join("models", "llama.pyi")),
     ("devtools", os.path.join("devtools", "__init__.pyi")),
+]
+
+# `current` members injected at runtime by decorators (via
+# current._update_env) — invisible to plain introspection of the Current
+# class, but the whole point of typed stubs is that `current.checkpoint.`
+# completes in an IDE (reference: stub_generator.py's "Add To Current"
+# docstring injection). Each entry: member name -> (module holding the
+# value's class, class name, injecting decorator).
+CURRENT_DYNAMIC_MEMBERS = [
+    ("parallel", "metaflow_tpu.current", "Parallel", "@parallel / @tpu"),
+    ("tpu", "metaflow_tpu.plugins.tpu.tpu_decorator", "TpuInfo", "@tpu"),
+    ("checkpoint", "metaflow_tpu.plugins.tpu.checkpoint_decorator",
+     "Checkpointer", "@checkpoint"),
+    ("card", "metaflow_tpu.plugins.cards.card_decorator", "CardCollector",
+     "@card"),
+    ("trigger", "metaflow_tpu.events", "Trigger",
+     "@trigger / @trigger_on_finish"),
+    ("preemption", "metaflow_tpu.plugins.tpu.preemption",
+     "PreemptionHandler", "the task runner (always present in steps)"),
+    ("project_name", None, "str", "@project"),
+    ("branch_name", None, "str", "@project"),
+    ("project_flow_name", None, "str", "@project"),
+    ("is_production", None, "bool", "@project"),
 ]
 
 
@@ -116,8 +139,50 @@ def _class_stub(name, cls):
     return "\n".join(lines + members)
 
 
+def _current_stub():
+    """The Current class with BOTH its static properties and the
+    decorator-injected dynamic members, plus stubs for the injected
+    members' own classes (introspected live, so their method signatures
+    and docstrings stay real)."""
+    import importlib
+
+    from ..current import Current
+
+    blocks = []
+    member_lines = []
+    injected_classes = []
+    for name, mod_name, cls_name, injector in CURRENT_DYNAMIC_MEMBERS:
+        ann = cls_name
+        if mod_name is not None:
+            try:
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+            except Exception:
+                continue
+            injected_classes.append((cls_name, cls))
+        member_lines.append("    @property")
+        member_lines.append("    def %s(self) -> %s:" % (name, ann))
+        member_lines.append(
+            '        """Injected by %s; raises AttributeError when that '
+            "decorator is not active (guard with current.get(%r))."
+            '"""' % (injector, name)
+        )
+        member_lines.append("        ...")
+
+    for cls_name, cls in injected_classes:
+        blocks.append(_class_stub(cls_name, cls))
+        blocks.append("")
+
+    cls_block = _class_stub("Current", Current)
+    blocks.append(cls_block)
+    blocks.extend(member_lines)
+    blocks.append("")
+    blocks.append("current: Current")
+    return "\n".join(blocks)
+
+
 def _module_stub(module):
     names = getattr(module, "__all__", None)
+    is_top = module.__name__ == "metaflow_tpu"
     if names is None:
         names = [n for n in sorted(vars(module))
                  if not n.startswith("_")
@@ -133,7 +198,9 @@ def _module_stub(module):
             obj = getattr(module, name)
         except AttributeError:
             continue
-        if inspect.isclass(obj):
+        if is_top and name == "current":
+            blocks.append(_current_stub())
+        elif inspect.isclass(obj):
             blocks.append(_class_stub(name, obj))
         elif inspect.isfunction(obj) or callable(obj):
             fn = obj if inspect.isfunction(obj) else getattr(
@@ -162,7 +229,9 @@ def generate(out_dir):
         with open(out_path, "w") as f:
             f.write(_module_stub(module))
         written.append(out_path)
-    # a py.typed-style marker naming the generator
+    # PEP 561: mark the stub tree as type information
+    with open(os.path.join(out_dir, "py.typed"), "w") as f:
+        f.write("")
     with open(os.path.join(out_dir, "GENERATED"), "w") as f:
         f.write("python -m metaflow_tpu.cmd.stubgen\n")
     return out_dir if len(written) > 1 else (written and written[0] or out_dir)

@@ -9,7 +9,10 @@ datastores, flow-level gs contexts) runs for real with no cloud access
 """
 
 import json
+import os
 import re
+import socket
+import tempfile
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -61,6 +64,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, *args):
         pass
+
+    @staticmethod
+    def _size_of(bucket, name):
+        """Object size without reading the payload when the bucket can
+        stat (disk mode); None when the object is missing."""
+        sizer = getattr(bucket, "size", None)
+        if sizer is not None:
+            return sizer(name)
+        data = bucket.get(name)
+        return None if data is None else len(data)
 
     # ------------- routes -------------
 
@@ -123,9 +136,12 @@ class _Handler(BaseHTTPRequestHandler):
         bucket = self.state.bucket(m.group(1))
         name = urllib.parse.unquote(m.group(2))
         with self.state.lock:
-            if name not in bucket:
+            try:
+                del bucket[name]
+            except KeyError:
+                # the lock is per-process: a concurrent cross-worker
+                # delete of the same object must 404, not crash
                 return self._json(404, {"error": "not found"})
-            del bucket[name]
         self._send(204)
 
     # ------------- handlers -------------
@@ -159,13 +175,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _stat(self, bucket_name, obj):
         bucket = self.state.bucket(bucket_name)
         with self.state.lock:
-            data = bucket.get(obj)
-        if data is None:
+            size = self._size_of(bucket, obj)
+        if size is None:
             return self._json(404, {"error": "not found"})
         with self.state.lock:
             gen = self.state.generation(bucket_name, obj)
         self._json(200, {"name": obj, "bucket": bucket_name,
-                         "size": str(len(data)),
+                         "size": str(size),
                          "generation": str(gen)})
 
     def _list(self, bucket_name, params):
@@ -184,8 +200,9 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     continue
             with self.state.lock:
-                items.append({"name": name,
-                              "size": str(len(bucket[name]))})
+                size = self._size_of(bucket, name)
+            if size is not None:  # deleted between snapshot and here
+                items.append({"name": name, "size": str(size)})
         self._json(200, {"items": items, "prefixes": sorted(prefixes)})
 
     def _compose(self, bucket_name, dest):
@@ -228,18 +245,198 @@ class FakeGCSServer(object):
         return False
 
 
+class _DiskBucket(object):
+    """Dict-shaped view of one bucket backed by files, so N server
+    PROCESSES share state through the filesystem (atomic tmp+rename
+    writes). Object names are percent-encoded into flat filenames."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name):
+        return os.path.join(self.root, urllib.parse.quote(name, safe=""))
+
+    def get(self, name, default=None):
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return default
+
+    def __getitem__(self, name):
+        data = self.get(name)
+        if data is None:
+            raise KeyError(name)
+        return data
+
+    def __setitem__(self, name, data):
+        path = self._path(name)
+        fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".inflight-")
+        try:
+            os.write(fd, data)
+        finally:
+            os.close(fd)
+        os.rename(tmp, path)
+
+    def __delitem__(self, name):
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            raise KeyError(name)
+
+    def __contains__(self, name):
+        return os.path.exists(self._path(name))
+
+    def size(self, name):
+        """O(1) size via stat (the handler's list/stat paths use this
+        instead of reading whole payloads); None when missing."""
+        try:
+            return os.stat(self._path(name)).st_size
+        except OSError:
+            return None
+
+    def __iter__(self):
+        for fn in os.listdir(self.root):
+            if not fn.startswith(".inflight-"):
+                yield urllib.parse.unquote(fn)
+
+
+class FakeGCSDiskState(object):
+    """Same surface as FakeGCSState, shared across worker processes via a
+    directory (put it on tmpfs to keep the bench memory-speed).
+    Generations are file mtime_ns — monotonic per object on every write."""
+
+    def __init__(self, root):
+        self.root = root
+        self.lock = threading.Lock()  # per-process; renames are atomic
+        self.request_count = 0
+
+    def bucket(self, name):
+        return _DiskBucket(
+            os.path.join(self.root, urllib.parse.quote(name, safe=""))
+        )
+
+    def bump_generation(self, bucket_name, obj):
+        return self.generation(bucket_name, obj)
+
+    def generation(self, bucket_name, obj):
+        try:
+            return os.stat(
+                self.bucket(bucket_name)._path(obj)).st_mtime_ns
+        except OSError:
+            return 1
+
+
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    allow_reuse_address = True
+
+    def server_bind(self):
+        # set SO_REUSEPORT directly (the allow_reuse_port class attribute
+        # only exists on newer socketserver versions): the kernel
+        # load-balances accepts across the worker processes
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        ThreadingHTTPServer.server_bind(self)
+
+
+def serve_cluster(workers, root, port=0):
+    """Pre-fork N worker processes all bound to ONE port via SO_REUSEPORT,
+    state shared through `root`. Returns (endpoint, child pids); the
+    caller owns cleanup (SIGTERM the pids). This exists so gsop benchmark
+    numbers measure the ENGINE, not a single-GIL test double."""
+    # reserve a port with SO_REUSEPORT so children can re-bind it
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    probe.bind(("127.0.0.1", port))
+    port = probe.getsockname()[1]
+
+    pids = []
+    for _ in range(workers):
+        pid = os.fork()
+        if pid == 0:  # child: serve forever
+            code = 0
+            try:
+                state = FakeGCSDiskState(root)
+                handler = type("BoundHandler", (_Handler,),
+                               {"state": state})
+                srv = _ReusePortHTTPServer(("127.0.0.1", port), handler)
+                probe.close()
+                srv.serve_forever()
+            except BaseException:
+                # a silently-dead worker would surface only as
+                # connection-refused at the client — say why instead
+                import traceback
+
+                traceback.print_exc()
+                code = 1
+            finally:
+                os._exit(code)
+        pids.append(pid)
+    probe.close()
+    return "http://127.0.0.1:%d" % port, pids
+
+
 def main():
     """Run standalone (separate process): prints the endpoint, serves until
-    killed. Benchmarks use this so client and server don't share a GIL."""
+    killed. Benchmarks use this so client and server don't share a GIL.
+
+        python -m metaflow_tpu.devtools.fake_gcs [--workers N [--root DIR]]
+
+    With --workers > 1, pre-forks N SO_REUSEPORT processes sharing state
+    via --root (default: a fresh tmpfs-backed tempdir under /dev/shm)."""
+    import signal
     import sys
 
-    srv = FakeGCSServer()
-    print(srv.endpoint, flush=True)
-    srv._thread.start()
-    try:
-        srv._thread.join()
-    except KeyboardInterrupt:
-        pass
+    workers = 1
+    root = None
+    args = sys.argv[1:]
+    while args:
+        if args[0] == "--workers":
+            workers = int(args[1])
+            args = args[2:]
+        elif args[0] == "--root":
+            root = args[1]
+            args = args[2:]
+        else:
+            print("unknown arg %s" % args[0], file=sys.stderr)
+            return 2
+
+    if workers <= 1:
+        srv = FakeGCSServer()
+        print(srv.endpoint, flush=True)
+        srv._thread.start()
+        try:
+            srv._thread.join()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    made_root = root is None
+    if root is None:
+        base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        root = tempfile.mkdtemp(prefix="fake-gcs-", dir=base)
+    endpoint, pids = serve_cluster(workers, root)
+    print(endpoint, flush=True)
+
+    def _bye(*_):
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+        if made_root:
+            # tmpfs-backed object data must not outlive the server —
+            # repeated bench runs would fill /dev/shm
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _bye)
+    signal.signal(signal.SIGINT, _bye)
+    for pid in pids:
+        os.waitpid(pid, 0)
+    return 0
 
 
 if __name__ == "__main__":

@@ -291,3 +291,57 @@ class TestThroughput:
                 break
         print("\ngsop get_many: %.0f MB/s (loopback)" % mbps)
         assert mbps > 50  # loopback floor; real NIC is the bench's job
+
+
+class TestClusterServer:
+    """The multi-process SO_REUSEPORT fake server (bench double) must be
+    semantically identical to the threaded one: cross-WORKER visibility
+    rides the shared tmpfs state."""
+
+    def test_gsop_against_cluster_server(self, tmp_path):
+        import subprocess
+        import sys
+        import time as _time
+
+        from metaflow_tpu.gsop import GSClient
+
+        root = str(tmp_path / "state")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "metaflow_tpu.devtools.fake_gcs",
+             "--workers", "4", "--root", root],
+            stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            endpoint = proc.stdout.readline().strip()
+            assert endpoint.startswith("http://127.0.0.1:")
+            client = GSClient(endpoint=endpoint)
+
+            srcs = []
+            for i in range(8):
+                p = tmp_path / ("s%d" % i)
+                p.write_bytes(os.urandom(256 * 1024 + i))
+                srcs.append(("o/%d" % i, str(p)))
+            client.put_many("bkt", srcs)
+
+            # gets round-robin across workers; every object visible
+            pairs = [("o/%d" % i, str(tmp_path / ("d%d" % i)))
+                     for i in range(8)]
+            client.get_many("bkt", pairs)
+            for i in range(8):
+                assert (tmp_path / ("d%d" % i)).read_bytes() == \
+                    (tmp_path / ("s%d" % i)).read_bytes()
+
+            # list + stat + delete all see cross-worker writes
+            files, _prefixes = client.list("bkt", prefix="o/")
+            assert sorted(files) == [
+                ("o/%d" % i, 256 * 1024 + i) for i in range(8)
+            ]
+            info = client.stat("bkt", "o/3")
+            assert int(info["size"]) == 256 * 1024 + 3
+            client.delete("bkt", "o/3")
+            _time.sleep(0.05)
+            files, _ = client.list("bkt", prefix="o/")
+            assert len(files) == 7
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
