@@ -2,12 +2,24 @@
 per-step environment.
 
 Reference behavior: metaflow/plugins/env_escape/ (client/server over a
-socket bytestream, proxied stubs, exception transfer). Compact TPU-first
-equivalent: an RPC server runs in the parent interpreter over a unix domain
-socket; inside the step's venv, `load_module('some_lib')` returns a proxy
-whose attribute chains resolve remotely and whose calls ship
-pickled args/results. Useful when a pinned @pypi env needs a library only
-installed in the TPU-VM system stack.
+socket bytestream, generated stubs with identity + special-method
+support, per-library override configurations, typed data transferer).
+TPU-first equivalent with the same architecture:
+
+- `wire.py` — length-prefixed JSON frames (NO pickle on the wire: a
+  compromised peer can hand back wrong data, never code).
+- `transfer.py` — explicit whitelist of value types; everything else
+  stays server-side behind identity-preserving handles.
+- `stub.py` — a proxy CLASS is generated per remote class from server
+  introspection, so len()/iteration/`with`/comparisons work; the same
+  remote object always materializes as the same stub instance.
+- `overrides.py` — per-library configurations: @local_override (runs
+  client-side, no RPC), @remote_override (wraps server-side),
+  getattr/setattr variants, exported exceptions (re-raised as real
+  classes), custom value transfers.
+- `client.py` / `server.py` — the two ends.
+
+Usage:
 
     # outer interpreter
     server = EscapeServer(modules=["math", "socket"]).start()
@@ -15,239 +27,36 @@ installed in the TPU-VM system stack.
     # inside the pinned env (TPUFLOW_ESCAPE_SOCKET is inherited)
     math = load_module("math")
     assert math.sqrt(4.0) == 2.0
+
+Useful when a pinned @pypi env needs a library only installed in the
+TPU-VM system stack.
 """
 
-import os
-import pickle
-import socket
-import socketserver
-import struct
-import tempfile
-import threading
-import traceback
+from .client import EscapeClient, RemoteError, load_module
+from .client import SOCKET_ENV  # noqa: F401
+from .overrides import (  # noqa: F401
+    local_getattr_override,
+    local_override,
+    local_setattr_override,
+    register_config,
+    remote_getattr_override,
+    remote_override,
+    remote_setattr_override,
+    value_transfer,
+)
+from .server import EscapeServer
 
-from ...exception import TpuFlowException
-
-SOCKET_ENV = "TPUFLOW_ESCAPE_SOCKET"
-
-
-def _send(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
-
-
-def _recv(sock):
-    header = b""
-    while len(header) < 8:
-        chunk = sock.recv(8 - len(header))
-        if not chunk:
-            raise ConnectionError("escape peer closed")
-        header += chunk
-    (length,) = struct.unpack("<Q", header)
-    data = b""
-    while len(data) < length:
-        chunk = sock.recv(min(1 << 20, length - len(data)))
-        if not chunk:
-            raise ConnectionError("escape peer closed")
-        data += chunk
-    return pickle.loads(data)
-
-
-class RemoteError(TpuFlowException):
-    headline = "Exception in the outer interpreter"
-
-
-class EscapeServer(object):
-    """Serves attribute resolution + calls for an allow-list of modules."""
-
-    def __init__(self, modules, socket_path=None):
-        self._allowed = set(modules)
-        self._objects = {}  # handle id -> live object
-        self._next_handle = [0]
-        self._handle_lock = threading.Lock()
-        self.socket_path = socket_path or os.path.join(
-            tempfile.mkdtemp(prefix="tpuflow_escape_"), "rpc.sock"
-        )
-        server = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                while True:
-                    try:
-                        request = _recv(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    _send(self.request, server._dispatch(request))
-
-        self._server = socketserver.ThreadingUnixStreamServer(
-            self.socket_path, Handler
-        )
-        # handler threads must not block interpreter exit when a client
-        # leaves its connection open
-        self._server.daemon_threads = True
-        self._thread = None
-
-    # ---- handle bookkeeping: unpicklable results become proxies ----
-
-    def _to_wire(self, value, force_handle=False):
-        # callables stay server-side handles: pickling a function by
-        # reference would make the CLIENT import + run it locally, which
-        # defeats the escape (and fails inside pinned envs missing the lib)
-        if not force_handle and not callable(value):
-            try:
-                # ship the value as its own pickled blob so a client whose
-                # env can't unpickle it (library-by-reference) can detect
-                # the failure and retry asking for a handle
-                return {"kind": "value", "blob": pickle.dumps(value)}
-            except Exception:
-                pass
-        with self._handle_lock:
-            self._next_handle[0] += 1
-            handle = self._next_handle[0]
-            self._objects[handle] = value
-        return {"kind": "handle", "handle": handle}
-
-    def _resolve(self, ref):
-        if ref["kind"] == "module":
-            if ref["name"] not in self._allowed:
-                raise TpuFlowException(
-                    "Module %r is not on the escape allow-list" % ref["name"]
-                )
-            import importlib
-
-            return importlib.import_module(ref["name"])
-        if ref["kind"] == "handle":
-            return self._objects[ref["handle"]]
-        return ref["value"]
-
-    def _dispatch(self, request):
-        try:
-            op = request["op"]
-            force_handle = bool(request.get("force_handle"))
-            if op == "getattr":
-                target = self._resolve(request["target"])
-                return {"ok": True,
-                        **self._to_wire(getattr(target, request["name"]),
-                                        force_handle)}
-            if op == "call":
-                target = self._resolve(request["target"])
-                args = [self._resolve(a) for a in request["args"]]
-                kwargs = {k: self._resolve(v)
-                          for k, v in request["kwargs"].items()}
-                return {"ok": True,
-                        **self._to_wire(target(*args, **kwargs),
-                                        force_handle)}
-            if op == "release":
-                self._objects.pop(request["handle"], None)
-                return {"ok": True, "kind": "value", "value": None}
-            raise TpuFlowException("Unknown escape op %r" % op)
-        except Exception as ex:
-            return {
-                "ok": False,
-                "error": "%s: %s" % (type(ex).__name__, ex),
-                "traceback": traceback.format_exc(),
-            }
-
-    def start(self):
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
-        )
-        self._thread.start()
-        os.environ[SOCKET_ENV] = self.socket_path
-        return self
-
-    def stop(self):
-        self._server.shutdown()
-        self._server.server_close()
-        try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
-
-
-class _Proxy(object):
-    """Client-side stand-in for a remote object."""
-
-    def __init__(self, client, ref):
-        object.__setattr__(self, "_client", client)
-        object.__setattr__(self, "_ref", ref)
-
-    def __getattr__(self, name):
-        return self._client.request(
-            {"op": "getattr", "target": self._ref, "name": name}
-        )
-
-    def __call__(self, *args, **kwargs):
-        client = self._client
-        return client.request({
-            "op": "call",
-            "target": self._ref,
-            "args": [client.to_ref(a) for a in args],
-            "kwargs": {k: client.to_ref(v) for k, v in kwargs.items()},
-        })
-
-    def __repr__(self):
-        return "<escape proxy %r>" % (self._ref,)
-
-
-class EscapeClient(object):
-    def __init__(self, socket_path=None):
-        path = socket_path or os.environ.get(SOCKET_ENV)
-        if not path:
-            raise TpuFlowException(
-                "No escape server configured (%s unset)" % SOCKET_ENV
-            )
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.connect(path)
-        self._lock = threading.Lock()
-
-    def to_ref(self, value):
-        if isinstance(value, _Proxy):
-            return object.__getattribute__(value, "_ref")
-        return {"kind": "value", "value": value}
-
-    def request(self, payload):
-        with self._lock:
-            _send(self._sock, payload)
-            response = _recv(self._sock)
-        if not response.get("ok"):
-            raise RemoteError(
-                "%s\n%s" % (response.get("error"),
-                            response.get("traceback", ""))
-            )
-        if response["kind"] == "handle":
-            return _Proxy(self, {"kind": "handle",
-                                 "handle": response["handle"]})
-        try:
-            return pickle.loads(response["blob"])
-        except Exception:
-            # this env can't materialize the value (pickled by reference to
-            # a library we don't have): re-request it as a server handle
-            if payload.get("force_handle"):
-                raise
-            return self.request(dict(payload, force_handle=True))
-
-    def load_module(self, name):
-        return _Proxy(self, {"kind": "module", "name": name})
-
-    def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-
-
-def load_module(name, socket_path=None):
-    """Convenience: connect (once per process) and proxy a module."""
-    global _default_client
-    try:
-        client = _default_client
-    except NameError:
-        client = None
-    if client is None:
-        client = EscapeClient(socket_path)
-        _default_client = client
-    return client.load_module(name)
-
-
-_default_client = None
+__all__ = [
+    "EscapeClient",
+    "EscapeServer",
+    "RemoteError",
+    "load_module",
+    "local_override",
+    "local_getattr_override",
+    "local_setattr_override",
+    "remote_override",
+    "remote_getattr_override",
+    "remote_setattr_override",
+    "register_config",
+    "value_transfer",
+]

@@ -1,0 +1,198 @@
+"""Escape client: typed RPC + stub materialization + exception mapping.
+
+Reference behavior: metaflow/plugins/env_escape/client.py:590. Remote
+exceptions re-raise as REAL classes when they are control-flow builtins
+(StopIteration and friends must work for iteration protocols) or when a
+library configuration exports them and they import locally; everything
+else raises a synthesized per-class subclass of RemoteError, so callers
+can catch either the broad bridge error or the specific remote type.
+"""
+
+import importlib
+import socket
+import threading
+
+from ...exception import TpuFlowException
+from .overrides import load_config, merge_configs, merge_into
+from .stub import BaseStub, ModuleProxy, StubFactory
+from .transfer import NotEncodable, decode, encode
+from .wire import SOCKET_ENV, recv_msg, send_msg
+
+
+class RemoteError(TpuFlowException):
+    headline = "Exception in the outer interpreter"
+
+
+# builtins that ARE protocol control flow: they must re-raise as the real
+# class or iteration/indexing/with blocks break on the client side
+_CONTROL_FLOW = {
+    "builtins.StopIteration": StopIteration,
+    "builtins.StopAsyncIteration": StopAsyncIteration,
+    "builtins.GeneratorExit": GeneratorExit,
+    "builtins.KeyError": KeyError,
+    "builtins.IndexError": IndexError,
+    "builtins.AttributeError": AttributeError,
+}
+
+
+class EscapeClient(object):
+    def __init__(self, socket_path=None):
+        import os
+
+        path = socket_path or os.environ.get(SOCKET_ENV)
+        if not path:
+            raise TpuFlowException(
+                "No escape server configured (%s unset)" % SOCKET_ENV
+            )
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(path)
+        self._lock = threading.Lock()
+        self._closed = False
+        self.config = merge_configs([])
+        self._loaded = set()
+        self._stubs = StubFactory(self)
+        self._exc_classes = {}
+        # handles queued by stub __del__ (GC context: no RPC allowed
+        # there); flushed piggybacked on the next roundtrip
+        self._pending_release = set()
+        self._release_lock = threading.Lock()
+
+    # ---- public surface ----
+
+    def load_module(self, name):
+        if name not in self._loaded:
+            self._loaded.add(name)
+            merge_into(self.config, load_config(name))
+        return ModuleProxy(self, name)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ---- plumbing used by stubs ----
+
+    def local_override_for(self, stub, kind, name):
+        if not isinstance(stub, BaseStub):
+            return None
+        cls_path = object.__getattribute__(stub, "_cls_name")
+        table = getattr(self.config, kind)
+        return (table.get((cls_path, name))
+                or table.get((cls_path.rsplit(".", 1)[-1], name)))
+
+    def encode_value(self, value):
+        def ref_of(v):
+            if isinstance(v, BaseStub):
+                return {"t": "ref",
+                        "handle": object.__getattribute__(v, "_handle")}
+            if isinstance(v, ModuleProxy):
+                return {"t": "module",
+                        "name": object.__getattribute__(v, "_name")}
+            raise NotEncodable(
+                "%r cannot cross the escape bridge — pass plain values "
+                "or escape stubs" % (type(v).__name__,)
+            )
+
+        return encode(value, make_ref=ref_of, dumpers=self.config.dumpers)
+
+    def op(self, op, **fields):
+        response = self._roundtrip(dict(fields, op=op))
+        if not response.get("ok"):
+            self._raise_remote(response["exc"])
+        return self._materialize(response["value"])
+
+    def queue_release(self, handle):
+        with self._release_lock:
+            self._pending_release.add(handle)
+
+    def keep_handle(self, handle):
+        """A new stub now points at `handle`: a queued release from a
+        dead predecessor must not drop it out from under it."""
+        with self._release_lock:
+            self._pending_release.discard(handle)
+
+    # ---- internals ----
+
+    def _roundtrip(self, payload):
+        with self._lock:
+            with self._release_lock:
+                pending, self._pending_release = \
+                    self._pending_release, set()
+            for handle in pending:
+                try:
+                    send_msg(self._sock, {"op": "release",
+                                          "handle": handle})
+                    recv_msg(self._sock)
+                except Exception:
+                    break  # socket down: the main request will say so
+            send_msg(self._sock, payload)
+            return recv_msg(self._sock)
+
+    def _materialize(self, payload):
+        def resolve(ref):
+            if ref["t"] == "ref":
+                if ref.get("exc_class"):
+                    return self.exception_class(ref["exc_class"])
+                return self._stubs.stub_for(ref)
+            raise NotEncodable("Unexpected payload %r" % ref["t"])
+
+        return decode(payload, resolve_ref=resolve,
+                      loaders=self.config.loaders)
+
+    def exception_class(self, full_name):
+        """The local class used for remote exceptions of `full_name`:
+        a control-flow builtin, a config-exported importable class, or a
+        synthesized RemoteError subclass (one per remote type, cached, so
+        `except client.exception_class('lib.Err')` works)."""
+        if full_name in _CONTROL_FLOW:
+            return _CONTROL_FLOW[full_name]
+        cached = self._exc_classes.get(full_name)
+        if cached is not None:
+            return cached
+        cls = None
+        if full_name in self.config.exported_exceptions:
+            mod_name, _, cls_name = full_name.rpartition(".")
+            try:
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+            except (ImportError, AttributeError):
+                cls = None
+        if cls is None:
+            cls = type(
+                full_name.rsplit(".", 1)[-1],
+                (RemoteError,),
+                {"remote_class": full_name},
+            )
+        self._exc_classes[full_name] = cls
+        return cls
+
+    def _raise_remote(self, exc_payload):
+        full = exc_payload["cls"]
+        try:
+            args = decode(exc_payload["args"])
+        except NotEncodable:
+            args = []
+        cls = self.exception_class(full)
+        if issubclass(cls, RemoteError):
+            raise cls(
+                "%s: %s\n\nRemote traceback:\n%s"
+                % (full, ", ".join(str(a) for a in args),
+                   exc_payload.get("tb", ""))
+            )
+        try:
+            ex = cls(*args)
+        except Exception:
+            ex = cls(", ".join(str(a) for a in args))
+        raise ex
+
+
+_default_client = None
+
+
+def load_module(name, socket_path=None):
+    """Convenience: connect (once per process) and proxy a module."""
+    global _default_client
+    if _default_client is None:
+        _default_client = EscapeClient(socket_path)
+    return _default_client.load_module(name)

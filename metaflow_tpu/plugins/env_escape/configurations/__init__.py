@@ -1,0 +1,4 @@
+"""Per-library escape configurations, one module per escaped library
+(module name with dots replaced by underscores — the reference's
+configurations/ package). Also registrable programmatically via
+env_escape.register_config for tests and extensions."""

@@ -1,0 +1,154 @@
+"""Per-library escape configurations: overrides + exception export +
+custom value transfers.
+
+Reference behavior: metaflow/plugins/env_escape/override_decorators.py +
+configurations/ (one package per emulated library: emulate_test_lib).
+A configuration module customizes how ONE library behaves across the
+bridge:
+
+    MODULE = "some_lib"
+    EXPORTED_EXCEPTIONS = ["some_lib.SomeError"]   # re-raised typed
+
+    @local_override({"SomeClass": ["cheap_method"]})
+    def cheap_method(stub, *args):       # runs CLIENT-side, no RPC
+        return 42
+
+    @remote_override({"SomeClass": ["fragile_method"]})
+    def fragile_method(obj, *args):      # wraps the call SERVER-side
+        return obj.fragile_method(*args) or "fixed"
+
+    @value_transfer("some_lib.Vector", dump=lambda v: [v.x, v.y])
+    def load_vector(payload):            # client-side loader
+        return LocalVector(*payload)     # NB: the remote type is named
+                                         # by STRING — a configuration
+                                         # never imports the escaped lib
+
+Configurations are discovered from
+`metaflow_tpu.plugins.env_escape.configurations.<module_with_underscores>`
+or registered programmatically with register_config().
+"""
+
+import importlib
+
+
+class Override(object):
+    def __init__(self, mapping, func, kind):
+        if not isinstance(mapping, dict):
+            raise ValueError(
+                "override decorators take {class name: [method names]}"
+            )
+        self.mapping = mapping
+        self.func = func
+        self.kind = kind  # 'local' | 'remote' | 'local_getattr' | ...
+
+
+def _make_decorator(kind):
+    def deco(mapping):
+        def wrap(func):
+            return Override(mapping, func, kind)
+
+        return wrap
+
+    return deco
+
+
+local_override = _make_decorator("local")
+remote_override = _make_decorator("remote")
+local_getattr_override = _make_decorator("local_getattr")
+local_setattr_override = _make_decorator("local_setattr")
+remote_getattr_override = _make_decorator("remote_getattr")
+remote_setattr_override = _make_decorator("remote_setattr")
+
+
+class ValueTransfer(object):
+    def __init__(self, cls_path, name, dump, load):
+        self.cls_path = cls_path  # "module.Class", resolved lazily
+        self.name = name or cls_path
+        self.dump = dump
+        self.load = load
+
+
+def value_transfer(cls_path, dump, name=None):
+    """Decorate the client-side loader for a custom value type.
+    `cls_path` is the remote type's "module.Class" STRING (the client
+    must not import the escaped library); `dump` runs server-side,
+    turning the value into wire-encodable data."""
+
+    def wrap(load):
+        return ValueTransfer(cls_path, name, dump, load)
+
+    return wrap
+
+
+class EscapeConfig(object):
+    """Parsed view of one library's configuration module."""
+
+    def __init__(self, module_name, config_module=None):
+        self.module_name = module_name
+        self.exported_exceptions = []
+        # (class name, member name) -> fn, per override kind
+        self.local = {}
+        self.remote = {}
+        self.local_getattr = {}
+        self.local_setattr = {}
+        self.remote_getattr = {}
+        self.remote_setattr = {}
+        self.dumpers = {}  # type -> (name, dump fn)   [server side]
+        self.loaders = {}  # name -> load fn           [client side]
+        if config_module is not None:
+            self._scan(config_module)
+
+    def _scan(self, mod):
+        self.exported_exceptions = list(
+            getattr(mod, "EXPORTED_EXCEPTIONS", [])
+        )
+        for attr in vars(mod).values():
+            if isinstance(attr, Override):
+                table = getattr(self, attr.kind)
+                for cls_name, members in attr.mapping.items():
+                    for member in members:
+                        table[(cls_name, member)] = attr.func
+            elif isinstance(attr, ValueTransfer):
+                self.dumpers[attr.cls_path] = (attr.name, attr.dump)
+                self.loaders[attr.name] = attr.load
+
+
+_registered = {}  # module name -> config module (tests/extensions)
+
+
+def register_config(module_name, config_module):
+    _registered[module_name] = config_module
+
+
+def load_config(module_name):
+    """The configuration for one escaped library (empty if none)."""
+    if module_name in _registered:
+        return EscapeConfig(module_name, _registered[module_name])
+    slug = module_name.replace(".", "_")
+    try:
+        mod = importlib.import_module(
+            "metaflow_tpu.plugins.env_escape.configurations.%s" % slug
+        )
+    except ImportError:
+        return EscapeConfig(module_name)
+    return EscapeConfig(module_name, mod)
+
+
+def merge_into(dst, cfg):
+    """Fold one library's config into an aggregate (the single place
+    that knows every config field)."""
+    dst.exported_exceptions += cfg.exported_exceptions
+    for kind in ("local", "remote", "local_getattr", "local_setattr",
+                 "remote_getattr", "remote_setattr"):
+        getattr(dst, kind).update(getattr(cfg, kind))
+    dst.dumpers.update(cfg.dumpers)
+    dst.loaders.update(cfg.loaders)
+    return dst
+
+
+def merge_configs(module_names):
+    """One combined view over several libraries' configs."""
+    merged = EscapeConfig("<merged>")
+    for name in module_names:
+        merge_into(merged, load_config(name))
+    return merged

@@ -1,0 +1,201 @@
+"""Escape server: serves an allow-list of outer-interpreter libraries
+over a unix socket with the typed wire protocol (transfer.py).
+
+Reference behavior: metaflow/plugins/env_escape/server.py — object
+handles with identity, per-class introspection for client stub
+generation, remote overrides from per-library configurations.
+"""
+
+import os
+import socketserver
+import tempfile
+import threading
+
+from .overrides import merge_configs
+from .transfer import decode, encode, encode_exception
+from .wire import SOCKET_ENV, recv_msg, send_msg
+
+# special methods a stub may forward; per-class introspection reports
+# which of these the real class actually defines
+SUPPORTED_DUNDERS = [
+    "__len__", "__getitem__", "__setitem__", "__delitem__",
+    "__contains__", "__iter__", "__next__", "__enter__", "__exit__",
+    "__str__", "__bool__", "__eq__", "__ne__", "__lt__", "__le__",
+    "__gt__", "__ge__", "__hash__", "__add__", "__sub__", "__mul__",
+    "__truediv__", "__call__",
+]
+
+
+class EscapeServer(object):
+    """Serves attribute resolution + calls for an allow-list of modules."""
+
+    def __init__(self, modules, socket_path=None):
+        self._allowed = set(modules)
+        self.config = merge_configs(sorted(self._allowed))
+        self._handles = {}       # handle -> live object (strong ref)
+        self._ids = {}           # id(obj) -> handle   (identity map)
+        self._next_handle = 0
+        self._lock = threading.Lock()
+        self.socket_path = socket_path or os.path.join(
+            tempfile.mkdtemp(prefix="tpuflow_escape_"), "rpc.sock"
+        )
+        server = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        request = recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    send_msg(self.request, server._dispatch(request))
+
+        self._server = socketserver.ThreadingUnixStreamServer(
+            self.socket_path, Handler
+        )
+        # handler threads must not block interpreter exit when a client
+        # leaves its connection open
+        self._server.daemon_threads = True
+        self._thread = None
+
+    # ---- handles (identity-preserving) ----
+
+    def _make_ref(self, value):
+        with self._lock:
+            handle = self._ids.get(id(value))
+            if handle is None or self._handles.get(handle) is not value:
+                self._next_handle += 1
+                handle = self._next_handle
+                self._handles[handle] = value
+                self._ids[id(value)] = handle
+        cls = type(value)
+        is_exc_class = isinstance(value, type) and \
+            issubclass(value, BaseException)
+        return {
+            "t": "ref",
+            "handle": handle,
+            "cls": "%s.%s" % (cls.__module__, cls.__name__),
+            "callable": callable(value),
+            "exc_class": (
+                "%s.%s" % (value.__module__, value.__name__)
+                if is_exc_class else None
+            ),
+        }
+
+    def _resolve(self, payload):
+        if payload["t"] == "module":
+            name = payload["name"]
+            if name not in self._allowed:
+                raise PermissionError(
+                    "Module %r is not on the escape allow-list" % name
+                )
+            import importlib
+
+            return importlib.import_module(name)
+        if payload["t"] == "ref":
+            return self._handles[payload["handle"]]
+        raise KeyError("Unresolvable target %r" % payload.get("t"))
+
+    def _decode(self, payload):
+        return decode(payload, resolve_ref=self._resolve)
+
+    def _encode(self, value):
+        return encode(value, make_ref=self._make_ref,
+                      dumpers=self.config.dumpers)
+
+    # ---- overrides ----
+
+    def _override_for(self, table, obj, name):
+        for cls in type(obj).__mro__:
+            full = "%s.%s" % (cls.__module__, cls.__name__)
+            fn = table.get((full, name)) or table.get((cls.__name__, name))
+            if fn is not None:
+                return fn
+        return None
+
+    # ---- dispatch ----
+
+    def _dispatch(self, request):
+        try:
+            op = request["op"]
+            if op == "ping":
+                return {"ok": True, "value": {"t": "str", "v": "pong"}}
+            if op == "release":
+                with self._lock:
+                    obj = self._handles.pop(request["handle"], None)
+                    if obj is not None:
+                        self._ids.pop(id(obj), None)
+                return {"ok": True, "value": {"t": "none", "v": None}}
+
+            target = self._resolve(request["target"])
+            if op == "getattr":
+                fn = self._override_for(
+                    self.config.remote_getattr, target, request["name"]
+                )
+                value = (fn(target, request["name"]) if fn
+                         else getattr(target, request["name"]))
+                return {"ok": True, "value": self._encode(value)}
+            if op == "setattr":
+                fn = self._override_for(
+                    self.config.remote_setattr, target, request["name"]
+                )
+                value = self._decode(request["value"])
+                if fn:
+                    fn(target, request["name"], value)
+                else:
+                    setattr(target, request["name"], value)
+                return {"ok": True, "value": {"t": "none", "v": None}}
+
+            args = [self._decode(a) for a in request.get("args", [])]
+            kwargs = {k: self._decode(v)
+                      for k, v in request.get("kwargs", {}).items()}
+            if op == "call":
+                return {"ok": True,
+                        "value": self._encode(target(*args, **kwargs))}
+            if op == "method":
+                name = request["name"]
+                fn = self._override_for(self.config.remote, target, name)
+                value = (fn(target, *args, **kwargs) if fn
+                         else getattr(target, name)(*args, **kwargs))
+                return {"ok": True, "value": self._encode(value)}
+            if op == "describe":
+                cls = type(target)
+                methods = sorted(
+                    n for n in dir(cls)
+                    if not n.startswith("_")
+                    and callable(getattr(cls, n, None))
+                )
+                dunders = [
+                    d for d in SUPPORTED_DUNDERS
+                    if getattr(cls, d, None) is not None
+                    and getattr(cls, d, None) is not getattr(object, d, None)
+                ]
+                doc = cls.__doc__  # a descriptor on some C types
+                return {"ok": True, "value": encode({
+                    "cls": "%s.%s" % (cls.__module__, cls.__name__),
+                    "name": cls.__name__,
+                    "methods": methods,
+                    "dunders": dunders,
+                    "doc": doc if isinstance(doc, str) else "",
+                })}
+            raise ValueError("Unknown escape op %r" % op)
+        except BaseException as ex:  # incl. StopIteration: it must transfer
+            return {"ok": False, "exc": encode_exception(ex)}
+
+    # ---- lifecycle ----
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        os.environ[SOCKET_ENV] = self.socket_path
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass

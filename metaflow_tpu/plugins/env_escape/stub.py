@@ -1,0 +1,187 @@
+"""Client-side stubs: generated proxy CLASSES per remote class.
+
+Reference behavior: metaflow/plugins/env_escape/stub.py:495 — a stub
+type is built per remote class from server introspection (methods +
+which special methods the class really defines), so `len(stub)`,
+iteration, context managers, comparisons and `with` blocks behave like
+the real object. Stub identity mirrors remote identity: the same remote
+object always resolves to the same stub instance (client-side weak map
+keyed by the server's identity-preserving handle).
+"""
+
+import weakref
+
+from .transfer import NotEncodable
+
+
+class BaseStub(object):
+    def __init__(self, client, handle, cls_name):
+        object.__setattr__(self, "_client", client)
+        object.__setattr__(self, "_handle", handle)
+        object.__setattr__(self, "_cls_name", cls_name)
+
+    def __getattr__(self, name):
+        client = object.__getattribute__(self, "_client")
+        fn = client.local_override_for(self, "local_getattr", name)
+        if fn is not None:
+            return fn(self, name)
+        return client.op(
+            "getattr", target=self._ref(), name=name
+        )
+
+    def __setattr__(self, name, value):
+        client = object.__getattribute__(self, "_client")
+        fn = client.local_override_for(self, "local_setattr", name)
+        if fn is not None:
+            return fn(self, name, value)
+        client.op("setattr", target=self._ref(), name=name,
+                  value=client.encode_value(value))
+
+    def _ref(self):
+        return {"t": "ref", "handle":
+                object.__getattribute__(self, "_handle")}
+
+    def __repr__(self):
+        return "<escape stub %s #%d>" % (
+            object.__getattribute__(self, "_cls_name"),
+            object.__getattribute__(self, "_handle"),
+        )
+
+    def __del__(self):
+        # NEVER an RPC here: cyclic GC can fire inside the client's own
+        # locked roundtrip (self-deadlock on the non-reentrant lock), so
+        # the handle is queued and released piggybacked on the next op
+        # (the reference queues deletions the same way)
+        try:
+            client = object.__getattribute__(self, "_client")
+            client.queue_release(object.__getattribute__(self, "_handle"))
+        except Exception:
+            pass  # interpreter teardown
+
+
+def _method_forward(name):
+    def method(self, *args, **kwargs):
+        client = object.__getattribute__(self, "_client")
+        return client.op(
+            "method", target=self._ref(), name=name,
+            args=[client.encode_value(a) for a in args],
+            kwargs={k: client.encode_value(v) for k, v in kwargs.items()},
+        )
+
+    method.__name__ = name
+    return method
+
+
+def _local_wrap(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    return method
+
+
+def _make_dunder(name):
+    if name == "__exit__":
+        # cross-process __exit__: exception objects/tracebacks are not
+        # wire-encodable, but the remote manager MUST be able to tell an
+        # exceptional exit from a clean one (commit vs rollback) — the
+        # class name and message cross as strings, the traceback as None
+        def dunder(self, exc_type, exc, tb):
+            client = object.__getattribute__(self, "_client")
+            enc = client.encode_value
+            return client.op(
+                "method", target=self._ref(), name="__exit__",
+                args=[
+                    enc(exc_type.__name__ if exc_type else None),
+                    enc(str(exc) if exc is not None else None),
+                    enc(None),
+                ],
+                kwargs={},
+            )
+
+        return dunder
+    if name == "__call__":
+        def dunder(self, *args, **kwargs):
+            client = object.__getattribute__(self, "_client")
+            return client.op(
+                "call", target=self._ref(),
+                args=[client.encode_value(a) for a in args],
+                kwargs={k: client.encode_value(v)
+                        for k, v in kwargs.items()},
+            )
+
+        return dunder
+    if name in ("__eq__", "__ne__", "__lt__", "__le__", "__gt__", "__ge__"):
+        def dunder(self, other):
+            client = object.__getattribute__(self, "_client")
+            try:
+                arg = client.encode_value(other)
+            except NotEncodable:
+                return NotImplemented
+            return client.op("method", target=self._ref(), name=name,
+                             args=[arg], kwargs={})
+
+        dunder.__name__ = name
+        return dunder
+    return _method_forward(name)
+
+
+class StubFactory(object):
+    """Builds + caches stub classes; maintains the handle→stub identity
+    map for one client."""
+
+    def __init__(self, client):
+        self.client = client
+        self._classes = {}  # remote class path -> stub type
+        self._instances = weakref.WeakValueDictionary()  # handle -> stub
+
+    def stub_for(self, ref_payload):
+        handle = ref_payload["handle"]
+        self.client.keep_handle(handle)
+        existing = self._instances.get(handle)
+        if existing is not None:
+            return existing
+        cls_path = ref_payload["cls"]
+        stub_cls = self._classes.get(cls_path)
+        if stub_cls is None:
+            info = self.client.op("describe",
+                                  target={"t": "ref", "handle": handle})
+            stub_cls = self._build_class(info)
+            self._classes[cls_path] = stub_cls
+        stub = stub_cls(self.client, handle, cls_path)
+        self._instances[handle] = stub
+        return stub
+
+    def _build_class(self, info):
+        ns = {"__doc__": info["doc"] or None}
+        names = (info["cls"], info["name"])
+        for meth in info["methods"]:
+            fn = None
+            for cls_name in names:
+                fn = self.client.config.local.get((cls_name, meth))
+                if fn is not None:
+                    break
+            ns[meth] = _local_wrap(fn) if fn is not None \
+                else _method_forward(meth)
+        for dunder in info["dunders"]:
+            ns[dunder] = _make_dunder(dunder)
+        return type("Stub_%s" % info["name"], (BaseStub,), ns)
+
+
+class ModuleProxy(object):
+    """`load_module('lib')` result: attribute chains resolve remotely."""
+
+    def __init__(self, client, name):
+        object.__setattr__(self, "_client", client)
+        object.__setattr__(self, "_name", name)
+
+    def __getattr__(self, name):
+        client = object.__getattribute__(self, "_client")
+        return client.op(
+            "getattr",
+            target={"t": "module",
+                    "name": object.__getattribute__(self, "_name")},
+            name=name,
+        )
+
+    def __repr__(self):
+        return "<escape module %r>" % object.__getattribute__(self, "_name")

@@ -1,0 +1,145 @@
+"""Typed wire encoding for the env-escape bridge — NO pickle on the wire.
+
+Reference behavior: metaflow/plugins/env_escape/data_transferer.py:382
+(explicit whitelist of encodable types; object references for the rest).
+Arbitrary pickle over a socket executes whatever the peer sends; this
+encoder only materializes a fixed set of plain types, so a compromised
+or version-skewed peer can at worst hand back wrong DATA, never code.
+
+Values outside the whitelist never cross the wire: the server keeps them
+and sends an object reference (handle + class info); the client wraps
+refs in stubs (stub.py). Per-library configs may register custom
+dumpers/loaders for extra value types (overrides.py).
+"""
+
+import base64
+import datetime
+
+# value kinds are explicit tags; adding one is a protocol change
+_SIMPLE = {
+    type(None): "none",
+    bool: "bool",
+    int: "int",
+    float: "float",
+    str: "str",
+}
+
+_CONTAINERS = {
+    list: "list",
+    tuple: "tuple",
+    set: "set",
+    frozenset: "frozenset",
+}
+
+
+class NotEncodable(TypeError):
+    """Value outside the wire whitelist (caller should send a ref)."""
+
+
+def encode(value, make_ref=None, dumpers=None):
+    """Encode `value` into a JSON-able tree. Unknown types go through
+    `make_ref(value) -> dict` when given (server side), else raise
+    NotEncodable (client side: only plain values and stubs may be sent)."""
+    t = type(value)
+    tag = _SIMPLE.get(t)
+    if tag is not None:
+        return {"t": tag, "v": value}
+    if t is complex:
+        return {"t": "complex", "v": [value.real, value.imag]}
+    if t in (bytes, bytearray):
+        return {
+            "t": "bytes" if t is bytes else "bytearray",
+            "v": base64.b64encode(bytes(value)).decode("ascii"),
+        }
+    tag = _CONTAINERS.get(t)
+    if tag is not None:
+        return {"t": tag,
+                "v": [encode(x, make_ref, dumpers) for x in value]}
+    if t is dict:
+        return {
+            "t": "dict",
+            "v": [
+                [encode(k, make_ref, dumpers), encode(v, make_ref, dumpers)]
+                for k, v in value.items()
+            ],
+        }
+    if t is datetime.datetime:
+        return {"t": "datetime", "v": value.isoformat()}
+    if t is datetime.timedelta:
+        return {"t": "timedelta",
+                "v": [value.days, value.seconds, value.microseconds]}
+    if dumpers:
+        # dumpers are keyed by "module.Class" strings so configurations
+        # never have to import the escaped library themselves
+        path = "%s.%s" % (t.__module__, t.__name__)
+        entry = dumpers.get(path)
+        if entry is not None:
+            name, dump = entry
+            return {"t": "custom", "name": name,
+                    "v": encode(dump(value), make_ref, dumpers)}
+    if make_ref is not None:
+        return make_ref(value)
+    raise NotEncodable(
+        "%r is not wire-encodable; pass plain values or escape stubs"
+        % (t.__name__,)
+    )
+
+
+def decode(payload, resolve_ref=None, loaders=None):
+    """Inverse of encode. `resolve_ref(payload) -> object` materializes
+    'ref'/'stub' payloads (server resolves handles; client makes stubs)."""
+    tag = payload["t"]
+    if tag in ("none", "bool", "int", "float", "str"):
+        return payload["v"]
+    if tag == "complex":
+        return complex(*payload["v"])
+    if tag == "bytes":
+        return base64.b64decode(payload["v"])
+    if tag == "bytearray":
+        return bytearray(base64.b64decode(payload["v"]))
+    if tag in ("list", "tuple", "set", "frozenset"):
+        items = [decode(x, resolve_ref, loaders) for x in payload["v"]]
+        return {"list": list, "tuple": tuple, "set": set,
+                "frozenset": frozenset}[tag](items)
+    if tag == "dict":
+        return {
+            decode(k, resolve_ref, loaders): decode(v, resolve_ref, loaders)
+            for k, v in payload["v"]
+        }
+    if tag == "datetime":
+        return datetime.datetime.fromisoformat(payload["v"])
+    if tag == "timedelta":
+        d, s, us = payload["v"]
+        return datetime.timedelta(days=d, seconds=s, microseconds=us)
+    if tag == "custom":
+        if not loaders or payload["name"] not in loaders:
+            raise NotEncodable(
+                "No loader registered for custom value %r — add a value "
+                "transfer to this library's escape configuration"
+                % payload["name"]
+            )
+        return loaders[payload["name"]](
+            decode(payload["v"], resolve_ref, loaders)
+        )
+    if tag in ("ref", "module"):
+        if resolve_ref is None:
+            raise NotEncodable("Unexpected reference payload")
+        return resolve_ref(payload)
+    raise NotEncodable("Unknown wire tag %r" % tag)
+
+
+def encode_exception(ex):
+    """Exceptions cross as (class path, safe args, traceback text)."""
+    import traceback
+
+    try:
+        args = encode(list(ex.args))
+    except NotEncodable:
+        args = encode([str(a) for a in ex.args])
+    cls = type(ex)
+    return {
+        "cls": "%s.%s" % (cls.__module__, cls.__name__),
+        "args": args,
+        "tb": "".join(traceback.format_exception(type(ex), ex,
+                                                 ex.__traceback__)),
+    }

@@ -1,0 +1,34 @@
+"""Length-prefixed JSON frames — the only thing that touches the socket.
+
+json.loads on untrusted bytes can produce wrong data but never executes
+code, unlike the pickle framing this replaced (round-2 verdict weak #5).
+"""
+
+import json
+import struct
+
+SOCKET_ENV = "TPUFLOW_ESCAPE_SOCKET"
+
+
+def send_msg(sock, obj):
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def recv_msg(sock):
+    header = b""
+    while len(header) < 8:
+        chunk = sock.recv(8 - len(header))
+        if not chunk:
+            raise ConnectionError("escape peer closed")
+        header += chunk
+    (length,) = struct.unpack("<Q", header)
+    if length > (1 << 31):
+        raise ConnectionError("oversized escape frame (%d bytes)" % length)
+    data = b""
+    while len(data) < length:
+        chunk = sock.recv(min(1 << 20, length - len(data)))
+        if not chunk:
+            raise ConnectionError("escape peer closed mid-frame")
+        data += chunk
+    return json.loads(data)

@@ -1,0 +1,36 @@
+"""Escape configuration for escape_test_lib (the reference's
+emulate_test_lib pattern), registered via register_config in tests."""
+
+from metaflow_tpu.plugins.env_escape import (
+    local_override,
+    remote_override,
+    value_transfer,
+)
+
+EXPORTED_EXCEPTIONS = ["escape_test_lib.SomeError"]
+
+
+@local_override({"Counter": ["expensive_roundtrip"]})
+def expensive_roundtrip(stub):
+    # runs CLIENT-side: no RPC at all
+    return "client-side"
+
+
+@remote_override({"Counter": ["increment"]})
+def increment(obj, by=1):
+    # wraps SERVER-side: doubles every increment
+    obj.value += 2 * by
+    return obj.value
+
+
+class LocalVector(object):
+    """Client-side substitute for escape_test_lib.Vector."""
+
+    def __init__(self, x, y):
+        self.x = x
+        self.y = y
+
+
+@value_transfer("escape_test_lib.Vector", dump=lambda v: [v.x, v.y])
+def load_vector(payload):
+    return LocalVector(*payload)

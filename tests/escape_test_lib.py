@@ -1,0 +1,62 @@
+"""Server-side-only library for env_escape tests (the reference's
+test_lib_impl pattern): classes, typed exceptions, iteration, context
+managers, and a custom value type."""
+
+
+class SomeError(Exception):
+    pass
+
+
+class Vector(object):
+    def __init__(self, x, y):
+        self.x = x
+        self.y = y
+
+
+class Counter(object):
+    """Stateful object exercising methods, dunders and properties."""
+
+    def __init__(self, start=0):
+        self.value = start
+        self.entered = False
+
+    def increment(self, by=1):
+        self.value += by
+        return self.value
+
+    def fail(self):
+        raise SomeError("counter exploded", self.value)
+
+    def expensive_roundtrip(self):
+        return "server-side"
+
+    def make_vector(self):
+        return Vector(self.value, -self.value)
+
+    def __len__(self):
+        return self.value
+
+    def __iter__(self):
+        return iter(range(self.value))
+
+    def __eq__(self, other):
+        return isinstance(other, Counter) and other.value == self.value
+
+    def __enter__(self):
+        self.entered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.entered = False
+        return False
+
+
+_singleton = Counter(7)
+
+
+def get_singleton():
+    return _singleton
+
+
+def raise_typed():
+    raise SomeError("typed boom")

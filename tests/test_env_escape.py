@@ -69,3 +69,120 @@ def test_cross_process(server):
                           capture_output=True, text=True, env=env)
     assert proc.returncode == 0, proc.stderr
     assert proc.stdout.strip() == "120"
+
+
+@pytest.fixture()
+def lib_server():
+    """Server escaping the test library, with its configuration
+    registered on both ends (reference: emulate_test_lib)."""
+    import escape_test_config
+    from metaflow_tpu.plugins.env_escape import register_config
+
+    register_config("escape_test_lib", escape_test_config)
+    srv = EscapeServer(modules=["escape_test_lib"]).start()
+    yield srv
+    srv.stop()
+
+
+class TestStubs:
+    def test_dunders_forwarded(self, lib_server):
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        counter = lib.Counter(3)
+        assert len(counter) == 3          # __len__
+        assert sorted(counter) == [0, 1, 2]  # __iter__ + remote StopIteration
+        with counter as c:                # __enter__/__exit__
+            assert c.entered is True
+        assert counter.entered is False
+        client.close()
+
+    def test_identity_preserved(self, lib_server):
+        """The same remote object materializes as the SAME stub
+        (reference: stub identity via the client-side object map)."""
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        a = lib.get_singleton()
+        b = lib.get_singleton()
+        assert a is b
+        assert a == b                     # __eq__ forwarded too
+        client.close()
+
+    def test_typed_exception_reraised(self, lib_server):
+        """Config-exported exceptions raise the REAL class client-side
+        (importable here since tests/ is on sys.path)."""
+        import escape_test_lib
+
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        with pytest.raises(escape_test_lib.SomeError) as exc:
+            lib.raise_typed()
+        assert "typed boom" in str(exc.value)
+        # instance methods too, with args preserved
+        counter = lib.Counter(5)
+        with pytest.raises(escape_test_lib.SomeError) as exc:
+            counter.fail()
+        assert exc.value.args == ("counter exploded", 5)
+        client.close()
+
+    def test_module_exception_class_catchable(self, lib_server):
+        """`except lib.SomeError` works through the module proxy — the
+        exception class resolves to the same local class that remote
+        raises map to."""
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        try:
+            lib.raise_typed()
+        except lib.SomeError as ex:
+            assert "typed boom" in str(ex)
+        else:
+            raise AssertionError("nothing raised")
+        client.close()
+
+    def test_local_override_skips_rpc(self, lib_server):
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        counter = lib.Counter(0)
+        assert counter.expensive_roundtrip() == "client-side"
+        client.close()
+
+    def test_remote_override_wraps_server_side(self, lib_server):
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        counter = lib.Counter(0)
+        assert counter.increment() == 2   # doubled by the override
+        assert counter.increment(by=3) == 8
+        client.close()
+
+    def test_custom_value_transfer(self, lib_server):
+        import escape_test_config
+
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        vec = lib.Counter(4).make_vector()
+        assert isinstance(vec, escape_test_config.LocalVector)
+        assert (vec.x, vec.y) == (4, -4)
+        client.close()
+
+    def test_no_pickle_on_the_wire(self, lib_server):
+        """The wire is JSON frames; a value outside the whitelist must be
+        refused client-side with a clear error, never pickled."""
+        from metaflow_tpu.plugins.env_escape.transfer import NotEncodable
+
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        counter = lib.Counter(1)
+        with pytest.raises(NotEncodable):
+            counter.increment(by=object())
+        # stubs themselves DO cross (as refs): remote __eq__ sees the
+        # real remote object
+        assert counter == counter
+        client.close()
+
+    def test_setattr_roundtrip(self, lib_server):
+        client = EscapeClient(lib_server.socket_path)
+        lib = client.load_module("escape_test_lib")
+        counter = lib.Counter(1)
+        counter.value = 41
+        assert counter.increment() == 43  # remote override adds 2
+        assert counter.value == 43
+        client.close()
