@@ -1,0 +1,71 @@
+"""Per-step execution environment: which interpreter runs a step and what
+must be bootstrapped first on a REMOTE host.
+
+Reference behavior: metaflow/metaflow_environment.py:21 — the environment
+abstraction decides `executable()` and `bootstrap_commands()` per step,
+so @conda/@pypi steps run under THEIR env's interpreter on schedulers
+too, not just locally (locally the decorators rewrite the entrypoint via
+runtime_step_cli; remotely the compiled command must do the equivalent).
+
+The Argo compiler asks this class for each step's bootstrap lines and
+interpreter; steps without an environment decorator get the image python
+and only the code-package bootstrap.
+"""
+
+import base64
+import json
+
+# shell variable the in-pod bootstrap assigns the env interpreter to
+ENV_PYTHON_VAR = "MF_ENV_PYTHON"
+
+_ENV_DECOS = ("pypi", "conda", "uv")
+
+
+class MetaflowEnvironment(object):
+    TYPE = "default"
+
+    def __init__(self, flow):
+        self.flow = flow
+
+    def _env_decorator(self, step_name):
+        step_func = getattr(self.flow, step_name)
+        for deco in getattr(step_func, "decorators", []):
+            if deco.name in _ENV_DECOS and not deco.attributes.get(
+                    "disabled"):
+                return deco
+        return None
+
+    def env_spec(self, step_name):
+        """JSON-able spec of the step's environment (None = plain) — the
+        decorator's own spec, so local and remote build identical envs."""
+        deco = self._env_decorator(step_name)
+        return None if deco is None else deco.env_spec()
+
+    def executable(self, step_name):
+        """The argv[0] for this step's command on a remote host."""
+        if self._env_decorator(step_name) is None:
+            return "python"
+        return '"$%s"' % ENV_PYTHON_VAR
+
+    def bootstrap_commands(self, step_name, package_url=None):
+        """Shell lines that must run before the step command on a remote
+        host: code-package download/unpack, then (for env steps) the
+        in-pod environment build, exporting the env interpreter."""
+        from .package import MetaflowPackage
+
+        cmds = []
+        if package_url:
+            cmds += MetaflowPackage.bootstrap_commands(package_url)
+        spec = self.env_spec(step_name)
+        if spec is not None:
+            blob = base64.b64encode(
+                json.dumps(spec, sort_keys=True).encode("utf-8")
+            ).decode("ascii")
+            cmds.append(
+                "%s=$(python -m metaflow_tpu.plugins.pypi.bootstrap %s)"
+                % (ENV_PYTHON_VAR, blob)
+            )
+        return cmds
+
+    def environment_info(self):
+        return {"environment": self.TYPE}

@@ -182,12 +182,14 @@ class ArgoWorkflows(object):
         gang=True builds the per-rank command of an Indexed Job pod: every
         pod is identical, so role (control vs worker), task id and split
         index derive from JOB_COMPLETION_INDEX in shell."""
-        from ...package import MetaflowPackage
+        from ...environment import MetaflowEnvironment
         from ...unbounded_foreach import UBF_CONTROL, UBF_TASK
 
-        cmds = []
-        if self.package_url:
-            cmds += MetaflowPackage.bootstrap_commands(self.package_url)
+        environment = MetaflowEnvironment(self.flow)
+        # code-package bootstrap + (for @pypi/@conda/@uv steps) the in-pod
+        # environment build exporting $MF_ENV_PYTHON — the step must run
+        # under ITS interpreter on the cluster, exactly as it does locally
+        cmds = environment.bootstrap_commands(node.name, self.package_url)
 
         task_id = "{{inputs.parameters.task-id}}"
         if gang:
@@ -258,7 +260,8 @@ class ArgoWorkflows(object):
         if node.type in ("foreach", "split-switch", "split-parallel"):
             step_opts.append("--argo-output-dir %s" % ARGO_OUTPUT_DIR)
 
-        step_cmd = "python %s %s step %s %s" % (
+        step_cmd = "%s %s %s step %s %s" % (
+            environment.executable(node.name),
             self.flow.script_name,
             self._top_level_flags(),
             node.name,

@@ -1,0 +1,58 @@
+"""In-pod environment bootstrap: build (or reuse) a step's environment
+and print its interpreter path.
+
+    python -m metaflow_tpu.plugins.pypi.bootstrap <base64 json spec>
+
+The compiled Argo command captures stdout into $MF_ENV_PYTHON and runs
+the step under it (environment.py). Build progress goes to stderr so the
+captured output is ONLY the interpreter path. Reference analogue: the
+bootstrap half of metaflow_environment.get_package_commands:192.
+"""
+
+import base64
+import functools
+import json
+import sys
+
+
+def environment_for_spec(spec):
+    """The environment object for a spec dict — the same selection logic
+    the step decorators use locally (micromamba-backed @conda when the
+    binary exists, venv/pip otherwise)."""
+    from .pypi_environment import PyPIEnvironment
+
+    kind = spec.get("kind", "pypi")
+    packages = dict(spec.get("libraries") or {})
+    packages.update(spec.get("packages") or {})
+    python = spec.get("python")
+    if kind == "conda":
+        from .micromamba import Micromamba
+
+        if Micromamba.available():
+            from .conda_environment import CondaEnvironment
+
+            return CondaEnvironment(
+                packages, python=python,
+                channels=tuple(spec.get("channels") or ()),
+            )
+        return PyPIEnvironment(packages, python=python)
+    if kind == "uv":
+        return PyPIEnvironment(packages, python=python, installer="uv")
+    return PyPIEnvironment(packages, python=python)
+
+
+def main(argv):
+    if len(argv) != 1:
+        print("usage: python -m metaflow_tpu.plugins.pypi.bootstrap "
+              "<base64 json spec>", file=sys.stderr)
+        return 2
+    spec = json.loads(base64.b64decode(argv[0]))
+    env = environment_for_spec(spec)
+    echo = functools.partial(print, file=sys.stderr)
+    interpreter = env.ensure(echo=echo)
+    print(interpreter)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

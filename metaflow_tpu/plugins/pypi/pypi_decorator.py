@@ -9,7 +9,6 @@ backend (a micromamba backend can slot into PyPIEnvironment later); the
 """
 
 from ...decorators import StepDecorator
-from .pypi_environment import PyPIEnvironment
 
 
 class PyPIStepDecorator(StepDecorator):
@@ -18,11 +17,23 @@ class PyPIStepDecorator(StepDecorator):
     name = "pypi"
     defaults = {"packages": {}, "python": None, "disabled": False}
 
+    def env_spec(self):
+        """JSON-able environment spec — the SINGLE source both local
+        execution and the remote in-pod bootstrap construct envs from
+        (spec drift would make the pod compute a different env id than
+        the lock shipped in the code package)."""
+        return {
+            "kind": self.name,
+            "packages": dict(self.attributes.get("packages") or {}),
+            "libraries": dict(self.attributes.get("libraries") or {}),
+            "python": self.attributes.get("python"),
+            "channels": list(self.attributes.get("channels") or ()),
+        }
+
     def _env(self):
-        return PyPIEnvironment(
-            self.attributes.get("packages") or {},
-            python=self.attributes.get("python"),
-        )
+        from .bootstrap import environment_for_spec
+
+        return environment_for_spec(self.env_spec())
 
     def runtime_init(self, flow, graph, package, run_id):
         if self.attributes.get("disabled"):
@@ -51,26 +62,6 @@ class CondaStepDecorator(PyPIStepDecorator):
     defaults = {"packages": {}, "libraries": {}, "python": None,
                 "channels": (), "disabled": False}
 
-    def _merged_packages(self):
-        packages = dict(self.attributes.get("libraries") or {})
-        packages.update(self.attributes.get("packages") or {})
-        return packages
-
-    def _env(self):
-        from .micromamba import Micromamba
-
-        if Micromamba.available():
-            from .conda_environment import CondaEnvironment
-
-            return CondaEnvironment(
-                self._merged_packages(),
-                python=self.attributes.get("python"),
-                channels=self.attributes.get("channels") or (),
-            )
-        return PyPIEnvironment(
-            self._merged_packages(), python=self.attributes.get("python")
-        )
-
     def add_to_package(self):
         # ship the solved lock in the code package: remote hosts create the
         # env from exact URLs without solving (offline-safe with a pkgs cache)
@@ -84,13 +75,7 @@ class CondaStepDecorator(PyPIStepDecorator):
 
 class UVStepDecorator(PyPIStepDecorator):
     """@uv(packages={...}) — uv-backed installs when the uv binary exists
-    (reference: plugins/uv/); falls back to pip transparently."""
+    (reference: plugins/uv/); falls back to pip transparently.
+    environment_for_spec routes kind='uv' to the uv installer."""
 
     name = "uv"
-
-    def _env(self):
-        return PyPIEnvironment(
-            self.attributes.get("packages") or {},
-            python=self.attributes.get("python"),
-            installer="uv",
-        )

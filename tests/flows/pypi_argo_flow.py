@@ -1,0 +1,29 @@
+"""@pypi step for the Argo e2e: the pod must run the step under the
+environment's interpreter, not the image python."""
+
+from metaflow_tpu import FlowSpec, pypi, step
+
+
+class PypiArgoFlow(FlowSpec):
+    @step
+    def start(self):
+        import sys
+
+        self.plain_python = sys.executable
+        self.next(self.isolated)
+
+    @pypi(packages={})
+    @step
+    def isolated(self):
+        import sys
+
+        self.env_python = sys.executable
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    PypiArgoFlow()

@@ -238,6 +238,20 @@ class TestArgoE2E:
         assert task["event_name"].data == "data_ready"
         assert task["path"].data == "gs://bucket/day=9"
 
+    def test_pypi_step_runs_under_env_interpreter(self, tpuflow_root,
+                                                  tmp_path, client):
+        """A @pypi step's pod bootstraps the environment and runs the
+        step under ITS interpreter (MetaflowEnvironment.executable), not
+        the image python — previously the env was silently ignored on
+        Argo."""
+        _simulate("pypi_argo_flow.py", tpuflow_root, tmp_path, "wf-pypi")
+        run = client("PypiArgoFlow")["argo-wf-pypi"]
+        assert run.successful
+        plain = run["start"].task["plain_python"].data
+        env_python = run["isolated"].task["env_python"].data
+        assert env_python != plain
+        assert os.sep + "envs" + os.sep in env_python
+
     def test_nested_foreach(self, tpuflow_root, tmp_path, client):
         """Nested fan-outs compile to recursive sub-DAG templates
         (VERDICT round-2 item #5): every (outer, inner) leaf runs as its
