@@ -190,9 +190,21 @@ def bench_step_launch():
                 if time.time() > deadline:
                     raise SystemExit("daemon never came up")
                 time.sleep(0.1)
-            cmd = [sys.executable, "-m", "metaflow_tpu.daemon", "run",
-                   flow, "run"]
+            # prefer the NATIVE thin client (no client interpreter boot);
+            # BENCH_NATIVE=0 forces the pure-Python client
+            native = None
+            if os.environ.get("BENCH_NATIVE", "1") == "1":
+                from metaflow_tpu.native import build_launch_client
+
+                native = build_launch_client(
+                    out=os.path.join(root, "tpuflow-launch"))
+            if native:
+                cmd = [native, flow, "run"]
+            else:
+                cmd = [sys.executable, "-m", "metaflow_tpu.daemon", "run",
+                       flow, "run"]
         else:
+            native = None
             cmd = [sys.executable, flow, "run"]
         for _ in range(5):
             t0 = time.perf_counter()
@@ -200,8 +212,11 @@ def bench_step_launch():
             # 3 tasks per run → per-task latency
             latencies.append((time.perf_counter() - t0) / 3)
     p50 = statistics.median(latencies)
+    suffix = ""
+    if use_daemon:
+        suffix = "_daemon_native" if native else "_daemon"
     return {
-        "metric": "step_launch_p50%s" % ("_daemon" if use_daemon else ""),
+        "metric": "step_launch_p50%s" % suffix,
         "value": round(p50 * 1000, 1),
         "unit": "ms",
         "vs_baseline": 1.0,

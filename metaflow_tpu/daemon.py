@@ -182,8 +182,21 @@ class SchedulerDaemon(object):
             # header read (forks stay on this thread by design)
             conn.settimeout(10)
             msg, fds, _flags, _addr = socket.recv_fds(conn, 1 << 20, 3)
+            # ONE recvmsg returns at most the socket buffer (~208 KiB
+            # default): a big client env can straddle reads, so keep
+            # recv'ing until the JSON parses or the 1 MiB cap trips
+            while True:
+                try:
+                    req = json.loads(msg.decode("utf-8"))
+                    break
+                except ValueError:
+                    if len(msg) > (1 << 20):
+                        raise
+                    more = conn.recv(1 << 20)
+                    if not more:
+                        raise
+                    msg += more
             conn.settimeout(None)
-            req = json.loads(msg.decode("utf-8"))
         except (OSError, ValueError):
             for fd in fds:  # received via SCM_RIGHTS before the failure
                 os.close(fd)

@@ -1,0 +1,51 @@
+"""Native runtime pieces (C). Currently: tpuflow-launch, the thin
+warm-launch client for the scheduler daemon — removes the launcher's own
+Python interpreter boot (~100ms) from the warm path, leaving socket
+round-trips + the daemon's fork as the whole cost.
+
+    python -m metaflow_tpu.native build     # cc -O2 -> <root>/bin/
+    tpuflow-launch flow.py run [...]
+
+The binary is built on demand (cc/gcc from the host toolchain); every
+behavior it implements is also available through the pure-Python client
+(`python -m metaflow_tpu.daemon run`), so nothing REQUIRES a compiler.
+"""
+
+import os
+import subprocess
+
+
+def _source_path():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "launch_client.c")
+
+
+def default_binary_path():
+    from ..util import get_tpuflow_root
+
+    return os.path.join(get_tpuflow_root(), "bin", "tpuflow-launch")
+
+
+def build_launch_client(out=None, echo=lambda *_: None):
+    """Compile the launch client; returns the binary path or None when no
+    C compiler is available."""
+    out = out or default_binary_path()
+    src = _source_path()
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            proc = subprocess.run(
+                [cc, "-O2", "-o", out, src],
+                capture_output=True, text=True, timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            echo("built %s with %s" % (out, cc))
+            return out
+        echo("%s failed:\n%s" % (cc, proc.stderr))
+    return None
+

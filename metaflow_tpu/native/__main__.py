@@ -1,0 +1,24 @@
+import sys
+
+from . import build_launch_client, default_binary_path
+
+
+def main(argv):
+    if argv[:1] in ([], ["build"]):
+        out = build_launch_client(echo=print)
+        if out is None:
+            print("no working C compiler found (tried cc/gcc/clang); the "
+                  "pure-Python client `python -m metaflow_tpu.daemon run` "
+                  "does the same job")
+            return 1
+        print(out)
+        return 0
+    if argv[:1] == ["path"]:
+        print(default_binary_path())
+        return 0
+    print("usage: python -m metaflow_tpu.native [build|path]")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,312 @@
+/* tpuflow-launch: native thin client for the scheduler daemon.
+ *
+ * The warm-launch path's residual latency is the *client's* Python
+ * interpreter boot (~100ms) — this C client removes it. Protocol
+ * (daemon.py): connect to the unix socket, send ONE JSON request
+ * carrying proto/token/argv/cwd/env with stdin/stdout/stderr passed via
+ * SCM_RIGHTS, then read two newline-terminated JSON replies:
+ * {"pid": N} and {"exit": N}. Signals forward to the child pid.
+ *
+ * Token: obtained from the daemon itself via a ping round-trip. The
+ * Python thin client hashes its own checkout to detect version skew
+ * between ITS imported modules and the daemon's; this client executes no
+ * framework code (the flow file is re-imported fresh in the daemon's
+ * fork), so echoing the daemon's token is sound — the only skew that
+ * matters is daemon-vs-disk, which a daemon restart fixes either way.
+ *
+ * Build: cc -O2 -o tpuflow-launch launch_client.c
+ * Usage: tpuflow-launch flow.py run [args...]
+ * Fallback: if no daemon is listening, exec python with the same argv
+ * (cold launch), matching `python -m metaflow_tpu.daemon run`.
+ */
+
+#define _GNU_SOURCE
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+extern char **environ;
+
+static pid_t child_pid = -1;
+
+static void forward_signal(int sig) {
+    if (child_pid > 0)
+        kill(child_pid, sig);
+}
+
+/* ---- tiny JSON writer (strings + arrays + objects we need) ---- */
+
+typedef struct {
+    char *buf;
+    size_t len, cap;
+} sbuf;
+
+static void sb_grow(sbuf *b, size_t need) {
+    if (b->len + need + 1 > b->cap) {
+        while (b->len + need + 1 > b->cap)
+            b->cap = b->cap ? b->cap * 2 : 4096;
+        b->buf = realloc(b->buf, b->cap);
+        if (!b->buf) { perror("realloc"); exit(70); }
+    }
+}
+
+static void sb_putc(sbuf *b, char c) {
+    sb_grow(b, 1);
+    b->buf[b->len++] = c;
+    b->buf[b->len] = 0;
+}
+
+static void sb_puts(sbuf *b, const char *s) {
+    size_t n = strlen(s);
+    sb_grow(b, n);
+    memcpy(b->buf + b->len, s, n);
+    b->len += n;
+    b->buf[b->len] = 0;
+}
+
+static void sb_json_str(sbuf *b, const char *s) {
+    sb_putc(b, '"');
+    for (; *s; s++) {
+        unsigned char c = (unsigned char)*s;
+        switch (c) {
+        case '"': sb_puts(b, "\\\""); break;
+        case '\\': sb_puts(b, "\\\\"); break;
+        case '\n': sb_puts(b, "\\n"); break;
+        case '\r': sb_puts(b, "\\r"); break;
+        case '\t': sb_puts(b, "\\t"); break;
+        default:
+            if (c < 0x20) {
+                char esc[8];
+                snprintf(esc, sizeof esc, "\\u%04x", c);
+                sb_puts(b, esc);
+            } else {
+                sb_putc(b, (char)c);
+            }
+        }
+    }
+    sb_putc(b, '"');
+}
+
+/* ---- minimal JSON field scanners for the daemon's replies ---- */
+
+static int json_find_int(const char *line, const char *key, long *out) {
+    char pat[64];
+    snprintf(pat, sizeof pat, "\"%s\"", key);
+    const char *p = strstr(line, pat);
+    if (!p) return 0;
+    p = strchr(p + strlen(pat), ':');
+    if (!p) return 0;
+    *out = strtol(p + 1, NULL, 10);
+    return 1;
+}
+
+static int json_find_str(const char *line, const char *key, char *out,
+                         size_t cap) {
+    char pat[64];
+    snprintf(pat, sizeof pat, "\"%s\"", key);
+    const char *p = strstr(line, pat);
+    if (!p) return 0;
+    p = strchr(p + strlen(pat), ':');
+    if (!p) return 0;
+    while (*p && *p != '"') p++;
+    if (*p != '"') return 0;
+    p++;
+    size_t i = 0;
+    /* daemon token/err strings never contain escapes */
+    while (*p && *p != '"' && i + 1 < cap) out[i++] = *p++;
+    out[i] = 0;
+    return 1;
+}
+
+static const char *socket_path(void) {
+    const char *p = getenv("TPUFLOW_DAEMON_SOCKET");
+    static char buf[108];
+    if (p && *p) return p;
+    /* the daemon defaults to tempfile.gettempdir(), which honors TMPDIR */
+    const char *tmp = getenv("TMPDIR");
+    if (!tmp || !*tmp) tmp = "/tmp";
+    snprintf(buf, sizeof buf, "%s/tpuflow-daemon-%d.sock", tmp,
+             (int)getuid());
+    return buf;
+}
+
+static int connect_daemon(void) {
+    struct sockaddr_un addr;
+    const char *path = socket_path();
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    memset(&addr, 0, sizeof addr);
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, path, sizeof addr.sun_path - 1);
+    if (connect(fd, (struct sockaddr *)&addr, sizeof addr) != 0) {
+        close(fd);
+        return -1;
+    }
+    return fd;
+}
+
+static ssize_t read_line(int fd, char *buf, size_t cap) {
+    size_t i = 0;
+    while (i + 1 < cap) {
+        char c;
+        ssize_t n = read(fd, &c, 1);
+        if (n <= 0) return -1;
+        if (c == '\n') break;
+        buf[i++] = c;
+    }
+    buf[i] = 0;
+    return (ssize_t)i;
+}
+
+static int cold_exec(int argc, char **argv) {
+    /* no daemon: run the flow cold, exactly like the python fallback */
+    char **nargv = calloc((size_t)argc + 2, sizeof(char *));
+    if (!nargv) { perror("calloc"); return 70; }
+    const char *py = getenv("TPUFLOW_PYTHON");
+    if (py && *py) {
+        nargv[0] = (char *)py;
+        for (int i = 0; i < argc; i++) nargv[i + 1] = argv[i];
+        execvp(py, nargv);
+    } else {
+        /* python3 first: plain `python` is absent on stock distros */
+        nargv[0] = "python3";
+        for (int i = 0; i < argc; i++) nargv[i + 1] = argv[i];
+        execvp("python3", nargv);
+        nargv[0] = "python";
+        execvp("python", nargv);
+    }
+    perror("execvp python");
+    return 127;
+}
+
+int main(int argc, char **argv) {
+    /* a peer-closed socket must surface as a sendmsg error (so the cold
+     * fallback runs), not kill us with SIGPIPE */
+    signal(SIGPIPE, SIG_IGN);
+    if (argc < 2) {
+        fprintf(stderr, "usage: tpuflow-launch flow.py run [args...]\n");
+        return 2;
+    }
+
+    /* 1. ping: learn the daemon's proto + token */
+    int fd = connect_daemon();
+    if (fd < 0)
+        return cold_exec(argc - 1, argv + 1);
+    {
+        const char *ping = "{\"op\": \"ping\"}";
+        struct iovec iov = {(void *)ping, strlen(ping)};
+        struct msghdr msg = {0};
+        msg.msg_iov = &iov;
+        msg.msg_iovlen = 1;
+        if (sendmsg(fd, &msg, 0) < 0) {
+            close(fd);
+            return cold_exec(argc - 1, argv + 1);
+        }
+    }
+    char line[4096];
+    long proto = 0;
+    char token[256] = "";
+    if (read_line(fd, line, sizeof line) < 0 ||
+        !json_find_int(line, "proto", &proto) ||
+        !json_find_str(line, "token", token, sizeof token)) {
+        close(fd);
+        return cold_exec(argc - 1, argv + 1);
+    }
+    close(fd);
+
+    /* 2. build the run request */
+    sbuf b = {0};
+    sb_puts(&b, "{\"proto\": ");
+    {
+        char num[32];
+        snprintf(num, sizeof num, "%ld", proto);
+        sb_puts(&b, num);
+    }
+    sb_puts(&b, ", \"token\": ");
+    sb_json_str(&b, token);
+    sb_puts(&b, ", \"argv\": [");
+    for (int i = 1; i < argc; i++) {
+        if (i > 1) sb_puts(&b, ", ");
+        sb_json_str(&b, argv[i]);
+    }
+    sb_puts(&b, "], \"cwd\": ");
+    {
+        char cwd[4096];
+        if (!getcwd(cwd, sizeof cwd)) strcpy(cwd, ".");
+        sb_json_str(&b, cwd);
+    }
+    sb_puts(&b, ", \"env\": {");
+    int first_env = 1;
+    for (char **e = environ; *e; e++) {
+        const char *eq = strchr(*e, '=');
+        if (!eq) continue;
+        if (!first_env) sb_puts(&b, ", ");
+        first_env = 0;
+        char *key = strndup(*e, (size_t)(eq - *e));
+        sb_json_str(&b, key);
+        free(key);
+        sb_puts(&b, ": ");
+        sb_json_str(&b, eq + 1);
+    }
+    sb_puts(&b, "}}");
+
+    if (b.len > (1 << 20) - 64) {
+        /* the daemon reads ONE recvmsg of at most 1 MiB */
+        fprintf(stderr, "tpuflow-launch: request too large (%zu bytes)\n",
+                b.len);
+        return cold_exec(argc - 1, argv + 1);
+    }
+
+    /* 3. send it with stdin/stdout/stderr via SCM_RIGHTS */
+    fd = connect_daemon();
+    if (fd < 0)
+        return cold_exec(argc - 1, argv + 1);
+    {
+        struct iovec iov = {b.buf, b.len};
+        union {
+            struct cmsghdr hdr;
+            char buf[CMSG_SPACE(3 * sizeof(int))];
+        } cmsg_buf;
+        memset(&cmsg_buf, 0, sizeof cmsg_buf);
+        struct msghdr msg = {0};
+        msg.msg_iov = &iov;
+        msg.msg_iovlen = 1;
+        msg.msg_control = cmsg_buf.buf;
+        msg.msg_controllen = CMSG_SPACE(3 * sizeof(int));
+        struct cmsghdr *cm = CMSG_FIRSTHDR(&msg);
+        cm->cmsg_level = SOL_SOCKET;
+        cm->cmsg_type = SCM_RIGHTS;
+        cm->cmsg_len = CMSG_LEN(3 * sizeof(int));
+        int fds[3] = {0, 1, 2};
+        memcpy(CMSG_DATA(cm), fds, sizeof fds);
+        if (sendmsg(fd, &msg, 0) < 0) {
+            close(fd);
+            return cold_exec(argc - 1, argv + 1);
+        }
+    }
+
+    /* 4. child pid, then forward signals until the exit report */
+    long pid = 0, code = 1;
+    if (read_line(fd, line, sizeof line) < 0 ||
+        !json_find_int(line, "pid", &pid)) {
+        char err[512];
+        if (json_find_str(line, "error", err, sizeof err))
+            fprintf(stderr, "tpuflow-launch: daemon refused: %s\n", err);
+        close(fd);
+        return cold_exec(argc - 1, argv + 1);
+    }
+    child_pid = (pid_t)pid;
+    signal(SIGINT, forward_signal);
+    signal(SIGTERM, forward_signal);
+    if (read_line(fd, line, sizeof line) < 0 ||
+        !json_find_int(line, "exit", &code))
+        code = 1;
+    close(fd);
+    return (int)code;
+}
