@@ -2,6 +2,7 @@ from .norms import rms_norm, layer_norm
 from .rope import rope_frequencies, apply_rope
 from .attention import attention, flash_attention, reference_attention
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses_attention import ulysses_attention, ulysses_attention_sharded
 from .moe import moe_ffn, top_k_router
 
 __all__ = [
@@ -14,6 +15,8 @@ __all__ = [
     "reference_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "moe_ffn",
     "top_k_router",
 ]

@@ -36,6 +36,19 @@ def _broadcast_gqa(k, num_q_heads):
     return jnp.repeat(k, reps, axis=-2)
 
 
+def shard_map_novma(fn, mesh, in_specs, out_specs):
+    """shard_map with check_vma=False — pallas_call inside shard_map
+    trips the vma checker's dynamic_slice rule; sharding correctness is
+    still enforced by the in/out specs. Shared by the sequence-parallel
+    attention variants (ring_attention.py, ulysses_attention.py)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
 def reference_attention(q, k, v, causal=True, scale=None):
     """XLA attention: [B, S, H, D] layout. Materializes S×S scores — fine for
     moderate sequence lengths; XLA fuses mask+softmax into the matmuls."""

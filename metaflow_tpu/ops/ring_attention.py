@@ -320,10 +320,7 @@ def ring_attention_sharded(mesh, axis_name="sequence", causal=True,
     TPUFLOW_RING_IMPL). 'flash' needs the per-device sequence shard to be
     a multiple of the pallas block size (BLOCK_Q, 128).
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .attention import shard_map_novma
 
     batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
     spec = P(batch_axes or None, axis_name, None, None)
@@ -340,16 +337,8 @@ def ring_attention_sharded(mesh, axis_name="sequence", causal=True,
             q, k, v, axis_name, causal=causal, scale=scale
         )
 
-    # check_vma=False: pallas_call inside shard_map trips the vma checker's
-    # dynamic_slice rule (the ValueError itself suggests this workaround);
-    # sharding correctness is still enforced by the in/out specs
-    return shard_map(
-        dispatch,
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )
+    return shard_map_novma(dispatch, mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sequence", causal=True,

@@ -1,0 +1,108 @@
+"""All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+The OTHER long-context strategy next to ring attention (SURVEY.md §5:
+"ring attention or all-to-all sequence/context parallelism"): instead of
+streaming K/V blocks around a ring (n-1 hops of [B, S/n, Hkv, D] each),
+all-to-alls re-shard the activations from sequence-sharded to
+HEAD-sharded — each device then runs ordinary full attention over the
+ENTIRE sequence for H/n of the heads, and a final all-to-all restores
+the sequence sharding (four all-to-alls total: q, k, v in, output out;
+k/v move at their GQA width, so their two are Hkv/H the size of q's).
+
+Trade-off vs ring (PAPERS.md: Ulysses vs ring/striped attention):
+  - comm is dense single-shot collectives XLA schedules without ring
+    attention's per-hop latency chain;
+  - attention itself is UNSHARDED per head group, so any inner kernel
+    (the pallas flash path included) runs at full sequence length —
+    no per-block causal bookkeeping;
+  - the head count must divide by the mesh axis (ring has no such
+    constraint) and activations momentarily hold [B, S, H/n, D] — at
+    extreme S, ring's O(S/n) residency wins; Ulysses wins while
+    S·H/n fits HBM.
+
+Parity with ring_attention's API: [B, S, H, D], S sharded over
+`axis_name`, batch over data axes when present, causal supported, GQA
+via minimal K/V head widening.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .attention import shard_map_novma
+
+
+def ulysses_attention_sharded(mesh, axis_name="sequence", causal=True,
+                              scale=None, impl="auto"):
+    """Build the sharded fn for [B, S, H, D] inputs with S split over
+    `axis_name` (batch over data/fsdp axes when the mesh has them).
+
+    impl: 'auto' | 'flash' | 'flash_interpret' | 'xla' — the inner
+    (full-sequence) attention; 'auto' picks flash when pallas is usable
+    and the shapes satisfy the 128-block constraint, else xla.
+    """
+    n = dict(mesh.shape).get(axis_name, 1)
+
+    def inner(q, k, v):
+        from .attention import attention
+
+        return attention(q, k, v, causal=causal, scale=scale, impl=impl)
+
+    if n == 1:
+        return inner
+
+    def local(q, k, v):
+        H = q.shape[2]
+        if H % n:
+            raise ValueError(
+                "Ulysses needs heads %% mesh axis == 0 (H=%d, %s=%d); "
+                "use ring_attention for indivisible head counts"
+                % (H, axis_name, n)
+            )
+
+        def seq_to_heads(x):
+            # [B, S/n, h, D] -> [B, S, h/n, D]
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        def heads_to_seq(x):
+            # [B, S, h/n, D] -> [B, S/n, h, D]
+            return jax.lax.all_to_all(
+                x, axis_name, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        qg = seq_to_heads(q)
+        # K/V cross at their GQA width: widen only as much as the
+        # all-to-all split and the inner broadcast require (full
+        # widening would inflate K/V comm + residency by H/Hkv)
+        kw = _widen_kv_minimal(k, H, n)
+        vw = _widen_kv_minimal(v, H, n)
+        out = inner(qg, seq_to_heads(kw), seq_to_heads(vw))
+        return heads_to_seq(out)
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    spec = P(batch_axes or None, axis_name, None, None)
+    return shard_map_novma(local, mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
+
+
+def _widen_kv_minimal(x, n_heads, n):
+    """Repeat K/V heads to the SMALLEST count that (a) splits over the
+    mesh axis and (b) still divides the query head count per device (so
+    the inner attention's GQA broadcast stays valid)."""
+    kv = x.shape[2]
+    reps = 1
+    while ((kv * reps) % n or n_heads % (kv * reps)) \
+            and kv * reps < n_heads:
+        reps += 1
+    if reps == 1:
+        return x
+    return jnp.repeat(x, reps, axis=2)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sequence", causal=True,
+                      scale=None, impl="auto"):
+    return ulysses_attention_sharded(mesh, axis_name, causal, scale, impl)(
+        q, k, v
+    )
