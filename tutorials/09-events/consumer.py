@@ -1,0 +1,47 @@
+"""Episode 09b: event-driven pipelines — flows that start each other.
+
+@trigger_on_finish subscribes this flow to ProducerFlow's completion;
+the consumed event surfaces as `current.trigger`. (@trigger does the
+same for ANY named event published via `ArgoEvent('name').publish()`,
+payload included.)
+
+Locally, LocalTriggerListener plays the Argo Events sensor:
+
+    python producer.py run                  # publishes run-finished
+    python - <<'PY'
+    from metaflow_tpu.events import LocalTriggerListener
+    listener = LocalTriggerListener()
+    listener.register("consumer.py")        # reads @trigger_on_finish
+    # ... after each producer run:
+    print(listener.poll_once())             # launches ConsumerFlow
+    PY
+
+On Argo, `argo-workflows create` also emits a Sensor whose submit
+trigger patches the consumed event's body into the workflow, so pods
+see the same `current.trigger` in-cluster.
+"""
+
+from metaflow_tpu import FlowSpec, current, step, trigger_on_finish
+
+
+@trigger_on_finish(flow="ProducerFlow")
+class ConsumerFlow(FlowSpec):
+    @step
+    def start(self):
+        t = current.get("trigger")
+        if t:
+            print("woken by %s (upstream run %s)"
+                  % (t.event.name, t.event.payload.get("run_id")))
+            self.upstream = t.event.payload.get("run_id")
+        else:
+            print("run directly (no trigger)")
+            self.upstream = None
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+if __name__ == "__main__":
+    ConsumerFlow()

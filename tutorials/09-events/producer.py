@@ -1,0 +1,20 @@
+"""Episode 09a: the upstream flow. Completion publishes
+`run-finished.ProducerFlow` — local JSONL bus under the datastore root,
+Argo Events webhook in-cluster (TPUFLOW_ARGO_EVENTS_URL)."""
+
+from metaflow_tpu import FlowSpec, step
+
+
+class ProducerFlow(FlowSpec):
+    @step
+    def start(self):
+        self.dataset = [1, 2, 3]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("dataset published:", self.dataset)
+
+
+if __name__ == "__main__":
+    ProducerFlow()
