@@ -133,6 +133,43 @@ def pipelined_forward(model_layer_fn, params_layers, x, mesh,
     )
 
 
+def _degenerate_train(layer_fn, loss_fn, stage_params, x, y, M,
+                      head_params=None, return_input_grad=False):
+    """S == 1: no pipeline — one microbatched scan, differentiated
+    directly. The single implementation behind both schedules' degenerate
+    paths."""
+
+    def full_loss(layers, head, xx):
+        mbs = xx.reshape((M, xx.shape[0] // M) + xx.shape[1:])
+        ybs = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+
+        def body(acc, mb_yb):
+            mb, yb = mb_yb
+            out, _ = jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp), None), mb, layers
+            )
+            out = out.astype(jnp.float32)
+            val = (loss_fn(out, yb, head) if head is not None
+                   else loss_fn(out, yb))
+            return acc + val, None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (mbs, ybs))
+        return total / M
+
+    if head_params is None and not return_input_grad:
+        return jax.value_and_grad(
+            lambda p: full_loss(p, None, x)
+        )(stage_params)
+    loss, (lg, hg, dx) = jax.value_and_grad(
+        full_loss, argnums=(0, 1, 2)
+    )(stage_params, head_params, x)
+    return loss, lg, {
+        "head_grads": hg if head_params is not None else None,
+        "input_grad": dx if return_input_grad else None,
+    }
+
+
 def pipeline_train_1f1b(layer_fn, loss_fn, stage_params, x, y, mesh,
                         num_microbatches, axis_name="pipeline"):
     """1F1B training schedule: loss + per-stage parameter gradients.
@@ -171,22 +208,7 @@ def pipeline_train_1f1b(layer_fn, loss_fn, stage_params, x, y, mesh,
     if n_stages == 1:
         # degenerate pipeline: plain microbatched loss/grad, no collectives
         # (size-1 mesh axes are dropped by MeshSpec)
-        def full_loss(params):
-            mbs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
-            ybs = y.reshape((M, y.shape[0] // M) + y.shape[1:])
-
-            def body(acc, mb_yb):
-                mb, yb = mb_yb
-                out, _ = jax.lax.scan(
-                    lambda c, lp: (layer_fn(c, lp), None), mb, params
-                )
-                return acc + loss_fn(out.astype(jnp.float32), yb), None
-
-            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
-                                    (mbs, ybs))
-            return total / M
-
-        return jax.value_and_grad(full_loss)(stage_params)
+        return _degenerate_train(layer_fn, loss_fn, stage_params, x, y, M)
 
     def local(x_local, y_local, params_local):
         stage = jax.lax.axis_index(axis_name)
@@ -476,7 +498,8 @@ def interleaved_schedule(M, V, S):
 
 def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
                                num_microbatches, num_virtual_stages=2,
-                               axis_name="pipeline"):
+                               axis_name="pipeline", head_params=None,
+                               return_input_grad=False):
     """Interleaved 1F1B: V virtual stages per device cut the pipeline
     bubble ~V-fold (each fill/drain tick now costs layers/(V*S) instead of
     layers/S of compute).
@@ -487,6 +510,19 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
     Backward recomputes each chunk forward from its saved input
     (remat-in-pipeline); gradients are returned in natural layer order.
 
+    Training a FULL model through the pipeline needs two more gradient
+    paths, both optional:
+      head_params: replicated pytree consumed by the loss —
+          loss_fn(out, targets, head_params) — e.g. final norm + unembed.
+          Their gradients accumulate on the last-chunk device and psum
+          across the axis.
+      return_input_grad=True: also return dL/dx (the cotangent leaving
+          chunk 0's backward, collected per microbatch) so the caller can
+          chain into the embedding lookup's scatter-add transpose.
+    With either option the result is (loss, stage_grads, aux) where
+    aux = {"head_grads": ..., "input_grad": ...} (absent entries None);
+    otherwise (loss, stage_grads) exactly as before.
+
     The instruction tables come from `interleaved_schedule`; the loop
     body executes one (possibly inactive) F slot and one B slot per
     cycle, with both transport rings running every cycle so the SPMD
@@ -495,10 +531,17 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
     S = dict(mesh.shape).get(axis_name, 1)
     V = int(num_virtual_stages)
     M = int(num_microbatches)
+    extras = head_params is not None or return_input_grad
     if V < 1:
         raise ValueError("num_virtual_stages must be >= 1")
-    if V == 1 or S == 1:
-        # V=1 IS plain 1F1B; S=1 has no pipeline at all
+    if S == 1:
+        # no pipeline at all: differentiate everything directly
+        return _degenerate_train(layer_fn, loss_fn, stage_params, x, y, M,
+                                 head_params=head_params,
+                                 return_input_grad=return_input_grad)
+    if V == 1 and not extras:
+        # V=1 IS plain 1F1B (the table path handles it too, but the
+        # dedicated implementation is simpler — keep the old contract)
         return pipeline_train_1f1b(layer_fn, loss_fn, stage_params, x, y,
                                    mesh, M, axis_name)
     L = jax.tree.leaves(stage_params)[0].shape[0]
@@ -526,7 +569,7 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
     perm_fwd = [(i, (i + 1) % S) for i in range(S)]
     perm_bwd = [(i, (i - 1) % S) for i in range(S)]
 
-    def local(x_local, y_local, params_local):
+    def local(x_local, y_local, params_local, head_local):
         stage = jax.lax.axis_index(axis_name)
         mb_size = x_local.shape[0] // M
         mbs = x_local.reshape((M, mb_size) + x_local.shape[1:])
@@ -546,6 +589,13 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
             return out
 
         var = functools.partial(_as_varying, axis_name=axis_name)
+        # head params arrive replicated (P() spec = unvarying): grad'ing
+        # an UNVARYING value inside a switch branch makes jax insert a
+        # backward psum — a collective only the branch-taking devices
+        # would execute (deadlock). Mark them varying; the manual psum
+        # after the loop does the cross-device reduction instead.
+        head_v = (None if head_local is None
+                  else jax.tree.map(var, head_local))
 
         act_shape = (mb_size,) + x_local.shape[1:]
         state = dict(
@@ -560,22 +610,41 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
             ),
             loss=var(jnp.zeros((), jnp.float32)),
         )
+        if head_local is not None:
+            state["hgrads"] = jax.tree.map(
+                lambda p: var(jnp.zeros_like(p, jnp.float32)), head_v
+            )
+        if return_input_grad:
+            state["dx"] = var(jnp.zeros((M,) + act_shape, jnp.float32))
 
         zero_act = var(jnp.zeros(act_shape, x_local.dtype))
         zero_cot = var(jnp.zeros(act_shape, jnp.float32))
 
         def cycle(c, st):
-            # one op per cycle: 0 = idle, 1 = forward, 2 = backward. The
-            # branches hold no collectives (layer-internal collectives run
-            # over OTHER mesh axes, where same-pipeline-coordinate devices
+            # one op per cycle: 0 = idle, 1 = forward, 2 = MID-chunk
+            # backward (cotangent from the ring, no loss), 3 = LAST-chunk
+            # backward (loss + optional head grads — the head's fwd+bwd
+            # is only ever paid where its result is real). The branches
+            # hold no collectives (layer-internal collectives run over
+            # OTHER mesh axes, where same-pipeline-coordinate devices
             # take the same branch), so only the selected branch's chunk
             # of compute is paid; both transport rings run unconditionally
             # after it to keep devices in lockstep.
-            op = T["f_on"][stage, c] + 2 * T["b_on"][stage, c]
+            op = (T["f_on"][stage, c] + 2 * T["b_on"][stage, c]
+                  + T["b_last"][stage, c])
+
+            def carried(st):
+                # everything a branch may update (recv buffers are
+                # handled outside, after the transport rings)
+                out = dict(saved=st["saved"], pgrads=st["pgrads"],
+                           loss=st["loss"])
+                for k in ("hgrads", "dx"):
+                    if k in st:
+                        out[k] = st[k]
+                return out
 
             def do_idle(st):
-                return zero_act, zero_cot, st["saved"], st["pgrads"], \
-                    st["loss"]
+                return zero_act, zero_cot, carried(st)
 
             def do_fwd(st):
                 a_in = jnp.where(
@@ -585,41 +654,77 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
                 )
                 saved = st["saved"].at[T["f_save"][stage, c]].set(a_in)
                 a_out = chunk_fwd(a_in, T["f_j"][stage, c], params_v)
-                return a_out, zero_cot, saved, st["pgrads"], st["loss"]
+                upd = carried(st)
+                upd["saved"] = saved
+                return a_out, zero_cot, upd
 
-            def do_bwd(st):
+            def _bwd_common(st, out, pullback, cot, b_j, b_m):
+                da, dp = pullback(cot.astype(out.dtype))
+                # dp is zero outside chunk b_j (gradients flow only
+                # through the dynamically selected chunk), so a full-tree
+                # add accumulates correctly without a scatter
+                upd = carried(st)
+                upd["pgrads"] = jax.tree.map(
+                    lambda acc, g: acc + g.astype(jnp.float32),
+                    st["pgrads"], dp,
+                )
+                if return_input_grad:
+                    # chunk 0's input cotangent IS dL/d(embedded input)
+                    # for this microbatch (local virtual stage 0 on the
+                    # first pipeline device)
+                    is_c0 = jnp.logical_and(stage == 0, b_j == 0)
+                    upd["dx"] = jnp.where(
+                        is_c0,
+                        st["dx"].at[b_m].set(da.astype(jnp.float32)),
+                        st["dx"],
+                    )
+                return zero_act, da.astype(jnp.float32), upd
+
+            def _chunk_vjp(st):
                 # recompute the chunk forward from its saved input
-                # (remat-in-pipeline), then pull the cotangent back
+                # (remat-in-pipeline); shared by both backward ops
                 b_j = T["b_j"][stage, c]
-                b_last = T["b_last"][stage, c] > 0
                 a_sv = st["saved"][T["b_save"][stage, c]]
                 out, pullback = jax.vjp(
                     lambda a, pv: chunk_fwd(a, b_j, pv), a_sv, params_v
                 )
-                loss_val, dldout = jax.value_and_grad(loss_fn)(
-                    out.astype(jnp.float32), ybs[T["b_m"][stage, c]]
-                )
-                cot = jnp.where(
-                    b_last,
-                    dldout.astype(out.dtype),
-                    st["recv_b"][jnp.clip(T["b_rslot"][stage, c], 0)]
-                    .astype(out.dtype),
-                )
-                da, dp = pullback(cot)
-                # dp is zero outside chunk b_j (gradients flow only
-                # through the dynamically selected chunk), so a full-tree
-                # add accumulates correctly without a scatter
-                pgrads = jax.tree.map(
-                    lambda acc, g: acc + g.astype(jnp.float32),
-                    st["pgrads"], dp,
-                )
-                loss = st["loss"] + jnp.where(b_last, loss_val, 0.0)
-                return zero_act, da.astype(jnp.float32), st["saved"], \
-                    pgrads, loss
+                return out, pullback, b_j
 
-            send_f, send_b, saved, pgrads, loss = jax.lax.switch(
-                op, [do_idle, do_fwd, do_bwd], st
+            def do_bwd_mid(st):
+                out, pullback, b_j = _chunk_vjp(st)
+                cot = st["recv_b"][jnp.clip(T["b_rslot"][stage, c], 0)]
+                return _bwd_common(st, out, pullback, cot, b_j,
+                                   T["b_m"][stage, c])
+
+            def do_bwd_last(st):
+                out, pullback, b_j = _chunk_vjp(st)
+                b_m = T["b_m"][stage, c]
+                if head_local is None:
+                    loss_val, dldout = jax.value_and_grad(loss_fn)(
+                        out.astype(jnp.float32), ybs[b_m]
+                    )
+                    dhead = None
+                else:
+                    loss_val, (dldout, dhead) = jax.value_and_grad(
+                        loss_fn, argnums=(0, 2)
+                    )(out.astype(jnp.float32), ybs[b_m], head_v)
+                send_f, send_b, upd = _bwd_common(
+                    st, out, pullback, dldout, b_j, b_m
+                )
+                upd["loss"] = st["loss"] + loss_val
+                if dhead is not None:
+                    # last-chunk ops all run on one device; the psum
+                    # after the loop spreads the sum
+                    upd["hgrads"] = jax.tree.map(
+                        lambda acc, g: acc + g.astype(jnp.float32),
+                        st["hgrads"], dhead,
+                    )
+                return send_f, send_b, upd
+
+            send_f, send_b, upd = jax.lax.switch(
+                op, [do_idle, do_fwd, do_bwd_mid, do_bwd_last], st
             )
+            saved, pgrads, loss = upd["saved"], upd["pgrads"], upd["loss"]
 
             arriving_f = jax.lax.ppermute(send_f, axis_name, perm_fwd)
             fstore = T["fstore"][stage, c]
@@ -635,8 +740,12 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
                 st["recv_b"].at[jnp.clip(bstore, 0)].set(arriving_b),
                 st["recv_b"],
             )
-            return dict(saved=saved, recv_f=recv_f, recv_b=recv_b,
-                        pgrads=pgrads, loss=loss)
+            new = dict(saved=saved, recv_f=recv_f, recv_b=recv_b,
+                       pgrads=pgrads, loss=loss)
+            for k in ("hgrads", "dx"):
+                if k in upd:
+                    new[k] = upd[k]
+            return new
 
         st = jax.lax.fori_loop(0, C, cycle, state)
         mean_loss = jax.lax.psum(st["loss"], axis_name) / M
@@ -644,15 +753,38 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
             lambda g: (g / M).reshape((V * Lc,) + g.shape[2:]),
             st["pgrads"],
         )
-        return mean_loss, grads
+        out = (mean_loss, grads)
+        if head_local is not None:
+            # accumulated only on the last-chunk device; zeros elsewhere
+            out += (jax.tree.map(
+                lambda g: jax.lax.psum(g, axis_name) / M, st["hgrads"]),)
+        if return_input_grad:
+            dx = jax.lax.psum(st["dx"], axis_name) / M
+            out += (dx.reshape(x_local.shape),)
+        return out
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    out_specs = (P(), param_specs)
+    if head_params is not None:
+        out_specs += (jax.tree.map(lambda _: P(), head_params),)
+    if return_input_grad:
+        out_specs += (P(),)
     fn = _shard_map(
         local, mesh,
-        in_specs=(P(), P(), param_specs),
-        out_specs=(P(), param_specs),
+        in_specs=(P(), P(), param_specs,
+                  jax.tree.map(lambda _: P(), head_params)),
+        out_specs=out_specs,
     )
     params_re = jax.tree.map(lambda p: p[perm], stage_params)
-    loss, grads_re = fn(x, y, params_re)
-    # back to natural layer order
-    return loss, jax.tree.map(lambda g: g[inv_perm], grads_re)
+    results = fn(x, y, params_re, head_params)
+    loss, grads_re = results[0], results[1]
+    grads = jax.tree.map(lambda g: g[inv_perm], grads_re)
+    if not extras:
+        return loss, grads
+    idx = 2
+    hg = None
+    if head_params is not None:
+        hg = results[idx]
+        idx += 1
+    dx = results[idx] if return_input_grad else None
+    return loss, grads, {"head_grads": hg, "input_grad": dx}

@@ -1,0 +1,65 @@
+"""Train a FULL Llama through pipeline parallelism.
+
+Bridges models/llama.py onto the interleaved-1F1B schedule
+(spmd/pipeline.py): the stacked transformer blocks become pipeline
+chunks; the embedding lookup runs before the pipeline with its gradient
+chained from the schedule's input cotangent (the scatter-add transpose
+of the gather); final norm + lm_head ride as replicated head params
+differentiated inside the last chunk's loss slots.
+
+This is what the reference delegates to torchrun+DeepSpeed pipeline
+engines — here the schedule, the model and the mesh are one system.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models import llama
+from ..ops import rms_norm, rope_frequencies
+from ..spmd.pipeline import pipeline_train_interleaved
+
+
+def pipeline_loss_and_grads(params, tokens, cfg, mesh,
+                            num_microbatches=4, num_virtual_stages=1,
+                            axis_name="pipeline"):
+    """Next-token loss + gradients for EVERY parameter of the Llama
+    pytree, computed through the pipeline schedule. Returns
+    (loss, grads) with grads shaped exactly like `params`."""
+    inp, tgt = tokens[:, :-1], tokens[:, 1:]
+    dt = llama.param_dtype(cfg)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, inp.shape[1], cfg.rope_theta, dtype=dt,
+        llama3_scaling=cfg.rope_llama3_scaling,
+    )
+
+    def layer_fn(x, lp):
+        return llama._layer(cfg, cos, sin, x, lp)
+
+    def loss_fn(out, y, head):
+        # the same chunk-safe CE the non-pipelined loss uses (fp32
+        # logits never materialize beyond one chunk)
+        h = rms_norm(out, head["final_norm"], cfg.norm_eps)
+        loss_sum, count = llama._ce_sums(h, head["lm_head"], y, None)
+        return loss_sum / jnp.maximum(count, 1)
+
+    head = {"final_norm": params["final_norm"],
+            "lm_head": params["lm_head"]}
+    x = params["embed"][inp].astype(dt)
+    loss, layer_grads, aux = pipeline_train_interleaved(
+        layer_fn, loss_fn, params["layers"], x, tgt, mesh,
+        num_microbatches=num_microbatches,
+        num_virtual_stages=num_virtual_stages, axis_name=axis_name,
+        head_params=head, return_input_grad=True,
+    )
+    # embedding gradient: the gather's transpose is a scatter-add of the
+    # input cotangent over the token ids
+    d_embed = jnp.zeros_like(params["embed"], jnp.float32).at[inp].add(
+        aux["input_grad"]
+    )
+    grads = {
+        "embed": d_embed,
+        "layers": layer_grads,
+        "final_norm": aux["head_grads"]["final_norm"],
+        "lm_head": aux["head_grads"]["lm_head"],
+    }
+    return loss, grads
