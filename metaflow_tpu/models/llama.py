@@ -162,17 +162,25 @@ def _layer(cfg, cos, sin, x, layer_params, mesh=None):
     v = (h @ layer_params["wv"]).reshape(B, S, KV, Hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if cfg.attention_impl == "ring":
-        # context parallelism: sequence stays sharded, KV blocks rotate
-        # around the 'sequence' mesh axis (ops/ring_attention.py)
-        from ..ops.ring_attention import ring_attention
-
+    if cfg.attention_impl in ("ring", "ulysses"):
+        # context parallelism over the 'sequence' mesh axis: 'ring'
+        # rotates KV blocks (ops/ring_attention.py, O(S/n) residency);
+        # 'ulysses' re-shards seq->heads with all-to-alls and runs
+        # full-sequence attention per head group
+        # (ops/ulysses_attention.py, unsharded inner kernel)
         if mesh is None or "sequence" not in mesh.axis_names:
             raise ValueError(
-                "attention_impl='ring' needs a mesh with a 'sequence' axis "
-                "passed to forward/loss_fn"
+                "attention_impl=%r needs a mesh with a 'sequence' axis "
+                "passed to forward/loss_fn" % cfg.attention_impl
             )
-        attn = ring_attention(q, k, v, mesh, causal=True)
+        if cfg.attention_impl == "ring":
+            from ..ops.ring_attention import ring_attention
+
+            attn = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            from ..ops.ulysses_attention import ulysses_attention
+
+            attn = ulysses_attention(q, k, v, mesh, causal=True)
     else:
         attn = attention(q, k, v, causal=True, impl=cfg.attention_impl)
     # named for remat_policy='attn_out': saving this tensor across the layer
@@ -223,7 +231,7 @@ def hidden_states(params, tokens, cfg, mesh=None):
 def forward(params, tokens, cfg, mesh=None):
     """tokens: [B, S] int32 → logits [B, S, vocab] (float32).
 
-    `mesh` is only needed for attention_impl='ring' (sequence parallelism)."""
+    `mesh` is only needed for the sequence-parallel attention impls ('ring'/'ulysses')."""
     x = hidden_states(params, tokens, cfg, mesh=mesh)
     return jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"],

@@ -99,9 +99,11 @@ def test_params_actually_sharded(tiny_cfg):
     assert shard.data.size == wq.size // 8
 
 
-def test_ring_attention_training_matches_dp(tiny_cfg):
-    """attention_impl='ring' on a sequence-parallel mesh trains identically
-    to plain attention on a data-parallel mesh (long-context path)."""
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_training_matches_dp(tiny_cfg, impl):
+    """attention_impl='ring' (rotating KV blocks) and 'ulysses'
+    (all-to-all head resharding) on a sequence-parallel mesh both train
+    identically to plain attention on a data-parallel mesh."""
     import dataclasses
 
     tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0,
@@ -125,13 +127,11 @@ def test_ring_attention_training_matches_dp(tiny_cfg):
         return losses
 
     l_ref = run(tiny_cfg, MeshSpec({"fsdp": 4}), n=4)
-    l_ring = run(
-        dataclasses.replace(tiny_cfg, attention_impl="ring"),
+    l_sp = run(
+        dataclasses.replace(tiny_cfg, attention_impl=impl),
         MeshSpec({"fsdp": 2, "sequence": 4}),
     )
-    import numpy as np
-
-    np.testing.assert_allclose(l_ref, l_ring, rtol=2e-3)
+    np.testing.assert_allclose(l_ref, l_sp, rtol=2e-3)
 
 
 def test_graft_entry_single_chip():
