@@ -387,6 +387,16 @@ def _rerun_on_cpu():
     ).returncode)
 
 
+def _submetric(fn):
+    """Run a secondary bench; a failure must never take down the primary
+    metric, but it must be visible in the artifact."""
+    try:
+        return fn()
+    except (Exception, SystemExit) as ex:  # SystemExit: raise SystemExit paths
+        return {"metric": getattr(fn, "__name__", "submetric"),
+                "error": "%s: %s" % (type(ex).__name__, ex)}
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_MODE", "train")
     if mode == "launch":
@@ -408,5 +418,20 @@ if __name__ == "__main__":
         elif result.get("extra", {}).get("backend") != "tpu":
             result["degraded"] = True
             result["degraded_reason"] = "no_tpu_backend"
+        # driver artifacts must carry the launch-latency + data-path
+        # numbers too (round-3 verdict weak #6: builder-recorded only);
+        # they are orchestration/IO metrics — valid even when the chip is
+        # gone, so they ride along regardless of degradation.
+        if os.environ.get("BENCH_SUBMETRICS", "1") == "1":
+            os.environ["BENCH_DAEMON"] = os.environ.get("BENCH_DAEMON", "1")
+            # the submetrics ride INSIDE the train entry (history gets one
+            # line per driver run, not three): an in-driver launch/data
+            # number shares the box with a just-finished training run, so
+            # it must not mingle with the standalone-mode populations of
+            # the same metric name
+            result["submetrics"] = [
+                _submetric(bench_step_launch),
+                _submetric(bench_data_path),
+            ]
     _append_history(result)
     print(json.dumps(result))

@@ -138,11 +138,14 @@ def _parse_task_pathspec(pathspec):
     return parts
 
 
-def _write_argo_outputs(state, out_dir, run_id, step_name, task_id):
+def _write_argo_outputs(state, out_dir, run_id, step_name, task_id,
+                        iteration=None):
     """Drop Argo output-parameter files (read via valueFrom.path): the
     foreach fan-out cardinality as a JSON index list (consumed by withParam
-    and by the join's --join-inputs), and the switch's chosen next step
-    (consumed by `when` conditions)."""
+    and by the join's --join-inputs), the switch's chosen next step
+    (consumed by `when` conditions), and — for recursive-switch loop
+    templates — the next iteration counter plus this task's own id (the
+    loop template exports the FINAL iteration's pathspec to its exits)."""
     os.makedirs(out_dir, exist_ok=True)
     ds = state.flow_datastore.get_task_datastore(run_id, step_name, task_id)
     num_splits = ds.get("_foreach_num_splits") or 0
@@ -158,6 +161,11 @@ def _write_argo_outputs(state, out_dir, run_id, step_name, task_id):
         f.write(str(int(num_splits) or 1))
     with open(os.path.join(out_dir, "next-step"), "w") as f:
         f.write(next_step)
+    with open(os.path.join(out_dir, "own-task-id"), "w") as f:
+        f.write(str(task_id))
+    if iteration not in (None, ""):
+        with open(os.path.join(out_dir, "iter-next"), "w") as f:
+            f.write(str(int(iteration) + 1))
 
 
 def _collect_params(flow, kwargs):
@@ -352,11 +360,16 @@ def make_cli(flow, state):
     @click.option("--argo-output-dir", default=None,
                   help="Directory to drop Argo output-parameter files into "
                        "after the task finishes (num-splits, next-step).")
+    @click.option("--argo-iteration", default=None,
+                  help="Recursive-switch loop iteration counter (compiled "
+                       "Argo loop templates only): written back as the "
+                       "iter-next output parameter = iteration + 1.")
     @click.pass_obj
     def step(state, step_name, run_id, task_id, input_paths, split_index,
              retry_count, max_user_code_retries, user_namespace, ubf_context,
              origin_run_id, params_json, params_from_env, input_paths_any,
-             join_inputs, join_inputs_control, argo_output_dir):
+             join_inputs, join_inputs_control, argo_output_dir,
+             argo_iteration):
         _finalize(state)
         os.environ[STEP_ARGV_ENV] = json.dumps(sys.argv)
         if ubf_context not in (None, "", "none"):
@@ -440,7 +453,7 @@ def make_cli(flow, state):
             )
             if argo_output_dir:
                 _write_argo_outputs(state, argo_output_dir, run_id, step_name,
-                                    task_id)
+                                    task_id, iteration=argo_iteration)
         finally:
             beat_stop.set()
 

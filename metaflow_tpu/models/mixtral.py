@@ -34,6 +34,10 @@ class MixtralConfig:
     attention_impl: str = "auto"
     remat: bool = True
     router_aux_coef: float = 0.02
+    # sparse = capacity-bucketed expert-parallel dispatch (ops/moe.py);
+    # dense = the O(num_experts × tokens) oracle, debugging only
+    moe_dispatch: str = "sparse"
+    capacity_factor: float = 2.0
 
     @property
     def head_dim(self):
@@ -110,7 +114,7 @@ def logical_axes(cfg):
     }
 
 
-def _layer(cfg, cos, sin, carry, layer_params):
+def _layer(cfg, cos, sin, carry, layer_params, mesh=None):
     x, aux_sum = carry
     B, S, D = x.shape
     H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -132,17 +136,20 @@ def _layer(cfg, cos, sin, carry, layer_params):
         layer_params["w_up"],
         layer_params["w_down"],
         num_experts_per_tok=cfg.experts_per_tok,
+        capacity_factor=cfg.capacity_factor,
+        dispatch=cfg.moe_dispatch,
+        mesh=mesh,
     )
     return (x + moe_out, aux_sum + aux), None
 
 
-def forward(params, tokens, cfg, return_aux=False):
+def forward(params, tokens, cfg, return_aux=False, mesh=None):
     dt = param_dtype(cfg)
     x = params["embed"][tokens].astype(dt)
     cos, sin = rope_frequencies(cfg.head_dim, tokens.shape[1], cfg.rope_theta,
                                 dtype=dt)
 
-    layer_fn = lambda carry, lp: _layer(cfg, cos, sin, carry, lp)
+    layer_fn = lambda carry, lp: _layer(cfg, cos, sin, carry, lp, mesh=mesh)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
     (x, aux), _ = jax.lax.scan(
@@ -157,13 +164,13 @@ def forward(params, tokens, cfg, return_aux=False):
     return logits
 
 
-def loss_fn(params, batch, cfg):
+def loss_fn(params, batch, cfg, mesh=None):
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits, aux = forward(params, inputs, cfg, return_aux=True)
+    logits, aux = forward(params, inputs, cfg, return_aux=True, mesh=mesh)
     logps = jax.nn.log_softmax(logits, axis=-1)
     token_lp = jnp.take_along_axis(logps, targets[..., None], axis=-1)[..., 0]
     ce = -jnp.mean(token_lp)

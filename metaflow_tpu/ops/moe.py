@@ -1,10 +1,31 @@
-"""Mixture-of-Experts: top-k router + dense einsum dispatch.
+"""Mixture-of-Experts: top-k router + capacity-bucketed sparse dispatch.
 
-Expert-parallel path (SURVEY.md §5.7, Mixtral target): experts live on the
-'expert' mesh axis. Dispatch uses one-hot einsums (MXU-friendly dense
-matmuls, no dynamic gather/scatter — XLA turns the expert dimension into an
-all-to-all when sharded). Capacity-dropping keeps shapes static for jit.
+Expert-parallel path (SURVEY.md §5.7, Mixtral target): the reference
+delegates MoE entirely to user frameworks (its training substrate is the
+rank/world-size env shim, /root/reference/metaflow/plugins/frameworks/
+pytorch.py:11-46), so an efficient TPU dispatch is this repo's job.
+
+Two dispatch strategies, numerically equivalent modulo capacity drops:
+
+``sparse`` (default) — capacity-bucketed dispatch, the GShard/Switch
+    pattern: top-k → position-in-expert (cumsum over a static slot order)
+    → scatter into static ``[experts, capacity, embed]`` buffers → local
+    expert matmuls → gather-combine. Compute and memory scale with
+    ``k × tokens × capacity_factor``, NOT ``num_experts × tokens``.
+    Sharded on the 'expert' mesh axis the scatter/gather become the
+    all-to-all boundary (XLA inserts it; we pin the buffer sharding so
+    the expert matmuls stay local).
+
+``dense`` — reference oracle: every expert sees every token via one-hot
+    einsums. O(num_experts × tokens) FLOPs; kept for equivalence tests
+    and tiny-scale debugging only.
+
+Capacity semantics are identical in both paths: an expert accepts its
+first ``capacity`` tokens in token order; the rest are dropped (their
+combine weight becomes 0 and the residual stream passes through).
 """
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -19,54 +40,161 @@ def top_k_router(logits, num_experts, k, dtype=jnp.float32):
     return weights.astype(dtype), idx
 
 
+def expert_capacity(num_tokens, num_experts, k, capacity_factor):
+    """Static per-expert token budget.
+
+    capacity_factor=None means lossless: capacity = num_tokens (the worst
+    case — every token routes a slot to the same expert), which makes the
+    sparse path bit-equivalent to dense dispatch without capacity."""
+    if capacity_factor is None:
+        return num_tokens
+    cap = int(math.ceil(capacity_factor * num_tokens * k / num_experts))
+    return max(1, min(cap, num_tokens))
+
+
+def _active_mesh():
+    """The mesh from an enclosing `with mesh:` block, if any."""
+    try:
+        try:  # jax >= 0.8.2 deprecated the pxla re-export
+            from jax._src.mesh import thread_resources
+        except ImportError:
+            from jax.interpreters.pxla import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh is not None and not mesh.empty:
+            return mesh
+    except Exception:
+        pass
+    return None
+
+
+def _constrain_expert_axis(x, mesh):
+    """Pin buffer axis 0 to the 'expert' mesh axis so the scatter/gather is
+    the single all-to-all boundary and expert matmuls stay chip-local."""
+    if mesh is None or "expert" not in mesh.axis_names:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = PartitionSpec("expert", *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
-            capacity_factor=None, activation=jax.nn.silu):
+            capacity_factor=None, activation=jax.nn.silu, dispatch="sparse",
+            mesh=None):
     """Token-choice MoE feed-forward.
 
     x:        [B, S, E]
     router_w: [E, num_experts]
     w_gate/w_up: [num_experts, E, F]; w_down: [num_experts, F, E]
+    mesh:     pass the device mesh explicitly so the sparse path can pin
+              its expert buffers to the 'expert' axis even when the step
+              is traced outside a `with mesh:` block; falls back to the
+              ambient mesh context when omitted.
 
-    Dense dispatch: combine weights become a [tokens, experts] matrix and the
-    expert computation is a batched einsum over the expert dim — sharded on
-    the 'expert' mesh axis this becomes all-to-all + local expert matmuls.
+    Returns (out [B, S, E], aux_loss scalar).
     """
     B, S, E = x.shape
     num_experts = router_w.shape[1]
+    k = num_experts_per_tok
     tokens = x.reshape(B * S, E)
 
     router_logits = jnp.einsum(
         "te,en->tn", tokens.astype(jnp.float32), router_w.astype(jnp.float32)
     )
-    weights, idx = top_k_router(router_logits, num_experts,
-                                num_experts_per_tok, dtype=x.dtype)
-
-    # combine matrix: [tokens, experts], rows sum to 1 over selected experts
+    weights, idx = top_k_router(router_logits, num_experts, k, dtype=x.dtype)
     one_hot = jax.nn.one_hot(idx, num_experts, dtype=x.dtype)  # [t, k, n]
+    aux = _load_balancing_loss(router_logits, one_hot)
+
+    if dispatch == "sparse":
+        out = _sparse_dispatch_ffn(
+            tokens, weights, idx, w_gate, w_up, w_down, num_experts, k,
+            capacity_factor, activation,
+            mesh if mesh is not None else _active_mesh(),
+        )
+    elif dispatch == "dense":
+        out = _dense_dispatch_ffn(
+            tokens, weights, idx, one_hot, w_gate, w_up, w_down, num_experts,
+            k, capacity_factor, activation,
+        )
+    else:
+        raise ValueError("dispatch must be 'sparse' or 'dense', got %r"
+                         % (dispatch,))
+    return out.reshape(B, S, E), aux
+
+
+def _sparse_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
+                         num_experts, k, capacity_factor, activation, mesh):
+    """Capacity-bucketed dispatch: O(k·T·capacity_factor) expert FLOPs.
+
+    Slot order is token-major (slot t·k+j precedes t'·k+j' iff t<t' or
+    (t==t', j<j')); since top-k indices are distinct per token, each token
+    holds at most one slot per expert, so per-expert arrival order equals
+    token order — the same drop decisions as the dense oracle's
+    token-axis cumsum."""
+    T, E = tokens.shape
+    N = num_experts
+    C = expert_capacity(T, N, k, capacity_factor)
+
+    e_flat = idx.reshape(T * k)                      # expert id per slot
+    w_flat = weights.reshape(T * k)                  # combine weight per slot
+    slot_one_hot = jax.nn.one_hot(e_flat, N, dtype=jnp.int32)  # [T*k, N]
+    # 0-based arrival position of each slot within its expert
+    pos = jnp.cumsum(slot_one_hot, axis=0) - 1       # [T*k, N]
+    pos_flat = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    keep = pos_flat < C
+    # dropped slots scatter out of range; mode="drop" discards them with
+    # static shapes (positions are unique per expert, so add == set)
+    safe_pos = jnp.where(keep, pos_flat, C)
+    t_flat = jnp.arange(T * k) // k                  # owning token per slot
+
+    x_buf = jnp.zeros((N, C, E), tokens.dtype).at[e_flat, safe_pos].add(
+        tokens[t_flat], mode="drop"
+    )
+    x_buf = _constrain_expert_axis(x_buf, mesh)      # all-to-all boundary in
+
+    gate = activation(jnp.einsum("nce,nef->ncf", x_buf, w_gate,
+                                 preferred_element_type=jnp.float32))
+    up = jnp.einsum("nce,nef->ncf", x_buf, w_up,
+                    preferred_element_type=jnp.float32)
+    y_buf = jnp.einsum("ncf,nfe->nce", (gate * up).astype(tokens.dtype),
+                       w_down, preferred_element_type=jnp.float32)
+    y_buf = _constrain_expert_axis(y_buf.astype(tokens.dtype), mesh)
+
+    # combine: gather each slot's expert output back (all-to-all boundary
+    # out); out-of-range gathers clamp but are zeroed by the keep mask
+    y_slots = y_buf[e_flat, safe_pos]                # [T*k, E]
+    y_slots = jnp.where(keep[:, None], y_slots, 0) * w_flat[:, None]
+    return y_slots.reshape(T, k, E).sum(axis=1)
+
+
+def _dense_dispatch_ffn(tokens, weights, idx, one_hot, w_gate, w_up, w_down,
+                        num_experts, k, capacity_factor, activation):
+    """Reference oracle: every expert sees every token (one-hot einsums)."""
+    T, E = tokens.shape
+    # combine matrix: [tokens, experts], rows sum to 1 over selected experts
     combine = jnp.einsum("tkn,tk->tn", one_hot, weights)
 
-    # dense dispatch: every expert sees every token, scaled post-hoc.
-    # With capacity_factor set, tokens beyond an expert's capacity drop out
-    # (position-in-expert computed via a cumulative sum).
     if capacity_factor is not None:
-        capacity = int(capacity_factor * (B * S) * num_experts_per_tok
-                       / num_experts)
-        dispatch_mask = combine > 0
+        C = expert_capacity(T, num_experts, k, capacity_factor)
+        # count capacity from the ROUTING mask (one_hot), not `combine > 0`:
+        # a top-k slot whose softmax weight underflowed to exactly 0 still
+        # occupies a capacity slot in the sparse path, and the oracle must
+        # make identical drop decisions
+        dispatch_mask = jnp.sum(one_hot, axis=1) > 0  # [t, n]
+        # 1-based arrival position in token order
         position_in_expert = jnp.cumsum(dispatch_mask, axis=0) * dispatch_mask
-        combine = jnp.where(position_in_expert <= capacity, combine, 0.0)
+        combine = jnp.where(position_in_expert <= C, combine, 0.0)
 
-    # [n, t, E]: per-expert token batch (sharded over 'expert' this is the
-    # all-to-all boundary)
+    # [n, t, E]: per-expert token batch
     h = jnp.einsum("te,tn->nte", tokens, combine != 0)
     gate = activation(jnp.einsum("nte,nef->ntf", h, w_gate,
                                  preferred_element_type=jnp.float32))
     up = jnp.einsum("nte,nef->ntf", h, w_up,
                     preferred_element_type=jnp.float32)
-    expert_out = jnp.einsum("ntf,nfe->nte", (gate * up).astype(x.dtype),
+    expert_out = jnp.einsum("ntf,nfe->nte", (gate * up).astype(tokens.dtype),
                             w_down, preferred_element_type=jnp.float32)
-    out = jnp.einsum("nte,tn->te", expert_out.astype(x.dtype), combine)
-    aux = _load_balancing_loss(router_logits, one_hot)
-    return out.reshape(B, S, E), aux
+    return jnp.einsum("nte,tn->te", expert_out.astype(tokens.dtype), combine)
 
 
 def _load_balancing_loss(router_logits, one_hot):

@@ -86,7 +86,83 @@ class ArgoWorkflows(object):
         self.metadata = metadata
         self.service_url = service_url
         self.parameters = parameters or {}
+        self._loops = self._compute_loops()
         self._validate()
+
+    # ---------------- recursive switch (template loops) ----------------
+    #
+    # A switch whose case targets an UPSTREAM step forms a loop. The
+    # reference compiles these to self-referencing Argo templates
+    # (metaflow/plugins/argo/argo_workflows.py:1029-1231, conditional/
+    # recursive compilation); here the shape is: every loop gets a
+    # `loop-<entry>` DAG template holding the member steps with
+    # iteration-suffixed task ids (`improve-i0`, `improve-i1`, ... — the
+    # client sees every iteration as its own task), plus a `continue` task
+    # that re-invokes the SAME template with iteration+1 while the switch
+    # keeps choosing the back-edge. The final iteration's chosen exit and
+    # task id propagate out through the recursion via valueFrom.expression
+    # output parameters, and the exit steps in the parent scope guard on
+    # them with `when`.
+
+    def _reaches(self, src, dst):
+        """True when dst is reachable from src following out_funcs."""
+        seen = set()
+        stack = [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self.graph[cur].out_funcs or [])
+        return False
+
+    def _compute_loops(self):
+        """{entry step: {"switch", "members", "exits"}} for every
+        recursive-switch loop in the graph."""
+        loops = {}
+        for name in self.graph.sorted_nodes():
+            node = self.graph[name]
+            if node.type != "split-switch":
+                continue
+            back = sorted({
+                t for t in node.out_funcs
+                if t == name or self._reaches(t, name)
+            })
+            if not back:
+                continue
+            if len(back) > 1:
+                raise TpuFlowException(
+                    "Step *%s*: switch has %d back-edges (%s); Argo "
+                    "compilation supports one loop per switch."
+                    % (name, len(back), ", ".join(back))
+                )
+            entry = back[0]
+            members = {
+                n for n in self.graph.sorted_nodes()
+                if self._reaches(entry, n) and self._reaches(n, name)
+            }
+            members.update((entry, name))
+            if entry in loops:
+                raise TpuFlowException(
+                    "Steps *%s* and *%s*: two switches loop back to the "
+                    "same entry step *%s*; Argo compilation supports one "
+                    "back-edge per loop."
+                    % (loops[entry]["switch"], name, entry)
+                )
+            loops[entry] = {"switch": name, "members": members}
+        return loops
+
+    def _loop_parent_of(self, name):
+        """The loop (entry-step key) this node is a member of, or None."""
+        for entry, loop in self._loops.items():
+            if name in loop["members"]:
+                return entry
+        return None
+
+    def _loop_name(self, entry):
+        return "loop-" + _argo_name(entry)
 
     def _validate(self):
         """Refuse graphs the Argo compilation can't express yet, and configs
@@ -105,6 +181,7 @@ class ArgoWorkflows(object):
             self._body_name(n) for n in self.graph.sorted_nodes()
             if self.graph[n].type == "foreach"
         )
+        reserved.update(self._loop_name(e) for e in self._loops)
         for name in self.graph.sorted_nodes():
             if _argo_name(name) in reserved:
                 raise TpuFlowException(
@@ -123,16 +200,58 @@ class ArgoWorkflows(object):
                     "names of concurrent gang instances would collide. Run "
                     "locally or lift the gang out of the loop." % name
                 )
-            if node.type == "split-switch":
-                for target in node.out_funcs:
-                    if self.graph[target] is node or target == name:
-                        raise TpuFlowException(
-                            "Step *%s*: recursive switch is not supported "
-                            "on Argo Workflows yet." % name
-                        )
+            # recursive switch compiles to a template loop; refuse only the
+            # shapes the loop template cannot express
+            loop_parent = self._loop_parent_of(name)
+            if loop_parent is not None:
+                loop = self._loops[loop_parent]
+                if node.type in ("foreach", "split", "split-parallel",
+                                 "join"):
+                    raise TpuFlowException(
+                        "Step *%s*: a %s inside a recursive-switch loop "
+                        "is not supported on Argo Workflows — lift it out "
+                        "of the loop." % (name, node.type)
+                    )
+                if self._foreach_parent_of(name):
+                    raise TpuFlowException(
+                        "Step *%s*: a recursive-switch loop nested inside "
+                        "a foreach is not supported on Argo Workflows yet."
+                        % name
+                    )
+                if (name != loop_parent
+                        and any(self._loop_parent_of(f) != loop_parent
+                                for f in node.in_funcs)):
+                    raise TpuFlowException(
+                        "Step *%s*: a recursive-switch loop must have a "
+                        "single entry step (*%s*), but this member has "
+                        "an in-edge from outside the loop."
+                        % (name, loop_parent)
+                    )
+                others = [e for e, l in self._loops.items()
+                          if e != loop_parent and name in l["members"]]
+                if others:
+                    raise TpuFlowException(
+                        "Step *%s*: overlapping recursive-switch loops "
+                        "(entries %s and %s) are not supported on Argo "
+                        "Workflows." % (name, loop_parent, others[0])
+                    )
+                if (name != loop["switch"]
+                        and any(t not in loop["members"]
+                                for t in node.out_funcs)):
+                    raise TpuFlowException(
+                        "Step *%s*: only the loop's switch step (*%s*) may "
+                        "exit a recursive-switch loop on Argo Workflows."
+                        % (name, loop["switch"])
+                    )
             if self._is_switch_merge(node):
                 for in_func in node.in_funcs:
-                    if self.graph[in_func].type == "split-switch":
+                    if (self.graph[in_func].type == "split-switch"
+                            # ONLY a loop's back-edge into its entry is the
+                            # recursion rather than a guarded branch — any
+                            # other switch-into-merge stays refused
+                            and not (loop_parent is not None
+                                     and name == loop_parent
+                                     and in_func == loop["switch"])):
                         raise TpuFlowException(
                             "Step *%s*: a step that is both a direct switch "
                             "target and a merge of other branches is not "
@@ -259,6 +378,13 @@ class ArgoWorkflows(object):
             step_opts += ['--ubf-context "$UBF"', '--split-index "$IDX"']
         if node.type in ("foreach", "split-switch", "split-parallel"):
             step_opts.append("--argo-output-dir %s" % ARGO_OUTPUT_DIR)
+            if (node.type == "split-switch"
+                    and self._loop_parent_of(node.name) is not None):
+                # the loop's switch writes iter-next = iteration + 1, which
+                # the `continue` task feeds back into the loop template
+                step_opts.append(
+                    "--argo-iteration '{{inputs.parameters.iteration}}'"
+                )
 
         step_cmd = "%s %s %s step %s %s" % (
             environment.executable(node.name),
@@ -366,6 +492,7 @@ class ArgoWorkflows(object):
             {"name": "split-path", "value": ""},
             {"name": "num-splits", "value": "[]"},
             {"name": "task-id", "value": node.name},
+            {"name": "iteration", "value": "0"},
         ]
         template = {
             "name": _argo_name(node.name),
@@ -400,6 +527,20 @@ class ArgoWorkflows(object):
                     "valueFrom": {
                         "path": "%s/next-step" % ARGO_OUTPUT_DIR,
                         "default": "",
+                    },
+                },
+                {
+                    "name": "own-task-id",
+                    "valueFrom": {
+                        "path": "%s/own-task-id" % ARGO_OUTPUT_DIR,
+                        "default": "",
+                    },
+                },
+                {
+                    "name": "iter-next",
+                    "valueFrom": {
+                        "path": "%s/iter-next" % ARGO_OUTPUT_DIR,
+                        "default": "1",
                     },
                 },
             ]}
@@ -588,7 +729,10 @@ class ArgoWorkflows(object):
 
     def _task_id_expr(self, name):
         """The datastore task id of a step, as an Argo expression valid
-        inside its own scope's DAG template."""
+        inside its own scope's DAG template. Loop members carry an
+        iteration suffix so every loop pass is its own task."""
+        if self._loop_parent_of(name) is not None:
+            return "%s-i{{inputs.parameters.iteration}}" % name
         path = self._scope_path_expr(self._foreach_parent_of(name))
         return name if not path else "%s-%s" % (name, path)
 
@@ -601,14 +745,27 @@ class ArgoWorkflows(object):
         scope = self._foreach_parent_of(node.name)
         return scope is not None and scope in node.in_funcs
 
-    def _input_paths_value(self, node):
+    def _input_paths_value(self, node, within_loop=None):
         """Input paths (run/step/task-id) for steps whose inputs live in
         the same scope. Datastore pathspecs use REAL step names; only
-        Argo template/task names are DNS-1123-restricted."""
-        return ",".join(
-            "%s/%s/%s" % (RUN_ID, in_func, self._task_id_expr(in_func))
-            for in_func in sorted(node.in_funcs)
-        )
+        Argo template/task names are DNS-1123-restricted. From OUTSIDE a
+        recursive-switch loop, an input produced by a loop member uses the
+        loop template's exported final task id; INSIDE the loop template
+        (within_loop=entry) members reference each other by their
+        iteration-suffixed ids."""
+        parts = []
+        for in_func in sorted(node.in_funcs):
+            loop_entry = self._loop_parent_of(in_func)
+            if loop_entry is not None and loop_entry != within_loop:
+                parts.append(
+                    "%s/%s/{{tasks.%s.outputs.parameters.exit-task-id}}"
+                    % (RUN_ID, in_func, self._loop_name(loop_entry))
+                )
+            else:
+                parts.append("%s/%s/%s"
+                             % (RUN_ID, in_func,
+                                self._task_id_expr(in_func)))
+        return ",".join(parts)
 
     def _foreach_body_task(self, node, path):
         """The fan-out task: one body sub-DAG per recorded split index."""
@@ -630,12 +787,55 @@ class ArgoWorkflows(object):
             ]},
         }
 
+    def _loop_invocation_task(self, entry):
+        """The parent-scope task standing in for a whole loop: invokes the
+        loop template at iteration 0 with the entry step's external
+        inputs."""
+        node = self.graph[entry]
+        outside = sorted(
+            f for f in node.in_funcs
+            if self._loop_parent_of(f) != entry
+        )
+        task = {
+            "name": self._loop_name(entry),
+            "template": self._loop_name(entry),
+            "arguments": {"parameters": [
+                {"name": "input-paths", "value": ",".join(
+                    "%s/%s/%s" % (RUN_ID, f, self._task_id_expr(f))
+                    for f in outside
+                )},
+                {"name": "iteration", "value": "0"},
+            ]},
+        }
+        if outside:
+            # a merge-entry's outside preds are alternative switch
+            # branches: exactly one ran, so OR them (&& would omit the
+            # loop when any branch was omitted)
+            joiner = " || " if self._is_switch_merge(node) else " && "
+            task["depends"] = joiner.join(
+                "%s.Succeeded" % _argo_name(f) for f in outside
+            )
+        switch_parent = self._switch_parent_of(entry)
+        if switch_parent and self._loop_parent_of(switch_parent) != entry:
+            task["when"] = (
+                "{{tasks.%s.outputs.parameters.next-step}} == %s"
+                % (_argo_name(switch_parent), entry)
+            )
+        return task
+
     def _scope_dag_tasks(self, scope):
         """DAG tasks for one scope (scope=None: the top level)."""
         path = self._scope_path_expr(scope)
         tasks = []
         for name in self.graph.sorted_nodes():
             if self._foreach_parent_of(name) != scope:
+                continue
+            if self._loop_parent_of(name) is not None:
+                # loop members live inside their loop template; the loop
+                # is represented here by one invocation task at the
+                # entry's position
+                if name == self._loop_parent_of(name):
+                    tasks.append(self._loop_invocation_task(name))
                 continue
             node = self.graph[name]
             argo = _argo_name(name)
@@ -651,7 +851,10 @@ class ArgoWorkflows(object):
             for f in node.in_funcs:
                 if f == scope:
                     continue  # body entry: inputs arrive via template params
-                if self._foreach_parent_of(f) == scope:
+                if self._loop_parent_of(f) is not None:
+                    # loop exit: depend on the loop invocation task
+                    deps.add(self._loop_name(self._loop_parent_of(f)))
+                elif self._foreach_parent_of(f) == scope:
                     deps.add(_argo_name(f))
                 else:
                     # in_func lives inside an inner foreach body: this is
@@ -718,10 +921,19 @@ class ArgoWorkflows(object):
 
             switch_parent = self._switch_parent_of(name)
             if switch_parent:
-                task["when"] = (
-                    "{{tasks.%s.outputs.parameters.next-step}} == %s"
-                    % (_argo_name(switch_parent), name)
-                )
+                loop_entry = self._loop_parent_of(switch_parent)
+                if loop_entry is not None:
+                    # loop exit: guard on the final iteration's choice,
+                    # exported through the recursion by the loop template
+                    task["when"] = (
+                        "{{tasks.%s.outputs.parameters.exit-step}} == %s"
+                        % (self._loop_name(loop_entry), name)
+                    )
+                else:
+                    task["when"] = (
+                        "{{tasks.%s.outputs.parameters.next-step}} == %s"
+                        % (_argo_name(switch_parent), name)
+                    )
             tasks.append(task)
             if node.type == "foreach":
                 tasks.append(self._foreach_body_task(node, path))
@@ -741,6 +953,105 @@ class ArgoWorkflows(object):
             for name in self.graph.sorted_nodes()
             if self.graph[name].type == "foreach"
         ]
+
+    def _loop_templates(self):
+        return [self._loop_template(entry) for entry in sorted(self._loops)]
+
+    def _loop_template(self, entry):
+        """The self-referencing DAG template for one recursive-switch loop:
+        member tasks with iteration-suffixed task ids, a `continue` task
+        re-invoking this template while the switch picks the back-edge, and
+        expression outputs exporting the FINAL iteration's chosen exit step
+        and switch task id (when `continue` ran, its exports win — that is
+        the deeper recursion's final iteration)."""
+        loop = self._loops[entry]
+        s_name = loop["switch"]
+        s_argo = _argo_name(s_name)
+        tasks = []
+        for name in self.graph.sorted_nodes():
+            if name not in loop["members"]:
+                continue
+            node = self.graph[name]
+            argo = _argo_name(name)
+            params = [
+                {"name": "task-id", "value": self._task_id_expr(name)},
+                {"name": "iteration",
+                 "value": "{{inputs.parameters.iteration}}"},
+            ]
+            if name == entry:
+                params.append({
+                    "name": "input-paths",
+                    "value": "{{inputs.parameters.input-paths}}",
+                })
+            else:
+                params.append({
+                    "name": "input-paths",
+                    "value": self._input_paths_value(node,
+                                                     within_loop=entry),
+                })
+            task = {
+                "name": argo,
+                "template": argo,
+                "arguments": {"parameters": params},
+            }
+            deps = {
+                _argo_name(f) for f in node.in_funcs
+                if f in loop["members"] and name != entry
+            }
+            joiner = " || " if self._is_switch_merge(node) else " && "
+            if deps:
+                task["depends"] = joiner.join(
+                    "%s.Succeeded" % d for d in sorted(deps))
+            switch_parent = self._switch_parent_of(name)
+            if switch_parent and name != entry:
+                task["when"] = (
+                    "{{tasks.%s.outputs.parameters.next-step}} == %s"
+                    % (_argo_name(switch_parent), name)
+                )
+            tasks.append(task)
+        tasks.append({
+            "name": "continue",
+            "template": self._loop_name(entry),
+            "depends": "%s.Succeeded" % s_argo,
+            "when": "{{tasks.%s.outputs.parameters.next-step}} == %s"
+            % (s_argo, entry),
+            "arguments": {"parameters": [
+                {"name": "input-paths",
+                 "value": "%s/%s/%s"
+                 % (RUN_ID, s_name, self._task_id_expr(s_name))},
+                {"name": "iteration",
+                 "value": "{{tasks.%s.outputs.parameters.iter-next}}"
+                 % s_argo},
+            ]},
+        })
+        # expr-lang output parameters (Argo >= 3.1 valueFrom.expression):
+        # when the continue task ran, the deeper recursion's exports are
+        # the final iteration's; otherwise THIS iteration is final.
+        recursed = "tasks['continue'].status == 'Succeeded'"
+        return {
+            "name": self._loop_name(entry),
+            "inputs": {"parameters": [
+                {"name": "input-paths"},
+                {"name": "iteration", "value": "0"},
+            ]},
+            "dag": {"tasks": tasks},
+            "outputs": {"parameters": [
+                {
+                    "name": "exit-step",
+                    "valueFrom": {"expression":
+                        "%s ? tasks['continue'].outputs.parameters"
+                        "['exit-step'] : tasks['%s'].outputs.parameters"
+                        "['next-step']" % (recursed, s_argo)},
+                },
+                {
+                    "name": "exit-task-id",
+                    "valueFrom": {"expression":
+                        "%s ? tasks['continue'].outputs.parameters"
+                        "['exit-task-id'] : tasks['%s'].outputs.parameters"
+                        "['own-task-id']" % (recursed, s_argo)},
+                },
+            ]},
+        }
 
     # ---------------- top-level objects ----------------
 
@@ -775,7 +1086,7 @@ class ArgoWorkflows(object):
                 "templates": [
                     {"name": "dag",
                      "dag": {"tasks": self._scope_dag_tasks(None)}}
-                ] + self._body_templates() + [
+                ] + self._body_templates() + self._loop_templates() + [
                     (self._gang_template(self.graph[name])
                      if self.graph[name].parallel_step
                      else self._container_template(self.graph[name]))

@@ -95,7 +95,7 @@ class ArgoSimulator(object):
         try:
             self.task_outputs = self._run_dag(
                 self.templates["dag"], inputs={}, inherited_item=None
-            )
+            )["outputs"]
         except ArgoSimError:
             self._run_on_exit("Failed")
             raise
@@ -134,7 +134,8 @@ class ArgoSimulator(object):
                     self._run_task(task, outputs, inputs, inherited_item)
                     succeeded.add(task["name"])
                 del pending[task["name"]]
-        return outputs
+        return {"outputs": outputs, "succeeded": succeeded,
+                "not_run": not_run}
 
     def _run_on_exit(self, status):
         """The controller runs spec.onExit after the workflow finishes,
@@ -215,7 +216,15 @@ class ArgoSimulator(object):
         params.update(args)
 
         if "dag" in template:
-            self._run_dag(template, params, inherited_item=eff_item)
+            result = self._run_dag(template, params, inherited_item=eff_item)
+            if item is None:
+                # nested-DAG output parameters (recursive-switch loop
+                # templates export their final iteration's choice);
+                # withParam fan-outs would need Argo's aggregation — not
+                # modeled, so their outputs stay unrecorded
+                outs = self._dag_template_outputs(template, result, params)
+                if outs:
+                    outputs[task["name"]] = outs
             return
 
         pod_scope = {"retries": "0", "pod.name": "sim-pod"}
@@ -271,6 +280,71 @@ class ArgoSimulator(object):
                 )
         if record:
             outputs[task["name"]] = outs
+
+    # ---------------- nested-DAG outputs & expressions ----------------
+
+    _STATUS_RE = re.compile(r"^tasks\['([^']+)'\]\.status$")
+    _TASK_OUT_RE = re.compile(
+        r"^tasks\['([^']+)'\]\.outputs\.parameters\['([^']+)'\]$")
+    _INPUT_RE = re.compile(r"^inputs\.parameters\.([\w.-]+)$")
+
+    def _dag_template_outputs(self, template, result, inputs):
+        outs = {}
+        for p in template.get("outputs", {}).get("parameters", []):
+            vf = p.get("valueFrom", {})
+            if "parameter" in vf:
+                outs[p["name"]] = self._subst(
+                    vf["parameter"],
+                    [self._dag_scope(result["outputs"], inputs)],
+                )
+            elif "expression" in vf:
+                outs[p["name"]] = self._eval_expr(
+                    vf["expression"], result, inputs)
+            else:
+                raise ArgoSimError(
+                    "DAG output parameter %s needs valueFrom.parameter or "
+                    ".expression" % p.get("name"))
+        return outs
+
+    def _eval_expr(self, expr, result, inputs):
+        """Restricted expr-lang evaluator: one ternary whose condition
+        compares a task status, with task-output / input / quoted-literal
+        atoms. Branches evaluate LAZILY (the unchosen branch may reference
+        outputs of a task that never ran), matching Argo."""
+        expr = expr.strip()
+        if "?" in expr:
+            cond, _, rest = expr.partition("?")
+            yes, _, no = rest.partition(":")
+            op = "!=" if "!=" in cond else "=="
+            left, _, right = cond.partition(op)
+            equal = (self._eval_expr(left, result, inputs)
+                     == self._eval_expr(right, result, inputs))
+            chosen = yes if (equal if op == "==" else not equal) else no
+            return self._eval_expr(chosen, result, inputs)
+        if expr.startswith("'") and expr.endswith("'"):
+            return expr[1:-1]
+        m = self._STATUS_RE.match(expr)
+        if m:
+            name = m.group(1)
+            if name in result["succeeded"]:
+                return "Succeeded"
+            if name in result["not_run"]:
+                return "Skipped"
+            return "Pending"
+        m = self._TASK_OUT_RE.match(expr)
+        if m:
+            try:
+                return result["outputs"][m.group(1)][m.group(2)]
+            except KeyError:
+                raise ArgoSimError(
+                    "Expression references missing output %s" % expr)
+        m = self._INPUT_RE.match(expr)
+        if m:
+            if m.group(1) not in inputs:
+                raise ArgoSimError(
+                    "Expression references missing input %s" % expr)
+            return inputs[m.group(1)]
+        raise ArgoSimError("Unsupported expression atom %r" % expr)
 
     # ---------------- resource templates (gang JobSets) ----------------
 

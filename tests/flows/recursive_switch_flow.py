@@ -1,0 +1,42 @@
+from metaflow_tpu import FlowSpec, Parameter, step
+
+
+class RecursiveSwitchFlow(FlowSpec):
+    """A while-loop via recursive switch whose back-edge targets an
+    UPSTREAM step (not the switch itself): work → check → work … until the
+    counter reaches the limit. On Argo this compiles to a self-referencing
+    loop template (plugins/argo/argo_workflows.py _loop_template)."""
+
+    limit = Parameter("limit", default=3, type=int)
+
+    @step
+    def start(self):
+        self.counter = 0
+        self.trace = []
+        self.next(self.work)
+
+    @step
+    def work(self):
+        self.counter += 1
+        self.trace = self.trace + ["work-%d" % self.counter]
+        self.next(self.check)
+
+    @step
+    def check(self):
+        self.verdict = "again" if self.counter < self.limit else "stop"
+        self.next({"again": self.work, "stop": self.done},
+                  condition="verdict")
+
+    @step
+    def done(self):
+        self.summary = "%d iterations" % self.counter
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.counter == self.limit, self.counter
+        print("trace:", self.trace)
+
+
+if __name__ == "__main__":
+    RecursiveSwitchFlow()

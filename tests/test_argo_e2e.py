@@ -351,13 +351,142 @@ class TestArgoCompileValidation:
         assert proc.returncode != 0
         assert "gang nested" in (proc.stderr + proc.stdout).lower()
 
-    def test_recursive_switch_refused(self, tpuflow_root):
+    def test_loop_with_foreach_member_refused(self, tpuflow_root, tmp_path):
+        flow_file = tmp_path / "foreach_in_loop.py"
+        flow_file.write_text(
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class ForeachInLoopFlow(FlowSpec):\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        self.n = 0\n"
+            "        self.next(self.fan)\n"
+            "    @step\n"
+            "    def fan(self):\n"
+            "        self.items = [1, 2]\n"
+            "        self.next(self.body, foreach='items')\n"
+            "    @step\n"
+            "    def body(self):\n"
+            "        self.next(self.collect)\n"
+            "    @step\n"
+            "    def collect(self, inputs):\n"
+            "        self.merge_artifacts(inputs, include=['n'])\n"
+            "        self.next(self.check)\n"
+            "    @step\n"
+            "    def check(self):\n"
+            "        self.n += 1\n"
+            "        self.verdict = 'go' if self.n < 2 else 'stop'\n"
+            "        self.next({'go': self.fan, 'stop': self.end},\n"
+            "                  condition='verdict')\n"
+            "    @step\n"
+            "    def end(self):\n"
+            "        pass\n"
+            "if __name__ == '__main__':\n"
+            "    ForeachInLoopFlow()\n"
+        )
         proc = subprocess.run(
-            [sys.executable, os.path.join(FLOWS, "switch_flow.py"),
+            [sys.executable, str(flow_file),
              "--datastore", "local", "--datastore-root", tpuflow_root,
              "argo-workflows", "create"],
             env=_pod_env(tpuflow_root), capture_output=True, text=True,
             timeout=120,
         )
         assert proc.returncode != 0
-        assert "recursive" in (proc.stderr + proc.stdout).lower()
+        assert "recursive-switch loop" in (proc.stderr + proc.stdout)
+
+    def test_two_switches_same_entry_refused(self, tpuflow_root, tmp_path):
+        flow_file = tmp_path / "double_back_edge.py"
+        flow_file.write_text(
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class DoubleBackEdgeFlow(FlowSpec):\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        self.n = 0\n"
+            "        self.next(self.a)\n"
+            "    @step\n"
+            "    def a(self):\n"
+            "        self.n += 1\n"
+            "        self.next(self.s1)\n"
+            "    @step\n"
+            "    def s1(self):\n"
+            "        self.v1 = 'back' if self.n % 2 else 'fwd'\n"
+            "        self.next({'back': self.a, 'fwd': self.c},\n"
+            "                  condition='v1')\n"
+            "    @step\n"
+            "    def c(self):\n"
+            "        self.next(self.s2)\n"
+            "    @step\n"
+            "    def s2(self):\n"
+            "        self.v2 = 'back' if self.n < 4 else 'stop'\n"
+            "        self.next({'back': self.a, 'stop': self.end},\n"
+            "                  condition='v2')\n"
+            "    @step\n"
+            "    def end(self):\n"
+            "        pass\n"
+            "if __name__ == '__main__':\n"
+            "    DoubleBackEdgeFlow()\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, str(flow_file),
+             "--datastore", "local", "--datastore-root", tpuflow_root,
+             "argo-workflows", "create"],
+            env=_pod_env(tpuflow_root), capture_output=True, text=True,
+            timeout=120,
+        )
+        assert proc.returncode != 0
+        # the doubled cycle makes every switch see both in-cycle targets,
+        # so the per-switch back-edge check fires first; the same-entry
+        # check in _compute_loops backstops any ordering where it doesn't
+        out = proc.stderr + proc.stdout
+        assert "back-edges" in out or "same entry" in out
+
+
+class TestArgoRecursiveSwitch:
+    """Recursive switch compiles to a self-referencing loop template
+    (VERDICT r3 missing #2; reference shape: compile-to-template-loops,
+    metaflow/plugins/argo/argo_workflows.py:1029-1231)."""
+
+    def test_back_edge_loop_iterates_and_exits(self, tpuflow_root, tmp_path,
+                                               client):
+        sim = _simulate("recursive_switch_flow.py", tpuflow_root, tmp_path,
+                        "wf-rec")
+        ran = [n for n, _ in sim.pods_run]
+        # 3 iterations of work+check, then the exit chain
+        assert ran.count("work") == 3 and ran.count("check") == 3
+        assert ran.index("done") > ran.index("check")
+
+        run = client("RecursiveSwitchFlow")["argo-wf-rec"]
+        assert run.successful
+        assert run.data.summary == "3 iterations"
+        assert run.data.trace == ["work-1", "work-2", "work-3"]
+        # the client sees every iteration as its own task with a
+        # deterministic iteration-suffixed id
+        work_ids = sorted(t.id for t in run["work"])
+        assert work_ids == ["work-i0", "work-i1", "work-i2"]
+        check_ids = sorted(t.id for t in run["check"])
+        assert check_ids == ["check-i0", "check-i1", "check-i2"]
+
+    def test_single_iteration_loop(self, tpuflow_root, tmp_path, client):
+        # limit=1: the switch exits on the first pass (the continue task
+        # is skipped at depth 0 and the exports still resolve)
+        _simulate("recursive_switch_flow.py", tpuflow_root, tmp_path,
+                  "wf-rec1", "--limit", "1")
+        run = client("RecursiveSwitchFlow")["argo-wf-rec1"]
+        assert run.successful
+        assert run.data.summary == "1 iterations"
+        assert [t.id for t in run["work"]] == ["work-i0"]
+
+    def test_self_loop_with_merge_entry(self, tpuflow_root, tmp_path,
+                                        client):
+        # switch_flow.py: a switch chooses fast/slow, both merge into a
+        # SELF-looping improve step (entry == switch) that iterates 3x
+        sim = _simulate("switch_flow.py", tpuflow_root, tmp_path, "wf-self",
+                        "--mode", "slow")
+        ran = [n for n, _ in sim.pods_run]
+        assert ran.count("improve") == 3
+        assert "fast-path" not in ran
+
+        run = client("SwitchFlow")["argo-wf-self"]
+        assert run.successful
+        assert run.data.rounds == 3
+        assert sorted(t.id for t in run["improve"]) == [
+            "improve-i0", "improve-i1", "improve-i2"]

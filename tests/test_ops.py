@@ -152,42 +152,135 @@ class TestRingFlashAttention:
                                        rtol=1e-3)
 
 
+def _moe_weights(B=2, S=16, E=32, F=64, N=4, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    x = jax.random.normal(ks[0], (B, S, E))
+    router = jax.random.normal(ks[1], (E, N)) * 0.5
+    wg = jax.random.normal(ks[2], (N, E, F)) * 0.05
+    wu = jax.random.normal(ks[3], (N, E, F)) * 0.05
+    wd = jax.random.normal(ks[4], (N, F, E)) * 0.05
+    return x, router, wg, wu, wd
+
+
 class TestMoE:
     def test_output_shape_and_balance(self):
-        B, S, E, F, N = 2, 16, 32, 64, 4
-        ks = jax.random.split(jax.random.PRNGKey(0), 5)
-        x = jax.random.normal(ks[0], (B, S, E))
-        router = jax.random.normal(ks[1], (E, N)) * 0.02
-        wg = jax.random.normal(ks[2], (N, E, F)) * 0.05
-        wu = jax.random.normal(ks[3], (N, E, F)) * 0.05
-        wd = jax.random.normal(ks[4], (N, F, E)) * 0.05
+        x, router, wg, wu, wd = _moe_weights()
         out, aux = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2)
-        assert out.shape == (B, S, E)
+        assert out.shape == x.shape
         assert float(aux) > 0
 
-    def test_expert_sharded_run(self):
-        mesh = create_mesh(MeshSpec.moe(expert=4))
+    def test_sparse_equals_dense_lossless(self):
+        """capacity_factor=None → zero drops → the sparse path must match
+        the dense oracle exactly (same matmuls, different layout)."""
+        x, router, wg, wu, wd = _moe_weights()
+        sparse, aux_s = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                                dispatch="sparse")
+        dense, aux_d = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                               dispatch="dense")
+        np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux_s), float(aux_d), rtol=1e-6)
+
+    def test_sparse_dense_drop_parity_at_binding_capacity(self):
+        """With a binding capacity factor both paths must drop the SAME
+        tokens (per-expert arrival order is token order in both)."""
+        x, router, wg, wu, wd = _moe_weights(B=2, S=32, seed=3)
+        for cf in (0.5, 1.0, 1.5):
+            sparse, _ = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                                capacity_factor=cf, dispatch="sparse")
+            dense, _ = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                               capacity_factor=cf, dispatch="dense")
+            np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
+                                       atol=1e-5, rtol=1e-5)
+            # the binding capacity must actually drop something at cf=0.5
+            if cf == 0.5:
+                lossless, _ = moe_ffn(x, router, wg, wu, wd,
+                                      num_experts_per_tok=2,
+                                      dispatch="sparse")
+                assert not np.allclose(np.asarray(sparse),
+                                       np.asarray(lossless))
+
+    def test_sparse_grads_flow(self):
+        x, router, wg, wu, wd = _moe_weights()
+
+        def loss(router, wg, wu, wd):
+            out, aux = moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                               capacity_factor=1.25, dispatch="sparse")
+            return jnp.mean(out ** 2) + 0.01 * aux
+
+        grads = jax.grad(loss, argnums=(0, 1, 2, 3))(router, wg, wu, wd)
+        for g in grads:
+            assert np.isfinite(np.asarray(g)).all()
+            assert float(jnp.abs(g).max()) > 0
+
+    def test_dispatch_flops_scale_with_k_not_num_experts(self):
+        """The VERDICT-required cost assertion: at fixed k and capacity
+        factor, doubling num_experts must NOT double sparse-dispatch FLOPs
+        (capacity shrinks with 1/N so total expert work is constant), while
+        the dense oracle's FLOPs do scale with num_experts."""
+
+        def flops(dispatch, N):
+            x, router, wg, wu, wd = _moe_weights(B=2, S=64, N=N)
+            fn = jax.jit(lambda *a: moe_ffn(
+                *a, num_experts_per_tok=2, capacity_factor=1.0,
+                dispatch=dispatch)[0])
+            cost = fn.lower(x, router, wg, wu, wd).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return float(cost["flops"])
+
+        sparse_4, sparse_8 = flops("sparse", 4), flops("sparse", 8)
+        dense_4, dense_8 = flops("dense", 4), flops("dense", 8)
+        assert sparse_8 < 1.4 * sparse_4, (sparse_4, sparse_8)
+        assert dense_8 > 1.7 * dense_4, (dense_4, dense_8)
+        # and at 8 experts the sparse path is far cheaper than dense
+        assert sparse_8 < 0.5 * dense_8, (sparse_8, dense_8)
+
+    def _sharded_setup(self, N=8, B=2, S=32):
         from metaflow_tpu.spmd import rules_for_mesh, spec_for
         from jax.sharding import NamedSharding
 
-        B, S, E, F, N = 2, 16, 32, 64, 4
-        ks = jax.random.split(jax.random.PRNGKey(0), 5)
-        x = jax.random.normal(ks[0], (B, S, E))
-        router = jax.random.normal(ks[1], (E, N)) * 0.02
+        mesh = create_mesh(MeshSpec.moe(expert=8))
+        x, router, wg, wu, wd = _moe_weights(B=B, S=S, N=N, seed=5)
         rules = rules_for_mesh(mesh)
         exp_sh = NamedSharding(mesh, spec_for(("expert", "embed", "mlp"),
                                               rules))
-        wg = jax.device_put(jax.random.normal(ks[2], (N, E, F)) * 0.05, exp_sh)
-        wu = jax.device_put(jax.random.normal(ks[3], (N, E, F)) * 0.05, exp_sh)
-        wd = jax.device_put(
-            jax.random.normal(ks[4], (N, F, E)) * 0.05,
-            NamedSharding(mesh, spec_for(("expert", "mlp", "embed"), rules)),
+        wg_s = jax.device_put(wg, exp_sh)
+        wu_s = jax.device_put(wu, exp_sh)
+        wd_s = jax.device_put(
+            wd, NamedSharding(mesh, spec_for(("expert", "mlp", "embed"),
+                                             rules)),
         )
+        return mesh, (x, router, wg, wu, wd), (x, router, wg_s, wu_s, wd_s)
+
+    def test_expert_sharded_run(self):
+        mesh, _plain, sharded = self._sharded_setup()
         with mesh:
             out, aux = jax.jit(
-                lambda *a: moe_ffn(*a, num_experts_per_tok=2)
-            )(x, router, wg, wu, wd)
-        assert out.shape == (B, S, E)
+                lambda *a: moe_ffn(*a, num_experts_per_tok=2,
+                                   capacity_factor=1.25)
+            )(*sharded)
+        assert out.shape == sharded[0].shape
+
+    def test_expert_sharded_drop_parity(self):
+        """VERDICT r3 weak #8: token-drop decisions at a BINDING capacity
+        factor must be identical between unsharded and expert-sharded
+        execution — the cumsum over the token axis is a global dependency
+        that GSPMD must not re-order."""
+        mesh, plain, sharded = self._sharded_setup()
+        ref, aux_ref = moe_ffn(*plain, num_experts_per_tok=2,
+                               capacity_factor=0.75)
+        with mesh:
+            out, aux = jax.jit(
+                lambda *a: moe_ffn(*a, num_experts_per_tok=2,
+                                   capacity_factor=0.75)
+            )(*sharded)
+        # capacity must be binding for this to test anything
+        lossless, _ = moe_ffn(*plain, num_experts_per_tok=2)
+        assert not np.allclose(np.asarray(ref), np.asarray(lossless))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
 
 
 class TestRopeNorms:
