@@ -433,5 +433,14 @@ if __name__ == "__main__":
                 _submetric(bench_step_launch),
                 _submetric(bench_data_path),
             ]
+            if result.get("degraded"):
+                # the degraded train line itself never reaches history
+                # (_append_history drops it), but the launch/data numbers
+                # are chip-independent and stay valid — persist them
+                # standalone, tagged so they don't mingle with the
+                # standalone-mode populations of the same metric
+                for sub in result["submetrics"]:
+                    if "error" not in sub:
+                        _append_history(dict(sub, context="in_driver"))
     _append_history(result)
     print(json.dumps(result))

@@ -1,4 +1,5 @@
-from .mesh import MeshSpec, create_mesh, batch_sharding, data_axes
+from .mesh import (MeshSpec, batch_sharding, create_hybrid_mesh,
+                   create_mesh, data_axes)
 from .sharding import (
     rules_for_mesh,
     spec_for,
@@ -16,6 +17,7 @@ from .distributed import (
 __all__ = [
     "MeshSpec",
     "create_mesh",
+    "create_hybrid_mesh",
     "batch_sharding",
     "data_axes",
     "rules_for_mesh",

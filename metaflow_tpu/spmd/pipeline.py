@@ -31,12 +31,29 @@ def _as_varying(z, axis_name):
     return jax.lax.pcast(z, (axis_name,), to="varying")
 
 
-def _shard_map(fn, mesh, in_specs, out_specs):
+def _shard_map(fn, mesh, in_specs, out_specs, manual_axes=None):
+    """shard_map manual ONLY over `manual_axes` (default: every mesh axis).
+
+    On a composed mesh (e.g. pipeline × fsdp) the schedule stays manual
+    over 'pipeline' while the remaining axes are left to GSPMD — the
+    body's arrays stay global over those axes, so an outer batch sharding
+    (fsdp/data) or ZeRO param sharding composes with the pipeline without
+    the schedule code knowing about it."""
+    kwargs = {}
+    partial = (manual_axes is not None
+               and set(manual_axes) != set(mesh.axis_names))
     try:
         from jax import shard_map
-    except ImportError:  # older jax
+
+        if partial:
+            kwargs["axis_names"] = frozenset(manual_axes)
+    except ImportError:  # older jax spells partial-manual mode `auto=`
         from jax.experimental.shard_map import shard_map
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+        if partial:
+            kwargs["auto"] = frozenset(mesh.axis_names) - set(manual_axes)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kwargs)
 
 
 def pipeline_apply(layer_fn, stage_params, x, mesh, num_microbatches,
@@ -121,6 +138,7 @@ def pipeline_apply(layer_fn, stage_params, x, mesh, num_microbatches,
         local, mesh,
         in_specs=(P(), param_specs),
         out_specs=P(),
+        manual_axes=(axis_name,),
     )
     return fn(x, stage_params)
 
@@ -302,6 +320,7 @@ def pipeline_train_1f1b(layer_fn, loss_fn, stage_params, x, y, mesh,
         local, mesh,
         in_specs=(P(), P(), param_specs),
         out_specs=(P(), param_specs),
+        manual_axes=(axis_name,),
     )
     return fn(x, y, stage_params)
 
@@ -774,6 +793,7 @@ def pipeline_train_interleaved(layer_fn, loss_fn, stage_params, x, y, mesh,
         in_specs=(P(), P(), param_specs,
                   jax.tree.map(lambda _: P(), head_params)),
         out_specs=out_specs,
+        manual_axes=(axis_name,),
     )
     params_re = jax.tree.map(lambda p: p[perm], stage_params)
     results = fn(x, y, params_re, head_params)

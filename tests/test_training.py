@@ -211,3 +211,90 @@ class TestPipelineLlama:
                 np.asarray(got), np.asarray(want), atol=5e-4, rtol=5e-3,
                 err_msg=str(path),
             )
+
+
+class TestComposedMeshes:
+    """Composed-axis meshes (VERDICT r3 #3/#5): the strategies must
+    compose in ONE mesh, not just work alone — a v5p-64 config uses
+    pipeline x fsdp or fsdp x sequence x tensor, and multi-slice runs put
+    'data' on DCN with the model axes inside a slice."""
+
+    def test_hybrid_dcn_mesh_matches_single_mesh(self, tiny_cfg):
+        from metaflow_tpu.spmd import create_hybrid_mesh
+        from metaflow_tpu.training import make_trainer
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
+                                    tiny_cfg.vocab_size)
+
+        def run(mesh):
+            state, step_fn, _ = make_trainer(
+                jax.random.PRNGKey(0), tiny_cfg, mesh, llama)
+            batch = shard_batch({"tokens": tokens}, mesh)
+            with mesh:
+                state, m = step_fn(state, batch)
+            return float(m["loss"])
+
+        ref = run(create_mesh(MeshSpec.fsdp_tp(2)))
+        hybrid = create_hybrid_mesh(MeshSpec.fsdp_tp(2), dcn_axis="data",
+                                    num_slices=2)
+        assert tuple(hybrid.axis_names) == ("data", "fsdp", "tensor")
+        assert abs(run(hybrid) - ref) < 2e-3
+
+    def test_pipeline_composes_with_fsdp_batch_sharding(self):
+        import dataclasses
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from metaflow_tpu.training.pipeline_trainer import (
+            pipeline_loss_and_grads,
+        )
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(), n_layers=4,
+                                  dtype="float32")
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                    cfg.vocab_size)
+
+        pp = create_mesh(MeshSpec({"pipeline": 2}), n_devices=2)
+        p_pp = dict(params, layers=jax.device_put(
+            params["layers"], NamedSharding(pp, P("pipeline"))))
+        ref_l, ref_g = pipeline_loss_and_grads(
+            p_pp, tokens, cfg, pp, num_microbatches=4)
+
+        pf = create_mesh(MeshSpec({"pipeline": 2, "fsdp": 4}))
+        p_pf = dict(params, layers=jax.device_put(
+            params["layers"], NamedSharding(pf, P("pipeline"))))
+        t_pf = jax.device_put(tokens, NamedSharding(pf, P("fsdp")))
+        pf_l, pf_g = pipeline_loss_and_grads(
+            p_pf, t_pf, cfg, pf, num_microbatches=4)
+
+        np.testing.assert_allclose(float(pf_l), float(ref_l), atol=1e-5,
+                                   rtol=1e-5)
+        flat_ref = jax.tree.leaves_with_path(ref_g)
+        flat_got = dict(jax.tree.leaves_with_path(pf_g))
+        for path, want in flat_ref:
+            np.testing.assert_allclose(
+                np.asarray(flat_got[path]), np.asarray(want), atol=5e-4,
+                rtol=5e-3, err_msg=str(path),
+            )
+
+    def test_ring_attention_composes_with_fsdp_tp(self, tiny_cfg):
+        import dataclasses
+
+        from metaflow_tpu.training import make_trainer
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
+                                    tiny_cfg.vocab_size)
+
+        def run(cfg, spec, n=None):
+            mesh = create_mesh(spec, n_devices=n)
+            state, step_fn, _ = make_trainer(
+                jax.random.PRNGKey(0), cfg, mesh, llama)
+            batch = shard_batch({"tokens": tokens}, mesh)
+            with mesh:
+                state, m = step_fn(state, batch)
+            return float(m["loss"])
+
+        ref = run(tiny_cfg, MeshSpec({"fsdp": 4}), n=4)
+        got = run(dataclasses.replace(tiny_cfg, attention_impl="ring"),
+                  MeshSpec({"fsdp": 2, "sequence": 2, "tensor": 2}))
+        assert abs(got - ref) < 5e-3, (got, ref)
