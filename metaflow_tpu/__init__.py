@@ -11,7 +11,7 @@ Public API (mirrors the reference's `from metaflow import ...` surface):
 
 from .flowspec import FlowSpec, step
 from .parameters import Parameter, JSONType
-from .includefile import IncludeFile
+from .includefile import IncludedFile, IncludeFile
 from .config_system import Config, ConfigValue, FlowMutator
 from .current import current
 from .exception import TpuFlowException, MetaflowException
@@ -83,6 +83,7 @@ __all__ = [
     "Parameter",
     "JSONType",
     "IncludeFile",
+    "IncludedFile",
     "Config",
     "ConfigValue",
     "FlowMutator",

@@ -301,9 +301,27 @@ def make_cli(flow, state):
                 run_id=origin, steps=["start"]
             )
             if origin_start:
+                from .includefile import IncludedFile
+
+                include_params = {
+                    name for name, p in flow._get_parameters()
+                    if getattr(p, "IS_INCLUDE_FILE", False)
+                }
                 ds = origin_start[0]
                 for name in ds.get("_parameter_names") or []:
-                    params[name] = ds[name]
+                    value = ds[name]
+                    if isinstance(value, IncludedFile):
+                        # replay the DESCRIPTOR (JSON-safe): the start
+                        # task resolves it without touching the original
+                        # path or re-uploading the content
+                        value = value.descriptor
+                    elif name in include_params and isinstance(
+                            value, (str, bytes)) and value is not None:
+                        # pre-descriptor runs stored the CONTENT itself;
+                        # provenance (an IncludeFile param's artifact)
+                        # makes this unambiguous — wrap explicitly
+                        value = IncludedFile.legacy_inline_descriptor(value)
+                    params[name] = value
         except Exception:
             pass
         runtime = NativeRuntime(

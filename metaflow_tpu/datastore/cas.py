@@ -22,6 +22,28 @@ class BlobCache(object):
         pass
 
 
+class _TaggedFileReader(object):
+    """File-like that serves a pack-format tag byte, then the file —
+    lets save_bytes stream a tagged blob without materializing it."""
+
+    def __init__(self, path, tag):
+        self._path = path
+        self._tag = tag
+        self._file = None
+
+    def read(self, n=-1):
+        if self._file is None:
+            self._file = open(self._path, "rb")
+            if n is None or n < 0:
+                return self._tag + self._file.read()
+            return self._tag + self._file.read(max(0, n - len(self._tag)))
+        return self._file.read(n)
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+
+
 class ContentAddressedStore(object):
     # pack formats: first byte of the stored object selects the decoder
     FMT_RAW = b"0"      # raw bytes
@@ -91,6 +113,56 @@ class ContentAddressedStore(object):
         self._storage.save_bytes(iter(to_save), overwrite=False,
                                  len_hint=len(to_save))
         return results
+
+    CHUNK = 1 << 20
+
+    def save_file(self, path):
+        """Stream one FILE into the store at bounded RSS: chunked SHA-256,
+        then a tag-prefixed reader handed to the storage backend (local
+        storage copies it file-to-file; GCS spools through a temp file
+        into the pread-based put_file path). Stored FMT_RAW — include
+        payloads are arbitrary user data, often incompressible, and raw
+        keeps the download side streamable too. Returns (uri, key)."""
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(self.CHUNK), b""):
+                h.update(chunk)
+        sha = h.hexdigest()
+        storage_path = self._path(sha)
+        self._storage.save_bytes(
+            iter([(storage_path, _TaggedFileReader(path, self.FMT_RAW))]),
+            overwrite=False, len_hint=1,
+        )
+        return self._storage.full_uri(storage_path), sha
+
+    def open_blob_stream(self, key):
+        """Context manager yielding a binary file object positioned at the
+        blob's payload (pack tag consumed, gzip transparently wrapped) —
+        the bounded-RSS read path for large raw blobs."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def opened():
+            with self._storage.load_bytes([self._path(key)]) as loaded:
+                for _path, local, _meta in loaded:
+                    if local is None:
+                        raise KeyError(
+                            "Content-addressed blob %s not found in "
+                            "datastore" % key
+                        )
+                    with open(local, "rb") as f:
+                        fmt = f.read(1)
+                        if fmt == self.FMT_RAW:
+                            yield f
+                        elif fmt == self.FMT_GZIP:
+                            yield gzip.GzipFile(fileobj=f, mode="rb")
+                        else:
+                            # no tag byte: whole object is the payload
+                            f.seek(0)
+                            yield f
+                    return
+
+        return opened()
 
     def load_blobs(self, keys, force_raw=False, missing_ok=False):
         """Yield (key, bytes) for each key (order not guaranteed).

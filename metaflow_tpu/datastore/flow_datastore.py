@@ -235,7 +235,12 @@ class FlowDataStore(object):
             now = time.time()
             changed = False
             for key in keys:
-                if key not in registry:
+                # refresh the timestamp on EVERY registration, including
+                # dedup hits: gc's mark phase keeps keys newer than the
+                # oldest kept run, so a payload re-included by a recent
+                # run must carry that run's timestamp, not its first
+                # upload's
+                if registry.get(key, 0) < now:
                     registry[key] = now
                     changed = True
             if changed:
@@ -258,6 +263,18 @@ class FlowDataStore(object):
                     {k: ts for k, ts in registry.items() if ts >= older_than}
                 )
             return dropped
+
+    def save_file(self, path):
+        """Stream a file into the CAS at bounded RSS (IncludeFile upload
+        path); registers the key for gc. Returns (uri, key)."""
+        uri, key = self.ca_store.save_file(path)
+        self._register_data_keys([key])
+        return uri, key
+
+    def open_data_stream(self, key):
+        """Context manager yielding a readable binary stream over a raw
+        data blob (IncludeFile download path, bounded RSS)."""
+        return self.ca_store.open_blob_stream(key)
 
     def load_data(self, keys):
         return {k: blob for k, blob in self.ca_store.load_blobs(keys, force_raw=True)}

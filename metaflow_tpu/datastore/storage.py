@@ -268,7 +268,19 @@ class GCSStorage(DataStoreStorage):
                 if isinstance(name, str) and os.path.isfile(name):
                     self.client.put_file(self._bucket_name, key, name)
                     return
-                byte_obj = byte_obj.read()
+                # unnamed reader (e.g. the CAS's tagged file stream):
+                # spool through a temp file at bounded memory, then the
+                # same pread-based upload
+                import tempfile
+
+                with tempfile.NamedTemporaryFile(delete=False) as tmp:
+                    shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
+                    tmpname = tmp.name
+                try:
+                    self.client.put_file(self._bucket_name, key, tmpname)
+                finally:
+                    os.unlink(tmpname)
+                return
             self.client.put_bytes(self._bucket_name, key, byte_obj)
 
         items = list(path_and_bytes_iter)

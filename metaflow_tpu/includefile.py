@@ -1,15 +1,138 @@
-"""IncludeFile: a file-as-parameter, stored once in the datastore.
+"""IncludeFile: a file-as-parameter, stored once in the flow datastore.
 
-Reference behavior: metaflow/includefile.py (IncludeFile:234) — the file
-given on the CLI is read at the start task and persisted as an artifact (the
-CAS dedups repeat uploads), so every downstream task and the client API see
-the content without touching the original path.
+Reference behavior: metaflow/includefile.py (IncludeFile:234) with the
+versioned uploader protocol (UploaderV1:386, UploaderV2:478). Design here:
+
+  - the parameter ARTIFACT is a small versioned DESCRIPTOR
+    ({"type": "tpuflow-include/v1", "key": <sha>, ...}), never the file
+    content — persisting a run never re-serializes the payload, and the
+    content-vs-path question is answered by an explicit type marker, not
+    a heuristic;
+  - upload streams the file into the content-addressed store in 1 MiB
+    chunks (chunked SHA-256 + file-to-file copy / GCS put_file), so a
+    multi-GB include runs at bounded RSS; repeat uploads dedup by hash;
+  - reads are lazy: user code gets an `IncludedFile` handle with
+    `.text` / `.blob` (load into memory) and `.stream()` / `.save_to()`
+    (bounded RSS) accessors;
+  - resume and event-triggered runs replay the DESCRIPTOR, so the
+    original path never needs to exist again and the content is not
+    re-uploaded.
 """
 
 import os
 
 from .exception import TpuFlowException
 from .parameters import Parameter
+
+# refuse absurd includes before reading anything: artifacts are the
+# inter-task data channel, not a bulk-data path (use the datastore or
+# gsop directly for datasets)
+MAX_SIZE_MB_ENV = "TPUFLOW_INCLUDEFILE_MAX_MB"
+DEFAULT_MAX_SIZE_MB = 10 * 1024
+
+
+class IncludedFile(object):
+    """Lazy handle to a file stored once in the flow's datastore.
+
+    Pickles (and JSON-encodes, via `.descriptor`) as the small descriptor;
+    content loads only when an accessor is called."""
+
+    TYPE = "tpuflow-include/v1"
+    # pre-descriptor runs stored the file CONTENT as the parameter
+    # artifact; resume wraps those in this marker (by PROVENANCE — the
+    # value came from an IncludeFile parameter's artifact — never by
+    # sniffing the string)
+    INLINE_TYPE = "tpuflow-include-inline/v1"
+
+    @classmethod
+    def legacy_inline_descriptor(cls, value):
+        """Wrap a legacy content-artifact (str/bytes) for replay."""
+        import base64
+
+        if isinstance(value, bytes):
+            return {"type": cls.INLINE_TYPE, "b64": True,
+                    "content": base64.b64encode(value).decode("ascii")}
+        return {"type": cls.INLINE_TYPE, "b64": False, "content": value}
+
+    def __init__(self, descriptor):
+        self._d = dict(descriptor)
+
+    # ---- identity ----
+
+    @property
+    def descriptor(self):
+        return dict(self._d)
+
+    @property
+    def key(self):
+        return self._d["key"]
+
+    @property
+    def size(self):
+        return int(self._d.get("size") or 0)
+
+    @property
+    def is_text(self):
+        return bool(self._d.get("is_text", True))
+
+    @property
+    def encoding(self):
+        return self._d.get("encoding") or "utf-8"
+
+    def __reduce__(self):
+        return (IncludedFile, (self._d,))
+
+    def __repr__(self):
+        return "IncludedFile(key=%s, size=%d, %s)" % (
+            self.key[:12], self.size,
+            "text" if self.is_text else "binary",
+        )
+
+    # NOTE: deliberately no __len__ — an included EMPTY file must still be
+    # truthy so `if self.param:` distinguishes "provided empty file" from
+    # "parameter absent"; use .size for the byte count.
+
+    # ---- content access ----
+
+    def _datastore(self):
+        from .datastore import STORAGE_BACKENDS, FlowDataStore
+
+        ds_type = self._d.get("ds_type", "local")
+        backend = STORAGE_BACKENDS.get(ds_type)
+        if backend is None:
+            raise TpuFlowException(
+                "IncludedFile stored in unknown datastore type %r" % ds_type
+            )
+        return FlowDataStore(
+            self._d["flow_name"], backend, ds_root=self._d.get("ds_root")
+        )
+
+    def stream(self, chunk_size=1 << 20, flow_datastore=None):
+        """Yield the content in chunks at bounded RSS."""
+        fds = flow_datastore or self._datastore()
+        with fds.open_data_stream(self.key) as f:
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    return
+                yield chunk
+
+    def save_to(self, path, flow_datastore=None):
+        """Download the content to `path` at bounded RSS."""
+        with open(path, "wb") as out:
+            for chunk in self.stream(flow_datastore=flow_datastore):
+                out.write(chunk)
+        return path
+
+    @property
+    def blob(self):
+        """The raw bytes (loads the whole payload into memory)."""
+        return b"".join(self.stream())
+
+    @property
+    def text(self):
+        """The decoded text (loads the whole payload into memory)."""
+        return self.blob.decode(self.encoding)
 
 
 class IncludeFile(Parameter):
@@ -22,23 +145,79 @@ class IncludeFile(Parameter):
         self.encoding = encoding
 
     def convert(self, value):
-        """CLI gives a path; the artifact is the file CONTENT."""
-        if value is None:
-            return None
-        if isinstance(value, (bytes,)):
+        """Datastore-less conversion: only already-uploaded forms pass
+        through (descriptor dict or IncludedFile); the upload itself needs
+        `include()` with a datastore."""
+        if value is None or isinstance(value, IncludedFile):
             return value
+        if isinstance(value, dict) and value.get("type") == IncludedFile.TYPE:
+            return IncludedFile(value)
+        raise TpuFlowException(
+            "IncludeFile *%s* got %r without a datastore to upload into — "
+            "this is a framework bug (task parameter init must call "
+            "include())." % (self.name, type(value).__name__)
+        )
+
+    def include(self, value, flow_datastore):
+        """Resolve a parameter value into an IncludedFile.
+
+        Explicit encoding, no content heuristics: a dict bearing the
+        descriptor type marker is an already-uploaded file (resume /
+        trigger replay); a string is ALWAYS a filesystem path, which must
+        exist; anything else is an error."""
+        if value is None or isinstance(value, IncludedFile):
+            return value
+        if isinstance(value, dict):
+            if value.get("type") == IncludedFile.INLINE_TYPE:
+                return self._include_legacy_inline(value, flow_datastore)
+            if value.get("type") != IncludedFile.TYPE:
+                raise TpuFlowException(
+                    "IncludeFile *%s*: unrecognized descriptor %r"
+                    % (self.name, value.get("type"))
+                )
+            return IncludedFile(value)
         path = os.path.expanduser(str(value))
-        if not os.path.exists(path):
-            # resume path: the value may already be the file CONTENT
-            # (re-fed from the origin run's artifacts)
-            if self.is_text and ("\n" in value or len(value) > 1024):
-                return value
+        if not os.path.isfile(path):
             raise TpuFlowException(
                 "IncludeFile *%s*: file '%s' does not exist." % (self.name,
                                                                  path)
             )
-        with open(path, "rb") as f:
-            data = f.read()
-        if self.is_text:
-            return data.decode(self.encoding)
-        return data
+        size = os.path.getsize(path)
+        max_mb = int(os.environ.get(MAX_SIZE_MB_ENV, DEFAULT_MAX_SIZE_MB))
+        if size > max_mb << 20:
+            raise TpuFlowException(
+                "IncludeFile *%s*: '%s' is %.1f MB, over the %d MB limit "
+                "(%s) — artifacts are the inter-task control channel; "
+                "ship bulk data through the datastore/gsop directly."
+                % (self.name, path, size / 1048576.0, max_mb,
+                   MAX_SIZE_MB_ENV)
+            )
+        _uri, key = flow_datastore.save_file(path)
+        return self._descriptor_for(key, size, flow_datastore)
+
+    def _descriptor_for(self, key, size, flow_datastore):
+        return IncludedFile({
+            "type": IncludedFile.TYPE,
+            "key": key,
+            "size": size,
+            "is_text": self.is_text,
+            "encoding": self.encoding,
+            "ds_type": flow_datastore.ds_type,
+            "ds_root": flow_datastore.ds_root,
+            "flow_name": flow_datastore.flow_name,
+        })
+
+    def _include_legacy_inline(self, value, flow_datastore):
+        """Replay a pre-descriptor content artifact: upload the content
+        once (in memory — legacy artifacts were in-memory by definition)
+        and hand back a normal lazy descriptor."""
+        import base64
+
+        content = value.get("content") or ""
+        if value.get("b64"):
+            data = base64.b64decode(content)
+        else:
+            data = content.encode(self.encoding)
+        results = flow_datastore.save_data([data])
+        (_uri, key) = results[0]
+        return self._descriptor_for(key, len(data), flow_datastore)

@@ -2,9 +2,11 @@ from .card_decorator import CardDecorator, CardCollector, card_path
 from .components import (
     Artifact,
     CardComponent,
+    Error,
     Image,
     Markdown,
     ProgressBar,
+    PythonCode,
     Table,
     VegaChart,
 )
@@ -15,9 +17,11 @@ __all__ = [
     "card_path",
     "Artifact",
     "CardComponent",
+    "Error",
     "Image",
     "Markdown",
     "ProgressBar",
+    "PythonCode",
     "Table",
     "VegaChart",
 ]

@@ -138,6 +138,7 @@ class CardDecorator(StepDecorator):
         self._step_name = step_name
         self._task_id = task_id
         self._start = time.time()
+        self._exception = None
         self._renderer = _AsyncRenderer(
             lambda: self._render(flow, None, retry_count, live=True)
         )
@@ -162,7 +163,8 @@ class CardDecorator(StepDecorator):
     def task_exception(self, exception, step_name, flow, graph, retry_count,
                        max_user_code_retries):
         # stop the realtime thread even on failure; the final render comes
-        # from task_finished with is_task_ok=False
+        # from task_finished with is_task_ok=False and shows the traceback
+        self._exception = exception
         try:
             self._renderer.stop()
         except Exception:
@@ -187,6 +189,10 @@ class CardDecorator(StepDecorator):
                     time.strftime("%Y-%m-%d %H:%M:%S"),
             }),
         ]
+        if not live and is_task_ok is False and self._exception is not None:
+            from .components import Error
+
+            components.append(Error(self._exception))
         components.extend(self._collector)
         # the live renderer races user code assigning artifacts; snapshot
         # with retries rather than dying on 'dict changed size'

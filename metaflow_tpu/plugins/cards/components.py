@@ -59,6 +59,10 @@ def _inline(text):
 
 
 class Table(CardComponent):
+    """Tabular data. REALTIME-UPDATABLE: components render at refresh
+    time, so mutating `data` (or calling add_row / update_cell) followed
+    by `current.card.refresh()` updates the live card in place."""
+
     def __init__(self, data=None, headers=None):
         self.data = data or []
         self.headers = headers or []
@@ -67,6 +71,12 @@ class Table(CardComponent):
     def from_dict(cls, d):
         return cls(data=[[k, _fmt(v)] for k, v in d.items()],
                    headers=["key", "value"])
+
+    def add_row(self, row):
+        self.data.append(list(row))
+
+    def update_cell(self, row, col, value):
+        self.data[row][col] = value
 
     def render(self):
         rows = []
@@ -131,7 +141,67 @@ class Artifact(CardComponent):
         )
 
 
+class Error(CardComponent):
+    """An exception rendered with its traceback (reference component set:
+    card_modules/components.py Error). Auto-appended to the default card
+    when a task fails."""
+
+    def __init__(self, exception=None, title=None, traceback_text=None):
+        self.title = title
+        if traceback_text is not None:
+            self.traceback_text = traceback_text
+            self.headline = title or "Error"
+        elif exception is not None:
+            import traceback
+
+            self.headline = title or type(exception).__name__
+            if exception.__traceback__ is not None:
+                self.traceback_text = "".join(traceback.format_exception(
+                    type(exception), exception, exception.__traceback__
+                ))
+            else:
+                self.traceback_text = "%s: %s" % (type(exception).__name__,
+                                                  exception)
+        else:
+            self.headline = title or "Error"
+            self.traceback_text = ""
+
+    def render(self):
+        return (
+            "<div class='error'><b>%s</b>"
+            "<pre class='traceback'>%s</pre></div>"
+            % (html.escape(self.headline),
+               html.escape(self.traceback_text))
+        )
+
+
+class PythonCode(CardComponent):
+    """Source code block: pass a code string or any object
+    `inspect.getsource` can resolve (function, class, module)."""
+
+    def __init__(self, code=None, obj=None):
+        if code is not None:
+            self.code = code
+        elif obj is not None:
+            import inspect
+
+            try:
+                self.code = inspect.getsource(obj)
+            except (OSError, TypeError):
+                self.code = repr(obj)
+        else:
+            self.code = ""
+
+    def render(self):
+        return "<pre class='pycode'><code>%s</code></pre>" % html.escape(
+            self.code
+        )
+
+
 class ProgressBar(CardComponent):
+    """REALTIME-UPDATABLE: call update(value) then
+    current.card.refresh() to move the live bar."""
+
     def __init__(self, max=100, label=None, value=0):
         self.max = max
         self.value = value
@@ -172,6 +242,16 @@ class VegaChart(CardComponent):
             },
         })
 
+    def add_point(self, x, y):
+        """Append a data point (line charts built via .line()) — with
+        current.card.refresh() this streams a live metric curve (e.g.
+        training loss) into the card."""
+        values = self.spec.setdefault("data", {}).setdefault("values", [])
+        enc = self.spec.get("encoding", {})
+        x_label = enc.get("x", {}).get("field", "x")
+        y_label = enc.get("y", {}).get("field", "y")
+        values.append({x_label: float(x), y_label: float(y)})
+
     _counter = [0]
 
     def render(self):
@@ -203,6 +283,11 @@ code {{ background: #f5f5f5; padding: 1px 4px; border-radius: 3px; }}
 h1 {{ border-bottom: 2px solid #4a90d9; padding-bottom: 4px; }}
 .pbar {{ margin: 0.5em 0; }}
 .artifact {{ margin: 0.3em 0; }}
+.error {{ border-left: 4px solid #c0392b; padding: 0.4em 1em;
+          background: #fdf2f0; margin: 1em 0; }}
+.error pre {{ white-space: pre-wrap; }}
+.pycode {{ background: #f5f5f5; padding: 0.7em 1em; border-radius: 4px;
+           overflow-x: auto; }}
 </style></head><body>
 {body}
 <hr><footer><small>metaflow_tpu card · {pathspec}</small></footer>

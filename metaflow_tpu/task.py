@@ -115,8 +115,15 @@ class MetaflowTask(object):
         for name, param in self.flow._get_parameters():
             if getattr(param, "IS_CONFIG_PARAMETER", False):
                 continue  # Configs resolve via the CLI, not as parameters
+            is_include = getattr(param, "IS_INCLUDE_FILE", False)
             if name in values:
-                value = param.convert(values[name])
+                if is_include:
+                    # path (fresh run) or descriptor (resume/trigger
+                    # replay) → streamed upload / lazy handle
+                    value = param.include(values[name],
+                                          self.flow_datastore)
+                else:
+                    value = param.convert(values[name])
             else:
                 value = param.resolve_default()
                 if value is None and param.is_required:
@@ -124,6 +131,8 @@ class MetaflowTask(object):
                         "Parameter *%s* is required but no value was "
                         "provided." % name
                     )
+                if is_include and value is not None:
+                    value = param.include(value, self.flow_datastore)
             setattr(self.flow, name, value)
             names.append(name)
         self.flow._parameter_names = names

@@ -21,7 +21,8 @@ class ConfigFlow(FlowSpec):
     @step
     def start(self):
         self.lr = self.settings.lr
-        self.file_content = self.notes
+        # IncludeFile gives a lazy IncludedFile handle; .text loads it
+        self.file_content = self.notes.text if self.notes else None
         self.next(self.end)
 
     @step

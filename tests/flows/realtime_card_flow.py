@@ -2,7 +2,7 @@ import os
 import time
 
 from metaflow_tpu import FlowSpec, current, step
-from metaflow_tpu.plugins.cards import Markdown, ProgressBar
+from metaflow_tpu.plugins.cards import Markdown, ProgressBar, VegaChart
 
 import metaflow_tpu
 
@@ -15,33 +15,58 @@ class RealtimeCardFlow(FlowSpec):
 
         current.card.append(Markdown("## live training"))
         bar = ProgressBar(max=3, value=0, label="steps")
+        chart = VegaChart.line([], [], x_label="step", y_label="loss",
+                               title="loss")
         current.card.append(bar)
+        current.card.append(chart)
         current.card.refresh()
 
-        # the async renderer should persist a LIVE card while the task runs
         ds = self._datastore._flow_datastore
         path = card_path(ds.storage, ds.flow_name, current.run_id,
                          current.step_name, current.task_id)
-        live_html = None
-        deadline = time.time() + 15
-        while time.time() < deadline:
+
+        def read_card():
             with ds.storage.load_bytes([path]) as loaded:
                 for _key, local_file, _meta in loaded:
                     if local_file:
                         with open(local_file) as f:
-                            live_html = f.read()
-            if live_html:
-                break
-            time.sleep(0.25)
+                            return f.read()
+            return None
+
+        def wait_for(predicate, timeout=15):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                html = read_card()
+                if html and predicate(html):
+                    return html
+                time.sleep(0.25)
+            return None
+
+        # the async renderer should persist a LIVE card while the task runs
+        live_html = wait_for(lambda h: True)
         assert live_html is not None, "no live card appeared mid-task"
         self.live_had_refresh_tag = 'http-equiv="refresh"' in live_html
         self.live_status_running = "running" in live_html
+
+        # the live-metrics loop: update the SAME components and refresh —
+        # the persisted card must pick up the new state (live loss curve)
+        for i in range(3):
+            bar.update(i + 1)
+            chart.add_point(i, 1.0 / (i + 1))
+            current.card.refresh()
+        updated = wait_for(
+            lambda h: "3/3" in h and '"loss": 0.3333' in h.replace(
+                "0.3333333333333333", "0.3333")
+        )
+        assert updated is not None, "live card never showed updated metrics"
+        self.live_chart_updated = True
         self.next(self.end)
 
     @step
     def end(self):
         assert self.live_had_refresh_tag, "mid-task card missing reload tag"
         assert self.live_status_running, "mid-task card not marked running"
+        assert self.live_chart_updated
         print("realtime card ok")
 
 

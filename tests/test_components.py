@@ -86,6 +86,81 @@ class TestCards:
         # components escape HTML
         assert "<script>alert" not in Markdown("<script>alert(1)</script>").render()
 
+    def test_error_component_renders_traceback(self):
+        from metaflow_tpu.plugins.cards import Error
+
+        try:
+            raise ValueError("boom <tag>")
+        except ValueError as ex:
+            rendered = Error(ex).render()
+        assert "ValueError" in rendered
+        assert "boom &lt;tag&gt;" in rendered          # escaped
+        assert "test_components.py" in rendered        # real traceback
+        # traceback-text form (remote/step-failure transport)
+        assert "from text" in Error(
+            traceback_text="from text", title="T").render()
+
+    def test_python_code_component(self):
+        from metaflow_tpu.plugins.cards import PythonCode
+
+        def sample_fn(x):
+            return x + 1
+
+        rendered = PythonCode(obj=sample_fn).render()
+        assert "def sample_fn" in rendered
+        assert "<pre class='pycode'>" in rendered
+        assert "&lt;b&gt;" in PythonCode(code="x = '<b>'").render()
+
+    def test_realtime_updatable_components(self):
+        from metaflow_tpu.plugins.cards import ProgressBar, Table, VegaChart
+
+        bar = ProgressBar(max=10, value=0, label="s")
+        bar.update(7)
+        assert "7/10" in bar.render()
+
+        t = Table(data=[["a", 1]], headers=["k", "v"])
+        t.add_row(["b", 2])
+        t.update_cell(0, 1, 99)
+        rendered = t.render()
+        assert "<td>99</td>" in rendered and "<td>b</td>" in rendered
+
+        chart = VegaChart.line([], [], x_label="step", y_label="loss")
+        chart.add_point(0, 0.5)
+        chart.add_point(1, 0.25)
+        assert chart.spec["data"]["values"] == [
+            {"step": 0.0, "loss": 0.5}, {"step": 1.0, "loss": 0.25}]
+
+    def test_failed_task_card_shows_error(self, run_flow, tpuflow_root,
+                                          tmp_path):
+        flow = tmp_path / "fail_card_flow.py"
+        flow.write_text(
+            "import metaflow_tpu\n"
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class FailCardFlow(FlowSpec):\n"
+            "    @metaflow_tpu.card\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        raise RuntimeError('card should show this')\n"
+            "        self.next(self.end)\n"
+            "    @step\n"
+            "    def end(self):\n"
+            "        pass\n"
+            "if __name__ == '__main__':\n"
+            "    FailCardFlow()\n"
+        )
+        run_flow(str(flow), "run", expect_fail=True)
+        import glob
+
+        cards = glob.glob(os.path.join(
+            tpuflow_root, "FailCardFlow", "mf.cards", "**", "*.html"),
+            recursive=True)
+        assert cards, "no card rendered for the failed task"
+        with open(cards[0]) as f:
+            html = f.read()
+        assert "failed" in html
+        assert "RuntimeError" in html
+        assert "card should show this" in html
+
 
 class TestPackage:
     def test_blob_deterministic_and_complete(self, tmp_path):

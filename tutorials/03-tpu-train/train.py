@@ -14,6 +14,7 @@ class TpuTrainFlow(FlowSpec):
         self.num_steps = 5
         self.next(self.train, num_parallel=2)
 
+    @metaflow_tpu.card
     @metaflow_tpu.checkpoint
     @step
     def train(self):
@@ -22,6 +23,7 @@ class TpuTrainFlow(FlowSpec):
         import jax
 
         from metaflow_tpu.models import llama
+        from metaflow_tpu.plugins.cards import Markdown, ProgressBar, VegaChart
         from metaflow_tpu.spmd import MeshSpec, create_mesh
         from metaflow_tpu.training import (
             default_optimizer,
@@ -41,9 +43,24 @@ class TpuTrainFlow(FlowSpec):
             jax.random.PRNGKey(1), (batch_size, 33), 0, cfg.vocab_size
         )
         batch = shard_batch({"tokens": tokens}, mesh)
+
+        # LIVE training card: point a browser at `python train.py card
+        # server` and watch the loss curve + progress bar move while the
+        # gang trains (current.card.refresh() re-renders in background)
+        current.card.append(Markdown("## rank %d training"
+                                     % current.parallel.node_index))
+        bar = ProgressBar(max=self.num_steps, label="step")
+        chart = VegaChart.line([], [], x_label="step", y_label="loss",
+                               title="training loss")
+        current.card.append(bar)
+        current.card.append(chart)
+
         with mesh:
             for i in range(self.num_steps):
                 state, metrics = train_step(state, batch)
+                bar.update(i + 1)
+                chart.add_point(i, float(metrics["loss"]))
+                current.card.refresh()
         self.loss = float(metrics["loss"])
         self.rank = current.parallel.node_index
         self.next(self.join)
