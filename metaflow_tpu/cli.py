@@ -316,7 +316,7 @@ def make_cli(flow, state):
                         # path or re-uploading the content
                         value = value.descriptor
                     elif name in include_params and isinstance(
-                            value, (str, bytes)) and value is not None:
+                            value, (str, bytes)):
                         # pre-descriptor runs stored the CONTENT itself;
                         # provenance (an IncludeFile param's artifact)
                         # makes this unambiguous — wrap explicitly

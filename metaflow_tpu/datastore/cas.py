@@ -32,6 +32,8 @@ class _TaggedFileReader(object):
         self._file = None
 
     def read(self, n=-1):
+        if n == 0:
+            return b""
         if self._file is None:
             self._file = open(self._path, "rb")
             if n is None or n < 0:
@@ -157,9 +159,17 @@ class ContentAddressedStore(object):
                         elif fmt == self.FMT_GZIP:
                             yield gzip.GzipFile(fileobj=f, mode="rb")
                         else:
-                            # no tag byte: whole object is the payload
+                            # no tag byte: MIRROR _unpack's fallback —
+                            # pre-tag-era blobs are whole-object gzip;
+                            # only yield raw when it isn't gzip at all
                             f.seek(0)
-                            yield f
+                            gz = gzip.GzipFile(fileobj=f, mode="rb")
+                            try:
+                                gz.peek(1)
+                                yield gz
+                            except OSError:
+                                f.seek(0)
+                                yield f
                     return
 
         return opened()

@@ -130,17 +130,23 @@ class LocalStorage(DataStoreStorage):
                 byte_obj = payload
             full = self._abs(path)
             if os.path.exists(full) and not overwrite:
+                if hasattr(byte_obj, "close"):
+                    byte_obj.close()
                 continue
             os.makedirs(os.path.dirname(full), exist_ok=True)
             # atomic write: temp file + rename, safe under concurrent tasks
-            with NamedTemporaryFile(
-                dir=os.path.dirname(full), delete=False
-            ) as tmp:
-                if hasattr(byte_obj, "read"):
-                    shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
-                else:
-                    tmp.write(byte_obj)
-                tmpname = tmp.name
+            try:
+                with NamedTemporaryFile(
+                    dir=os.path.dirname(full), delete=False
+                ) as tmp:
+                    if hasattr(byte_obj, "read"):
+                        shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
+                    else:
+                        tmp.write(byte_obj)
+                    tmpname = tmp.name
+            finally:
+                if hasattr(byte_obj, "close"):
+                    byte_obj.close()
             os.replace(tmpname, full)
 
     def load_bytes(self, paths):
@@ -260,27 +266,41 @@ class GCSStorage(DataStoreStorage):
                 byte_obj = payload
             key = self._key(path)
             if not overwrite and self.client.exists(self._bucket_name, key):
+                if hasattr(byte_obj, "close"):
+                    byte_obj.close()
                 return
             if hasattr(byte_obj, "read"):
-                # stream file-backed payloads through put_file (pread-based,
-                # constant memory) instead of materializing multi-GB blobs
-                name = getattr(byte_obj, "name", None)
-                if isinstance(name, str) and os.path.isfile(name):
-                    self.client.put_file(self._bucket_name, key, name)
-                    return
-                # unnamed reader (e.g. the CAS's tagged file stream):
-                # spool through a temp file at bounded memory, then the
-                # same pread-based upload
-                import tempfile
-
-                with tempfile.NamedTemporaryFile(delete=False) as tmp:
-                    shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
-                    tmpname = tmp.name
                 try:
-                    self.client.put_file(self._bucket_name, key, tmpname)
+                    # stream file-backed payloads through put_file
+                    # (pread-based, constant memory) instead of
+                    # materializing multi-GB blobs
+                    name = getattr(byte_obj, "name", None)
+                    if isinstance(name, str) and os.path.isfile(name):
+                        self.client.put_file(self._bucket_name, key, name)
+                        return
+                    # unnamed reader (e.g. the CAS's tagged file stream):
+                    # spool through a temp file at bounded memory, then
+                    # the same pread-based upload. TPUFLOW_SCRATCH_DIR
+                    # picks the spool location — the default /tmp is
+                    # tmpfs on many hosts, where a multi-GB spool would
+                    # eat RAM-backed storage
+                    import tempfile
+
+                    scratch = os.environ.get("TPUFLOW_SCRATCH_DIR") or None
+                    with tempfile.NamedTemporaryFile(
+                        delete=False, dir=scratch
+                    ) as tmp:
+                        shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
+                        tmpname = tmp.name
+                    try:
+                        self.client.put_file(self._bucket_name, key,
+                                             tmpname)
+                    finally:
+                        os.unlink(tmpname)
+                    return
                 finally:
-                    os.unlink(tmpname)
-                return
+                    if hasattr(byte_obj, "close"):
+                        byte_obj.close()
             self.client.put_bytes(self._bucket_name, key, byte_obj)
 
         items = list(path_and_bytes_iter)

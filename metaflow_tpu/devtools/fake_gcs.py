@@ -305,7 +305,13 @@ class _DiskBucket(object):
 class FakeGCSDiskState(object):
     """Same surface as FakeGCSState, shared across worker processes via a
     directory (put it on tmpfs to keep the bench memory-speed).
-    Generations are file mtime_ns — monotonic per object on every write."""
+
+    Generations must STRICTLY increase per object, but two rapid
+    overwrites can land inside one filesystem timestamp quantum (the tmp
+    file's mtime is set at write time and survives the rename) — so the
+    issued generation is max(mtime_ns, last_issued + 1), tracked in a
+    flock-guarded sidecar (named under the .inflight- prefix the listing
+    already skips) and stamped back onto the object with utime."""
 
     def __init__(self, root):
         self.root = root
@@ -317,8 +323,32 @@ class FakeGCSDiskState(object):
             os.path.join(self.root, urllib.parse.quote(name, safe=""))
         )
 
+    def _gen_sidecar(self, bucket_name, obj):
+        bucket = self.bucket(bucket_name)
+        return os.path.join(
+            bucket.root, ".inflight-gen-" + urllib.parse.quote(obj, safe="")
+        )
+
     def bump_generation(self, bucket_name, obj):
-        return self.generation(bucket_name, obj)
+        import fcntl
+
+        path = self.bucket(bucket_name)._path(obj)
+        try:
+            with open(self._gen_sidecar(bucket_name, obj), "a+") as gf:
+                fcntl.flock(gf, fcntl.LOCK_EX)
+                gf.seek(0)
+                raw = gf.read().strip()
+                last = int(raw) if raw else 0
+                st = os.stat(path)
+                gen = max(st.st_mtime_ns, last + 1)
+                if gen != st.st_mtime_ns:
+                    os.utime(path, ns=(st.st_atime_ns, gen))
+                gf.seek(0)
+                gf.truncate()
+                gf.write(str(gen))
+                return gen
+        except OSError:
+            return 1
 
     def generation(self, bucket_name, obj):
         try:

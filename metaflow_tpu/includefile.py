@@ -175,6 +175,14 @@ class IncludeFile(Parameter):
                     "IncludeFile *%s*: unrecognized descriptor %r"
                     % (self.name, value.get("type"))
                 )
+            # descriptor replay (resume/trigger) re-references the payload:
+            # refresh its gc registry timestamp so the blob outlives the
+            # NEW run, not just the original upload's retention window
+            if value.get("key"):
+                try:
+                    flow_datastore._register_data_keys([value["key"]])
+                except Exception:
+                    pass  # a read-only datastore view must still resolve
             return IncludedFile(value)
         path = os.path.expanduser(str(value))
         if not os.path.isfile(path):

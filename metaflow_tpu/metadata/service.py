@@ -25,6 +25,10 @@ from .metadata import MetadataProvider, timestamp_millis
 class ServiceException(TpuFlowException):
     headline = "Metadata service error"
 
+    def __init__(self, msg, http_code=None):
+        super().__init__(msg)
+        self.http_code = http_code
+
 
 class ServiceMetadataProvider(MetadataProvider):
     TYPE = "service"
@@ -71,7 +75,10 @@ class ServiceMetadataProvider(MetadataProvider):
                 last_err = ex
             if attempt < retries - 1:
                 time.sleep(0.2 * (2 ** attempt))
-        raise ServiceException("%s %s failed: %s" % (method, path, last_err))
+        raise ServiceException(
+            "%s %s failed: %s" % (method, path, last_err),
+            http_code=getattr(last_err, "code", None),
+        )
 
     def version(self):
         info = self._request("GET", "/ping")
@@ -203,11 +210,15 @@ class ServiceMetadataProvider(MetadataProvider):
                 "PATCH", "/flows/%s/runs/%s/tags" % (flow_name, run_id),
                 {"add": sorted(add or []), "remove": sorted(remove or [])},
             )
-        except ServiceException:
-            # None = run not found, the same contract as get_run_info —
-            # callers (tag CLI, client Run._mutate_tags) turn it into
-            # their own not-found errors
-            return None
+        except ServiceException as ex:
+            # None = run not found (HTTP 404), the same contract as
+            # get_run_info — callers (tag CLI, client Run._mutate_tags)
+            # turn it into their own not-found errors. Anything else (5xx
+            # after retries, network failure) is a real outage and must
+            # surface, not masquerade as a missing run.
+            if ex.http_code == 404:
+                return None
+            raise
 
 
 class MetadataService(object):

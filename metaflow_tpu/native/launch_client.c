@@ -21,6 +21,9 @@
  */
 
 #define _GNU_SOURCE
+
+/* keep in lockstep with metaflow_tpu/daemon.py PROTO_VERSION */
+#define CLIENT_PROTO_VERSION 1
 #include <errno.h>
 #include <signal.h>
 #include <stdio.h>
@@ -219,13 +222,20 @@ int main(int argc, char **argv) {
         return cold_exec(argc - 1, argv + 1);
     }
     close(fd);
+    /* this binary speaks protocol 1 (metaflow_tpu/daemon.py
+     * PROTO_VERSION). Echoing the daemon's advertised proto would defeat
+     * the version negotiation — a stale binary would "pass" a proto-2
+     * handshake while sending a proto-1-shaped request. Send OUR version;
+     * a daemon from a newer checkout rejects it and we fall back cold. */
+    if (proto != CLIENT_PROTO_VERSION)
+        return cold_exec(argc - 1, argv + 1);
 
     /* 2. build the run request */
     sbuf b = {0};
     sb_puts(&b, "{\"proto\": ");
     {
         char num[32];
-        snprintf(num, sizeof num, "%ld", proto);
+        snprintf(num, sizeof num, "%ld", (long)CLIENT_PROTO_VERSION);
         sb_puts(&b, num);
     }
     sb_puts(&b, ", \"token\": ");

@@ -580,8 +580,8 @@ class ArgoWorkflows(object):
         # Argo only defines {{retries}} inside templates that have a
         # retryStrategy — bake a literal 0 otherwise.
         attempt = "{{retries}}" if retries else "0"
-        js_name = "{{workflow.name}}-%s-r%s" % (_argo_name(node.name),
-                                                attempt)
+        js_name = "{{workflow.name}}-%s-r%s" % (
+            self._gang_step_label(node), attempt)
         container = {
             "name": "main",
             "image": self.image,
@@ -653,6 +653,42 @@ class ArgoWorkflows(object):
                 "retryPolicy": "Always",
             }
         return template
+
+    # K8s DNS-1123 labels (hostnames, object names used as hostnames) cap
+    # at 63 chars; the deepest derived name is the gang pod hostname
+    # '<workflow>-<step>-rN-gang-0-0'. The workflow name is only known at
+    # run time, but its length is bounded by the deployed template name
+    # plus Argo's generateName suffix — validate/truncate at COMPILE time
+    # so a long flow or step name is a compile error, not a JobSet that
+    # fails admission or pods without their stable DNS names.
+    _DNS_LABEL_MAX = 63
+    _WF_SUFFIX_BUDGET = 6   # '-xxxxx' generateName suffix on submission
+    # budget the pod index at 4 digits (gangs up to 9999 ranks): the
+    # index is a runtime parameter, so compile time must reserve for the
+    # largest supported gang, not index 0
+    _GANG_SUFFIX = "-gang-0-9999"
+
+    def _gang_step_label(self, node):
+        import hashlib
+
+        step_part = _argo_name(node.name)
+        fixed = (len(self._deployed_name()) + self._WF_SUFFIX_BUDGET
+                 + 1                      # '-' before the step part
+                 + len("-r") + 2          # attempt counter (<= 2 digits)
+                 + len(self._GANG_SUFFIX))
+        room = self._DNS_LABEL_MAX - fixed
+        if len(step_part) <= room:
+            return step_part
+        digest = hashlib.sha1(step_part.encode("utf-8")).hexdigest()[:6]
+        keep = room - len(digest) - 1
+        if keep < 1:
+            raise TpuFlowException(
+                "Gang step *%s*: the deployed workflow name %r is too long "
+                "to derive a DNS-1123-safe JobSet pod hostname (63-char "
+                "label limit) — shorten the flow/project name."
+                % (node.name, self._deployed_name())
+            )
+        return "%s-%s" % (step_part[:keep], digest)
 
     def _validate_gang_hosts(self, node):
         """A multi-host slice needs exactly ONE pod per host: when both

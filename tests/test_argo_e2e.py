@@ -393,6 +393,52 @@ class TestArgoCompileValidation:
         assert proc.returncode != 0
         assert "recursive-switch loop" in (proc.stderr + proc.stdout)
 
+    def test_gang_jobset_name_fits_dns_label(self, tpuflow_root, tmp_path):
+        """A long gang step name must compile to a JobSet whose derived
+        pod hostname ('<wf>-<step>-rN-gang-0-0') fits the 63-char
+        DNS-1123 label limit — truncated with a content hash, not left to
+        fail admission at run time."""
+        long_step = "train_" + "x" * 70
+        flow_file = tmp_path / "long_gang.py"
+        flow_file.write_text(
+            "from metaflow_tpu import FlowSpec, step\n"
+            "class LongGangFlow(FlowSpec):\n"
+            "    @step\n"
+            "    def start(self):\n"
+            "        self.next(self.%(s)s, num_parallel=2)\n"
+            "    @step\n"
+            "    def %(s)s(self):\n"
+            "        self.next(self.join)\n"
+            "    @step\n"
+            "    def join(self, inputs):\n"
+            "        self.next(self.end)\n"
+            "    @step\n"
+            "    def end(self):\n"
+            "        pass\n"
+            "if __name__ == '__main__':\n"
+            "    LongGangFlow()\n" % {"s": long_step}
+        )
+        manifest = _compile(str(flow_file), tpuflow_root)
+        gang = next(t for t in manifest["spec"]["templates"]
+                    if "resource" in t)
+        import re
+        import yaml
+
+        js = yaml.safe_load(gang["resource"]["manifest"].replace(
+            "{{inputs.parameters.num-parallel}}", "2"))
+        name = js["metadata"]["name"]
+        m = re.match(r"\{\{workflow\.name\}\}-(.*)-r(.*)$", name)
+        assert m, name
+        label_tail = m.group(1)
+        # estimated runtime hostname: deployed wf name + '-xxxxx' suffix
+        # + '-' + tail + '-rN' + '-gang-0-0' must fit one DNS label
+        est = len("longgangflow") + 6 + 1 + len(label_tail) + 3 + len(
+            "-gang-0-0")
+        assert est <= 63, (label_tail, est)
+        # truncation is content-hashed, not blind
+        assert label_tail != ("train-" + "x" * 70)
+        assert re.search(r"-[0-9a-f]{6}$", label_tail), label_tail
+
     def test_two_switches_same_entry_refused(self, tpuflow_root, tmp_path):
         flow_file = tmp_path / "double_back_edge.py"
         flow_file.write_text(

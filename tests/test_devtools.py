@@ -65,3 +65,22 @@ def test_gsop_against_packaged_fake(tmp_path):
         dst = tmp_path / "out"
         client.get_many("bkt", [("obj", str(dst))])
         assert dst.read_bytes() == src.read_bytes()
+
+
+def test_disk_state_generations_strictly_monotonic(tmp_path):
+    """Rapid overwrites within one filesystem timestamp quantum must still
+    get strictly increasing generations (the conditional-GET/ranged-read
+    semantics of the double depend on it)."""
+    from metaflow_tpu.devtools.fake_gcs import FakeGCSDiskState
+
+    state = FakeGCSDiskState(str(tmp_path))
+    bucket = state.bucket("b")
+    gens = []
+    for i in range(20):
+        bucket["obj"] = b"v%d" % i
+        gens.append(state.bump_generation("b", "obj"))
+    assert gens == sorted(set(gens)), gens  # strictly increasing
+    # the issued generation is also what a later stat-based read reports
+    assert state.generation("b", "obj") == gens[-1]
+    # sidecar files never leak into listings
+    assert list(bucket) == ["obj"]
