@@ -158,6 +158,67 @@ def _append_history(result):
         f.write(json.dumps(entry) + "\n")
 
 
+def bench_decode():
+    """Autoregressive decode throughput (tokens/s/chip): jitted
+    prefill+scan generation from metaflow_tpu.inference on the bench
+    model (KV-cache resident in HBM)."""
+    import jax
+
+    from metaflow_tpu.inference import make_generator
+    from metaflow_tpu.models import llama
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig.bench_1b(attention_impl="xla", remat=False)
+        batch = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+        prompt_len, new_tokens = 128, 256
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, prompt_len, new_tokens = 2, 16, 16
+
+    from metaflow_tpu.spmd import MeshSpec, batch_sharding, create_mesh
+
+    n_devices = len(jax.devices())
+    # data-parallel decode over every chip: the per-chip division below
+    # is only honest when the work is actually spread (contrast a bare
+    # jit, which would pin everything to one device)
+    mesh = create_mesh(MeshSpec.dp())
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if batch % n_devices:
+        batch = max(n_devices, batch - batch % n_devices)
+    prompt = jax.device_put(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size),
+        batch_sharding(mesh),
+    )
+    gen = make_generator(cfg, max_new_tokens=new_tokens)
+    with mesh:
+        out = gen(params, prompt, jax.random.PRNGKey(2))  # compile+warmup
+        jax.block_until_ready(out)
+        reps = 3
+        t0 = time.perf_counter()
+        for i in range(reps):
+            out = gen(params, prompt, jax.random.PRNGKey(3 + i))
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    tps = batch * new_tokens * reps / dt / n_devices
+    return {
+        "metric": "llama_%s_decode_tokens_per_sec_per_chip"
+        % ("1b_bf16" if on_tpu else "tiny_cpu"),
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": _vs_baseline(tps),
+        "extra": {
+            "backend": jax.default_backend(),
+            "n_devices": n_devices,
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "new_tokens": new_tokens,
+            "params": llama.num_params(params),
+        },
+    }
+
+
 def bench_step_launch():
     """p50 latency from scheduler queue → task attempt marker (the reference
     instruments this via metaflow_profile from_start markers).
@@ -403,6 +464,14 @@ if __name__ == "__main__":
         result = bench_step_launch()
     elif mode == "data":
         result = bench_data_path()
+    elif mode == "decode":
+        if os.environ.get("BENCH_SKIP_PROBE") != "1":
+            if _wait_for_tpu() is None:
+                _rerun_on_cpu()
+        result = bench_decode()
+        if os.environ.get("BENCH_DEGRADED"):
+            result["degraded"] = True
+            result["degraded_reason"] = os.environ["BENCH_DEGRADED"]
     else:
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             backend = _wait_for_tpu()

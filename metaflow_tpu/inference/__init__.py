@@ -1,0 +1,13 @@
+from .decode import (
+    decode_forward,
+    generate,
+    init_kv_cache,
+    make_generator,
+)
+
+__all__ = [
+    "decode_forward",
+    "generate",
+    "init_kv_cache",
+    "make_generator",
+]

@@ -1,0 +1,194 @@
+"""Autoregressive decoding with a KV cache (Llama family).
+
+The serving-side counterpart of models/llama.py: prefill runs the prompt
+through the stack once and fills a static-shape KV cache; each decode step
+appends one position via lax.dynamic_update_slice and attends over the
+cache with a position mask. Everything is shape-static and jittable —
+the whole generate loop is ONE compiled program (prefill + lax.scan over
+steps), which is what keeps the MXU fed on TPU instead of relaunching a
+kernel per token.
+
+The reference framework has no inference engine (it orchestrates user
+frameworks); this is part of the training/serving substrate the TPU
+rebuild provides natively (SURVEY.md §5.7).
+
+Sharding: the cache carries the same logical axes as activations
+([layers, batch, seq, kv_heads, head_dim]) — under a mesh, batch rides
+the data/fsdp axes and kv_heads the tensor axis, so decode parallelizes
+with the exact rule table training uses (spmd/sharding.py); XLA keeps the
+per-step all-gathers on ICI.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..models import llama
+from ..ops import rms_norm
+from ..ops.attention import NEG_INF, _broadcast_gqa
+from ..ops.rope import apply_rope, rope_frequencies
+
+
+def init_kv_cache(cfg, batch_size, max_seq_len, dtype=None):
+    """Static [layers, batch, max_seq, kv_heads, head_dim] cache pair."""
+    dt = jnp.dtype(dtype) if dtype is not None else llama.param_dtype(cfg)
+    shape = (cfg.n_layers, batch_size, max_seq_len, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attention(q, cache_k, cache_v, pos):
+    """q: [B, T, H, Hd] at absolute positions pos..pos+T-1; cache_k/v:
+    [B, Smax, KV, Hd]. Keys at index i are visible to query t iff
+    i <= pos + t (unfilled cache slots fall outside by construction)."""
+    B, T, H, Hd = q.shape
+    k = _broadcast_gqa(cache_k, H)
+    v = _broadcast_gqa(cache_v, H)
+    scale = 1.0 / math.sqrt(Hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    key_idx = jnp.arange(k.shape[1])[None, None, None, :]
+    q_pos = (pos + jnp.arange(T))[None, None, :, None]
+    logits = jnp.where(key_idx <= q_pos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
+                  mesh=None):
+    """One block over T new tokens, reading+extending this layer's cache.
+    Dense (Llama) or MoE (Mixtral) FFN is picked off the parameter tree —
+    the attention/cache half is identical."""
+    B, T, D = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    lp = layer_params
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, H, Hd)
+    k = (h @ lp["wk"]).reshape(B, T, KV, Hd)
+    v = (h @ lp["wv"]).reshape(B, T, KV, Hd)
+    positions = pos + jnp.arange(T)
+    q = apply_rope(q, cos, sin, positions=positions)
+    k = apply_rope(k, cos, sin, positions=positions)
+
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, k.astype(cache_k.dtype), pos, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, v.astype(cache_v.dtype), pos, axis=1)
+
+    attn = _cached_attention(q, cache_k, cache_v, pos)
+    x = x + attn.reshape(B, T, H * Hd) @ lp["wo"]
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    if "router" in lp:  # Mixtral: token-choice MoE FFN
+        from ..ops.moe import moe_ffn
+
+        moe_out, _aux = moe_ffn(
+            h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            num_experts_per_tok=cfg.experts_per_tok,
+            capacity_factor=None,  # decode batches are tiny: lossless
+            dispatch=getattr(cfg, "moe_dispatch", "sparse"),
+            mesh=mesh,
+        )
+        x = x + moe_out
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"])
+        up = h @ lp["w_up"]
+        x = x + (gate * up) @ lp["w_down"]
+    return x, cache_k, cache_v
+
+
+def decode_forward(params, tokens, cache, pos, cfg, mesh=None):
+    """Forward over T new tokens at absolute position `pos` (a traced
+    scalar), reading and extending the cache. Works for any model in the
+    Llama family layout (Llama dense FFN, Mixtral MoE FFN).
+
+    tokens: [B, T] (T static: the prompt length for prefill, 1 per decode
+    step). Returns (logits [B, T, vocab] fp32, updated cache)."""
+    dt = llama.param_dtype(cfg)
+    max_seq = cache["k"].shape[2]
+    x = params["embed"][tokens].astype(dt)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, max_seq, cfg.rope_theta, dtype=dt,
+        llama3_scaling=getattr(cfg, "rope_llama3_scaling", False),
+    )
+
+    def layer_fn(carry, inp):
+        lp, ck, cv = inp
+        out, nk, nv = _decode_layer(cfg, cos, sin, pos, carry, lp, ck, cv,
+                                    mesh=mesh)
+        return out, (nk, nv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum("btd,dv->btv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _sample(logits, temperature, rng):
+    """logits: [B, vocab] fp32 → [B] int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits / temperature, axis=-1).astype(jnp.int32)
+
+
+def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
+             rng=None, eos_id=None, max_seq_len=None, mesh=None):
+    """Generate max_new_tokens continuations of prompt_tokens [B, P].
+
+    Pure jax (jit-friendly; max_new_tokens/temperature/eos_id must be
+    static under jit). Returns [B, P + max_new_tokens] int32; once a
+    sequence emits eos_id its tail is padded with eos_id.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    B, P = prompt_tokens.shape
+    total = P + max_new_tokens
+    cache = init_kv_cache(cfg, B, max_seq_len or total)
+
+    logits, cache = decode_forward(params, prompt_tokens, cache, 0, cfg,
+                                   mesh=mesh)
+    rng, step_rng = jax.random.split(rng)
+    tok = _sample(logits[:, -1], temperature, step_rng)
+    done = (tok == eos_id) if eos_id is not None else None
+
+    def step(carry, step_rng):
+        cache, tok, pos, done = carry
+        logits, cache = decode_forward(params, tok[:, None], cache, pos,
+                                       cfg, mesh=mesh)
+        nxt = _sample(logits[:, 0], temperature, step_rng)
+        if done is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, pos + 1, done), nxt
+
+    if max_new_tokens > 1:
+        (cache, _, _, _), rest = jax.lax.scan(
+            step, (cache, tok, jnp.int32(P), done),
+            jax.random.split(rng, max_new_tokens - 1),
+        )
+        new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
+    else:
+        new_tokens = tok[:, None]
+    return jnp.concatenate([prompt_tokens.astype(jnp.int32), new_tokens],
+                           axis=1)
+
+
+def make_generator(cfg, max_new_tokens, temperature=0.0, eos_id=None,
+                   max_seq_len=None):
+    """A jitted (params, prompt_tokens, rng) -> tokens generator with the
+    static knobs baked in — compile once, serve many."""
+
+    @functools.partial(jax.jit, static_argnames=())
+    def run(params, prompt_tokens, rng):
+        return generate(params, prompt_tokens, cfg, max_new_tokens,
+                        temperature=temperature, rng=rng, eos_id=eos_id,
+                        max_seq_len=max_seq_len)
+
+    return run

@@ -829,8 +829,17 @@ class NativeRuntime(object):
     # ------------------------------------------------------------------
 
     def _build_origin_index(self):
-        """Index the origin run's DONE tasks by (step, foreach-index-path)."""
+        """Index the origin run's DONE tasks by (step, foreach-index-path).
+
+        A recursive switch re-executes the same steps at the same foreach
+        path once per iteration, so each key holds an ordered LIST of
+        origin tasks (creation order = iteration order, task ids are
+        monotonic); _maybe_clone replays them with a cursor, which keeps
+        the cloned transitions walking the loop exactly as the origin run
+        did (the reference tracks the same thing via its recursive
+        iteration bookkeeping, runtime.py:1076)."""
         max_id = 0
+        entries = []
         for ds in self._flow_datastore.get_task_datastores(
             run_id=self._clone_run_id
         ):
@@ -838,10 +847,20 @@ class NativeRuntime(object):
                 continue
             stack = ds.get("_foreach_stack") or []
             index_path = tuple(int(frame[1]) for frame in stack)
-            self._origin_index[(ds.step_name, index_path)] = ds
+            entries.append((ds.step_name, index_path, ds))
             tid = ds.task_id.split("-")[0]
             if tid.isdigit():
                 max_id = max(max_id, int(tid))
+
+        def _task_order(ds):
+            tid = ds.task_id.split("-")[0]
+            return (0, int(tid)) if tid.isdigit() else (1, ds.task_id)
+
+        entries.sort(key=lambda e: _task_order(e[2]))
+        for step_name, index_path, ds in entries:
+            self._origin_index.setdefault((step_name, index_path),
+                                          []).append(ds)
+        self._origin_clone_cursor = {}
         self._task_index = max_id
 
     def _maybe_clone(self, task):
@@ -856,11 +875,16 @@ class NativeRuntime(object):
             if path not in self._cloned_pathspecs:
                 return False
         index_path = self._index_path_for(task)
-        origin_ds = self._origin_index.get((task.step, index_path))
-        if origin_ds is None:
+        candidates = self._origin_index.get((task.step, index_path))
+        if not candidates:
             return False
-
-        self._clone_task(task, origin_ds)
+        # recursion-aware: the Nth visit of (step, path) clones the Nth
+        # origin iteration
+        cursor = self._origin_clone_cursor.get((task.step, index_path), 0)
+        if cursor >= len(candidates):
+            return False
+        self._origin_clone_cursor[(task.step, index_path)] = cursor + 1
+        self._clone_task(task, candidates[cursor])
         return True
 
     def _index_path_for(self, task):

@@ -59,6 +59,18 @@ GRAPHS = {
         {"name": "j", "join": True, "next": ["end"]},
         {"name": "end"},
     ],
+    # recursion via switch back-edge: work+check iterate loop_counter
+    # times, then the exit case runs (reference: test/core recursive
+    # graph shapes)
+    "recursive": [
+        {"name": "start", "next": ["work"]},
+        {"name": "work", "next": ["check"]},
+        {"name": "check", "switch": {"again": "work", "stop": "done"},
+         "loop_counter": 3, "loop_case": "again", "exit_case": "stop",
+         "next": ["work", "done"]},
+        {"name": "done", "next": ["end"]},
+        {"name": "end"},
+    ],
 }
 
 # execution contexts: CLI/env/provider variations every graph must survive.
@@ -187,6 +199,16 @@ def expected_task_counts(graph):
         child_mult = multiplier * spec.get("foreach", 1) \
             * spec.get("num_parallel", 1)
         if spec.get("switch"):
+            if spec.get("loop_counter"):
+                # the switch and its back-edge target each run
+                # loop_counter times; one pass was already counted on the
+                # way in, so add the remaining K-1 before taking the exit
+                k = spec["loop_counter"]
+                back = spec["switch"][spec["loop_case"]]
+                counts[name] += multiplier * (k - 1)
+                counts[back] = counts.get(back, 0) + multiplier * (k - 1)
+                visit(spec["switch"][spec["exit_case"]], child_mult)
+                return
             # only the chosen case executes
             chosen = spec["switch"][spec["condition_value"]]
             visit(chosen, child_mult)
@@ -248,7 +270,12 @@ def _innermost_split(graph, join_name):
                 result.setdefault(name, stack[-1])
                 stack = stack[:-1]
         elif spec.get("switch"):
-            pass  # a switch executes ONE branch: no split level opened
+            # a switch executes ONE branch: no split level opened. A
+            # recursive switch's back-edge is not walked (the stack walk
+            # is about split levels, and looping would never terminate).
+            if spec.get("loop_counter"):
+                walk(spec["switch"][spec["exit_case"]], stack)
+                return
         elif (spec.get("foreach") or spec.get("num_parallel")
               or len(spec.get("next", [])) > 1):
             stack = stack + [name]
@@ -303,7 +330,20 @@ def generate_flow(graph, flow_name, fail_step=None):
         else:
             lines.append("        self.trace = self.trace + [%r]" % name)
         if spec.get("switch"):
-            lines.append("        self.choice = %r" % spec["condition_value"])
+            if spec.get("loop_counter"):
+                # data-dependent recursion: iterate until the counter
+                # (carried as an artifact across iterations) hits K
+                lines.append(
+                    "        self.loop_n = getattr(self, 'loop_n', 0) + 1"
+                )
+                lines.append(
+                    "        self.choice = %r if self.loop_n < %d else %r"
+                    % (spec["loop_case"], spec["loop_counter"],
+                       spec["exit_case"])
+                )
+            else:
+                lines.append("        self.choice = %r"
+                             % spec["condition_value"])
             cases = ", ".join(
                 "%r: self.%s" % (k, v) for k, v in spec["switch"].items()
             )

@@ -89,6 +89,8 @@ RESUME_CASES = [
     ("nested_foreach", "leaf"),
     ("branch", "j"),
     ("gang", "train"),
+    # failing AFTER the loop: every recursion iteration must clone
+    ("recursive", "done"),
 ]
 
 # resume under every scheduler-execution context: the fork pool (default),
