@@ -69,6 +69,245 @@ def configure_unset(key):
     click.echo("removed %s from %s" % (key.upper(), path))
 
 
+@configure.command(name="list", help="List configuration profiles.")
+def configure_list():
+    import json
+
+    from .metaflow_config import _profile_path
+
+    root = os.path.dirname(_profile_path())
+    active = os.environ.get("TPUFLOW_PROFILE", "") or "(default)"
+    if not os.path.isdir(root):
+        click.echo("no profiles yet (%s does not exist)" % root)
+        return
+    for name in sorted(os.listdir(root)):
+        if not (name == "config.json" or (name.startswith("config_")
+                                          and name.endswith(".json"))):
+            continue
+        prof = name[len("config_"):-len(".json")] if name != "config.json" \
+            else "(default)"
+        try:
+            with open(os.path.join(root, name)) as f:
+                n_keys = len(json.load(f))
+        except (OSError, ValueError):
+            n_keys = "?"
+        click.echo("%s %-20s %s keys  (%s)"
+                   % ("*" if prof == active else " ", prof, n_keys, name))
+
+
+@configure.command(name="export", help="Print the active profile as JSON.")
+@click.argument("out", required=False, type=click.Path())
+def configure_export(out):
+    import json
+
+    from .metaflow_config import _profile_path
+
+    try:
+        with open(_profile_path()) as f:
+            payload = f.read()
+        json.loads(payload)
+    except FileNotFoundError:
+        payload = "{}"
+    except ValueError as ex:
+        raise click.ClickException(
+            "profile %s is not valid JSON: %s" % (_profile_path(), ex))
+    if out:
+        with open(out, "w") as f:
+            f.write(payload)
+        click.echo("exported %s to %s" % (_profile_path(), out))
+    else:
+        click.echo(payload)
+
+
+@configure.command(name="import", help="Load a JSON file into the profile.")
+@click.argument("src", type=click.Path(exists=True))
+def configure_import(src):
+    import json
+
+    from .metaflow_config import _profile_path
+
+    with open(src) as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict):
+        raise click.ClickException("profile must be a JSON object")
+    # the resolver only matches uppercase names (set_conf uppercases too)
+    payload = {k.upper(): v for k, v in payload.items()}
+    path = _profile_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+    click.echo("imported %d keys into %s" % (len(payload), path))
+
+
+@configure.command(
+    name="gcp",
+    help="Guided GCP/TPU setup: shared GCS datastore (+ optional metadata "
+         "service). Prompts when flags are omitted (reference: the "
+         "interactive `metaflow configure` flows, non-cloud-specific "
+         "parts re-homed for GCS/TPU).")
+@click.option("--datastore-root", default=None,
+              help="gs://bucket/prefix for artifacts")
+@click.option("--service-url", default=None,
+              help="metadata service URL (empty = keep local metadata)")
+@click.option("--yes", is_flag=True, help="accept without prompting")
+def configure_gcp(datastore_root, service_url, yes):
+    from .metaflow_config import set_conf
+
+    if datastore_root is None:
+        if yes:
+            raise click.ClickException(
+                "--yes needs --datastore-root (nothing to prompt for)")
+        datastore_root = click.prompt(
+            "GCS datastore root (gs://bucket/prefix)", type=str)
+    if not datastore_root.startswith("gs://"):
+        raise click.ClickException(
+            "datastore root must be a gs:// URL, got %r" % datastore_root)
+    if service_url is None and not yes:
+        service_url = click.prompt(
+            "metadata service URL (blank keeps local metadata)",
+            default="", show_default=False)
+    updates = {
+        "DEFAULT_DATASTORE": "gs",
+        "DATASTORE_SYSROOT_GS": datastore_root,
+    }
+    if service_url:
+        updates["DEFAULT_METADATA"] = "service"
+        updates["SERVICE_URL"] = service_url
+    if not yes:
+        for k, v in updates.items():
+            click.echo("  %s = %s" % (k, v))
+        click.confirm("write these to the profile?", abort=True)
+    for k, v in updates.items():
+        path = set_conf(k, v)
+    click.echo("configured for GCP (%s)" % path)
+
+
+@configure.command(name="local",
+                   help="Reset to local datastore + local metadata.")
+def configure_local():
+    from .metaflow_config import set_conf
+
+    for key in ("DEFAULT_DATASTORE", "DATASTORE_SYSROOT_GS",
+                "DEFAULT_METADATA", "SERVICE_URL"):
+        path = set_conf(key, None)
+    click.echo("reset to local defaults (%s)" % path)
+
+
+@configure.command(
+    name="validate",
+    help="Probe the configured providers: local root writable, GCS "
+         "endpoint reachable, metadata service answering /ping.")
+def configure_validate():
+    from . import metaflow_config as cfg
+
+    failures = 0
+
+    def report(name, ok, detail=""):
+        nonlocal failures
+        failures += 0 if ok else 1
+        click.echo("  [%s] %-18s %s" % ("ok" if ok else "FAIL", name,
+                                        detail))
+
+    root = cfg.datastore_sysroot_local()
+    try:
+        os.makedirs(root, exist_ok=True)
+        probe = os.path.join(root, ".configure-probe")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.unlink(probe)
+        report("local datastore", True, root)
+    except OSError as ex:
+        report("local datastore", False, "%s: %s" % (root, ex))
+
+    if cfg.default_datastore() == "gs" or cfg.datastore_sysroot_gs():
+        gs_root = cfg.datastore_sysroot_gs()
+        if not gs_root:
+            report("gs datastore", False, "DATASTORE_SYSROOT_GS unset")
+        else:
+            try:
+                from .gsop import GSClient, parse_gs_url
+
+                bucket, prefix = parse_gs_url(gs_root)
+                GSClient().list(bucket, prefix=prefix, delimiter="/")
+                report("gs datastore", True, gs_root)
+            except Exception as ex:
+                report("gs datastore", False, "%s (%s)" % (gs_root, ex))
+
+    if cfg.default_metadata() == "service" or cfg.service_url():
+        url = cfg.service_url()
+        if not url:
+            report("metadata service", False, "SERVICE_URL unset")
+        else:
+            try:
+                import json
+                import urllib.request
+
+                with urllib.request.urlopen(url.rstrip("/") + "/ping",
+                                            timeout=5) as resp:
+                    info = json.loads(resp.read() or b"{}")
+                report("metadata service", True,
+                       "%s (version %s)" % (url, info.get("version", "?")))
+            except Exception as ex:
+                report("metadata service", False, "%s (%s)" % (url, ex))
+
+    if failures:
+        raise click.ClickException("%d probe(s) failed" % failures)
+    click.echo("configuration valid")
+
+
+@main.group(help="Developer tooling (reference: `metaflow develop`).")
+def develop():
+    pass
+
+
+@develop.command(name="stubs", help="Generate .pyi stubs (alias of "
+                                    "`python -m metaflow_tpu stubs`).")
+@click.argument("out_dir", default="metaflow_tpu-stubs")
+def develop_stubs(out_dir):
+    from .cmd.stubgen import generate
+
+    click.echo("wrote %s" % generate(out_dir))
+
+
+def _run_flow_subcommand(flow_file, subcommand):
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, flow_file, subcommand], capture_output=True,
+            text=True, timeout=120,
+        )
+    except subprocess.TimeoutExpired:
+        raise click.ClickException(
+            "`%s %s` timed out after 120s (hanging import?)"
+            % (flow_file, subcommand))
+    if proc.returncode != 0:
+        # both streams: the error usually lands on stderr while partial
+        # output sits on stdout
+        for stream in (proc.stdout, proc.stderr):
+            if stream.strip():
+                click.echo(stream.strip(), err=True)
+        raise SystemExit(proc.returncode)
+    click.echo(proc.stdout.strip() or proc.stderr.strip())
+
+
+@develop.command(name="check",
+                 help="Import a flow file and run the full linter without "
+                      "executing anything.")
+@click.argument("flow_file", type=click.Path(exists=True))
+def develop_check(flow_file):
+    _run_flow_subcommand(flow_file, "check")
+
+
+@develop.command(name="graph",
+                 help="Print a flow's DAG (text, or graphviz dot with "
+                      "--dot).")
+@click.argument("flow_file", type=click.Path(exists=True))
+@click.option("--dot", is_flag=True)
+def develop_graph(flow_file, dot):
+    _run_flow_subcommand(flow_file, "output-dot" if dot else "show")
+
+
 @main.group()
 def tutorials():
     pass

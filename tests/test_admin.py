@@ -41,6 +41,74 @@ class TestMainCli:
         conf = json.load(open(os.path.join(home, "config.json")))
         assert "DEFAULT_DATASTORE" not in conf
 
+    def test_configure_profiles_list_export_import(self, tmp_path):
+        home = str(tmp_path / "cfghome")
+        env = {"TPUFLOW_HOME": home}
+        _mcli("configure", "set", "default_datastore", "gs", env_extra=env)
+        _mcli("configure", "set", "datastore_sysroot_gs", "gs://b/p",
+              env_extra=env)
+        out = _mcli("configure", "list", env_extra=env)
+        assert out.returncode == 0 and "(default)" in out.stdout
+
+        exported = str(tmp_path / "prof.json")
+        out = _mcli("configure", "export", exported, env_extra=env)
+        assert out.returncode == 0
+        assert json.load(open(exported))["DEFAULT_DATASTORE"] == "gs"
+
+        # import into a DIFFERENT profile
+        env2 = dict(env, TPUFLOW_PROFILE="staging")
+        out = _mcli("configure", "import", exported, env_extra=env2)
+        assert out.returncode == 0
+        conf = json.load(open(os.path.join(home, "config_staging.json")))
+        assert conf["DATASTORE_SYSROOT_GS"] == "gs://b/p"
+        out = _mcli("configure", "list", env_extra=env2)
+        assert "staging" in out.stdout and "* staging" in out.stdout
+
+    def test_configure_gcp_flags_and_local_reset(self, tmp_path):
+        home = str(tmp_path / "cfghome")
+        env = {"TPUFLOW_HOME": home}
+        out = _mcli("configure", "gcp", "--datastore-root", "gs://bkt/rt",
+                    "--service-url", "", "--yes", env_extra=env)
+        assert out.returncode == 0, out.stderr
+        conf = json.load(open(os.path.join(home, "config.json")))
+        assert conf["DEFAULT_DATASTORE"] == "gs"
+        assert conf["DATASTORE_SYSROOT_GS"] == "gs://bkt/rt"
+        # bad URL refused
+        out = _mcli("configure", "gcp", "--datastore-root", "s3://nope",
+                    "--yes", env_extra=env)
+        assert out.returncode != 0
+        # reset
+        out = _mcli("configure", "local", env_extra=env)
+        assert out.returncode == 0
+        conf = json.load(open(os.path.join(home, "config.json")))
+        assert "DEFAULT_DATASTORE" not in conf
+
+    def test_configure_validate(self, tmp_path):
+        home = str(tmp_path / "cfghome")
+        root = str(tmp_path / "dsroot")
+        env = {"TPUFLOW_HOME": home,
+               "TPUFLOW_DATASTORE_SYSROOT_LOCAL": root}
+        out = _mcli("configure", "validate", env_extra=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "configuration valid" in out.stdout
+        # a configured-but-unreachable service must FAIL the probe
+        env["TPUFLOW_SERVICE_URL"] = "http://127.0.0.1:1/x"
+        env["TPUFLOW_DEFAULT_METADATA"] = "service"
+        out = _mcli("configure", "validate", env_extra=env)
+        assert out.returncode != 0
+        assert "FAIL" in out.stdout
+
+    def test_develop_check_and_graph(self, tmp_path):
+        flow = os.path.join(REPO, "tests", "flows", "linear_flow.py")
+        env = {"TPUFLOW_DATASTORE_SYSROOT_LOCAL": str(tmp_path / "r"),
+               "JAX_PLATFORMS": "cpu"}
+        out = _mcli("develop", "check", flow, env_extra=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        out = _mcli("develop", "graph", flow, env_extra=env)
+        assert out.returncode == 0 and "start" in out.stdout
+        out = _mcli("develop", "graph", flow, "--dot", env_extra=env)
+        assert out.returncode == 0 and "digraph" in out.stdout
+
     def test_tutorials_list(self):
         out = _mcli("tutorials", "list")
         assert "00-helloworld" in out.stdout

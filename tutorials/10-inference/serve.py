@@ -1,0 +1,55 @@
+"""Episode 10: batch inference with the KV-cache decode engine.
+
+Training produced a checkpoint; this flow fans prompts out over a
+foreach, and every branch runs jitted autoregressive generation
+(metaflow_tpu.inference) — prefill + scan in ONE compiled program, the
+KV cache resident in device memory. On real hardware each branch lands
+on its own chip/slice (BASELINE's SD3-style sharded-inference pattern,
+applied to LLM decoding).
+
+Run:  python serve.py run
+"""
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, step
+
+
+class InferenceFlow(FlowSpec):
+    @step
+    def start(self):
+        # three prompt batches; real flows would read these from the
+        # datastore or an IncludeFile
+        self.prompt_sets = [11, 22, 33]  # rng seeds standing in for data
+        self.next(self.generate, foreach="prompt_sets")
+
+    @step
+    def generate(self):
+        import jax
+
+        from metaflow_tpu.inference import make_generator
+        from metaflow_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()   # llama3_8b() on real hardware
+        # production: llama.load_checkpoint(...) / orbax restore
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        prompts = jax.random.randint(
+            jax.random.PRNGKey(self.input), (4, 16), 0, cfg.vocab_size
+        )
+        gen = make_generator(cfg, max_new_tokens=16, temperature=0.7)
+        out = gen(params, prompts, jax.random.PRNGKey(self.input))
+        self.completions = out.tolist()
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.all_completions = sum((i.completions for i in inputs), [])
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print("generated %d completions of %d tokens each"
+              % (len(self.all_completions), len(self.all_completions[0])))
+
+
+if __name__ == "__main__":
+    InferenceFlow()
