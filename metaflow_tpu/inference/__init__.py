@@ -4,10 +4,12 @@ from .decode import (
     init_kv_cache,
     make_generator,
 )
+from .loading import load_run_checkpoint
 
 __all__ = [
     "decode_forward",
     "generate",
     "init_kv_cache",
     "make_generator",
+    "load_run_checkpoint",
 ]

@@ -1,0 +1,113 @@
+"""Serve from a past run's @checkpoint without re-entering a flow.
+
+Training steps save model state through `current.checkpoint` (orbax, into
+the run's datastore tree — plugins/tpu/checkpoint_decorator.py). This is
+the read side for serving: resolve a run through the client API, locate
+its checkpoint root, orbax-restore the pytree, hand it to the decode
+engine. The reference keeps checkpointing in an external extension and
+has no serving story at all; here train → checkpoint → serve is one
+framework.
+"""
+
+import os
+
+from ..exception import TpuFlowException
+
+
+def _ds_root():
+    from .. import metaflow_config as cfg
+
+    if cfg.default_datastore() == "gs":
+        root = cfg.datastore_sysroot_gs()
+        if not root:
+            raise TpuFlowException(
+                "DEFAULT_DATASTORE is gs but DATASTORE_SYSROOT_GS is "
+                "unset — configure the shared datastore root first."
+            )
+        return root, "gs"
+    return cfg.datastore_sysroot_local(), "local"
+
+
+def _candidate_run_ids(flow_name, run_namespace):
+    """Successful run ids, newest first. Serving usually runs as a
+    different identity than training, so the default looks across ALL
+    namespaces (pass run_namespace='user:alice' etc. to narrow)."""
+    from ..client import Flow, get_namespace, namespace
+
+    saved = get_namespace()
+    namespace(run_namespace)
+    try:
+        return [run.id for run in Flow(flow_name).runs if run.successful]
+    finally:
+        namespace(saved)
+
+
+def _resolve_tree(run_root, ds_type, flow_name, run_id, step_name):
+    """(step_name, missing_reason): auto-detect the checkpointing step."""
+    if step_name is not None:
+        return step_name, None
+    if ds_type != "local":
+        raise TpuFlowException(
+            "step_name is required on non-local datastores (listing "
+            "gs:// checkpoint trees is ambiguous)."
+        )
+    candidates = sorted(os.listdir(run_root)) if os.path.isdir(
+        run_root) else []
+    if len(candidates) == 1:
+        return candidates[0], None
+    if not candidates:
+        return None, "no checkpoints"
+    raise TpuFlowException(
+        "Run %s/%s has %d checkpointing steps (%s); pass step_name "
+        "explicitly." % (flow_name, run_id, len(candidates),
+                         ", ".join(candidates))
+    )
+
+
+def load_run_checkpoint(flow_name, run_id=None, step_name=None,
+                        scope="root", ckpt_step=None, like=None,
+                        run_namespace=None):
+    """Restore the pytree a past run checkpointed.
+
+    flow_name: the flow whose run saved the checkpoint.
+    run_id:    default = the newest successful run WITH checkpoints —
+               a resumed run clones its checkpointing step and writes
+               nothing of its own, so the scan walks back to the origin
+               run's tree automatically.
+    step_name: the @checkpoint step; auto-detected when the run has
+               exactly one checkpointing step.
+    scope:     foreach-index path ('root' outside any foreach — the same
+               scoping checkpoint_decorator writes).
+    ckpt_step: which saved step to load (default: latest).
+    like:      structure template for orbax restore (sharded/typed).
+    run_namespace: client namespace for the run scan (default: all
+               namespaces — serving rarely shares the trainer's user tag).
+    """
+    from ..plugins.tpu.checkpoint_decorator import Checkpointer, _join
+
+    ds_root, ds_type = _ds_root()
+    if run_id is not None:
+        candidates = [str(run_id)]
+    else:
+        candidates = _candidate_run_ids(flow_name, run_namespace)
+        if not candidates:
+            raise TpuFlowException(
+                "No successful run of %s to load a checkpoint from."
+                % flow_name
+            )
+    for rid in candidates:
+        run_root = _join(ds_root, flow_name, "checkpoints", rid)
+        step, missing = _resolve_tree(run_root, ds_type, flow_name, rid,
+                                      step_name)
+        if missing:
+            continue
+        root = _join(run_root, step, scope)
+        restored = Checkpointer(root).load(step=ckpt_step, like=like)
+        if restored is not None:
+            return restored
+        if run_id is not None:
+            break
+    raise TpuFlowException(
+        "No checkpoint found for %s (runs tried: %s) — saved with "
+        "current.checkpoint.save()?" % (flow_name, ", ".join(candidates))
+    )

@@ -152,3 +152,57 @@ class TestShardedDecode:
                 lambda p, t: generate(p, t, cfg, max_new_tokens=4)
             )(sharded_params, batch["tokens"])
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestCheckpointServing:
+    def test_load_run_checkpoint(self, run_flow, tpuflow_root, tmp_path):
+        """train (a flow with @checkpoint) → serve: load the saved pytree
+        outside any flow through the client/checkpoint bridge."""
+        import os
+        import textwrap
+
+        from metaflow_tpu.inference import load_run_checkpoint
+
+        flow = tmp_path / "ckpt_train_flow.py"
+        flow.write_text(textwrap.dedent("""
+            import metaflow_tpu
+            from metaflow_tpu import FlowSpec, current, step
+
+            class CkptTrainFlow(FlowSpec):
+                @metaflow_tpu.checkpoint
+                @step
+                def start(self):
+                    import jax.numpy as jnp
+                    w = jnp.arange(4.0)
+                    for i in range(3):
+                        w = w + 1.0
+                        current.checkpoint.save({"w": w, "step": i},
+                                                step=i)
+                    self.next(self.end)
+
+                @step
+                def end(self):
+                    pass
+
+            if __name__ == "__main__":
+                CkptTrainFlow()
+        """))
+        run_flow(str(flow), "run")
+        restored = load_run_checkpoint("CkptTrainFlow")
+        assert int(restored["step"]) == 2
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.arange(4.0) + 3.0)
+        # explicit checkpoint step
+        early = load_run_checkpoint("CkptTrainFlow", step_name="start",
+                                    ckpt_step=0)
+        np.testing.assert_allclose(np.asarray(early["w"]),
+                                   np.arange(4.0) + 1.0)
+
+    def test_load_run_checkpoint_errors(self, tpuflow_root):
+        import pytest as _pytest
+
+        from metaflow_tpu.exception import TpuFlowException
+        from metaflow_tpu.inference import load_run_checkpoint
+
+        with _pytest.raises(TpuFlowException):
+            load_run_checkpoint("NoSuchFlowEver")

@@ -30,7 +30,9 @@ class InferenceFlow(FlowSpec):
         from metaflow_tpu.models import llama
 
         cfg = llama.LlamaConfig.tiny()   # llama3_8b() on real hardware
-        # production: llama.load_checkpoint(...) / orbax restore
+        # production: restore a trained run's weights instead —
+        #   from metaflow_tpu.inference import load_run_checkpoint
+        #   params = load_run_checkpoint("TpuTrainFlow")["params"]
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         prompts = jax.random.randint(
             jax.random.PRNGKey(self.input), (4, 16), 0, cfg.vocab_size
