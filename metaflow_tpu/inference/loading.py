@@ -28,18 +28,56 @@ def _ds_root():
     return cfg.datastore_sysroot_local(), "local"
 
 
-def _candidate_run_ids(flow_name, run_namespace):
-    """Successful run ids, newest first. Serving usually runs as a
-    different identity than training, so the default looks across ALL
-    namespaces (pass run_namespace='user:alice' etc. to narrow)."""
-    from ..client import Flow, get_namespace, namespace
+def _with_namespace(run_namespace, fn):
+    from ..client import get_namespace, namespace
 
     saved = get_namespace()
     namespace(run_namespace)
     try:
-        return [run.id for run in Flow(flow_name).runs if run.successful]
+        return fn()
     finally:
         namespace(saved)
+
+
+def _latest_successful_run_id(flow_name, run_namespace):
+    """Newest successful run id (lazy — stops at the first hit). Serving
+    usually runs as a different identity than training, so the default
+    looks across ALL namespaces (pass run_namespace='user:alice' etc. to
+    narrow)."""
+    from ..client import Flow
+
+    def scan():
+        for run in Flow(flow_name):
+            if run.successful:
+                return run.id
+        return None
+
+    return _with_namespace(run_namespace, scan)
+
+
+def _origin_run_of(flow_name, run_id, run_namespace):
+    """The origin run a resumed run cloned from, via task metadata
+    ('origin-run-id' on re-executed tasks, 'origin-task' pathspecs on
+    clones); None for a fresh run."""
+    from ..client import Run
+
+    def scan():
+        try:
+            run = Run("%s/%s" % (flow_name, run_id))
+        except Exception:
+            return None
+        for step_obj in run:
+            for task in step_obj:
+                md = task.metadata_dict
+                origin = md.get("origin-run-id")
+                if origin:
+                    return str(origin)
+                origin_task = md.get("origin-task")
+                if origin_task and origin_task.count("/") == 3:
+                    return origin_task.split("/")[1]
+        return None
+
+    return _with_namespace(run_namespace, scan)
 
 
 def _resolve_tree(run_root, ds_type, flow_name, run_id, step_name):
@@ -70,10 +108,11 @@ def load_run_checkpoint(flow_name, run_id=None, step_name=None,
     """Restore the pytree a past run checkpointed.
 
     flow_name: the flow whose run saved the checkpoint.
-    run_id:    default = the newest successful run WITH checkpoints —
-               a resumed run clones its checkpointing step and writes
-               nothing of its own, so the scan walks back to the origin
-               run's tree automatically.
+    run_id:    default = the newest successful run; when that run has no
+               checkpoints of its own (resume clones the checkpointing
+               step, writing nothing), the loader follows its recorded
+               origin-run lineage back to the run that actually saved —
+               it never falls through to unrelated older runs.
     step_name: the @checkpoint step; auto-detected when the run has
                exactly one checkpointing step.
     scope:     foreach-index path ('root' outside any foreach — the same
@@ -86,28 +125,36 @@ def load_run_checkpoint(flow_name, run_id=None, step_name=None,
     from ..plugins.tpu.checkpoint_decorator import Checkpointer, _join
 
     ds_root, ds_type = _ds_root()
-    if run_id is not None:
-        candidates = [str(run_id)]
-    else:
-        candidates = _candidate_run_ids(flow_name, run_namespace)
-        if not candidates:
+    if run_id is None:
+        run_id = _latest_successful_run_id(flow_name, run_namespace)
+        if run_id is None:
             raise TpuFlowException(
                 "No successful run of %s to load a checkpoint from."
                 % flow_name
             )
-    for rid in candidates:
+    # follow the resume lineage (bounded — cycles are impossible but a
+    # corrupt metadata chain must not loop forever)
+    tried = []
+    rid = str(run_id)
+    while rid and rid not in tried and len(tried) < 16:
+        tried.append(rid)
         run_root = _join(ds_root, flow_name, "checkpoints", rid)
         step, missing = _resolve_tree(run_root, ds_type, flow_name, rid,
                                       step_name)
-        if missing:
-            continue
-        root = _join(run_root, step, scope)
-        restored = Checkpointer(root).load(step=ckpt_step, like=like)
-        if restored is not None:
-            return restored
-        if run_id is not None:
-            break
+        if not missing:
+            root = _join(run_root, step, scope)
+            restored = Checkpointer(root).load(step=ckpt_step, like=like)
+            if restored is not None:
+                return restored
+            if ckpt_step is not None:
+                # the run HAS a checkpoint tree but not this step: raise
+                # rather than silently serving some other run's weights
+                raise TpuFlowException(
+                    "Run %s/%s has checkpoints under %s but none for "
+                    "ckpt_step=%r." % (flow_name, rid, root, ckpt_step)
+                )
+        rid = _origin_run_of(flow_name, rid, run_namespace)
     raise TpuFlowException(
-        "No checkpoint found for %s (runs tried: %s) — saved with "
-        "current.checkpoint.save()?" % (flow_name, ", ".join(candidates))
+        "No checkpoint found for %s (resume lineage tried: %s) — saved "
+        "with current.checkpoint.save()?" % (flow_name, ", ".join(tried))
     )

@@ -158,7 +158,6 @@ class TestCheckpointServing:
     def test_load_run_checkpoint(self, run_flow, tpuflow_root, tmp_path):
         """train (a flow with @checkpoint) → serve: load the saved pytree
         outside any flow through the client/checkpoint bridge."""
-        import os
         import textwrap
 
         from metaflow_tpu.inference import load_run_checkpoint
@@ -206,3 +205,52 @@ class TestCheckpointServing:
 
         with _pytest.raises(TpuFlowException):
             load_run_checkpoint("NoSuchFlowEver")
+
+    def test_resume_lineage_finds_origin_checkpoint(self, run_flow,
+                                                    tpuflow_root,
+                                                    tmp_path):
+        """A resumed run CLONES its checkpointing step (writes no
+        checkpoints of its own); the loader must follow the origin-run
+        lineage instead of falling through to unrelated runs."""
+        import textwrap
+
+        from metaflow_tpu.inference import load_run_checkpoint
+
+        flow = tmp_path / "ckpt_resume_flow.py"
+        flow.write_text(textwrap.dedent("""
+            import os
+
+            import metaflow_tpu
+            from metaflow_tpu import FlowSpec, current, step
+
+            class CkptResumeFlow(FlowSpec):
+                @metaflow_tpu.checkpoint
+                @step
+                def start(self):
+                    import jax.numpy as jnp
+                    current.checkpoint.save(
+                        {"w": jnp.ones((2,)) * 5.0, "step": 0}, step=0)
+                    self.next(self.late)
+
+                @step
+                def late(self):
+                    if os.environ.get("FAIL_ONCE") == "1":
+                        raise RuntimeError("induced failure")
+                    self.next(self.end)
+
+                @step
+                def end(self):
+                    pass
+
+            if __name__ == "__main__":
+                CkptResumeFlow()
+        """))
+        run_flow(str(flow), "run", expect_fail=True,
+                 env_extra={"FAIL_ONCE": "1"})
+        proc = run_flow(str(flow), "resume")
+        assert "Cloned" in proc.stdout
+        # the latest SUCCESSFUL run is the resumed one (start cloned, no
+        # checkpoints of its own) — the loader must walk to the origin
+        restored = load_run_checkpoint("CkptResumeFlow")
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.ones(2) * 5.0)
