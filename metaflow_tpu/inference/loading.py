@@ -143,12 +143,15 @@ def load_run_checkpoint(flow_name, run_id=None, step_name=None,
                                       step_name)
         if not missing:
             root = _join(run_root, step, scope)
-            restored = Checkpointer(root).load(step=ckpt_step, like=like)
+            ckpt = Checkpointer(root)
+            restored = ckpt.load(step=ckpt_step, like=like)
             if restored is not None:
                 return restored
-            if ckpt_step is not None:
+            if ckpt_step is not None and ckpt.list():
                 # the run HAS a checkpoint tree but not this step: raise
-                # rather than silently serving some other run's weights
+                # rather than silently serving some other run's weights.
+                # (An EMPTY tree — explicit step_name on a resumed run —
+                # falls through to the origin lineage below.)
                 raise TpuFlowException(
                     "Run %s/%s has checkpoints under %s but none for "
                     "ckpt_step=%r." % (flow_name, rid, root, ckpt_step)
