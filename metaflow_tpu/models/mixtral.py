@@ -136,7 +136,9 @@ def _layer(cfg, cos, sin, carry, layer_params, mesh=None):
         layer_params["w_up"],
         layer_params["w_down"],
         num_experts_per_tok=cfg.experts_per_tok,
-        capacity_factor=cfg.capacity_factor,
+        # gmm is dropless: the capacity knob does not apply to it
+        capacity_factor=(None if cfg.moe_dispatch == "gmm"
+                         else cfg.capacity_factor),
         dispatch=cfg.moe_dispatch,
         mesh=mesh,
     )

@@ -1,0 +1,259 @@
+"""Grouped matrix multiply: the dropless-MoE kernel (megablocks pattern).
+
+`y[i] = x[i] @ w[g(i)]` where rows of x are grouped (sorted + padded so
+every `block_s`-row tile belongs to exactly ONE group). The pallas TPU
+kernel streams row tiles through the MXU with the group's weight tile
+selected per grid step via a scalar-prefetched tile→group table — no
+`[groups, tokens]` one-hot, no capacity drops: compute scales with the
+actual token count (plus ≤ groups·block_s rows of zero padding).
+
+Backward: dx is the same kernel with transposed weights; dw accumulates
+per-tile outer products into the group's weight-grad block, exploiting
+the sorted layout (tiles of one group are consecutive, so the output
+block is revisited across consecutive grid steps — the pallas TPU
+accumulation idiom).
+
+The reference delegates MoE entirely to user frameworks (SURVEY.md §5.7);
+this is this repo's scalable-dispatch fast path alongside the
+capacity-bucketed one in ops/moe.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas import is TPU/CPU-interpret capable; keep soft for portability
+    from jax.experimental import pallas as pl
+except Exception:  # pragma: no cover
+    pl = None
+
+
+def _pltpu():
+    """LAZY import: jax.experimental.pallas.tpu touches the TPU plugin
+    registry at import time — with the axon tunnel wedged that hangs, so
+    it must never run at module import (only when a gmm actually
+    executes, by which point the caller has committed to a backend)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu
+
+BLOCK_S = 128  # row-tile = the padding quantum of the grouped layout
+BLOCK_F = 128
+BLOCK_D = 128
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# grouped layout: sort slots by group, pad each group to a BLOCK_S multiple
+# ---------------------------------------------------------------------------
+
+
+def make_group_layout(group_ids, num_groups, block_s=BLOCK_S):
+    """Static-shape grouped layout for `gmm`.
+
+    group_ids: [n] int32 — the group of each row.
+    Returns dict with:
+      dest       [n]        destination row of each input row
+      tile_group [n_tiles]  group id of every block_s-row tile
+      padded_len            static total rows (multiple of block_s)
+
+    Every group's rows land contiguously at a block_s-aligned offset, so
+    each tile belongs to exactly one group; rows past a group's count are
+    zero padding (they multiply into zeros and accumulate nothing).
+    """
+    n = group_ids.shape[0]
+    counts = jnp.bincount(group_ids, length=num_groups)
+    padded = ((counts + block_s - 1) // block_s) * block_s
+    ends = jnp.cumsum(padded)
+    offsets = ends - padded
+    # rank of each row within its group (stable arrival order) via a
+    # stable argsort — O(n log n), no [n, groups] one-hot materialized
+    order = jnp.argsort(group_ids, stable=True)
+    excl = jnp.cumsum(counts) - counts  # rows in earlier groups
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32)
+        - excl[group_ids[order]].astype(jnp.int32)
+    )
+    dest = offsets[group_ids] + rank
+
+    # static upper bound on total padded rows
+    padded_len = -(-n // block_s) * block_s + num_groups * block_s
+    n_tiles = padded_len // block_s
+    tile_start = jnp.arange(n_tiles, dtype=jnp.int32) * block_s
+    # tile t belongs to the first group whose padded range ends past it;
+    # tiles beyond every group clamp to the last group — they hold only
+    # zero rows, so the extra matmuls produce zeros
+    tile_group = jnp.minimum(
+        jnp.searchsorted(ends, tile_start, side="right"),
+        num_groups - 1,
+    ).astype(jnp.int32)
+    return {"dest": dest, "tile_group": tile_group,
+            "padded_len": padded_len}
+
+
+def scatter_rows(rows, layout):
+    """[n, D] → padded [padded_len, D] grouped layout (zeros elsewhere)."""
+    out = jnp.zeros((layout["padded_len"], rows.shape[1]), rows.dtype)
+    return out.at[layout["dest"]].set(rows)
+
+
+def gather_rows(padded, layout):
+    return padded[layout["dest"]]
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+
+def _gmm_fwd_kernel(tg_ref, x_ref, w_ref, y_ref):
+    y_ref[...] = jnp.dot(
+        x_ref[...], w_ref[0],
+        preferred_element_type=jnp.float32,
+    ).astype(y_ref.dtype)
+
+
+def _gmm_call(x, w, tile_group, block_s, block_f, interpret):
+    if pl is None:
+        raise ImportError(
+            "jax.experimental.pallas is unavailable in this jax install — "
+            "gmm dispatch needs it (use dispatch='sparse' instead)")
+    S, D = x.shape
+    G, Dw, F = w.shape
+    assert D == Dw, (D, Dw)
+    block_f = min(block_f, F)
+    if S % block_s or F % block_f:
+        raise ValueError(
+            "gmm needs S %% block_s == 0 and F %% block_f == 0 "
+            "(S=%d bs=%d, F=%d bf=%d)" % (S, block_s, F, block_f))
+    grid = (S // block_s, F // block_f)
+    return pl.pallas_call(
+        _gmm_fwd_kernel,
+        grid_spec=_pltpu().PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_s, D), lambda i, j, tg: (i, 0)),
+                pl.BlockSpec((1, D, block_f), lambda i, j, tg: (tg[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((block_s, block_f),
+                                   lambda i, j, tg: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, F), x.dtype),
+        interpret=interpret,
+    )(tile_group, x, w)
+
+
+def _gmm_dw_kernel(tg_ref, x_ref, dy_ref, dw_ref):
+    i = pl.program_id(2)
+    first_of_group = jnp.logical_or(
+        i == 0, tg_ref[i] != tg_ref[jnp.maximum(i - 1, 0)]
+    )
+    tile = jnp.dot(
+        x_ref[...].T, dy_ref[...], preferred_element_type=jnp.float32
+    ).astype(dw_ref.dtype)
+
+    @pl.when(first_of_group)
+    def _():
+        dw_ref[0] = tile
+
+    @pl.when(jnp.logical_not(first_of_group))
+    def _():
+        dw_ref[0] = dw_ref[0] + tile
+
+
+def _gmm_dw_call(x, dy, tile_group, num_groups, block_s, block_d, block_f,
+                 interpret):
+    S, D = x.shape
+    _, F = dy.shape
+    block_d = min(block_d, D)
+    block_f = min(block_f, F)
+    if D % block_d or F % block_f:
+        raise ValueError(
+            "gmm dw needs D %% block_d == 0 and F %% block_f == 0 "
+            "(D=%d bd=%d, F=%d bf=%d)" % (D, block_d, F, block_f))
+    # i (row tiles) INNERMOST: for a fixed (d, f) the output block
+    # dw[tg[i], d, f] is revisited across the consecutive i of one group
+    grid = (D // block_d, F // block_f, S // block_s)
+    return pl.pallas_call(
+        _gmm_dw_kernel,
+        grid_spec=_pltpu().PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_s, block_d),
+                             lambda d, f, i, tg: (i, d)),
+                pl.BlockSpec((block_s, block_f),
+                             lambda d, f, i, tg: (i, f)),
+            ],
+            out_specs=pl.BlockSpec((1, block_d, block_f),
+                                   lambda d, f, i, tg: (tg[i], d, f)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((num_groups, D, F), jnp.float32),
+        interpret=interpret,
+    )(tile_group, x, dy)
+
+
+# ---------------------------------------------------------------------------
+# public op with custom vjp
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def gmm(x, w, tile_group, block_s=BLOCK_S, block_f=BLOCK_F,
+        interpret=None):
+    """y[i·bs:(i+1)·bs] = x[i·bs:(i+1)·bs] @ w[tile_group[i]].
+
+    x: [S, D] grouped+padded rows (S % block_s == 0 — make_group_layout);
+    w: [G, D, F]; tile_group: [S // block_s] int32.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    return _gmm_call(x, w, tile_group, block_s, block_f, interpret)
+
+
+def _gmm_fwd(x, w, tile_group, block_s, block_f, interpret):
+    if interpret is None:
+        interpret = _default_interpret()
+    y = _gmm_call(x, w, tile_group, block_s, block_f, interpret)
+    return y, (x, w, tile_group)
+
+
+def _gmm_bwd(block_s, block_f, interpret, residuals, dy):
+    x, w, tile_group = residuals
+    if interpret is None:
+        interpret = _default_interpret()
+    # dx: the same grouped matmul against w^T
+    dx = _gmm_call(
+        dy, jnp.swapaxes(w, 1, 2), tile_group, block_s,
+        min(block_f, w.shape[1]), interpret,
+    ).astype(x.dtype)
+    dw = _gmm_dw_call(
+        x, dy, tile_group, w.shape[0], block_s,
+        min(BLOCK_D, w.shape[1]), block_f, interpret,
+    )
+    # a group with ZERO rows owns no tile, so the dw grid never writes
+    # its block — on real TPU that block is uninitialized memory, not
+    # zeros (interpret mode hides this). Mask to the visited groups.
+    # where, not multiply: the unvisited block may be NaN-filled
+    # (interpret mode) or arbitrary bits (hardware) — 0 * NaN is NaN
+    visited = jnp.zeros((w.shape[0],), bool).at[tile_group].set(True)
+    dw = jnp.where(visited[:, None, None], dw, 0).astype(w.dtype)
+    return dx, dw, None
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def gmm_reference(x, w, tile_group, block_s=BLOCK_S):
+    """XLA oracle: one-hot tile→group selection (tests only)."""
+    S, D = x.shape
+    tiles = x.reshape(S // block_s, block_s, D)
+    w_per_tile = w[tile_group]  # [n_tiles, D, F]
+    y = jnp.einsum("tbd,tdf->tbf", tiles, w_per_tile,
+                   preferred_element_type=jnp.float32)
+    return y.reshape(S, w.shape[-1]).astype(x.dtype)

@@ -20,9 +20,18 @@ Two dispatch strategies, numerically equivalent modulo capacity drops:
     einsums. O(num_experts × tokens) FLOPs; kept for equivalence tests
     and tiny-scale debugging only.
 
-Capacity semantics are identical in both paths: an expert accepts its
-first ``capacity`` tokens in token order; the rest are dropped (their
-combine weight becomes 0 and the residual stream passes through).
+``gmm`` — DROPLESS dispatch via the pallas grouped-matmul kernel
+    (ops/gmm.py, megablocks pattern): slots sort into expert-contiguous
+    tiles and each tile multiplies its expert's weights directly on the
+    MXU. Exact top-k semantics (no capacity, no drops) at
+    O(k × tokens + experts·block) FLOPs. Single-shard experts (dense/
+    tensor-parallel meshes); the capacity path remains the
+    expert-parallel all-to-all story.
+
+Capacity semantics are identical in the sparse and dense paths: an
+expert accepts its first ``capacity`` tokens in token order; the rest
+are dropped (their combine weight becomes 0 and the residual stream
+passes through). The gmm path has no capacity — it is exactly dropless.
 """
 
 import math
@@ -117,9 +126,18 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
             tokens, weights, idx, one_hot, w_gate, w_up, w_down, num_experts,
             k, capacity_factor, activation,
         )
+    elif dispatch == "gmm":
+        if capacity_factor is not None:
+            raise ValueError(
+                "dispatch='gmm' is dropless — capacity_factor must be None"
+            )
+        out = _gmm_dispatch_ffn(
+            tokens, weights, idx, w_gate, w_up, w_down, num_experts, k,
+            activation,
+        )
     else:
-        raise ValueError("dispatch must be 'sparse' or 'dense', got %r"
-                         % (dispatch,))
+        raise ValueError("dispatch must be 'sparse', 'dense' or 'gmm', "
+                         "got %r" % (dispatch,))
     return out.reshape(B, S, E), aux
 
 
@@ -165,6 +183,29 @@ def _sparse_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
     # out); out-of-range gathers clamp but are zeroed by the keep mask
     y_slots = y_buf[e_flat, safe_pos]                # [T*k, E]
     y_slots = jnp.where(keep[:, None], y_slots, 0) * w_flat[:, None]
+    return y_slots.reshape(T, k, E).sum(axis=1)
+
+
+def _gmm_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
+                      num_experts, k, activation):
+    """Dropless dispatch through the pallas grouped matmul: sort slots
+    into expert-contiguous 128-row tiles, run the three expert matmuls as
+    gmm, gather-combine. Exact top-k output (bit-comparable to the dense
+    oracle without capacity)."""
+    from .gmm import gather_rows, gmm, make_group_layout, scatter_rows
+
+    T, E = tokens.shape
+    e_flat = idx.reshape(T * k)
+    w_flat = weights.reshape(T * k)
+    t_flat = jnp.arange(T * k) // k
+
+    layout = make_group_layout(e_flat, num_experts)
+    x_pad = scatter_rows(tokens[t_flat], layout)
+    tg = layout["tile_group"]
+    gate = activation(gmm(x_pad, w_gate, tg))
+    up = gmm(x_pad, w_up, tg)
+    y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg)
+    y_slots = gather_rows(y_pad, layout) * w_flat[:, None]
     return y_slots.reshape(T, k, E).sum(axis=1)
 
 
