@@ -219,6 +219,72 @@ def bench_decode():
     }
 
 
+def bench_moe():
+    """Mixtral-style MoE train-step throughput (tokens/s/chip), dispatch
+    selectable via BENCH_MOE_DISPATCH (sparse | gmm | dense) — the
+    on-chip comparison of the capacity-bucketed vs dropless paths."""
+    import jax
+
+    from metaflow_tpu.models import mixtral
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import (make_trainer,
+                                       memory_efficient_optimizer,
+                                       shard_batch)
+
+    on_tpu = jax.default_backend() == "tpu"
+    dispatch = os.environ.get("BENCH_MOE_DISPATCH", "gmm")
+    if on_tpu:
+        cfg = mixtral.MixtralConfig(
+            vocab_size=32_000, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=4, ffn_dim=2048, n_experts=8, experts_per_tok=2,
+            dtype="bfloat16", moe_dispatch=dispatch,
+            capacity_factor=None if dispatch == "gmm" else 1.25,
+        )
+        batch, seq, steps = 16, 1024, 8
+    else:
+        cfg = mixtral.MixtralConfig.tiny(
+            moe_dispatch=dispatch,
+            capacity_factor=None if dispatch == "gmm" else 1.25,
+        )
+        batch, seq, steps = 4, 128, 2
+
+    mesh = create_mesh(MeshSpec.dp() if len(jax.devices()) == 1
+                       else MeshSpec.fsdp())
+    state, step, _ = make_trainer(
+        jax.random.PRNGKey(0), cfg, mesh, mixtral,
+        optimizer=memory_efficient_optimizer(total_steps=1000),
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    data = shard_batch({"tokens": tokens}, mesh)
+    with mesh:
+        state, m = step(state, data)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, data)
+        jax.block_until_ready(m["loss"])
+        dt = time.perf_counter() - t0
+    n_devices = len(jax.devices())
+    tps = batch * seq * steps / dt / n_devices
+    return {
+        "metric": "mixtral_%s_moe_%s_train_tokens_per_sec_per_chip"
+        % ("8x1b" if on_tpu else "tiny_cpu", dispatch),
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": _vs_baseline(tps),
+        "extra": {
+            "backend": jax.default_backend(),
+            "n_devices": n_devices,
+            "dispatch": dispatch,
+            "params": mixtral.num_params(state["params"]),
+            "batch": batch,
+            "seq": seq,
+            "loss": float(m["loss"]),
+        },
+    }
+
+
 def bench_step_launch():
     """p50 latency from scheduler queue → task attempt marker (the reference
     instruments this via metaflow_profile from_start markers).
@@ -464,11 +530,11 @@ if __name__ == "__main__":
         result = bench_step_launch()
     elif mode == "data":
         result = bench_data_path()
-    elif mode == "decode":
+    elif mode in ("decode", "moe"):
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             if _wait_for_tpu() is None:
                 _rerun_on_cpu()
-        result = bench_decode()
+        result = bench_decode() if mode == "decode" else bench_moe()
         if os.environ.get("BENCH_DEGRADED"):
             result["degraded"] = True
             result["degraded_reason"] = os.environ["BENCH_DEGRADED"]

@@ -85,11 +85,17 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
     if "router" in lp:  # Mixtral: token-choice MoE FFN
         from ..ops.moe import moe_ffn
 
+        dispatch = getattr(cfg, "moe_dispatch", "sparse")
+        if dispatch == "gmm":
+            # gmm's block-aligned padding is sized for training batches;
+            # a per-token decode step would pad ~8 rows to experts×128.
+            # sparse with no capacity is lossless — identical outputs.
+            dispatch = "sparse"
         moe_out, _aux = moe_ffn(
             h, lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             num_experts_per_tok=cfg.experts_per_tok,
             capacity_factor=None,  # decode batches are tiny: lossless
-            dispatch=getattr(cfg, "moe_dispatch", "sparse"),
+            dispatch=dispatch,
             mesh=mesh,
         )
         x = x + moe_out

@@ -131,6 +131,15 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
             raise ValueError(
                 "dispatch='gmm' is dropless — capacity_factor must be None"
             )
+        active = mesh if mesh is not None else _active_mesh()
+        if active is not None and "expert" in active.axis_names:
+            # silently all-gathering every expert's weights (and fp32
+            # grads) onto every chip would defeat the expert axis the
+            # user asked for — the capacity path is the EP story
+            raise ValueError(
+                "dispatch='gmm' runs experts single-shard; on an "
+                "expert-parallel mesh use dispatch='sparse'"
+            )
         out = _gmm_dispatch_ffn(
             tokens, weights, idx, w_gate, w_up, w_down, num_experts, k,
             activation,
