@@ -3,6 +3,7 @@ from .rope import rope_frequencies, apply_rope
 from .attention import attention, flash_attention, reference_attention
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses_attention import ulysses_attention, ulysses_attention_sharded
+from .gmm import gather_rows, gmm, make_group_layout, scatter_rows
 from .moe import moe_ffn, top_k_router
 
 __all__ = [
@@ -18,5 +19,9 @@ __all__ = [
     "ulysses_attention",
     "ulysses_attention_sharded",
     "moe_ffn",
+    "gmm",
+    "make_group_layout",
+    "scatter_rows",
+    "gather_rows",
     "top_k_router",
 ]

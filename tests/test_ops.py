@@ -295,10 +295,19 @@ class TestGroupedMatmul:
         layout = make_group_layout(gids, G)
         return gids, rows, w, layout, scatter_rows(rows, layout)
 
-    def test_forward_matches_per_row_matmul(self):
-        from metaflow_tpu.ops.gmm import gather_rows, gmm
+    # the sweep covers n < block_s, a single group, odd n, and a
+    # multi-F-tile many-group case alongside the default
+    @pytest.mark.parametrize("n,D,F,G,seed", [
+        (300, 64, 128, 4, 0),
+        (64, 32, 64, 8, 10),
+        (128, 64, 128, 1, 11),
+        (517, 32, 64, 3, 12),
+        (1024, 64, 256, 16, 13),
+    ])
+    def test_forward_matches_per_row_matmul(self, n, D, F, G, seed):
+        from metaflow_tpu.ops import gather_rows, gmm
 
-        gids, rows, w, layout, x = self._case()
+        gids, rows, w, layout, x = self._case(n=n, D=D, F=F, G=G, seed=seed)
         y = gmm(x, w, layout["tile_group"])
         direct = jnp.einsum("nd,ndf->nf", rows, w[gids])
         np.testing.assert_allclose(
@@ -514,3 +523,5 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
         assert "data" in str(out.sharding.spec)
+
+
