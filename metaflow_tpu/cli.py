@@ -959,11 +959,16 @@ def make_cli(flow, state):
                 rep = rep[:max_value_size] + "..."
             print("%s = %s" % (name, rep))
 
-    @start.command(help="Show logs of a task: logs RUN/STEP/TASK")
+    @start.command(help="Show logs of a task: logs RUN/STEP/TASK. "
+                        "--scrub PERMANENTLY replaces the stored stream "
+                        "with a scrub marker (leaked secrets, PII).")
     @click.argument("pathspec")
     @click.option("--stderr/--stdout", default=False)
+    @click.option("--scrub", is_flag=True,
+                  help="Overwrite the selected stream's persisted content "
+                       "instead of showing it.")
     @click.pass_obj
-    def logs(state, pathspec, stderr):
+    def logs(state, pathspec, stderr, scrub):
         run_id, step_name, task_id = _parse_task_pathspec(pathspec)
         ds = state.flow_datastore.get_task_datastore(
             run_id, step_name, task_id, allow_not_done=True
@@ -971,6 +976,23 @@ def make_cli(flow, state):
         name = "stderr" if stderr else "stdout"
         from . import mflog
 
+        if scrub:
+            # EVERY attempt: failed attempts persist logs too, and a
+            # leaked secret usually predates the successful retry
+            marker = mflog.decorate(b"runtime", b"[log content scrubbed]")
+            scrubbed = []
+            for attempt in range(7):  # hard attempt cap
+                att_ds = state.flow_datastore.get_task_datastore(
+                    run_id, step_name, task_id, attempt=attempt,
+                    allow_not_done=True,
+                )
+                if att_ds.load_log_legacy("runtime", name):
+                    att_ds.save_logs("runtime", {name: marker})
+                    scrubbed.append(attempt)
+            echo("scrubbed %s of %s/%s/%s (attempts: %s)"
+                 % (name, run_id, step_name, task_id,
+                    ", ".join(map(str, scrubbed)) or "none"))
+            return
         data = ds.load_log_legacy("runtime", name)
         sys.stdout.write(
             mflog.format_merged([data]).decode("utf-8", errors="replace")

@@ -360,3 +360,20 @@ def test_client_tag_mutation_concurrent(run_flow, flows_dir, tpuflow_root):
     assert all(p.wait(timeout=120) == 0 for p in procs)
     fresh = c.Flow("LinearFlow").latest_run
     assert {"worker:%d" % i for i in range(8)} <= fresh.tags
+
+
+def test_logs_scrub(run_flow, flows_dir, tpuflow_root):
+    """`logs --scrub` permanently replaces a task's persisted stream
+    (leaked secrets) — reference logs_cli scrub parity."""
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "run")
+    with open(os.path.join(tpuflow_root, "LinearFlow", "latest_run")) as f:
+        run_id = f.read().strip()
+    spec = "%s/end/3" % run_id
+
+    proc = run_flow(os.path.join(flows_dir, "linear_flow.py"), "logs", spec)
+    assert "final x" in proc.stdout
+    run_flow(os.path.join(flows_dir, "linear_flow.py"), "logs", spec,
+             "--scrub")
+    proc = run_flow(os.path.join(flows_dir, "linear_flow.py"), "logs", spec)
+    assert "final x" not in proc.stdout
+    assert "scrubbed" in proc.stdout

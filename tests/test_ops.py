@@ -409,6 +409,15 @@ class TestGroupedMatmul:
             moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
                     capacity_factor=1.0, dispatch="gmm")
 
+    def test_gmm_refuses_expert_parallel_mesh(self):
+        """gmm runs experts single-shard — on an 'expert' mesh it would
+        silently all-gather every expert's weights; must refuse loudly."""
+        x, router, wg, wu, wd = _moe_weights(E=128, F=128)
+        mesh = create_mesh(MeshSpec.moe(expert=4))
+        with pytest.raises(ValueError, match="expert-parallel"):
+            moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
+                    dispatch="gmm", mesh=mesh)
+
 
 class TestRopeNorms:
     def test_rope_rotation_preserves_norm(self):
@@ -523,5 +532,3 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
         assert "data" in str(out.sharding.spec)
-
-
