@@ -979,9 +979,11 @@ def make_cli(flow, state):
         if scrub:
             # EVERY attempt: failed attempts persist logs too, and a
             # leaked secret usually predates the successful retry
+            from .datastore import MAX_ATTEMPTS
+
             marker = mflog.decorate(b"runtime", b"[log content scrubbed]")
             scrubbed = []
-            for attempt in range(7):  # hard attempt cap
+            for attempt in range(MAX_ATTEMPTS):
                 att_ds = state.flow_datastore.get_task_datastore(
                     run_id, step_name, task_id, attempt=attempt,
                     allow_not_done=True,

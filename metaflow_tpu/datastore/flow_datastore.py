@@ -233,17 +233,19 @@ class FlowDataStore(object):
         with self._registry_lock():
             registry = self._read_registry()
             now = time.time()
-            changed = False
+            # refresh the timestamp on EVERY registration, including
+            # dedup hits: gc's mark phase keeps keys newer than the
+            # oldest kept run, so a payload re-included by a recent run
+            # must carry that run's timestamp, not its first upload's.
+            # Every call therefore rewrites the registry JSON — it is
+            # small (one entry per code package) and registration is
+            # once per run, not per artifact. max(): a clock-skewed
+            # writer must never move a stamp backwards (the lock is
+            # best-effort on remote stores) — that could expose a live
+            # package to gc pruning.
             for key in keys:
-                # refresh the timestamp on EVERY registration, including
-                # dedup hits: gc's mark phase keeps keys newer than the
-                # oldest kept run, so a payload re-included by a recent
-                # run must carry that run's timestamp, not its first
-                # upload's
-                if registry.get(key, 0) < now:
-                    registry[key] = now
-                    changed = True
-            if changed:
+                registry[key] = max(now, registry.get(key, 0))
+            if keys:
                 self._write_registry(registry)
 
     def registered_data_keys(self, newer_than=None):

@@ -287,16 +287,19 @@ class GCSStorage(DataStoreStorage):
                     import tempfile
 
                     scratch = os.environ.get("TPUFLOW_SCRATCH_DIR") or None
-                    with tempfile.NamedTemporaryFile(
+                    tmp = tempfile.NamedTemporaryFile(
                         delete=False, dir=scratch
-                    ) as tmp:
-                        shutil.copyfileobj(byte_obj, tmp, length=1 << 20)
-                        tmpname = tmp.name
-                    try:
+                    )
+                    try:  # one unlink guard over spool AND upload: a
+                        # failed copy (scratch disk full) must not leak
+                        # the spool file
+                        with tmp:
+                            shutil.copyfileobj(byte_obj, tmp,
+                                               length=1 << 20)
                         self.client.put_file(self._bucket_name, key,
-                                             tmpname)
+                                             tmp.name)
                     finally:
-                        os.unlink(tmpname)
+                        os.unlink(tmp.name)
                     return
                 finally:
                     if hasattr(byte_obj, "close"):

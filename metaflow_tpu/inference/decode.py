@@ -156,6 +156,13 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
         rng = jax.random.PRNGKey(0)
     B, P = prompt_tokens.shape
     total = P + max_new_tokens
+    if max_seq_len is not None and max_seq_len < total:
+        # dynamic_update_slice clamps out-of-range writes, which would
+        # silently overwrite live cache slots instead of failing
+        raise ValueError(
+            "max_seq_len=%d < prompt_len (%d) + max_new_tokens (%d); "
+            "the KV cache cannot hold the generation" %
+            (max_seq_len, P, max_new_tokens))
     cache = init_kv_cache(cfg, B, max_seq_len or total)
 
     logits, cache = decode_forward(params, prompt_tokens, cache, 0, cfg,

@@ -213,7 +213,23 @@ def gmm(x, w, tile_group, block_s=BLOCK_S, block_f=BLOCK_F,
     """
     if interpret is None:
         interpret = _default_interpret()
+    _check_bwd_blocks(w, block_f)
     return _gmm_call(x, w, tile_group, block_s, block_f, interpret)
+
+
+def _check_bwd_blocks(w, block_f):
+    """The backward pass tiles D as a feature dim (dx) and as a reduced
+    dim (dw); misconfigured shapes must fail at forward time, not when
+    gradients are first taken."""
+    D = w.shape[1]
+    if D % min(block_f, D):
+        raise ValueError(
+            "gmm needs D %% min(block_f, D) == 0 (D=%d, block_f=%d): the "
+            "dx backward kernel tiles D with that block" % (D, block_f))
+    if D % min(BLOCK_D, D):
+        raise ValueError(
+            "gmm needs D %% min(%d, D) == 0 (D=%d): the dw backward "
+            "kernel tiles D with that block" % (BLOCK_D, D))
 
 
 def _gmm_fwd(x, w, tile_group, block_s, block_f, interpret):

@@ -99,6 +99,14 @@ class TestGenerate:
         row = np.asarray(out[0, tokens.shape[1]:])
         assert row[0] == eos and (row == eos).all()
 
+    def test_undersized_max_seq_len_refused(self, setup):
+        # dynamic_update_slice would clamp the write index and silently
+        # corrupt the cache; must fail loudly up front
+        cfg, params, tokens = setup
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(params, tokens, cfg, max_new_tokens=4,
+                     max_seq_len=tokens.shape[1] + 2)
+
     def test_jitted_generator(self, setup):
         cfg, params, tokens = setup
         gen = make_generator(cfg, max_new_tokens=4)

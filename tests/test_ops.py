@@ -409,6 +409,18 @@ class TestGroupedMatmul:
             moe_ffn(x, router, wg, wu, wd, num_experts_per_tok=2,
                     capacity_factor=1.0, dispatch="gmm")
 
+    def test_gmm_indivisible_model_dim_fails_at_forward(self):
+        """D=192 tiles fine forward (D is never blocked there) but the
+        dx backward kernel tiles D by block_f — must fail at forward
+        time with one clear error, not on the first grad."""
+        from metaflow_tpu.ops.gmm import gmm
+
+        x = jnp.ones((128, 192), jnp.float32)
+        w = jnp.ones((2, 192, 128), jnp.float32)
+        tg = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(ValueError, match="backward"):
+            gmm(x, w, tg, interpret=True)
+
     def test_gmm_refuses_expert_parallel_mesh(self):
         """gmm runs experts single-shard — on an 'expert' mesh it would
         silently all-gather every expert's weights; must refuse loudly."""
