@@ -221,8 +221,11 @@ def bench_decode():
 
 def bench_moe():
     """Mixtral-style MoE train-step throughput (tokens/s/chip), dispatch
-    selectable via BENCH_MOE_DISPATCH (sparse | gmm | dense) — the
-    on-chip comparison of the capacity-bucketed vs dropless paths."""
+    selectable via BENCH_MOE_DISPATCH (sparse | gmm | gmm_ep | dense) —
+    the on-chip comparison of the capacity-bucketed vs dropless paths.
+    gmm_ep runs on an expert-axis mesh (size min(experts, devices); 1 on
+    the single bench chip, where it measures the a2a+local-gmm machinery
+    itself); BENCH_MOE_EP_FACTOR bounds its a2a buffers (default exact)."""
     import jax
 
     from metaflow_tpu.models import mixtral
@@ -233,23 +236,41 @@ def bench_moe():
 
     on_tpu = jax.default_backend() == "tpu"
     dispatch = os.environ.get("BENCH_MOE_DISPATCH", "gmm")
+    dropless = dispatch in ("gmm", "gmm_ep")
+    ep_factor = os.environ.get("BENCH_MOE_EP_FACTOR")
     if on_tpu:
         cfg = mixtral.MixtralConfig(
             vocab_size=32_000, dim=1024, n_layers=8, n_heads=16,
             n_kv_heads=4, ffn_dim=2048, n_experts=8, experts_per_tok=2,
             dtype="bfloat16", moe_dispatch=dispatch,
-            capacity_factor=None if dispatch == "gmm" else 1.25,
+            capacity_factor=None if dropless else 1.25,
+            ep_buffer_factor=float(ep_factor) if ep_factor else None,
         )
         batch, seq, steps = 16, 1024, 8
     else:
         cfg = mixtral.MixtralConfig.tiny(
             moe_dispatch=dispatch,
-            capacity_factor=None if dispatch == "gmm" else 1.25,
+            capacity_factor=None if dropless else 1.25,
+            ep_buffer_factor=float(ep_factor) if ep_factor else None,
         )
         batch, seq, steps = 4, 128, 2
 
-    mesh = create_mesh(MeshSpec.dp() if len(jax.devices()) == 1
-                       else MeshSpec.fsdp())
+    if dispatch == "gmm_ep":
+        ep = min(cfg.n_experts, len(jax.devices()))
+        if ep > 1:
+            mesh = create_mesh(MeshSpec.moe(expert=ep))
+        else:
+            # single chip: MeshSpec canonicalization drops size-1 axes,
+            # but gmm_ep needs the 'expert' axis to exist — build the
+            # degenerate mesh directly (a2a become no-ops; the bench
+            # measures the dispatch machinery + local gmm)
+            import numpy as _np
+            from jax.sharding import Mesh
+
+            mesh = Mesh(_np.asarray(jax.devices()[:1]), ("expert",))
+    else:
+        mesh = create_mesh(MeshSpec.dp() if len(jax.devices()) == 1
+                           else MeshSpec.fsdp())
     state, step, _ = make_trainer(
         jax.random.PRNGKey(0), cfg, mesh, mixtral,
         optimizer=memory_efficient_optimizer(total_steps=1000),

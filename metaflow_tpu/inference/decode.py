@@ -86,7 +86,7 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
         from ..ops.moe import moe_ffn
 
         dispatch = getattr(cfg, "moe_dispatch", "sparse")
-        if dispatch == "gmm":
+        if dispatch in ("gmm", "gmm_ep"):
             # gmm's block-aligned padding is sized for training batches;
             # a per-token decode step would pad ~8 rows to experts×128.
             # sparse with no capacity is lossless — identical outputs.

@@ -35,9 +35,13 @@ class MixtralConfig:
     remat: bool = True
     router_aux_coef: float = 0.02
     # sparse = capacity-bucketed expert-parallel dispatch (ops/moe.py);
+    # gmm = dropless grouped-matmul, single-shard experts;
+    # gmm_ep = dropless composed with expert parallelism (a2a + local
+    # gmm, bounded by ep_buffer_factor);
     # dense = the O(num_experts × tokens) oracle, debugging only
     moe_dispatch: str = "sparse"
     capacity_factor: float = 2.0
+    ep_buffer_factor: float = None  # gmm_ep only; None = exact/dropless
 
     @property
     def head_dim(self):
@@ -136,11 +140,13 @@ def _layer(cfg, cos, sin, carry, layer_params, mesh=None):
         layer_params["w_up"],
         layer_params["w_down"],
         num_experts_per_tok=cfg.experts_per_tok,
-        # gmm is dropless: the capacity knob does not apply to it
-        capacity_factor=(None if cfg.moe_dispatch == "gmm"
+        # gmm/gmm_ep are dropless: the capacity knob does not apply
+        capacity_factor=(None if cfg.moe_dispatch in ("gmm", "gmm_ep")
                          else cfg.capacity_factor),
         dispatch=cfg.moe_dispatch,
         mesh=mesh,
+        ep_buffer_factor=(cfg.ep_buffer_factor
+                          if cfg.moe_dispatch == "gmm_ep" else None),
     )
     return (x + moe_out, aux_sum + aux), None
 

@@ -25,8 +25,20 @@ Two dispatch strategies, numerically equivalent modulo capacity drops:
     tiles and each tile multiplies its expert's weights directly on the
     MXU. Exact top-k semantics (no capacity, no drops) at
     O(k × tokens + experts·block) FLOPs. Single-shard experts (dense/
-    tensor-parallel meshes); the capacity path remains the
-    expert-parallel all-to-all story.
+    tensor-parallel meshes).
+
+``gmm_ep`` — dropless dispatch COMPOSED with expert parallelism
+    (shard_map over the 'expert' mesh axis): each expert-axis member
+    routes a 1/P token slice, all-to-alls slots to the shard owning
+    their expert, runs the LOCAL grouped matmul over its n/P experts,
+    and all-to-alls results back. Static shapes force a per-(src,dst)
+    send budget: ``ep_buffer_factor=None`` (default) sizes it at the
+    worst case — bit-equivalent to the dense oracle, truly dropless,
+    but each shard's gmm is padded to the full slot count (weights and
+    grads still shard P ways); a finite factor sizes buffers at
+    ``factor·slots/P`` for real P-fold FLOPs scaling with
+    shard-overflow drops only under routing imbalance (the aux loss
+    pushes toward balance).
 
 Capacity semantics are identical in the sparse and dense paths: an
 expert accepts its first ``capacity`` tokens in token order; the rest
@@ -90,7 +102,7 @@ def _constrain_expert_axis(x, mesh):
 
 def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
             capacity_factor=None, activation=jax.nn.silu, dispatch="sparse",
-            mesh=None):
+            mesh=None, ep_buffer_factor=None):
     """Token-choice MoE feed-forward.
 
     x:        [B, S, E]
@@ -100,12 +112,35 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
               its expert buffers to the 'expert' axis even when the step
               is traced outside a `with mesh:` block; falls back to the
               ambient mesh context when omitted.
+    ep_buffer_factor: 'gmm_ep' only — per-(src,dst) all-to-all budget as
+              a multiple of the balanced share. None = exact worst case
+              (dropless); ~1-2 trades shard-overflow drops under extreme
+              imbalance for P-fold FLOPs scaling.
 
     Returns (out [B, S, E], aux_loss scalar).
     """
     B, S, E = x.shape
     num_experts = router_w.shape[1]
     k = num_experts_per_tok
+
+    if dispatch == "gmm_ep":
+        # routing happens per token-slice INSIDE the shard_map; branch
+        # before the full-batch router below
+        if capacity_factor is not None:
+            raise ValueError(
+                "dispatch='gmm_ep' is dropless — capacity_factor must be "
+                "None (bound memory with ep_buffer_factor instead)")
+        active = mesh if mesh is not None else _active_mesh()
+        if active is None or "expert" not in active.axis_names:
+            raise ValueError(
+                "dispatch='gmm_ep' needs a mesh with an 'expert' axis "
+                "(use dispatch='gmm' for single-shard experts)")
+        return _gmm_ep_dispatch_ffn(
+            x, router_w, w_gate, w_up, w_down, num_experts, k, activation,
+            active, ep_buffer_factor,
+        )
+    if ep_buffer_factor is not None:
+        raise ValueError("ep_buffer_factor only applies to dispatch='gmm_ep'")
     tokens = x.reshape(B * S, E)
 
     router_logits = jnp.einsum(
@@ -138,15 +173,16 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, num_experts_per_tok=2,
             # user asked for — the capacity path is the EP story
             raise ValueError(
                 "dispatch='gmm' runs experts single-shard; on an "
-                "expert-parallel mesh use dispatch='sparse'"
+                "expert-parallel mesh use dispatch='gmm_ep' (dropless) "
+                "or 'sparse' (capacity-bucketed)"
             )
         out = _gmm_dispatch_ffn(
             tokens, weights, idx, w_gate, w_up, w_down, num_experts, k,
             activation,
         )
     else:
-        raise ValueError("dispatch must be 'sparse', 'dense' or 'gmm', "
-                         "got %r" % (dispatch,))
+        raise ValueError("dispatch must be 'sparse', 'dense', 'gmm' or "
+                         "'gmm_ep', got %r" % (dispatch,))
     return out.reshape(B, S, E), aux
 
 
@@ -216,6 +252,140 @@ def _gmm_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
     y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg)
     y_slots = gather_rows(y_pad, layout) * w_flat[:, None]
     return y_slots.reshape(T, k, E).sum(axis=1)
+
+
+def _gmm_ep_dispatch_ffn(x, router_w, w_gate, w_up, w_down, num_experts, k,
+                         activation, mesh, ep_buffer_factor):
+    """Dropless grouped-matmul dispatch composed with expert parallelism.
+
+    shard_map over the WHOLE mesh: batch rides its usual ('data','fsdp')
+    axes, expert weights live split on 'expert' (and their mlp dim on
+    'tensor'). Per expert-axis member, over its static 1/P token slice:
+
+      route → bucket slots by destination shard → all_to_all in →
+      local gmm over this shard's n/P experts → psum partial mlp
+      contractions over 'tensor' → all_to_all back → weighted combine →
+      all_gather token slices.
+
+    The per-(src,dst) buffer is the static-shape price of dropless EP on
+    TPU (XLA cannot ship dynamic row counts): exact worst case when
+    ep_buffer_factor is None, `ceil(factor·slots/P)` otherwise. The
+    reference delegates all of MoE to user frameworks
+    (/root/reference/metaflow/plugins/frameworks/pytorch.py:11-46); this
+    composition is the repo's own per-chip-efficiency path for the
+    Mixtral target.
+    """
+    import math as _math
+
+    from jax.sharding import PartitionSpec as P
+
+    from .attention import shard_map_novma
+    from .gmm import BLOCK_S, gather_rows, gmm, make_group_layout, \
+        scatter_rows
+
+    axes = set(mesh.axis_names)
+    ep = mesh.shape["expert"]
+    if num_experts % ep:
+        raise ValueError(
+            "gmm_ep needs num_experts %% expert-axis size == 0 "
+            "(experts=%d, expert axis=%d)" % (num_experts, ep))
+    n_local = num_experts // ep
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in axes)
+    tensor = "tensor" if "tensor" in axes else None
+    token_axes = batch_axes + ("expert",)
+
+    B, S, E = x.shape
+    batch_shards = 1
+    for a in batch_axes:
+        batch_shards *= mesh.shape[a]
+    if B % batch_shards:
+        raise ValueError("gmm_ep: batch %d not divisible by batch shards %d"
+                         % (B, batch_shards))
+    T_block = (B // batch_shards) * S   # tokens per batch-shard block
+    if T_block % ep:
+        raise ValueError(
+            "gmm_ep: per-shard token count %d not divisible by the "
+            "expert axis (%d) — each member routes a 1/P token slice"
+            % (T_block, ep))
+    T_slice = T_block // ep
+    slots = T_slice * k
+    if ep_buffer_factor is None:
+        c_send = slots                  # worst case: every slot, one dst
+    else:
+        c_send = min(slots, int(_math.ceil(ep_buffer_factor * slots / ep)))
+        c_send = max(1, c_send)
+
+    def per_member(xb, rw, wg, wu, wd):
+        Bb, Sb, Eb = xb.shape
+        tok_all = xb.reshape(Bb * Sb, Eb)
+        p = jax.lax.axis_index("expert")
+        tok = jax.lax.dynamic_slice_in_dim(tok_all, p * T_slice, T_slice, 0)
+
+        logits = jnp.einsum("te,en->tn", tok.astype(jnp.float32),
+                            rw.astype(jnp.float32))
+        weights, idx = top_k_router(logits, num_experts, k, dtype=xb.dtype)
+        sel = jax.nn.one_hot(idx, num_experts, dtype=xb.dtype)
+        # aux: pmean the per-slice ingredients over every token-sharding
+        # axis, THEN combine — sum(mean·mean) is not mean(sum·sum)
+        probs = jax.nn.softmax(logits, axis=-1)
+        fraction = jax.lax.pmean(jnp.mean(sel.sum(axis=1), axis=0),
+                                 token_axes)
+        prob_mean = jax.lax.pmean(jnp.mean(probs, axis=0), token_axes)
+        aux = num_experts * jnp.sum(fraction * prob_mean)
+
+        e_flat = idx.reshape(slots)
+        w_flat = weights.reshape(slots)
+        t_flat = jnp.arange(slots) // k
+        dst = e_flat // n_local
+        # arrival position of each slot within its destination block
+        pos = jnp.cumsum(jax.nn.one_hot(dst, ep, dtype=jnp.int32),
+                         axis=0) - 1
+        pos_flat = jnp.take_along_axis(pos, dst[:, None], axis=1)[:, 0]
+        keep = pos_flat < c_send        # exact mode: always true
+        safe_pos = jnp.where(keep, pos_flat, c_send)
+
+        send_x = jnp.zeros((ep, c_send, Eb), xb.dtype).at[
+            dst, safe_pos].add(tok[t_flat], mode="drop")
+        # local expert id rides with each row; unwritten rows stay 0 —
+        # zero data into expert 0's group contributes nothing
+        send_le = jnp.zeros((ep, c_send), jnp.int32).at[dst, safe_pos].set(
+            e_flat % n_local, mode="drop")
+
+        # [P, C, ·] tiled all_to_all = (member, block) grid transpose:
+        # recv[src] is what src addressed to this member
+        recv_x = jax.lax.all_to_all(send_x, "expert", 0, 0, tiled=True)
+        recv_le = jax.lax.all_to_all(send_le, "expert", 0, 0, tiled=True)
+
+        rows = recv_x.reshape(ep * c_send, Eb)
+        layout = make_group_layout(recv_le.reshape(ep * c_send), n_local,
+                                   block_s=BLOCK_S)
+        x_pad = scatter_rows(rows, layout)
+        tg = layout["tile_group"]
+        gate = activation(gmm(x_pad, wg, tg))
+        up = gmm(x_pad, wu, tg)
+        y_pad = gmm((gate * up).astype(xb.dtype), wd, tg)
+        y_rows = gather_rows(y_pad, layout)
+        if tensor:                      # w_down contracted a sharded mlp dim
+            y_rows = jax.lax.psum(y_rows, tensor)
+
+        y_back = jax.lax.all_to_all(
+            y_rows.reshape(ep, c_send, Eb), "expert", 0, 0, tiled=True)
+        y_slots = y_back[dst, safe_pos]
+        y_slots = jnp.where(keep[:, None], y_slots, 0) * w_flat[:, None]
+        y_slice = y_slots.reshape(T_slice, k, Eb).sum(axis=1)
+        y_full = jax.lax.all_gather(y_slice, "expert", axis=0, tiled=True)
+        return y_full.reshape(Bb, Sb, Eb), aux
+
+    batch_spec = batch_axes if len(batch_axes) > 1 else \
+        (batch_axes[0] if batch_axes else None)
+    out, aux = shard_map_novma(
+        per_member, mesh,
+        in_specs=(P(batch_spec, None, None), P(None, None),
+                  P("expert", None, tensor), P("expert", None, tensor),
+                  P("expert", tensor, None)),
+        out_specs=(P(batch_spec, None, None), P()),
+    )(x, router_w, w_gate, w_up, w_down)
+    return out, aux
 
 
 def _dense_dispatch_ffn(tokens, weights, idx, one_hot, w_gate, w_up, w_down,

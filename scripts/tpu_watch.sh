@@ -51,6 +51,7 @@ while true; do
     commit_history "On-chip decode bench"
     run_bench moe_gmm         BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm
     run_bench moe_sparse      BENCH_MODE=moe BENCH_MOE_DISPATCH=sparse
+    run_bench moe_gmm_ep      BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm_ep
     commit_history "On-chip MoE dispatch benches (gmm vs sparse)"
     run_bench launch          BENCH_MODE=launch BENCH_DAEMON=1
     run_bench data            BENCH_MODE=data
