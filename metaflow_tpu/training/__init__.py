@@ -1,3 +1,4 @@
+from .data import STATE_KEY, ResumableTokenBatches, sharded_dataset
 from .train_step import (
     default_optimizer,
     memory_efficient_optimizer,
@@ -16,4 +17,7 @@ __all__ = [
     "make_trainer",
     "make_eval_step",
     "shard_batch",
+    "ResumableTokenBatches",
+    "sharded_dataset",
+    "STATE_KEY",
 ]

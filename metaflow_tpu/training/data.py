@@ -4,12 +4,118 @@ The reference delegates data loading to user code entirely; on TPU the
 framework must keep the MXU fed — this module provides a minimal sharded
 loader: deterministic global batches cut per-host, placed onto the mesh
 asynchronously one step ahead (double buffering hides the host→HBM copy).
+
+Resumable streams: the reference gets exact resume for free by persisting
+every artifact per task (/root/reference/metaflow/datastore/
+task_datastore.py:880); a TPU training step's data cursor lives in the
+input iterator, so ResumableTokenBatches carries explicit state (epoch,
+batch cursor, shuffle seed) and stamps it onto every batch — checkpoint
+the stamp with the model and a preempted run resumes its token sequence
+exactly, no replay, no skip.
 """
 
 import collections
 import threading
 
 import numpy as np
+
+# key under which ResumableTokenBatches stamps its resume state into each
+# batch dict; shard_iterator passes it through host-side (never deviced)
+STATE_KEY = "data_state"
+
+
+class ResumableTokenBatches(object):
+    """Deterministic, resumable epoch iterator over a 1-D token array.
+
+    Yields {'tokens': [B, seq_len+1], STATE_KEY: {...}} batches. The
+    per-epoch shuffle is a pure function of (seed, epoch), so the stamped
+    state — three ints — fully determines the rest of the stream:
+
+        ds = ResumableTokenBatches(data, 8, 128, seed=0)
+        ...train, checkpoint batch[STATE_KEY] with the model...
+        ds2 = ResumableTokenBatches(data, 8, 128, seed=0)
+        ds2.restore(saved_state)   # continues with the NEXT batch
+
+    The stamp rides inside the batch (not on the iterator) so device
+    prefetch — which runs the iterator ahead of consumption — cannot
+    desynchronize the checkpointed cursor from the batches the train
+    loop actually consumed.
+    """
+
+    def __init__(self, data, batch_size, seq_len, *, seed=None,
+                 epochs=None, drop_last=True):
+        self._data = np.asarray(data)
+        self._batch_size = batch_size
+        self._window = seq_len + 1
+        self._seed = seed
+        self._epochs = epochs
+        self._drop_last = drop_last
+        self._epoch = 0
+        self._cursor = 0  # batches already yielded in the current epoch
+        n_windows = len(self._data) // self._window
+        if n_windows == 0:
+            raise ValueError(
+                "data holds %d tokens — shorter than one %d-token window"
+                % (len(self._data), self._window))
+        self._n_windows = n_windows
+
+    @property
+    def batches_per_epoch(self):
+        if self._drop_last:
+            return self._n_windows // self._batch_size
+        return -(-self._n_windows // self._batch_size)
+
+    def state(self):
+        """Resume state BEFORE the next batch to be produced (flat ints;
+        JSON- and orbax-serializable). Carries the stream geometry too,
+        so restoring onto a differently-shaped stream is a hard error,
+        not a silently different token sequence."""
+        return {"epoch": int(self._epoch), "cursor": int(self._cursor),
+                "seed": self._seed,
+                "batch_size": int(self._batch_size),
+                "window": int(self._window),
+                "n_windows": int(self._n_windows)}
+
+    def restore(self, state):
+        """Position the stream just after the batch that carried `state`
+        — iteration continues with the batch that would have come next."""
+        if state.get("seed") != self._seed:
+            raise ValueError(
+                "checkpointed stream seed %r != this stream's %r — "
+                "restoring would produce a different shuffle order"
+                % (state.get("seed"), self._seed))
+        for key, mine in (("batch_size", self._batch_size),
+                          ("window", self._window),
+                          ("n_windows", self._n_windows)):
+            theirs = int(state[key])
+            if theirs != mine:
+                raise ValueError(
+                    "checkpointed stream %s=%d != this stream's %d — the "
+                    "cursor would address different tokens (same data, "
+                    "batch_size and seq_len are required to resume)"
+                    % (key, theirs, mine))
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        return self
+
+    def _order(self, epoch):
+        if self._seed is None:
+            return np.arange(self._n_windows)
+        rng = np.random.default_rng([int(self._seed), int(epoch)])
+        return rng.permutation(self._n_windows)
+
+    def __iter__(self):
+        data, W, B = self._data, self._window, self._batch_size
+        while self._epochs is None or self._epoch < self._epochs:
+            order = self._order(self._epoch)
+            per_epoch = self.batches_per_epoch
+            while self._cursor < per_epoch:
+                idxs = order[self._cursor * B:(self._cursor + 1) * B]
+                rows = [data[i * W:(i + 1) * W] for i in idxs]
+                self._cursor += 1
+                yield {"tokens": np.stack(rows), STATE_KEY: self.state()}
+            self._epoch += 1
+            self._cursor = 0
 
 
 def token_batches(data, batch_size, seq_len, *, rng=None, drop_last=True):
@@ -32,11 +138,16 @@ def token_batches(data, batch_size, seq_len, *, rng=None, drop_last=True):
 
 
 def shard_iterator(it, mesh):
-    """Place each host batch onto the mesh (batch dim over data axes)."""
+    """Place each host batch onto the mesh (batch dim over data axes).
+    The STATE_KEY resume stamp stays host-side, untouched."""
     from .train_step import shard_batch
 
     for batch in it:
-        yield shard_batch(batch, mesh)
+        state = batch.pop(STATE_KEY, None)
+        batch = shard_batch(batch, mesh)
+        if state is not None:
+            batch[STATE_KEY] = state
+        yield batch
 
 
 def prefetch(iterator, depth=2):
@@ -92,11 +203,21 @@ def prefetch(iterator, depth=2):
 
 
 def sharded_dataset(data, batch_size, seq_len, mesh, rng=None,
-                    prefetch_depth=2):
-    """token_batches → mesh placement → background prefetch, composed."""
-    return prefetch(
-        shard_iterator(
-            token_batches(data, batch_size, seq_len, rng=rng), mesh
-        ),
-        depth=prefetch_depth,
-    )
+                    prefetch_depth=2, seed=None, state=None, epochs=None):
+    """Batching → mesh placement → background prefetch, composed.
+
+    With `seed` (and optionally a checkpointed `state` stamp to resume
+    from), batches come from ResumableTokenBatches and carry their
+    STATE_KEY resume stamp; the legacy `rng` path is single-epoch and
+    unstamped."""
+    if seed is not None or state is not None:
+        ds = ResumableTokenBatches(data, batch_size, seq_len,
+                                   seed=seed if seed is not None
+                                   else (state or {}).get("seed"),
+                                   epochs=epochs)
+        if state is not None:
+            ds.restore(state)
+        source = iter(ds)
+    else:
+        source = token_batches(data, batch_size, seq_len, rng=rng)
+    return prefetch(shard_iterator(source, mesh), depth=prefetch_depth)

@@ -39,10 +39,34 @@ class TpuTrainFlow(FlowSpec):
                                         total_steps=100),
         )
         batch_size = max(4, len(jax.devices()))
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(1), (batch_size, 33), 0, cfg.vocab_size
-        )
-        batch = shard_batch({"tokens": tokens}, mesh)
+
+        # resumable input stream: the stream's cursor (epoch, batch,
+        # shuffle seed + geometry) is checkpointed WITH the full train
+        # state (params, optimizer moments, schedule step), so a
+        # preempted gang resumes its exact token sequence AND loss
+        # trajectory — no replayed batches, no reset Adam moments
+        import numpy as np
+
+        from metaflow_tpu.training import (STATE_KEY,
+                                           ResumableTokenBatches)
+        from metaflow_tpu.training.data import prefetch, shard_iterator
+
+        corpus = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=batch_size * 34 * self.num_steps)
+        ds = ResumableTokenBatches(corpus, batch_size, 32, seed=17,
+                                   epochs=1)
+        shardings = jax.tree.map(lambda x: x.sharding, state)
+        # `like=` template: orbax restores INTO this structure (optax
+        # namedtuples survive); restored arrays land back on the mesh
+        restored = current.checkpoint.load(
+            like={"state": state, "data_state": ds.state(), "loss": 0.0})
+        last_loss, done_steps = None, 0
+        if restored is not None:
+            state = jax.device_put(restored["state"], shardings)
+            ds.restore(restored["data_state"])
+            last_loss = float(restored["loss"])
+            done_steps = int(restored["data_state"]["cursor"])
+        stream = prefetch(shard_iterator(iter(ds), mesh))
 
         # LIVE training card: point a browser at `python train.py card
         # server` and watch the loss curve + progress bar move while the
@@ -55,13 +79,27 @@ class TpuTrainFlow(FlowSpec):
         current.card.append(bar)
         current.card.append(chart)
 
+        # checkpoint CADENCE: a full-pytree orbax save each step would
+        # stall the MXU at real model sizes — save every N steps; on
+        # retry the stream replays only the (deterministic) tail since
+        # the last save, so the trajectory is still exact
+        ckpt_every = 2
         with mesh:
-            for i in range(self.num_steps):
+            for i, batch in enumerate(stream, start=done_steps):
+                stamp = batch.pop(STATE_KEY)
                 state, metrics = train_step(state, batch)
+                last_loss = float(metrics["loss"])
+                if (i + 1) % ckpt_every == 0:
+                    current.checkpoint.save(
+                        {"state": state, "data_state": stamp,
+                         "loss": last_loss}, step=i)
                 bar.update(i + 1)
-                chart.add_point(i, float(metrics["loss"]))
+                chart.add_point(i, last_loss)
                 current.card.refresh()
-        self.loss = float(metrics["loss"])
+        # last_loss survives even if the retry resumed past the final
+        # batch (empty stream): it came from the checkpoint
+        assert last_loss is not None, "no batches and no checkpoint"
+        self.loss = last_loss
         self.rank = current.parallel.node_index
         self.next(self.join)
 
