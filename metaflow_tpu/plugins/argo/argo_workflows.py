@@ -192,14 +192,6 @@ class ArgoWorkflows(object):
                 )
         for name in self.graph.sorted_nodes():
             node = self.graph[name]
-            if (node.type == "split-parallel"
-                    and self._foreach_parent_of(name)):
-                raise TpuFlowException(
-                    "Step *%s*: a num_parallel gang nested inside a foreach "
-                    "is not supported on Argo Workflows yet — the JobSet "
-                    "names of concurrent gang instances would collide. Run "
-                    "locally or lift the gang out of the loop." % name
-                )
             # recursive switch compiles to a template loop; refuse only the
             # shapes the loop template cannot express
             loop_parent = self._loop_parent_of(name)
@@ -358,8 +350,12 @@ class ArgoWorkflows(object):
                 )
             elif join_mode == "gang":
                 ctl = sorted(node.in_funcs)[0]
+                # the control task id carries the split path inside a
+                # foreach (this join shares the gang's scope, so its own
+                # split-path parameter is the same value)
                 step_opts.append(
-                    "--join-inputs-control '%s/%s/%s'" % (RUN_ID, ctl, ctl)
+                    "--join-inputs-control '%s/%s/%s'"
+                    % (RUN_ID, ctl, self._task_id_expr(ctl))
                 )
             elif self._is_switch_merge(node):
                 step_opts.append(
@@ -407,7 +403,30 @@ class ArgoWorkflows(object):
         )
         cmds.append("mkdir -p %s" % ARGO_OUTPUT_DIR)
         cmds.append(capture)
+        if node.type == "foreach" and self._has_gang_descendant(node.name):
+            # a gang inside this foreach bakes the iteration's split path
+            # into its JobSet name; the compile-time DNS budget reserves
+            # 4 digits per level (_gang_step_label), so the fan-out is
+            # capped — fail HERE at the split, not thousands of
+            # iterations later when a 5-digit name fails admission
+            cmds.append(
+                "python -c 'import json,sys; sys.exit(1 if "
+                "len(json.load(open(\"%s/num-splits\"))) > 9999 else 0)' "
+                "|| { echo \"foreach fan-out exceeds the 9999-iteration "
+                "JobSet-name budget (a num_parallel gang runs inside this "
+                "foreach)\"; exit 1; }" % ARGO_OUTPUT_DIR
+            )
         return ["bash", "-c", " && ".join(cmds)]
+
+    def _has_gang_descendant(self, foreach_name):
+        """True when a num_parallel gang executes inside this foreach's
+        scope (directly or in a nested foreach)."""
+        return any(
+            self.graph[n].type == "split-parallel"
+            and any(p == foreach_name for p in self.graph[n].split_parents
+                    if self.graph[p].type == "foreach")
+            for n in self.graph.sorted_nodes()
+        )
 
     def _param_names(self):
         return [
@@ -575,13 +594,22 @@ class ArgoWorkflows(object):
         resources, node_selector = self._resources_for(node)
         retries = self._retries_for(node)
         self._validate_gang_hosts(node)
-        # unique per (workflow, step, attempt): a retried resource
-        # template must not collide with the JobSet it created last time.
-        # Argo only defines {{retries}} inside templates that have a
-        # retryStrategy — bake a literal 0 otherwise.
+        # unique per (workflow, step, foreach-iteration, attempt): a
+        # retried resource template must not collide with the JobSet it
+        # created last time, and concurrent gang instances fanned out by
+        # an enclosing foreach must not collide with EACH OTHER — the
+        # split path ("2-0" = outer split 2, inner split 0; digits and
+        # dashes, DNS-safe) is the iteration identity, the same way the
+        # reference suffixes per-instance entropy into its JobSet names
+        # (metaflow/plugins/argo/argo_workflows.py:1358,
+        # jobset_input_paths.py:4-11). Argo only defines {{retries}}
+        # inside templates that have a retryStrategy — bake a literal 0
+        # otherwise.
         attempt = "{{retries}}" if retries else "0"
-        js_name = "{{workflow.name}}-%s-r%s" % (
-            self._gang_step_label(node), attempt)
+        split_seg = ("-s{{inputs.parameters.split-path}}"
+                     if self._foreach_parent_of(node.name) else "")
+        js_name = "{{workflow.name}}-%s%s-r%s" % (
+            self._gang_step_label(node), split_seg, attempt)
         container = {
             "name": "main",
             "image": self.image,
@@ -637,6 +665,7 @@ class ArgoWorkflows(object):
             "inputs": {"parameters": [
                 {"name": "input-paths", "value": ""},
                 {"name": "num-parallel", "value": "1"},
+                {"name": "split-path", "value": ""},
                 {"name": "task-id", "value": node.name},
             ]},
             "resource": {
@@ -668,13 +697,28 @@ class ArgoWorkflows(object):
     # largest supported gang, not index 0
     _GANG_SUFFIX = "-gang-0-9999"
 
+    def _foreach_depth_of(self, name):
+        """How many foreach scopes enclose this node (0 = top level)."""
+        depth = 0
+        for parent in self.graph[name].split_parents:
+            if self.graph[parent].type == "foreach":
+                depth += 1
+        return depth
+
     def _gang_step_label(self, node):
         import hashlib
 
         step_part = _argo_name(node.name)
+        # a gang inside a foreach carries '-s<split-path>' in its JobSet
+        # name; the path is a runtime value, so reserve for the worst
+        # case at COMPILE time — 4 digits per foreach level (the same
+        # 9999 budget as the rank suffix) plus separators
+        depth = self._foreach_depth_of(node.name)
+        split_budget = (2 + 4 * depth + (depth - 1)) if depth else 0
         fixed = (len(self._deployed_name()) + self._WF_SUFFIX_BUDGET
                  + 1                      # '-' before the step part
                  + len("-r") + 2          # attempt counter (<= 2 digits)
+                 + split_budget
                  + len(self._GANG_SUFFIX))
         room = self._DNS_LABEL_MAX - fixed
         if len(step_part) <= room:

@@ -43,6 +43,7 @@ class ArgoSimulator(object):
         }
         self.task_outputs = {}  # dag task name -> {param: value}
         self.pods_run = []      # (dag task name, item) in execution order
+        self.jobsets_created = []  # JobSet names, creation order
 
     # ---------------- template variable substitution ----------------
 
@@ -374,6 +375,22 @@ class ArgoSimulator(object):
             raise ArgoSimError(
                 "Resource template %s: expected a JobSet manifest, got %r"
                 % (task["name"], manifest.get("kind")))
+        js_name = manifest.get("metadata", {}).get("name", "")
+        if js_name in self.jobsets_created:
+            # `action: create` of an existing object name is exactly what
+            # a real cluster rejects — concurrent gang instances (foreach
+            # fan-out, retries) must derive distinct JobSet names
+            raise ArgoSimError(
+                "Resource template %s: JobSet name %r already created "
+                "this run — concurrent/sequential gang instances collide"
+                % (task["name"], js_name))
+        if len(js_name) > 63 - len("-gang-0-0") or not re.match(
+                r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$", js_name):
+            raise ArgoSimError(
+                "Resource template %s: JobSet name %r is not a DNS-1123 "
+                "label with room for the pod hostname suffix"
+                % (task["name"], js_name))
+        self.jobsets_created.append(js_name)
         rjobs = manifest["spec"]["replicatedJobs"]
         if len(rjobs) != 1:
             raise ArgoSimError("Expected ONE replicated job, got %d"

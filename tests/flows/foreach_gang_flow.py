@@ -1,0 +1,47 @@
+"""A num_parallel gang NESTED INSIDE a foreach — the hyperparameter-sweep
+shape (one gang-trained model per sweep point). On Argo each iteration's
+gang must materialize as its OWN JobSet: the compiler suffixes the
+iteration's split path into the JobSet name the way the reference
+suffixes per-instance entropy (reference: metaflow/plugins/argo/
+jobset_input_paths.py:4-11, argo_workflows.py:2298)."""
+
+from metaflow_tpu import FlowSpec, current, step
+
+
+class ForeachGangFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [10, 20]
+        self.next(self.prep, foreach="items")
+
+    @step
+    def prep(self):
+        self.base = self.input
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index
+        self.val = self.base + self.rank
+        self.next(self.gang_join)
+
+    @step
+    def gang_join(self, inputs):
+        self.base = inputs[0].base
+        self.ranksum = sum(i.val for i in inputs)  # base*2 + 1
+        self.next(self.sweep_join)
+
+    @step
+    def sweep_join(self, inputs):
+        self.total = sum(i.ranksum for i in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        # (10*2+1) + (20*2+1)
+        assert self.total == 62, self.total
+        print("foreach-of-gangs ok: total", self.total)
+
+
+if __name__ == "__main__":
+    ForeachGangFlow()

@@ -59,6 +59,16 @@ GRAPHS = {
         {"name": "j", "join": True, "next": ["end"]},
         {"name": "end"},
     ],
+    # a gang fanned out by a foreach (hyperparameter sweep of gang-trained
+    # models): on Argo every iteration must create its own JobSet
+    "foreach_gang": [
+        {"name": "start", "foreach": 2, "next": ["prep"]},
+        {"name": "prep", "num_parallel": 2, "next": ["train"]},
+        {"name": "train", "next": ["gj"]},
+        {"name": "gj", "join": True, "next": ["oj"]},
+        {"name": "oj", "join": True, "next": ["end"]},
+        {"name": "end"},
+    ],
     # recursion via switch back-edge: work+check iterate loop_counter
     # times, then the exit case runs (reference: test/core recursive
     # graph shapes)
@@ -286,25 +296,42 @@ def _innermost_split(graph, join_name):
     return result.get(join_name)
 
 
-def generate_flow(graph, flow_name, fail_step=None):
+def generate_flow(graph, flow_name, fail_step=None, specs=()):
     """Emit a runnable flow file for a graph template. Each task appends its
     step name to a 'trace' artifact; joins merge traces.
 
     fail_step: that step raises while env FAIL_ONCE=1 (resume tests). In a
     gang step only rank 1 fails — so the first run leaves the gang
     partially done (other ranks wrote their datastores) and `resume` must
-    re-run it as a unit."""
+    re-run it as a unit.
+
+    specs: Spec instances (tests/specs.py — the harness's orthogonal
+    "tests" axis, reference MetaflowTest pattern): each contributes
+    flow-level lines, per-step-kind decorators and body lines. Body lines
+    inject after the trace bookkeeping and before control flow (for `end`
+    steps: after the TRACE print, so a spec may raise under @catch
+    without losing the trace)."""
+    from specs import step_kind
+
     lines = [
         "import os",
         "",
-        "from metaflow_tpu import FlowSpec, current, step",
+        "import metaflow_tpu",
+        "from metaflow_tpu import FlowSpec, Parameter, current, step",
         "",
         "",
         "class %s(FlowSpec):" % flow_name,
     ]
+    for sp in specs:
+        lines += ["    %s" % l for l in sp.param_lines]
     for spec in graph:
         name = spec["name"]
+        kind = step_kind(spec)
         args = "(self, inputs)" if spec.get("join") else "(self)"
+        for sp in specs:
+            for deco in (sp.decorators.get("all", [])
+                         + sp.decorators.get(kind, [])):
+                lines.append("    %s" % deco)
         lines.append("    @step")
         lines.append("    def %s%s:" % (name, args))
         if name == fail_step:
@@ -329,6 +356,10 @@ def generate_flow(graph, flow_name, fail_step=None):
             lines.append("        self.trace = [%r]" % name)
         else:
             lines.append("        self.trace = self.trace + [%r]" % name)
+        if kind != "end":
+            for sp in specs:
+                lines += ["        %s" % l
+                          for l in sp.lines(kind, spec, graph)]
         if spec.get("switch"):
             if spec.get("loop_counter"):
                 # data-dependent recursion: iterate until the counter
@@ -364,6 +395,9 @@ def generate_flow(graph, flow_name, fail_step=None):
             )
         else:
             lines.append("        print('TRACE:', ','.join(self.trace))")
+            for sp in specs:
+                lines += ["        %s" % l
+                          for l in sp.lines(kind, spec, graph)]
         lines.append("")
     lines.append("")
     lines.append("if __name__ == '__main__':")

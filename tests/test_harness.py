@@ -105,7 +105,10 @@ def test_generated_flow_on_argo(graph_name, run_flow, tpuflow_root,
     # hermetic blob cache, like the run_flow fixture (conftest.py)
     env["TPUFLOW_CLIENT_CACHE"] = os.path.join(tpuflow_root, "blobcache")
     sim = ArgoSimulator(
-        manifest, workflow_name="wf-h-%s" % graph_name, env=env,
+        # a real workflow name is DNS-1123 (no underscores) — the sim's
+        # JobSet name validation relies on that
+        manifest, workflow_name="wf-h-%s" % graph_name.replace("_", "-"),
+        env=env,
         cwd=str(tmp_path), output_dir=str(tmp_path / "argo-outputs"),
     )
     sim.run()
@@ -122,6 +125,9 @@ RESUME_CASES = [
     ("nested_foreach", "leaf"),
     ("branch", "j"),
     ("gang", "train"),
+    # a gang INSIDE a foreach: resume must re-run only the failed
+    # iteration's gang as a unit
+    ("foreach_gang", "train"),
     # failing AFTER the loop: every recursion iteration must clone
     ("recursive", "done"),
 ]
