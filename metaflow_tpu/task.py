@@ -214,7 +214,18 @@ class MetaflowTask(object):
         flow._success_internal = False
 
         if is_join:
-            # joins start from a clean slate; user merges explicitly
+            # joins start from a clean slate; user merges explicitly —
+            # EXCEPT parameters, which the reference passes down through
+            # the entire graph (reference task.py:191 passdown_partial):
+            # every input carries the identical start-task values, so
+            # inherit them from the first input
+            if primary_input is not None:
+                param_keys = [n for n, _ in flow._get_parameters()]
+                param_keys.append("_parameter_names")
+                for key in param_keys:
+                    if key in primary_input._objects:
+                        output._objects[key] = primary_input._objects[key]
+                        output._info[key] = primary_input._info[key]
             flow._set_datastore(output)
         else:
             # inherit the (single) parent's artifacts: reads resolve through

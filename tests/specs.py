@@ -1,0 +1,310 @@
+"""The harness's "tests" axis: templated step bodies + assertions applied
+to every compatible graph × context (reference: the MetaflowTest pattern,
+test/README.md:60-140 — ~70 specs in test/core/tests/ multiply against
+graphs × contexts; this is the same orthogonal dimension).
+
+A Spec contributes: flow-level source lines (parameters), per-step-kind
+decorator and body lines, extra `run` args, and a client-side checker.
+ADDITIVE specs (bodies only add artifacts/assertions, never change
+control flow) are STACKED into one generated flow per graph — one run
+exercises every stacked spec, the matrix cost stays linear in graphs.
+Control-flow specs (catch_retry raises mid-run) run their own flows.
+
+Step kinds: start | linear | foreach-split | parallel-split | switch |
+join | end (a step can be both start and a split; kind reflects the
+node's structural role, `name` disambiguates).
+"""
+
+import json
+import os
+
+
+def step_kind(node):
+    if node.get("join"):
+        return "join"
+    if node.get("switch"):
+        return "switch"
+    if node.get("foreach"):
+        return "foreach-split"
+    if node.get("num_parallel"):
+        return "parallel-split"
+    if not node.get("next"):
+        return "end"
+    return "linear"
+
+
+class Spec(object):
+    name = None
+    additive = True          # stackable: never changes control flow
+    skip_graphs = ()
+    contexts = None          # None = any; else allowed context names
+    extra_args = ()          # appended AFTER `run` (run options)
+    pre_args = ()            # inserted BEFORE `run` (top-level, e.g. --with)
+    param_lines = ()         # class-level flow source lines
+    decorators = {}          # kind -> [decorator source lines]
+
+    def lines(self, kind, node, graph):
+        return []
+
+    def check(self, run, graph, counts, harness_env):
+        pass
+
+
+class ArtifactPropagationSpec(Spec):
+    """An artifact set in start is visible in every downstream step —
+    including across joins, foreach bodies and gang ranks (reference:
+    tests/basic_artifact.py)."""
+
+    name = "artifact_propagation"
+
+    def lines(self, kind, node, graph):
+        if kind == "join":
+            return [
+                "assert {i.seed_art for i in inputs} == {'abc'}",
+                "self.seed_art = inputs[0].seed_art",
+            ]
+        out = ["self.seed_art = 'abc'"] if node["name"] == "start" else []
+        out.append("assert self.seed_art == 'abc'")
+        return out
+
+    def check(self, run, graph, counts, harness_env):
+        for name, count in counts.items():
+            if count == 0:
+                continue
+            for task in run[name].tasks():
+                assert task["seed_art"].data == "abc", (name, task)
+
+
+class MergeArtifactsConflictSpec(Spec):
+    """merge_artifacts: identical values merge silently (foreach/gang
+    joins — every input is an instance of the same step), differing
+    values across static branches raise the conflict error (reference:
+    tests/merge_artifacts*.py)."""
+
+    name = "merge_artifacts_conflict"
+
+    def lines(self, kind, node, graph):
+        if kind != "join":
+            return ["self.conflict_probe = %r" % node["name"]]
+        return [
+            "vals = {i.conflict_probe for i in inputs}",
+            "try:",
+            "    self.merge_artifacts(inputs, include=['conflict_probe'])",
+            "    self.conflict_detected = False",
+            "except Exception:",
+            "    self.conflict_detected = True",
+            "    self.conflict_probe = sorted(vals)[0]",
+            "assert self.conflict_detected == (len(vals) > 1), vals",
+        ]
+
+    def check(self, run, graph, counts, harness_env):
+        by_name = {s["name"]: s for s in graph}
+        for node in graph:
+            if not node.get("join") or counts.get(node["name"], 0) == 0:
+                continue
+            in_steps = {s["name"] for s in graph
+                        if node["name"] in s.get("next", [])}
+            expect_conflict = len(in_steps) > 1  # static branch join
+            for task in run[node["name"]].tasks():
+                assert task["conflict_detected"].data == expect_conflict, (
+                    node["name"], in_steps)
+
+
+class ForeachStackSpec(Spec):
+    """foreach_stack() frames carry (index, cardinality, value); sibling
+    tasks of a foreach body cover exactly the index range (reference:
+    tests/basic_foreach.py + foreach_stack checks)."""
+
+    name = "foreach_stack"
+
+    def lines(self, kind, node, graph):
+        # gang (num_parallel) frames ride the same stack under the
+        # internal _parallel_ubf_iter var; keep only real foreach frames
+        return [
+            "_fs = self.foreach_stack()",
+            "self.fstack = [_fs[i] for i, f in"
+            " enumerate(self._foreach_stack)"
+            " if f[0] != '_parallel_ubf_iter']",
+        ]
+
+    def check(self, run, graph, counts, harness_env):
+        by_name = {s["name"]: s for s in graph}
+
+        def foreach_sizes(name, acc):
+            # fan-out sizes of the foreach ancestors, outermost first.
+            # A join predecessor closes its split's scope: continue the
+            # walk FROM that split (same ancestor chain), else a step
+            # after a join inside an outer foreach would drop the outer
+            # frames
+            from harness import _innermost_split
+
+            for s in graph:
+                if name not in s.get("next", []):
+                    continue
+                if s.get("join"):
+                    split = _innermost_split(graph, s["name"])
+                    return foreach_sizes(split, acc) if split else acc
+                return foreach_sizes(
+                    s["name"],
+                    ([s["foreach"]] if s.get("foreach") else []) + acc)
+            return acc
+
+        for node in graph:
+            name = node["name"]
+            if counts.get(name, 0) == 0 or node.get("join"):
+                continue
+            sizes = foreach_sizes(name, [])
+            stacks = [t["fstack"].data for t in run[name].tasks()]
+            leaves = sorted(tuple(f[0] for f in st) for st in stacks)
+            import itertools
+
+            expected = sorted(
+                itertools.product(*[range(n) for n in sizes]))
+            mult = counts[name] // max(1, len(expected))
+            assert leaves == sorted(expected * mult), (name, leaves)
+            for st in stacks:
+                for (idx, card, value) in st:
+                    assert 0 <= idx < card and value == idx, st
+
+
+class TagMutationSpec(Spec):
+    """A step mutates its own run's tags mid-run through the client API
+    (optimistic mutation against the live metadata provider; reference:
+    tests/basic_tags.py)."""
+
+    name = "tag_mutation"
+
+    def lines(self, kind, node, graph):
+        if node["name"] != "start":
+            return []
+        return [
+            "from metaflow_tpu import client as _c",
+            "_c.namespace(None)",
+            "_c.Flow(current.flow_name)[current.run_id]"
+            ".add_tag('spec-tag')",
+        ]
+
+    def check(self, run, graph, counts, harness_env):
+        assert "spec-tag" in run.tags, run.tags
+
+
+class ParameterVisibilitySpec(Spec):
+    """A flow Parameter is readable in EVERY step and in the client
+    (reference: tests/basic_parameters.py)."""
+
+    name = "parameter_visibility"
+    param_lines = ("spec_alpha = Parameter('spec_alpha', default='3')",)
+    extra_args = ("--spec-alpha", "7")
+
+    def lines(self, kind, node, graph):
+        return ["assert str(self.spec_alpha) == '7'"]
+
+    def check(self, run, graph, counts, harness_env):
+        assert str(run.data.spec_alpha) == "7"
+
+
+class AttemptOkMetadataSpec(Spec):
+    """Every finished task records attempt_ok=true metadata, and the
+    client's `successful` derives from it (reference: metadata attempt
+    bookkeeping, task.py attempt_ok writes)."""
+
+    name = "attempt_ok_metadata"
+
+    def check(self, run, graph, counts, harness_env):
+        for name, count in counts.items():
+            if count == 0:
+                continue
+            for task in run[name].tasks():
+                md = task.metadata_dict
+                assert json.loads(md.get("attempt_ok", "false")) is True, (
+                    name, md)
+                assert task.successful
+
+
+class HeartbeatLivenessSpec(Spec):
+    """The run heartbeat exists after a run on the local metadata
+    provider (file mtime = liveness; the service provider's REST
+    heartbeat has its own tests)."""
+
+    name = "heartbeat_liveness"
+    contexts = ("default", "exec_workers", "daemon")
+
+    def check(self, run, graph, counts, harness_env):
+        root = os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"]
+        flow_name = run.pathspec.split("/")[0]
+        hb = os.path.join(root, flow_name, run.id, "_heartbeat.json")
+        assert os.path.exists(hb), hb
+
+
+class CardPresenceSpec(Spec):
+    """`--with card` attaches a rendered card to every task (reference:
+    tests/card_simple.py); local-storage contexts check the stored
+    HTML."""
+
+    name = "card_presence"
+    contexts = ("default", "exec_workers", "daemon")
+    pre_args = ("--with", "card")
+
+    def check(self, run, graph, counts, harness_env):
+        root = os.environ["TPUFLOW_DATASTORE_SYSROOT_LOCAL"]
+        flow_name = run.pathspec.split("/")[0]
+        for name, count in counts.items():
+            if count == 0:
+                continue
+            for task in run[name].tasks():
+                path = os.path.join(root, flow_name, "mf.cards",
+                                    run.id, name, task.id, "default.html")
+                assert os.path.exists(path), path
+
+
+class CatchRetrySpec(Spec):
+    """@retry re-runs a failing attempt; @catch swallows a permanent
+    failure into an artifact; both compose with every graph shape
+    (reference: tests/catch_retry.py). NOT additive: raises mid-run."""
+
+    name = "catch_retry"
+    additive = False
+    contexts = ("default",)
+    decorators = {
+        "all": ["@metaflow_tpu.retry(times=1, minutes_between_retries=0)"],
+        "end": ["@metaflow_tpu.catch(var='caught', print_exception=False)"],
+    }
+
+    def lines(self, kind, node, graph):
+        if kind in ("linear",) and node["name"] != "start":
+            return [
+                "self.spec_attempt = current.retry_count",
+                "if current.retry_count == 0:",
+                "    raise Exception('spec-induced retry')",
+            ]
+        if kind == "end":
+            # after the trace print: the catch var records this
+            return ["raise Exception('spec-induced permanent failure')"]
+        return []
+
+    def check(self, run, graph, counts, harness_env):
+        assert run.successful
+        end_task = run["end"].task
+        assert end_task["caught"].data is not None
+        for node in graph:
+            if (step_kind(node) == "linear" and node["name"] != "start"
+                    and counts.get(node["name"], 0) > 0):
+                for task in run[node["name"]].tasks():
+                    # the surviving attempt is the retry
+                    assert task["spec_attempt"].data == 1, node["name"]
+
+
+ADDITIVE_SPECS = [
+    ArtifactPropagationSpec(),
+    MergeArtifactsConflictSpec(),
+    ForeachStackSpec(),
+    TagMutationSpec(),
+    ParameterVisibilitySpec(),
+    AttemptOkMetadataSpec(),
+    HeartbeatLivenessSpec(),
+    CardPresenceSpec(),
+]
+
+SOLO_SPECS = [CatchRetrySpec()]
+
+ALL_SPECS = ADDITIVE_SPECS + SOLO_SPECS
