@@ -29,6 +29,13 @@ class ArgoSimError(Exception):
 
 class ArgoSimulator(object):
     def __init__(self, manifest, workflow_name, env, cwd, output_dir):
+        # every manifest the sim executes is first validated against the
+        # pinned upstream schemas — the sim interprets manifests itself,
+        # so without this a field typo would pass every test and fail
+        # only on a real cluster
+        from schema_validate import validate_manifest
+
+        validate_manifest(manifest)
         self.spec = manifest["spec"]
         self.workflow_name = workflow_name
         self.env = env
@@ -375,6 +382,9 @@ class ArgoSimulator(object):
             raise ArgoSimError(
                 "Resource template %s: expected a JobSet manifest, got %r"
                 % (task["name"], manifest.get("kind")))
+        from schema_validate import validate_manifest
+
+        validate_manifest(manifest)  # post-substitution: real int types
         js_name = manifest.get("metadata", {}).get("name", "")
         if js_name in self.jobsets_created:
             # `action: create` of an existing object name is exactly what
