@@ -168,6 +168,10 @@ def bench_decode():
     from metaflow_tpu.models import llama
 
     on_tpu = jax.default_backend() == "tpu"
+    # flash-decode (chunked online-softmax over only the filled prefix)
+    # is the long-context serving path; BENCH_DECODE_ATTN=dense compares
+    # against the whole-cache einsum
+    attn_impl = os.environ.get("BENCH_DECODE_ATTN", "chunked")
     if on_tpu:
         cfg = llama.LlamaConfig.bench_1b(attention_impl="xla", remat=False)
         batch = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
@@ -191,7 +195,8 @@ def bench_decode():
             jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size),
         batch_sharding(mesh),
     )
-    gen = make_generator(cfg, max_new_tokens=new_tokens)
+    gen = make_generator(cfg, max_new_tokens=new_tokens,
+                         attn_impl=attn_impl)
     with mesh:
         out = gen(params, prompt, jax.random.PRNGKey(2))  # compile+warmup
         jax.block_until_ready(out)
@@ -214,6 +219,7 @@ def bench_decode():
             "batch": batch,
             "prompt_len": prompt_len,
             "new_tokens": new_tokens,
+            "attn_impl": attn_impl,
             "params": llama.num_params(params),
         },
     }

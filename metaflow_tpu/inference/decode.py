@@ -42,7 +42,10 @@ def init_kv_cache(cfg, batch_size, max_seq_len, dtype=None):
 def _cached_attention(q, cache_k, cache_v, pos):
     """q: [B, T, H, Hd] at absolute positions pos..pos+T-1; cache_k/v:
     [B, Smax, KV, Hd]. Keys at index i are visible to query t iff
-    i <= pos + t (unfilled cache slots fall outside by construction)."""
+    i <= pos + t (unfilled cache slots fall outside by construction).
+
+    Dense: touches the WHOLE [Smax] cache every step — fine at moderate
+    max_seq, bandwidth-bound for long-context serving (use 'chunked')."""
     B, T, H, Hd = q.shape
     k = _broadcast_gqa(cache_k, H)
     v = _broadcast_gqa(cache_v, H)
@@ -56,8 +59,57 @@ def _cached_attention(q, cache_k, cache_v, pos):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+DECODE_CHUNK = 256
+
+
+def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
+    """Flash-decode: the same attention reading ONLY the filled prefix.
+
+    KV chunks stream through an online-softmax accumulation
+    (lax.fori_loop with a TRACED trip count ceil((pos+T)/chunk), lowered
+    to a while_loop) — per emitted token the HBM traffic is O(filled),
+    not O(Smax), which is what long-context serving needs. Numerics
+    match the dense path: same fp32 logits, same masking; the edge
+    chunk's clamped slice re-reads earlier keys, masked out by the
+    `key >= chunk start` term."""
+    B, T, H, Hd = q.shape
+    Smax = cache_k.shape[1]
+    chunk = min(chunk, Smax)
+    scale = 1.0 / math.sqrt(Hd)
+    qf = q.astype(jnp.float32)
+    n_chunks = (pos + T + chunk - 1) // chunk  # traced
+
+    def body(i, carry):
+        m, l, acc = carry
+        start = jnp.minimum(i * chunk, Smax - chunk)
+        k_blk = _broadcast_gqa(
+            jax.lax.dynamic_slice_in_dim(cache_k, start, chunk, 1), H)
+        v_blk = _broadcast_gqa(
+            jax.lax.dynamic_slice_in_dim(cache_v, start, chunk, 1), H)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_blk.astype(jnp.float32)) * scale
+        key_idx = (start + jnp.arange(chunk))[None, None, None, :]
+        q_pos = (pos + jnp.arange(T))[None, None, :, None]
+        visible = (key_idx <= q_pos) & (key_idx >= i * chunk)
+        logits = jnp.where(visible, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc0 = jnp.zeros((B, H, T, Hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_chunks, body, (m0, l0, acc0))
+    out = acc / l[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, T, H, Hd]
+
+
 def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
-                  mesh=None):
+                  mesh=None, attn_impl="dense"):
     """One block over T new tokens, reading+extending this layer's cache.
     Dense (Llama) or MoE (Mixtral) FFN is picked off the parameter tree —
     the attention/cache half is identical."""
@@ -78,7 +130,10 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
     cache_v = jax.lax.dynamic_update_slice_in_dim(
         cache_v, v.astype(cache_v.dtype), pos, axis=1)
 
-    attn = _cached_attention(q, cache_k, cache_v, pos)
+    if attn_impl == "chunked":
+        attn = _chunked_cached_attention(q, cache_k, cache_v, pos)
+    else:
+        attn = _cached_attention(q, cache_k, cache_v, pos)
     x = x + attn.reshape(B, T, H * Hd) @ lp["wo"]
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
@@ -106,7 +161,8 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
     return x, cache_k, cache_v
 
 
-def decode_forward(params, tokens, cache, pos, cfg, mesh=None):
+def decode_forward(params, tokens, cache, pos, cfg, mesh=None,
+                   attn_impl="dense"):
     """Forward over T new tokens at absolute position `pos` (a traced
     scalar), reading and extending the cache. Works for any model in the
     Llama family layout (Llama dense FFN, Mixtral MoE FFN).
@@ -124,7 +180,7 @@ def decode_forward(params, tokens, cache, pos, cfg, mesh=None):
     def layer_fn(carry, inp):
         lp, ck, cv = inp
         out, nk, nv = _decode_layer(cfg, cos, sin, pos, carry, lp, ck, cv,
-                                    mesh=mesh)
+                                    mesh=mesh, attn_impl=attn_impl)
         return out, (nk, nv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -136,21 +192,46 @@ def decode_forward(params, tokens, cache, pos, cfg, mesh=None):
     return logits, {"k": new_k, "v": new_v}
 
 
-def _sample(logits, temperature, rng):
-    """logits: [B, vocab] fp32 → [B] int32."""
+def _sample(logits, temperature, rng, top_k=None, top_p=None):
+    """logits: [B, vocab] fp32 → [B] int32.
+
+    top_k keeps the k highest-logit tokens; top_p keeps the smallest
+    nucleus whose probability mass reaches p (the highest-probability
+    token always survives). Both compose (top_k filters first)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits / temperature, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p is not None and top_p < 1.0:
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # EXCLUSIVE cumulative mass: a token is kept while the mass
+        # before it is < p, so the top token always survives
+        before = jnp.cumsum(probs, axis=-1) - probs
+        drop_sorted = before >= top_p
+        drop = jnp.zeros_like(drop_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(drop_sorted)
+        logits = jnp.where(drop, NEG_INF, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
-             rng=None, eos_id=None, max_seq_len=None, mesh=None):
+             rng=None, eos_id=None, max_seq_len=None, mesh=None,
+             attn_impl="auto", top_k=None, top_p=None):
     """Generate max_new_tokens continuations of prompt_tokens [B, P].
 
-    Pure jax (jit-friendly; max_new_tokens/temperature/eos_id must be
-    static under jit). Returns [B, P + max_new_tokens] int32; once a
-    sequence emits eos_id its tail is padded with eos_id.
+    Pure jax (jit-friendly; max_new_tokens/temperature/eos_id/top_k/
+    top_p/attn_impl must be static under jit). Returns
+    [B, P + max_new_tokens] int32; once a sequence emits eos_id its tail
+    is padded with eos_id.
+
+    attn_impl: 'dense' (whole-cache masked attention), 'chunked'
+    (flash-decode: online softmax over only the filled prefix — the
+    long-context serving path), or 'auto' (chunked once the cache is
+    larger than 2 chunks).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -164,18 +245,26 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
             "the KV cache cannot hold the generation" %
             (max_seq_len, P, max_new_tokens))
     cache = init_kv_cache(cfg, B, max_seq_len or total)
+    if attn_impl not in ("auto", "dense", "chunked"):
+        # a typo'd impl must not silently select dense (and then be
+        # recorded verbatim in benchmark results)
+        raise ValueError("attn_impl must be 'auto', 'dense' or "
+                         "'chunked', got %r" % (attn_impl,))
+    if attn_impl == "auto":
+        attn_impl = ("chunked" if cache["k"].shape[2] > 2 * DECODE_CHUNK
+                     else "dense")
 
     logits, cache = decode_forward(params, prompt_tokens, cache, 0, cfg,
-                                   mesh=mesh)
+                                   mesh=mesh, attn_impl=attn_impl)
     rng, step_rng = jax.random.split(rng)
-    tok = _sample(logits[:, -1], temperature, step_rng)
+    tok = _sample(logits[:, -1], temperature, step_rng, top_k, top_p)
     done = (tok == eos_id) if eos_id is not None else None
 
     def step(carry, step_rng):
         cache, tok, pos, done = carry
         logits, cache = decode_forward(params, tok[:, None], cache, pos,
-                                       cfg, mesh=mesh)
-        nxt = _sample(logits[:, 0], temperature, step_rng)
+                                       cfg, mesh=mesh, attn_impl=attn_impl)
+        nxt = _sample(logits[:, 0], temperature, step_rng, top_k, top_p)
         if done is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
@@ -194,7 +283,8 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
 
 
 def make_generator(cfg, max_new_tokens, temperature=0.0, eos_id=None,
-                   max_seq_len=None):
+                   max_seq_len=None, attn_impl="auto", top_k=None,
+                   top_p=None):
     """A jitted (params, prompt_tokens, rng) -> tokens generator with the
     static knobs baked in — compile once, serve many."""
 
@@ -202,6 +292,7 @@ def make_generator(cfg, max_new_tokens, temperature=0.0, eos_id=None,
     def run(params, prompt_tokens, rng):
         return generate(params, prompt_tokens, cfg, max_new_tokens,
                         temperature=temperature, rng=rng, eos_id=eos_id,
-                        max_seq_len=max_seq_len)
+                        max_seq_len=max_seq_len, attn_impl=attn_impl,
+                        top_k=top_k, top_p=top_p)
 
     return run
