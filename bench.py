@@ -118,6 +118,17 @@ _TPU_PEAK_TFLOPS = [
     ("v3", 123.0),
 ]
 
+# HBM bandwidth GB/s per chip, from the same published specs (used by
+# the hlo_estimate roofline; keep in step with _TPU_PEAK_TFLOPS)
+_TPU_HBM_GBPS = [
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v6e", 1640.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+]
+
 
 def _mfu(tps_per_chip, params, cfg, seq, device_kind):
     """Model FLOPs utilization for a train step (fwd+bwd = 3x fwd).
@@ -221,6 +232,131 @@ def bench_decode():
             "new_tokens": new_tokens,
             "attn_impl": attn_impl,
             "params": llama.num_params(params),
+        },
+    }
+
+
+def bench_hlo_estimate():
+    """XLA cost-model MFU ESTIMATE for the 886M on-chip train config —
+    the alternative evidence path while the TPU tunnel is down (round-4
+    verdict #1): lower the EXACT bench train step (bench_1b, bf16,
+    batch×seq from the same env knobs) fully abstractly (eval_shape —
+    no parameters materialize), compile, and read XLA's cost analysis
+    (flops + bytes accessed) off the optimized module. An aggregate
+    roofline against published v5e constants (197 bf16 TFLOP/s, 819
+    GB/s HBM) then gives the cost-model step time
+    max(F/peak, B/bw) and the MFU that implies.
+
+    CLEARLY LABELED AN ESTIMATE: the module is CPU-optimized (fusion
+    differs from TPU, so bytes-accessed is pessimistic) and a roofline
+    assumes perfect compute/transfer overlap — this bounds what the
+    hardware model allows; it is NOT a measurement and is never
+    appended as a backend:"tpu" entry."""
+    import jax
+    import jax.numpy as jnp
+
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import (make_train_step,
+                                       memory_efficient_optimizer)
+
+    from metaflow_tpu.training import default_optimizer
+
+    cfg = llama.LlamaConfig.bench_1b(
+        attention_impl="xla",  # the pallas kernel doesn't lower on CPU;
+        # flash-attn FLOPs are identical, bytes differ (noted in caveats)
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "") or None,
+        loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")),
+    )
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seq = int(os.environ.get("BENCH_SEQ", "2048"))
+    # same knob the measuring bench honors ('factored' is the on-chip
+    # default) — the estimate must be for the EXACT swept config
+    opt_kind = os.environ.get("BENCH_OPT", "factored")
+    optimizer = (memory_efficient_optimizer(total_steps=1000)
+                 if opt_kind == "factored"
+                 else default_optimizer(total_steps=1000))
+    mesh = create_mesh(MeshSpec.dp())
+
+    key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    params_s = jax.eval_shape(lambda k: llama.init_params(k, cfg), key)
+    opt_s = jax.eval_shape(optimizer.init, params_s)
+    state_s = {"params": params_s, "opt_state": opt_s,
+               "step": jax.ShapeDtypeStruct((), jnp.int32)}
+    batch_s = {"tokens": jax.ShapeDtypeStruct((batch, seq + 1),
+                                              jnp.int32)}
+    step = make_train_step(cfg, mesh, llama, optimizer=optimizer)
+    t0 = time.perf_counter()
+    compiled = step.lower(state_s, batch_s).compile()
+    compile_s = time.perf_counter() - t0
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0]
+    flops = float(cost.get("flops", 0.0))
+    if "bytes accessed" not in cost:
+        # a silently-missing bytes figure would zero the bandwidth term
+        # and unconditionally report compute_bound — the exact actionable
+        # verdict this mode exists to produce
+        raise SystemExit(
+            "XLA cost_analysis did not report 'bytes accessed' "
+            "(keys: %s) — cannot form the roofline" % sorted(cost))
+    bytes_accessed = float(cost["bytes accessed"])
+
+    chip = os.environ.get("BENCH_TARGET_CHIP", "v5e")
+    peak = next((tf for sub, tf in _TPU_PEAK_TFLOPS if sub in chip),
+                None)
+    hbm = next((bw for sub, bw in _TPU_HBM_GBPS if sub in chip), None)
+    if peak is None or hbm is None:
+        raise SystemExit("no roofline constants for BENCH_TARGET_CHIP=%r"
+                         % chip)
+    peak *= 1e12
+    hbm_bw = hbm * 1e9
+    tokens_per_step = batch * seq
+    n_params = sum(int(s.size) for s in jax.tree.leaves(params_s))
+    # the COMPUTE term uses the analytic PaLM-convention count (_mfu):
+    # XLA:CPU rewrites large matmuls into oneDNN custom calls whose
+    # flops the cost analysis does NOT count (observed 12x undercount),
+    # so the HLO flops figure is reported but never used for the bound
+    analytic_flops = (6.0 * n_params
+                      + 12.0 * cfg.n_layers * cfg.dim * seq) \
+        * tokens_per_step
+    t_compute = analytic_flops / peak
+    t_bytes = bytes_accessed / hbm_bw
+    t_step = max(t_compute, t_bytes)
+    tps_bound = tokens_per_step / t_step
+    mfu_at_bound = t_compute / t_step
+
+    return {
+        "metric": "llama_1b_train_tokens_per_sec_roofline_bound",
+        "value": round(tps_bound, 1),
+        "unit": "tokens/s/chip (cost-model upper bound)",
+        "vs_baseline": 1.0,
+        "estimate": True,
+        "extra": {
+            "method": "analytic_flops + xla_cost_analysis_bytes, "
+                      "aggregate roofline",
+            "hardware_model": "%s: %.0f bf16 TFLOP/s, %.0f GB/s HBM"
+            % (chip, peak / 1e12, hbm_bw / 1e9),
+            "optimizer": opt_kind,
+            "bound_kind": ("hbm_bandwidth_bound" if t_bytes > t_compute
+                           else "compute_bound"),
+            "mfu_at_bound": round(mfu_at_bound, 4),
+            "analytic_flops_per_step": analytic_flops,
+            "hlo_flops_per_step_unused": flops,
+            "hlo_bytes_per_step": bytes_accessed,
+            "roofline_step_seconds": round(t_step, 4),
+            "batch": batch,
+            "seq": seq,
+            "n_params": n_params,
+            "compile_seconds": round(compile_s, 1),
+            "caveats": "ESTIMATE, not a measurement: CPU-optimized HLO "
+                       "(TPU fusion differs; bytes approximate and "
+                       "custom-call reads may be uncounted), xla "
+                       "attention (flash kernel bytes would be lower), "
+                       "perfect-overlap roofline. bound_kind is the "
+                       "actionable output: compute_bound means the "
+                       "measured-MFU gap is scheduling/fusion overhead, "
+                       "not an HBM wall",
         },
     }
 
@@ -522,16 +658,18 @@ def _wait_for_tpu():
         time.sleep(min(60, max(1, remaining)))
 
 
-def _rerun_on_cpu():
+def _rerun_on_cpu(degraded=True):
     """Re-exec the bench CPU-pinned (axon sitecustomize stripped so the
-    subprocess cannot touch the wedged tunnel)."""
+    subprocess cannot touch the wedged tunnel). degraded=False for modes
+    where CPU is BY DESIGN (hlo_estimate), not a fallback."""
     import subprocess
 
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_PLATFORM_NAME"] = "cpu"
     env["BENCH_SKIP_PROBE"] = "1"
-    env["BENCH_DEGRADED"] = "tpu_tunnel_unresponsive"
+    if degraded:
+        env["BENCH_DEGRADED"] = "tpu_tunnel_unresponsive"
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in env.get("PYTHONPATH", "").split(os.pathsep)
         if p and "axon_site" not in p
@@ -557,6 +695,15 @@ if __name__ == "__main__":
         result = bench_step_launch()
     elif mode == "data":
         result = bench_data_path()
+    elif mode == "hlo_estimate":
+        # no chip needed BY DESIGN (abstract lowering + cost model): pin
+        # to CPU before jax initializes — this mode must never touch the
+        # axon tunnel, and CPU here is not a degraded fallback
+        if (os.environ.get("JAX_PLATFORMS") != "cpu"
+                or any("axon_site" in p for p in
+                       os.environ.get("PYTHONPATH", "").split(os.pathsep))):
+            _rerun_on_cpu(degraded=False)
+        result = bench_hlo_estimate()
     elif mode in ("decode", "moe"):
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             if _wait_for_tpu() is None:
