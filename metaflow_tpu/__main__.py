@@ -69,6 +69,28 @@ def configure_unset(key):
     click.echo("removed %s from %s" % (key.upper(), path))
 
 
+@configure.command(
+    name="reset",
+    help="Delete the active profile (reverts to local defaults; the "
+         "reference's `configure reset`).",
+)
+@click.option("--yes", is_flag=True, help="delete without prompting")
+def configure_reset(yes):
+    from .metaflow_config import _profile_path
+
+    path = _profile_path()
+    if not os.path.exists(path):
+        click.echo("nothing to reset (%s does not exist)" % path)
+        return
+    if not yes and not click.confirm(
+            "Delete %s and revert to local defaults?" % path):
+        click.echo("aborted")
+        return
+    os.unlink(path)
+    click.echo("removed %s — runs now use local datastore/metadata "
+               "defaults" % path)
+
+
 @configure.command(name="list", help="List configuration profiles.")
 def configure_list():
     import json

@@ -58,11 +58,34 @@ def capture(args, child_argv):
         args.run_id, args.step, args.task_id, attempt=args.attempt, mode="w"
     )
 
+    from .util import preexec_die_with_parent
+
+    # the child must not outlive this supervisor: locally the gang
+    # teardown chain is control →(PDEATHSIG) capture →(PDEATHSIG) step,
+    # and a SIGKILLed capture must never orphan a rank wedged in a
+    # collective (on a cluster the pod cgroup covers this; arming it
+    # everywhere keeps local semantics identical)
     proc = subprocess.Popen(
-        child_argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE
+        child_argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        preexec_fn=preexec_die_with_parent(os.getpid()),
     )
     os.set_blocking(proc.stdout.fileno(), False)
     os.set_blocking(proc.stderr.fileno(), False)
+
+    # graceful-stop parity with the unsupervised rank: a SIGTERM to this
+    # supervisor FORWARDS to the child (whose preemption/shield handler
+    # gets its grace window) rather than dying instantly and letting
+    # PDEATHSIG SIGKILL the rank mid-checkpoint; the loop below then
+    # drains the tail and persists a final log snapshot
+    import signal
+
+    def _forward_term(signum, frame):
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+
+    signal.signal(signal.SIGTERM, _forward_term)
 
     bufs = {"stdout": b"", "stderr": b""}
     partial = {"stdout": b"", "stderr": b""}
@@ -106,14 +129,28 @@ def capture(args, child_argv):
 
     start = time.time()
     next_flush = start + _flush_delay(0)
-    while open_streams:
-        for key, _ in sel.select(timeout=1.0):
+    rc = None
+    while True:
+        if open_streams:
+            events = sel.select(timeout=0.2)
+        else:  # child closed its stdio but still runs: just poll it
+            time.sleep(0.2)
+            events = []
+        for key, _ in events:
             drain(key.fileobj, key.data)
         now = time.time()
         if now >= next_flush:
             persist()
             next_flush = now + _flush_delay(now - start)
-    rc = proc.wait()
+        if rc is None:
+            rc = proc.poll()
+        # exit on child death even while pipe write-ends survive in a
+        # grandchild — the gang watcher polls THIS process's rc to
+        # detect a dead rank, so lingering here would stall failure
+        # detection (it used to poll the rank directly). Keep draining
+        # only while data is actually arriving.
+        if rc is not None and (not open_streams or not events):
+            break
     for name in partial:
         if partial[name]:
             bufs[name] += mflog.decorate(mflog.TASK, partial[name])

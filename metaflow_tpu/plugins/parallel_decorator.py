@@ -42,6 +42,7 @@ class ParallelDecorator(StepDecorator):
         self._run_id = run_id
         self._step_name = step_name
         self._task_id = task_id
+        self._flow_datastore = task_datastore._flow_datastore
         num_nodes = int(os.environ.get("MF_PARALLEL_NUM_NODES", "1"))
         node_index = int(os.environ.get("MF_PARALLEL_NODE_INDEX", "0"))
         main_ip = os.environ.get("MF_PARALLEL_MAIN_IP", "127.0.0.1")
@@ -165,6 +166,21 @@ class ParallelDecorator(StepDecorator):
         from ..util import preexec_die_with_parent
 
         rank_preexec = preexec_die_with_parent(os.getpid())
+        # each rank runs under the mflog_capture supervisor, exactly as a
+        # gang pod does on Argo: its stdout/stderr persist into ITS OWN
+        # task datastore (readable via client/logs CLI) while still
+        # teeing through to this console. Without it worker-rank logs
+        # existed only on the cluster path (local/remote divergence the
+        # log_capture harness spec caught).
+        fds = self._flow_datastore
+        capture_prefix = [
+            sys.executable, "-m", "metaflow_tpu.mflog_capture",
+            "--flow-name", flow.name, "--run-id", str(run_id),
+            "--step", step_name, "--attempt", str(retry_count),
+            "--datastore", fds.ds_type,
+        ]
+        if fds.ds_root:
+            capture_prefix += ["--datastore-root", fds.ds_root]
         mapper_task_ids = [str(control_task_id)]
         procs = []
         for node_index in range(1, num_parallel):
@@ -178,12 +194,13 @@ class ParallelDecorator(StepDecorator):
             env["MF_PARALLEL_NODE_INDEX"] = str(node_index)
             procs.append(
                 subprocess.Popen(
-                    argv,
+                    capture_prefix + ["--task-id", task_id, "--"] + argv,
                     env=env,
                     stdout=sys.stdout,
                     stderr=sys.stderr,
-                    # SIGKILLed control task ⇒ kernel reaps the ranks too
-                    # (a rank wedged in a collective outlives any
+                    # SIGKILLed control task ⇒ kernel reaps the capture
+                    # supervisor, whose own PDEATHSIG reaps the rank (a
+                    # rank wedged in a collective outlives any
                     # Python-level cleanup)
                     preexec_fn=rank_preexec,
                 )

@@ -257,6 +257,32 @@ class CardPresenceSpec(Spec):
                 assert os.path.exists(path), path
 
 
+class LogCaptureSpec(Spec):
+    """Every task's stdout AND stderr are captured into the datastore
+    and readable through the client (reference: tests/basic_log.py —
+    mflog end-to-end through every graph shape and scheduler
+    context)."""
+
+    name = "log_capture"
+
+    def lines(self, kind, node, graph):
+        return [
+            "import sys as _sys",
+            "print('LOGSPEC-OUT %s ' + str(current.task_id))" % node["name"],
+            "_sys.stderr.write('LOGSPEC-ERR %s\\n')" % node["name"],
+        ]
+
+    def check(self, run, graph, counts, harness_env):
+        for name, count in counts.items():
+            if count == 0:
+                continue
+            for task in run[name].tasks():
+                out, err = task.stdout, task.stderr
+                assert "LOGSPEC-OUT %s %s" % (name, task.id) in out, (
+                    name, task.id, out[-500:])
+                assert "LOGSPEC-ERR %s" % name in err, (name, err[-500:])
+
+
 class CatchRetrySpec(Spec):
     """@retry re-runs a failing attempt; @catch swallows a permanent
     failure into an artifact; both compose with every graph shape
@@ -303,6 +329,7 @@ ADDITIVE_SPECS = [
     AttemptOkMetadataSpec(),
     HeartbeatLivenessSpec(),
     CardPresenceSpec(),
+    LogCaptureSpec(),
 ]
 
 SOLO_SPECS = [CatchRetrySpec()]

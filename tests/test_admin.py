@@ -41,6 +41,18 @@ class TestMainCli:
         conf = json.load(open(os.path.join(home, "config.json")))
         assert "DEFAULT_DATASTORE" not in conf
 
+    def test_configure_reset(self, tmp_path):
+        home = str(tmp_path / "cfghome")
+        env = {"TPUFLOW_HOME": home}
+        _mcli("configure", "set", "default_datastore", "gs", env_extra=env)
+        assert os.path.exists(os.path.join(home, "config.json"))
+        out = _mcli("configure", "reset", "--yes", env_extra=env)
+        assert out.returncode == 0 and "removed" in out.stdout
+        assert not os.path.exists(os.path.join(home, "config.json"))
+        # idempotent: resetting again reports, does not fail
+        out = _mcli("configure", "reset", "--yes", env_extra=env)
+        assert out.returncode == 0 and "nothing to reset" in out.stdout
+
     def test_configure_profiles_list_export_import(self, tmp_path):
         home = str(tmp_path / "cfghome")
         env = {"TPUFLOW_HOME": home}
