@@ -361,6 +361,14 @@ def bench_hlo_estimate():
     }
 
 
+def _gmm_blocks():
+    import importlib
+
+    # metaflow_tpu.ops re-exports a `gmm` FUNCTION; fetch the module
+    _g = importlib.import_module("metaflow_tpu.ops.gmm")
+    return [_g.BLOCK_S, _g.BLOCK_F, _g.BLOCK_D]
+
+
 def bench_moe():
     """Mixtral-style MoE train-step throughput (tokens/s/chip), dispatch
     selectable via BENCH_MOE_DISPATCH (sparse | gmm | gmm_ep | dense) —
@@ -440,6 +448,9 @@ def bench_moe():
             "backend": jax.default_backend(),
             "n_devices": n_devices,
             "dispatch": dispatch,
+            # MXU tile sizes (env-swept on-chip via TPUFLOW_GMM_BLOCK_*)
+            "gmm_blocks": _gmm_blocks() if dispatch.startswith("gmm")
+            else None,
             "params": mixtral.num_params(state["params"]),
             "batch": batch,
             "seq": seq,

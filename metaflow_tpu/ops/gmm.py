@@ -19,6 +19,7 @@ capacity-bucketed one in ops/moe.py.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +39,13 @@ def _pltpu():
 
     return pltpu
 
-BLOCK_S = 128  # row-tile = the padding quantum of the grouped layout
-BLOCK_F = 128
-BLOCK_D = 128
+# default kernel tiles; env-overridable (TPUFLOW_* layering) so the
+# on-chip MFU sweep can tune MXU block sizes without code edits —
+# BLOCK_S is also the padding quantum of the grouped layout, so a run
+# must use ONE consistent value end to end
+BLOCK_S = int(os.environ.get("TPUFLOW_GMM_BLOCK_S", "128"))
+BLOCK_F = int(os.environ.get("TPUFLOW_GMM_BLOCK_F", "128"))
+BLOCK_D = int(os.environ.get("TPUFLOW_GMM_BLOCK_D", "128"))
 
 
 def _default_interpret():

@@ -6,6 +6,7 @@ from .train_step import (
     make_train_step,
     make_trainer,
     make_eval_step,
+    reshard_like,
     shard_batch,
 )
 
@@ -16,6 +17,7 @@ __all__ = [
     "make_train_step",
     "make_trainer",
     "make_eval_step",
+    "reshard_like",
     "shard_batch",
     "ResumableTokenBatches",
     "sharded_dataset",

@@ -11,6 +11,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..spmd import sharding as shd
@@ -75,6 +76,32 @@ def memory_efficient_optimizer(lr=3e-4, weight_decay=0.1, clip_norm=1.0,
         optax.clip_by_global_norm(clip_norm),
         optax.GradientTransformation(init_fn, update_fn),
     )
+
+
+def reshard_like(tree, like):
+    """Re-place a checkpoint-restored pytree onto the shardings of a
+    LIVE state tree (same structure) — the resume recipe for a fresh
+    process.
+
+    orbax restores arrays with the shardings they were SAVED with, which
+    a retry/resume process cannot use directly. Mesh-sharded leaves are
+    device_put onto their NamedSharding; leaves whose live counterpart
+    sits on a single device (optimizer step counters and other scalars
+    that jit left unconstrained) are returned as HOST numpy instead —
+    committing them to device 0 via device_put would poison a
+    multi-device jit with 'incompatible devices', while an uncommitted
+    host array lets jit place them exactly as it placed the originals.
+    """
+    from jax.sharding import NamedSharding
+
+    def _place(restored, live):
+        host = np.asarray(jax.device_get(restored))
+        sharding = getattr(live, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(host, sharding)
+        return host
+
+    return jax.tree.map(_place, tree, like)
 
 
 def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):

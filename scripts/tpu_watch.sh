@@ -52,7 +52,14 @@ while true; do
     run_bench moe_gmm         BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm
     run_bench moe_sparse      BENCH_MODE=moe BENCH_MOE_DISPATCH=sparse
     run_bench moe_gmm_ep      BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm_ep
-    commit_history "On-chip MoE dispatch benches (gmm vs sparse)"
+    commit_history "On-chip MoE dispatch benches (gmm/sparse/gmm_ep)"
+    # gmm MXU tile sweep (VERDICT r4 weak #2: 128^3 blocks untuned) —
+    # committed separately so a re-wedged tunnel mid-sweep can never
+    # take the dispatch results with it
+    run_bench moe_gmm_s256    BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm TPUFLOW_GMM_BLOCK_S=256
+    run_bench moe_gmm_f256    BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm TPUFLOW_GMM_BLOCK_F=256
+    run_bench moe_gmm_f512    BENCH_MODE=moe BENCH_MOE_DISPATCH=gmm TPUFLOW_GMM_BLOCK_F=512
+    commit_history "On-chip gmm block-size sweep"
     run_bench launch          BENCH_MODE=launch BENCH_DAEMON=1
     run_bench data            BENCH_MODE=data
     commit_history "On-chip launch + data benches"

@@ -303,11 +303,16 @@ def test_resnet_foreach_finetune(run_flow, flows_dir, tpuflow_root):
 
 
 def test_moe_expert_parallel_checkpoint(run_flow, flows_dir, tpuflow_root):
+    """The BASELINE 'Expert-parallel + resume' north star in one flow:
+    Mixtral with DROPLESS gmm_ep dispatch on an expert mesh + resumable
+    data stream + full-state checkpoint, preempted and resumed exactly
+    (the flow itself asserts token-sequence and schedule-step
+    exactness)."""
     proc = run_flow(
         os.path.join(flows_dir, "moe_checkpoint_flow.py"), "run",
         env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
     )
-    assert "resumed from 2" in proc.stdout
+    assert "moe checkpoint ok: gmm_ep resumed from 3" in proc.stdout
 
 
 def test_namespace_filtering(run_flow, flows_dir, tpuflow_root):

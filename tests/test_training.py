@@ -134,6 +134,42 @@ def test_sequence_parallel_training_matches_dp(tiny_cfg, impl):
     np.testing.assert_allclose(l_ref, l_sp, rtol=2e-3)
 
 
+def test_reshard_like_cross_process_restore(tiny_cfg, tmp_path):
+    """The resume recipe: a state restored in a FRESH process re-places
+    onto the live mesh and runs — including the committed-scalar trap
+    (device_put'ing an optimizer counter to device 0 poisons a
+    multi-device jit; reshard_like leaves such leaves uncommitted)."""
+    import jax
+    import numpy as np
+
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.plugins.tpu.checkpoint_decorator import Checkpointer
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import (default_optimizer, make_trainer,
+                                       reshard_like, shard_batch)
+
+    mesh = create_mesh(MeshSpec.fsdp())
+    state, step_fn, _ = make_trainer(
+        jax.random.PRNGKey(0), tiny_cfg, mesh, llama,
+        optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                    total_steps=10),
+    )
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save({"state": state}, step=0)
+    restored = ckpt.load(like={"state": state})
+    state2 = reshard_like(restored["state"], state)
+    # params landed back on the mesh; the schedule counter is host-side
+    assert len(state2["params"]["embed"].sharding.device_set) > 1
+    batch = shard_batch(
+        {"tokens": jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                      tiny_cfg.vocab_size)}, mesh)
+    with mesh:
+        state3, m = step_fn(state2, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert int(jax.device_get(state3["step"])) == int(
+        jax.device_get(state["step"])) + 1
+
+
 def test_graft_entry_single_chip():
     import __graft_entry__ as ge
 

@@ -48,21 +48,23 @@ class TpuTrainFlow(FlowSpec):
         import numpy as np
 
         from metaflow_tpu.training import (STATE_KEY,
-                                           ResumableTokenBatches)
+                                           ResumableTokenBatches,
+                                           reshard_like)
         from metaflow_tpu.training.data import prefetch, shard_iterator
 
         corpus = np.random.default_rng(0).integers(
             0, cfg.vocab_size, size=batch_size * 34 * self.num_steps)
         ds = ResumableTokenBatches(corpus, batch_size, 32, seed=17,
                                    epochs=1)
-        shardings = jax.tree.map(lambda x: x.sharding, state)
         # `like=` template: orbax restores INTO this structure (optax
-        # namedtuples survive); restored arrays land back on the mesh
+        # namedtuples survive); reshard_like re-places every leaf onto
+        # THIS attempt's mesh (a fresh process cannot reuse the saved
+        # shardings, and committing scalars would poison the jit)
         restored = current.checkpoint.load(
             like={"state": state, "data_state": ds.state(), "loss": 0.0})
         last_loss, done_steps = None, 0
         if restored is not None:
-            state = jax.device_put(restored["state"], shardings)
+            state = reshard_like(restored["state"], state)
             ds.restore(restored["data_state"])
             last_loss = float(restored["loss"])
             done_steps = int(restored["data_state"]["cursor"])
