@@ -57,14 +57,23 @@ def _default_interpret():
 # ---------------------------------------------------------------------------
 
 
-def make_group_layout(group_ids, num_groups, block_s=BLOCK_S):
+def make_group_layout(group_ids, num_groups, block_s=BLOCK_S,
+                      row_valid=None):
     """Static-shape grouped layout for `gmm`.
 
     group_ids: [n] int32 — the group of each row.
+    row_valid: optional [n] int32/bool — rows marked 0 are PADDING the
+      caller was forced to carry at static shape (e.g. gmm_ep's
+      unwritten all-to-all buffer slots). They still get layout
+      positions (AFTER their group's valid rows) but never mark a tile
+      active, so the kernels skip their compute; their gathered outputs
+      come from zeroed tiles. Without this, padding rows masquerade as
+      real rows of their group and re-inflate the skipped work.
     Returns dict with:
-      dest       [n]        destination row of each input row
-      tile_group [n_tiles]  group id of every block_s-row tile
-      padded_len            static total rows (multiple of block_s)
+      dest        [n]        destination row of each input row
+      tile_group  [n_tiles]  group id of every block_s-row tile
+      tile_active [n_tiles]  1 iff the tile holds >= 1 (valid) row
+      padded_len             static total rows (multiple of block_s)
 
     Every group's rows land contiguously at a block_s-aligned offset, so
     each tile belongs to exactly one group; rows past a group's count are
@@ -72,12 +81,22 @@ def make_group_layout(group_ids, num_groups, block_s=BLOCK_S):
     """
     n = group_ids.shape[0]
     counts = jnp.bincount(group_ids, length=num_groups)
+    if row_valid is None:
+        valid = jnp.ones((n,), jnp.int32)
+        counts_valid = counts
+    else:
+        valid = row_valid.astype(jnp.int32)
+        counts_valid = jnp.bincount(group_ids, weights=valid,
+                                    length=num_groups).astype(jnp.int32)
     padded = ((counts + block_s - 1) // block_s) * block_s
     ends = jnp.cumsum(padded)
     offsets = ends - padded
-    # rank of each row within its group (stable arrival order) via a
-    # stable argsort — O(n log n), no [n, groups] one-hot materialized
-    order = jnp.argsort(group_ids, stable=True)
+    # rank of each row within its group via a stable argsort — O(n log
+    # n), no [n, groups] one-hot materialized. Sort key puts each
+    # group's VALID rows first (arrival-stable within each class) so
+    # valid rows form a prefix and tile_active is a per-group prefix
+    # predicate
+    order = jnp.argsort(group_ids * 2 + (1 - valid), stable=True)
     excl = jnp.cumsum(counts) - counts  # rows in earlier groups
     rank = jnp.zeros((n,), jnp.int32).at[order].set(
         jnp.arange(n, dtype=jnp.int32)
@@ -96,8 +115,18 @@ def make_group_layout(group_ids, num_groups, block_s=BLOCK_S):
         jnp.searchsorted(ends, tile_start, side="right"),
         num_groups - 1,
     ).astype(jnp.int32)
+    # a tile is ACTIVE iff it holds at least one VALID row: valid rows
+    # of group g occupy the prefix [offset_g, offset_g+counts_valid_g).
+    # The kernels skip the MXU work of inactive tiles — this keeps the
+    # padded static layout's compute proportional to the ACTUAL row
+    # count (the dropless point; for gmm_ep's exact mode the worst-case
+    # a2a buffers are mostly invalid rows, so skipping approaches a
+    # P-fold FLOPs saving on a balanced P-way expert mesh)
+    tile_active = (
+        tile_start < (offsets + counts_valid)[tile_group]
+    ).astype(jnp.int32)
     return {"dest": dest, "tile_group": tile_group,
-            "padded_len": padded_len}
+            "tile_active": tile_active, "padded_len": padded_len}
 
 
 def scatter_rows(rows, layout):
@@ -115,14 +144,26 @@ def gather_rows(padded, layout):
 # ---------------------------------------------------------------------------
 
 
-def _gmm_fwd_kernel(tg_ref, x_ref, w_ref, y_ref):
-    y_ref[...] = jnp.dot(
-        x_ref[...], w_ref[0],
-        preferred_element_type=jnp.float32,
-    ).astype(y_ref.dtype)
+def _gmm_fwd_kernel(tg_ref, ta_ref, x_ref, w_ref, y_ref):
+    i = pl.program_id(0)
+
+    # inactive tiles hold only zero padding: skip their MXU work (the
+    # output block must still be WRITTEN — on hardware it is otherwise
+    # uninitialized memory, not zeros). != 0 / == 0 are TOTAL: a block
+    # left unwritten by non-exhaustive branches would be garbage HBM
+    @pl.when(ta_ref[i] != 0)
+    def _():
+        y_ref[...] = jnp.dot(
+            x_ref[...], w_ref[0],
+            preferred_element_type=jnp.float32,
+        ).astype(y_ref.dtype)
+
+    @pl.when(ta_ref[i] == 0)
+    def _():
+        y_ref[...] = jnp.zeros_like(y_ref)
 
 
-def _gmm_call(x, w, tile_group, block_s, block_f, interpret):
+def _gmm_call(x, w, tile_group, tile_active, block_s, block_f, interpret):
     if pl is None:
         raise ImportError(
             "jax.experimental.pallas is unavailable in this jax install — "
@@ -139,40 +180,51 @@ def _gmm_call(x, w, tile_group, block_s, block_f, interpret):
     return pl.pallas_call(
         _gmm_fwd_kernel,
         grid_spec=_pltpu().PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((block_s, D), lambda i, j, tg: (i, 0)),
-                pl.BlockSpec((1, D, block_f), lambda i, j, tg: (tg[i], 0, j)),
+                pl.BlockSpec((block_s, D), lambda i, j, tg, ta: (i, 0)),
+                pl.BlockSpec((1, D, block_f),
+                             lambda i, j, tg, ta: (tg[i], 0, j)),
             ],
             out_specs=pl.BlockSpec((block_s, block_f),
-                                   lambda i, j, tg: (i, j)),
+                                   lambda i, j, tg, ta: (i, j)),
         ),
         out_shape=jax.ShapeDtypeStruct((S, F), x.dtype),
         interpret=interpret,
-    )(tile_group, x, w)
+    )(tile_group, tile_active, x, w)
 
 
-def _gmm_dw_kernel(tg_ref, x_ref, dy_ref, dw_ref):
+def _gmm_dw_kernel(tg_ref, ta_ref, x_ref, dy_ref, dw_ref):
     i = pl.program_id(2)
     first_of_group = jnp.logical_or(
         i == 0, tg_ref[i] != tg_ref[jnp.maximum(i - 1, 0)]
     )
-    tile = jnp.dot(
-        x_ref[...].T, dy_ref[...], preferred_element_type=jnp.float32
-    ).astype(dw_ref.dtype)
+    active = ta_ref[i] != 0
+    # a group's real rows are a PREFIX of its tiles, so its first tile
+    # is active whenever the group has any rows (empty groups own no
+    # tiles and are masked by `visited` downstream): initialize on the
+    # first (necessarily active) tile, accumulate on later active ones,
+    # and skip the MXU entirely for padding tiles — the revisited block
+    # persists untouched across skipped grid steps
 
-    @pl.when(first_of_group)
+    @pl.when(active)
     def _():
-        dw_ref[0] = tile
+        tile = jnp.dot(
+            x_ref[...].T, dy_ref[...], preferred_element_type=jnp.float32
+        ).astype(dw_ref.dtype)
 
-    @pl.when(jnp.logical_not(first_of_group))
-    def _():
-        dw_ref[0] = dw_ref[0] + tile
+        @pl.when(first_of_group)
+        def _():
+            dw_ref[0] = tile
+
+        @pl.when(jnp.logical_not(first_of_group))
+        def _():
+            dw_ref[0] = dw_ref[0] + tile
 
 
-def _gmm_dw_call(x, dy, tile_group, num_groups, block_s, block_d, block_f,
-                 interpret):
+def _gmm_dw_call(x, dy, tile_group, tile_active, num_groups, block_s,
+                 block_d, block_f, interpret):
     S, D = x.shape
     _, F = dy.shape
     block_d = min(block_d, D)
@@ -187,20 +239,20 @@ def _gmm_dw_call(x, dy, tile_group, num_groups, block_s, block_d, block_f,
     return pl.pallas_call(
         _gmm_dw_kernel,
         grid_spec=_pltpu().PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((block_s, block_d),
-                             lambda d, f, i, tg: (i, d)),
+                             lambda d, f, i, tg, ta: (i, d)),
                 pl.BlockSpec((block_s, block_f),
-                             lambda d, f, i, tg: (i, f)),
+                             lambda d, f, i, tg, ta: (i, f)),
             ],
             out_specs=pl.BlockSpec((1, block_d, block_f),
-                                   lambda d, f, i, tg: (tg[i], d, f)),
+                                   lambda d, f, i, tg, ta: (tg[i], d, f)),
         ),
         out_shape=jax.ShapeDtypeStruct((num_groups, D, F), jnp.float32),
         interpret=interpret,
-    )(tile_group, x, dy)
+    )(tile_group, tile_active, x, dy)
 
 
 # ---------------------------------------------------------------------------
@@ -208,18 +260,25 @@ def _gmm_dw_call(x, dy, tile_group, num_groups, block_s, block_d, block_f,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def gmm(x, w, tile_group, block_s=BLOCK_S, block_f=BLOCK_F,
-        interpret=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def gmm(x, w, tile_group, tile_active=None, block_s=BLOCK_S,
+        block_f=BLOCK_F, interpret=None):
     """y[i·bs:(i+1)·bs] = x[i·bs:(i+1)·bs] @ w[tile_group[i]].
 
     x: [S, D] grouped+padded rows (S % block_s == 0 — make_group_layout);
-    w: [G, D, F]; tile_group: [S // block_s] int32.
+    w: [G, D, F]; tile_group: [S // block_s] int32;
+    tile_active: [S // block_s] int32 (make_group_layout's
+    `tile_active`) — tiles marked 0 hold only zero padding and SKIP
+    their MXU work in forward, dx and dw (compute stays proportional to
+    real rows, the dropless point). None = treat every tile as active.
     """
+    if tile_active is None:
+        tile_active = jnp.ones_like(tile_group)
     if interpret is None:
         interpret = _default_interpret()
     _check_bwd_blocks(w, block_f)
-    return _gmm_call(x, w, tile_group, block_s, block_f, interpret)
+    return _gmm_call(x, w, tile_group, tile_active, block_s, block_f,
+                     interpret)
 
 
 def _check_bwd_blocks(w, block_f):
@@ -237,34 +296,40 @@ def _check_bwd_blocks(w, block_f):
             "kernel tiles D with that block" % (BLOCK_D, D))
 
 
-def _gmm_fwd(x, w, tile_group, block_s, block_f, interpret):
+def _gmm_fwd(x, w, tile_group, tile_active, block_s, block_f, interpret):
+    if tile_active is None:
+        tile_active = jnp.ones_like(tile_group)
     if interpret is None:
         interpret = _default_interpret()
-    y = _gmm_call(x, w, tile_group, block_s, block_f, interpret)
-    return y, (x, w, tile_group)
+    y = _gmm_call(x, w, tile_group, tile_active, block_s, block_f,
+                  interpret)
+    return y, (x, w, tile_group, tile_active)
 
 
 def _gmm_bwd(block_s, block_f, interpret, residuals, dy):
-    x, w, tile_group = residuals
+    x, w, tile_group, tile_active = residuals
     if interpret is None:
         interpret = _default_interpret()
     # dx: the same grouped matmul against w^T
     dx = _gmm_call(
-        dy, jnp.swapaxes(w, 1, 2), tile_group, block_s,
+        dy, jnp.swapaxes(w, 1, 2), tile_group, tile_active, block_s,
         min(block_f, w.shape[1]), interpret,
     ).astype(x.dtype)
     dw = _gmm_dw_call(
-        x, dy, tile_group, w.shape[0], block_s,
+        x, dy, tile_group, tile_active, w.shape[0], block_s,
         min(BLOCK_D, w.shape[1]), block_f, interpret,
     )
-    # a group with ZERO rows owns no tile, so the dw grid never writes
-    # its block — on real TPU that block is uninitialized memory, not
-    # zeros (interpret mode hides this). Mask to the visited groups.
-    # where, not multiply: the unvisited block may be NaN-filled
-    # (interpret mode) or arbitrary bits (hardware) — 0 * NaN is NaN
-    visited = jnp.zeros((w.shape[0],), bool).at[tile_group].set(True)
-    dw = jnp.where(visited[:, None, None], dw, 0).astype(w.dtype)
-    return dx, dw, None
+    # a group whose tiles were all SKIPPED (zero real rows — including
+    # the trailing clamped tiles assigned to the last group) never
+    # writes its dw block — on real TPU that block is uninitialized
+    # memory, not zeros (interpret mode hides this). Mask to groups
+    # with at least one ACTIVE tile. where, not multiply: the unvisited
+    # block may be NaN-filled (interpret) or arbitrary bits (hardware)
+    visited = jnp.zeros((w.shape[0],), jnp.int32).at[tile_group].max(
+        tile_active)
+    dw = jnp.where(visited.astype(bool)[:, None, None], dw, 0) \
+        .astype(w.dtype)
+    return dx, dw, None, None
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)

@@ -246,10 +246,10 @@ def _gmm_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
 
     layout = make_group_layout(e_flat, num_experts)
     x_pad = scatter_rows(tokens[t_flat], layout)
-    tg = layout["tile_group"]
-    gate = activation(gmm(x_pad, w_gate, tg))
-    up = gmm(x_pad, w_up, tg)
-    y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg)
+    tg, ta = layout["tile_group"], layout["tile_active"]
+    gate = activation(gmm(x_pad, w_gate, tg, ta))
+    up = gmm(x_pad, w_up, tg, ta)
+    y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg, ta)
     y_slots = gather_rows(y_pad, layout) * w_flat[:, None]
     return y_slots.reshape(T, k, E).sum(axis=1)
 
@@ -346,24 +346,32 @@ def _gmm_ep_dispatch_ffn(x, router_w, w_gate, w_up, w_down, num_experts, k,
 
         send_x = jnp.zeros((ep, c_send, Eb), xb.dtype).at[
             dst, safe_pos].add(tok[t_flat], mode="drop")
-        # local expert id rides with each row; unwritten rows stay 0 —
-        # zero data into expert 0's group contributes nothing
+        # local expert id AND a validity flag ride with each row:
+        # unwritten buffer slots must not masquerade as expert-0 rows,
+        # or the grouped layout would mark their tiles active and the
+        # kernels would burn the full worst-case MXU work on padding
         send_le = jnp.zeros((ep, c_send), jnp.int32).at[dst, safe_pos].set(
             e_flat % n_local, mode="drop")
+        send_ok = jnp.zeros((ep, c_send), jnp.int32).at[dst, safe_pos].set(
+            1, mode="drop")
 
         # [P, C, ·] tiled all_to_all = (member, block) grid transpose:
         # recv[src] is what src addressed to this member
         recv_x = jax.lax.all_to_all(send_x, "expert", 0, 0, tiled=True)
         recv_le = jax.lax.all_to_all(send_le, "expert", 0, 0, tiled=True)
+        recv_ok = jax.lax.all_to_all(send_ok, "expert", 0, 0, tiled=True)
 
         rows = recv_x.reshape(ep * c_send, Eb)
         layout = make_group_layout(recv_le.reshape(ep * c_send), n_local,
-                                   block_s=BLOCK_S)
+                                   block_s=BLOCK_S,
+                                   row_valid=recv_ok.reshape(ep * c_send))
         x_pad = scatter_rows(rows, layout)
-        tg = layout["tile_group"]
-        gate = activation(gmm(x_pad, wg, tg))
-        up = gmm(x_pad, wu, tg)
-        y_pad = gmm((gate * up).astype(xb.dtype), wd, tg)
+        tg, ta = layout["tile_group"], layout["tile_active"]
+        gate = activation(gmm(x_pad, wg, tg, ta))
+        up = gmm(x_pad, wu, tg, ta)
+        y_pad = gmm((gate * up).astype(xb.dtype), wd, tg, ta)
+        # invalid rows gathered from skipped tiles read zeros, exactly
+        # what their (zero) data would have produced
         y_rows = gather_rows(y_pad, layout)
         if tensor:                      # w_down contracted a sharded mlp dim
             y_rows = jax.lax.psum(y_rows, tensor)
