@@ -530,29 +530,14 @@ def bench_data_path():
     the real-NIC number is this capped by wire bandwidth. The reference
     ships the harness without stored numbers (BASELINE.md); we store ours."""
     import contextlib
-    import subprocess
     import tempfile
 
     from metaflow_tpu.gsop import GSClient
 
-    here = os.path.dirname(os.path.abspath(__file__))
     # the fake server gets its OWN processes: a pre-forked SO_REUSEPORT
     # cluster (state shared via tmpfs) so the measured ceiling is the
     # gsop ENGINE, not one server process's GIL (round-2 verdict weak #5)
-    server_workers = int(os.environ.get("BENCH_GCS_WORKERS",
-                                        min(8, max(4, os.cpu_count() or 4))))
-    server = subprocess.Popen(
-        [sys.executable, os.path.join(here, "tests", "fake_gcs.py"),
-         "--workers", str(server_workers)],
-        stdout=subprocess.PIPE, text=True,
-    )
-    endpoint = server.stdout.readline().strip()
-    if not endpoint.startswith("http://127.0.0.1:"):
-        server.terminate()
-        raise SystemExit(
-            "fake GCS server failed to start (got %r) — refusing to fall "
-            "back to the real GCS endpoint" % endpoint
-        )
+    server, endpoint, server_workers = _fake_gcs_server()
 
     n_objects, obj_mb = 8, 32
     blob = os.urandom(obj_mb << 20)
@@ -595,6 +580,182 @@ def bench_data_path():
                 "object_mb": obj_mb,
                 "transport": "loopback_fake_gcs_cluster",
                 "server_workers": server_workers,
+            },
+        }
+
+
+def _fake_gcs_server():
+    """Start the loopback fake-GCS cluster; returns
+    (popen, endpoint, n_workers) — the single source of truth for the
+    worker count reported in bench extras."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    server_workers = int(os.environ.get("BENCH_GCS_WORKERS",
+                                        min(8, max(4, os.cpu_count() or 4))))
+    server = subprocess.Popen(
+        [sys.executable, os.path.join(here, "tests", "fake_gcs.py"),
+         "--workers", str(server_workers)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    endpoint = server.stdout.readline().strip()
+    if not endpoint.startswith("http://127.0.0.1:"):
+        server.terminate()
+        raise SystemExit(
+            "fake GCS server failed to start (got %r) — refusing to fall "
+            "back to the real GCS endpoint" % endpoint
+        )
+    return server, endpoint, server_workers
+
+
+def bench_artifact_persist():
+    """Pipelined vs serial artifact persist (8×32 MB artifacts) against
+    the loopback fake GCS: measures the TaskDataStore.save_artifacts path
+    end to end — serialize (D2H + pack + sha256) overlapped with upload
+    vs the old serialize-everything-then-upload sequence. The headline
+    number is the PIPELINED rate; extra carries the serial rate and the
+    speedup (acceptance floor: ≥1.5×)."""
+    import contextlib
+
+    import numpy as np
+
+    from metaflow_tpu.datastore import FlowDataStore, GCSStorage
+
+    n_objects, obj_mb = 8, 32
+    total_mb = n_objects * obj_mb
+    rng = np.random.default_rng(0)
+    # distinct incompressible arrays: dedup must not collapse the set
+    base = [rng.integers(0, 255, obj_mb << 20, dtype=np.uint8)
+            for _ in range(n_objects)]
+    salt = [0]
+
+    def fresh_artifacts():
+        # content-addressing skips the PUT for bytes the store has seen:
+        # every measured run must persist NEVER-SEEN content or it would
+        # time 8 exists-checks instead of 256 MB of upload
+        salt[0] += 1
+        return [("a%d" % i, arr ^ np.uint8(salt[0]))
+                for i, arr in enumerate(base)]
+
+    server, endpoint, _workers = _fake_gcs_server()
+    with contextlib.ExitStack() as stack:
+        stack.callback(server.terminate)
+        os.environ["TPUFLOW_GS_ENDPOINT"] = endpoint
+        stack.callback(os.environ.pop, "TPUFLOW_GS_ENDPOINT", None)
+        # blob cache off: measure the persist path, not this disk
+        fds = FlowDataStore("BenchPersist", GCSStorage,
+                            ds_root="gs://bench-persist/root",
+                            blob_cache=False)
+
+        def run(task_id, pipelined):
+            arts = fresh_artifacts()
+            ds = fds.get_task_datastore("1", "persist", task_id, attempt=0,
+                                        mode="w")
+            ds.init_task()
+            t0 = time.perf_counter()
+            ds.save_artifacts(arts, pipelined=pipelined)
+            return time.perf_counter() - t0
+
+        run("warm", False)  # warmup: server allocators, conn pools
+        serial_dt = min(run("s%d" % i, False) for i in range(2))
+        pipe_dt = min(run("p%d" % i, True) for i in range(2))
+        pipe_rate = total_mb / pipe_dt
+        return {
+            "metric": "artifact_persist_mb_per_s",
+            "value": round(pipe_rate, 1),
+            "unit": "MB/s",
+            "vs_baseline": _vs_baseline(pipe_rate),
+            "extra": {
+                "serial_mb_per_s": round(total_mb / serial_dt, 1),
+                "speedup_vs_serial": round(serial_dt / pipe_dt, 2),
+                "objects": n_objects,
+                "object_mb": obj_mb,
+                "transport": "loopback_fake_gcs_cluster",
+            },
+        }
+
+
+def bench_ckpt_overlap():
+    """Async checkpoint overlap: how much of a checkpoint's wall-clock the
+    train loop gets back. ckpt_overlap_ratio = 1 − save()_visible / sync,
+    where sync is the full serialize+upload wall-clock (save + wait) and
+    save()_visible is the time the async save blocks the caller (host
+    snapshot only). Between save() and wait() the bench keeps running
+    jitted train-step stand-ins and reports how many completed inside the
+    upload window — proof the overlap is real compute, not idle time.
+    Acceptance: visible < 10% of sync."""
+    import contextlib
+
+    import numpy as np
+
+    from metaflow_tpu.datastore import FlowDataStore, GCSStorage
+    from metaflow_tpu.training import AsyncCheckpointManager
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    state = {
+        "params": {"w%d" % i: rng.standard_normal((1024, 1024))
+                   .astype(np.float32) for i in range(16)},
+        "step": 123,
+    }  # 16 × 4 MB = 64 MB
+    state_mb = sum(v.nbytes for v in state["params"].values()) >> 20
+
+    # train-step stand-in: a jitted matmul chain, sized to a few ms
+    @jax.jit
+    def fake_step(x):
+        for _ in range(4):
+            x = jnp.tanh(x @ x)
+        return x
+
+    x0 = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+    fake_step(x0).block_until_ready()  # compile
+
+    server, endpoint, _workers = _fake_gcs_server()
+    with contextlib.ExitStack() as stack:
+        stack.callback(server.terminate)
+        os.environ["TPUFLOW_GS_ENDPOINT"] = endpoint
+        stack.callback(os.environ.pop, "TPUFLOW_GS_ENDPOINT", None)
+        fds = FlowDataStore("BenchCkpt", GCSStorage,
+                            ds_root="gs://bench-ckpt/root",
+                            blob_cache=False)
+        mgr = AsyncCheckpointManager(fds, name="bench")
+        # warmup (step 0): conn pool + allocator
+        mgr.save(state, 0)
+        mgr.wait()
+        sync_dt = []
+        vis_dt = []
+        overlapped_steps = []
+        for i in range(1, 4):
+            # distinct step content each round so upload really happens
+            state["params"]["w0"] = state["params"]["w0"] + np.float32(i)
+            t0 = time.perf_counter()
+            mgr.save(state, i)
+            vis = time.perf_counter() - t0
+            # the train loop continues while the upload is in flight
+            steps = 0
+            while not mgr.done():
+                fake_step(x0).block_until_ready()
+                steps += 1
+            sync_dt.append(time.perf_counter() - t0)
+            vis_dt.append(vis)
+            overlapped_steps.append(steps)
+        sync = statistics.median(sync_dt)
+        visible = statistics.median(vis_dt)
+        ratio = max(0.0, 1.0 - visible / sync) if sync > 0 else 0.0
+        return {
+            "metric": "ckpt_overlap_ratio",
+            "value": round(ratio, 4),
+            "unit": "fraction of checkpoint wall-clock overlapped",
+            "vs_baseline": 1.0,
+            "extra": {
+                "sync_save_s": round(sync, 4),
+                "async_visible_s": round(visible, 4),
+                "visible_fraction": round(visible / sync, 4) if sync else None,
+                "train_steps_during_upload": overlapped_steps,
+                "state_mb": state_mb,
+                "transport": "loopback_fake_gcs_cluster",
             },
         }
 
@@ -706,6 +867,11 @@ if __name__ == "__main__":
         result = bench_step_launch()
     elif mode == "data":
         result = bench_data_path()
+    elif mode == "persist":
+        # artifact persist pipeline + async checkpoint overlap: pure
+        # host/IO metrics, no chip needed
+        result = bench_artifact_persist()
+        result["submetrics"] = [_submetric(bench_ckpt_overlap)]
     elif mode == "hlo_estimate":
         # no chip needed BY DESIGN (abstract lowering + cost model): pin
         # to CPU before jax initializes — this mode must never touch the
@@ -752,6 +918,8 @@ if __name__ == "__main__":
             result["submetrics"] = [
                 _submetric(bench_step_launch),
                 _submetric(bench_data_path),
+                _submetric(bench_artifact_persist),
+                _submetric(bench_ckpt_overlap),
             ]
             if result.get("degraded"):
                 # the degraded train line itself never reaches history

@@ -882,6 +882,22 @@ def make_cli(flow, state):
                         live.update(manifest.get("objects", {}).values())
                     except (OSError, ValueError):
                         continue
+        # async-checkpoint manifests (<flow>/_checkpoints/<name>/
+        # step_N.json) reference CAS blobs too — their snapshots must
+        # survive the sweep or restore() finds a manifest over a hole
+        ckpt_dir = os.path.join(flow_dir, "_checkpoints")
+        for dirpath, _dirs, files in os.walk(ckpt_dir):
+            for name in files:
+                if not (name.startswith("step_")
+                        and name.endswith(".json")):
+                    continue
+                try:
+                    with open(os.path.join(dirpath, name)) as f:
+                        manifest = _json.load(f)
+                    if manifest.get("key"):
+                        live.add(manifest["key"])
+                except (OSError, ValueError):
+                    continue
         # sweep: blobs not referenced by any kept run
         data_dir = os.path.join(flow_dir, "data")
         dead_blobs = []

@@ -56,13 +56,10 @@ def _flow_datastore(flow_name):
     from ..metaflow_config import default_datastore
 
     ds_type = default_datastore()
-    fds = FlowDataStore(flow_name, STORAGE_BACKENDS[ds_type])
-    if ds_type != "local":
-        # remote reads go through the on-disk LRU blob cache
-        from .filecache import FileCache
-
-        fds.ca_store.set_blob_cache(FileCache())
-    return fds
+    # FlowDataStore auto-attaches the shared on-disk blob cache for
+    # remote storage (read-through for the client, write-through for
+    # tasks) — no client-side special case needed anymore
+    return FlowDataStore(flow_name, STORAGE_BACKENDS[ds_type])
 
 
 class MetaflowObject(object):

@@ -21,6 +21,14 @@ class BlobCache(object):
     def store_key(self, key, blob):
         pass
 
+    def key_lock(self, key):
+        """Context manager serializing fetches of one key across readers
+        (in-flight dedup). The base cache does not dedup: concurrent
+        fetchers proceed independently."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
 
 class _TaggedFileReader(object):
     """File-like that serves a pack-format tag byte, then the file —
@@ -63,8 +71,21 @@ class ContentAddressedStore(object):
     def set_blob_cache(self, blob_cache):
         self._blob_cache = blob_cache
 
+    @property
+    def blob_cache(self):
+        return self._blob_cache
+
+    @property
+    def storage(self):
+        return self._storage
+
     def _path(self, key):
         return self._storage.path_join(self._prefix, key[:2], key)
+
+    def blob_path(self, key):
+        """Storage path of a content key (the persist pipeline streams
+        packed blobs straight to storage under these paths)."""
+        return self._path(key)
 
     # once a persist has streamed this much hash+gzip work, the REMAINING
     # blobs are fanned over forked workers (multicore.parallel_map —
@@ -75,16 +96,36 @@ class ContentAddressedStore(object):
     PARALLEL_PACK_MIN_BLOBS = 4
     PARALLEL_PACK_WORKERS = None  # None = multicore's cpu-count default
 
-    def _pack_blob(self, blob, raw):
+    def pack_blob(self, blob, raw=False):
+        """(sha256 hex key, packed bytes) for one blob — the SINGLE pack
+        implementation, shared by the serial save path and the pipelined
+        one so both produce byte-identical objects and keys."""
         sha = hashlib.sha256(blob).hexdigest()
         if raw or len(blob) > self.COMPRESS_MAX:
             packed = self.FMT_RAW + blob
         else:
-            packed = self.FMT_GZIP + gzip.compress(blob, compresslevel=3)
+            # mtime=0: gzip otherwise stamps wall-clock into the header,
+            # making packed bytes non-reproducible — a CAS object's bytes
+            # must be a pure function of its payload
+            packed = self.FMT_GZIP + gzip.compress(blob, compresslevel=3,
+                                                   mtime=0)
         return sha, packed
 
-    def save_blobs(self, blob_iter, raw=False, len_hint=0):
-        """Save blobs; returns list of (uri, key) in input order."""
+    # internal alias kept for the forked parallel_map closure below
+    _pack_blob = pack_blob
+
+    def save_blobs(self, blob_iter, raw=False, len_hint=0, cacheable=True):
+        """Save blobs; returns list of (uri, key) in input order.
+
+        cacheable=False skips the blob-cache write-through (checkpoint
+        snapshots use it: a superseded multi-GB checkpoint payload in
+        the LRU cache would only evict the artifact blobs the cache
+        exists for)."""
+        # write-through happens INLINE at pack time: a resumed/forked
+        # task on this host reads the artifact back from disk instead of
+        # re-downloading, and no raw payload is pinned past its pack (the
+        # streaming prefix keeps its one-blob-at-a-time memory profile)
+        keep = cacheable and self._blob_cache is not None
         packed_all = []
         it = iter(blob_iter)
         count = 0
@@ -93,7 +134,10 @@ class ContentAddressedStore(object):
         for blob in it:
             count += 1
             total += len(blob)
-            packed_all.append(self._pack_blob(blob, raw))
+            sha, packed = self._pack_blob(blob, raw)
+            packed_all.append((sha, packed))
+            if keep:
+                self._blob_cache.store_key(sha, blob)
             if (count >= self.PARALLEL_PACK_MIN_BLOBS
                     and total >= self.PARALLEL_PACK_MIN_BYTES):
                 tail = list(it)
@@ -101,10 +145,16 @@ class ContentAddressedStore(object):
         if tail:
             from ..multicore import parallel_map
 
-            packed_all.extend(parallel_map(
+            packed_tail = parallel_map(
                 lambda b: self._pack_blob(b, raw), tail,
                 max_parallel=self.PARALLEL_PACK_WORKERS, min_chunk=2,
-            ))
+            )
+            if keep:
+                # tail blobs are already materialized (tail list) — this
+                # adds no pinning beyond the pre-existing parallel_map
+                for (sha, _packed), blob in zip(packed_tail, tail):
+                    self._blob_cache.store_key(sha, blob)
+            packed_all.extend(packed_tail)
         results = []
         to_save = []
         for sha, packed in packed_all:
@@ -174,12 +224,19 @@ class ContentAddressedStore(object):
 
         return opened()
 
-    def load_blobs(self, keys, force_raw=False, missing_ok=False):
+    def load_blobs(self, keys, force_raw=False, missing_ok=False,
+                   cacheable=True):
         """Yield (key, bytes) for each key (order not guaranteed).
 
         missing_ok=True skips absent keys instead of raising — for
         opportunistic prefetch, where a missing blob should surface (or
-        not) at the actual read."""
+        not) at the actual read.
+
+        cacheable=False reads THROUGH the cache (hits still served) but
+        never stores into it — for one-shot multi-GB payloads (checkpoint
+        restore) that would only evict the artifact blobs the LRU cache
+        exists for. Also skips the key locks: without a store there is
+        nothing for a deduped second reader to pick up."""
         remaining = []
         for key in keys:
             if self._blob_cache is not None:
@@ -190,23 +247,67 @@ class ContentAddressedStore(object):
             remaining.append(key)
         if not remaining:
             return
-        paths = {self._path(k): k for k in remaining}
-        with self._storage.load_bytes(list(paths)) as loaded:
-            for path, local, _meta in loaded:
-                key = paths[path]
-                if local is None:
-                    if missing_ok:
-                        continue
-                    raise KeyError(
-                        "Content-addressed blob %s not found in datastore"
-                        % key
-                    )
-                with open(local, "rb") as f:
-                    packed = f.read()
-                blob = self._unpack(packed)
-                if self._blob_cache is not None:
-                    self._blob_cache.store_key(key, blob)
-                yield key, blob
+        for pair in self._fetch_blobs(remaining, missing_ok,
+                                      cacheable=cacheable):
+            yield pair
+
+    def _fetch_blobs(self, keys, missing_ok, cacheable=True):
+        """Fetch keys from storage with in-flight dedup: when the blob
+        cache provides key locks (FileCache does), concurrent gang
+        workers racing on the same keys serialize per key and all but the
+        first fetcher resolve from the cache instead of re-downloading.
+        Locks are taken in sorted key order, and the cache's key_lock is
+        BOUNDED (times out into an unlocked fetch) — nested loads across
+        workers can interleave lock batches in conflicting orders, so an
+        untimed lock could cycle; a timeout costs at most one duplicate
+        download, never a hang.
+
+        Streaming: blobs yield ONE at a time (bulk data stages on disk
+        via load_bytes), so peak RSS is one unpacked blob regardless of
+        the artifact set size. The key locks consequently stay held
+        while the consumer iterates — that can extend another worker's
+        wait, but never beyond this reader's own load, and the
+        alternative (buffering every blob to release locks early) trades
+        a wait for an OOM."""
+        cache = self._blob_cache if cacheable else None
+        lock_fn = getattr(cache, "key_lock", None) if cache else None
+        locks = []
+        try:
+            if lock_fn is not None:
+                for key in sorted(set(keys)):
+                    lk = lock_fn(key)
+                    lk.__enter__()
+                    locks.append(lk)
+                # under the locks another worker may have landed the blob
+                still = []
+                for key in keys:
+                    cached = cache.load_key(key)
+                    if cached is not None:
+                        yield key, cached
+                    else:
+                        still.append(key)
+                keys = still
+            if keys:
+                paths = {self._path(k): k for k in keys}
+                with self._storage.load_bytes(list(paths)) as loaded:
+                    for path, local, _meta in loaded:
+                        key = paths[path]
+                        if local is None:
+                            if missing_ok:
+                                continue
+                            raise KeyError(
+                                "Content-addressed blob %s not found in "
+                                "datastore" % key
+                            )
+                        with open(local, "rb") as f:
+                            packed = f.read()
+                        blob = self._unpack(packed)
+                        if cache is not None:
+                            cache.store_key(key, blob)
+                        yield key, blob
+        finally:
+            for lk in reversed(locks):
+                lk.__exit__(None, None, None)
 
     def blob_exists(self, keys):
         return self._storage.is_file([self._path(k) for k in keys])

@@ -12,15 +12,34 @@ from .task_datastore import TaskDataStore
 
 
 class FlowDataStore(object):
-    def __init__(self, flow_name, storage_impl, ds_root=None):
+    def __init__(self, flow_name, storage_impl, ds_root=None,
+                 blob_cache=None):
         """storage_impl: a DataStoreStorage subclass; ds_root overrides its
-        configured root."""
+        configured root.
+
+        blob_cache: None (default) auto-attaches the shared on-disk
+        FileCache for REMOTE storage — tasks then write artifacts through
+        the cache on persist and resumed/forked tasks + load_artifacts
+        read locally-present keys from disk instead of GCS, with
+        in-flight dedup across gang workers on one host. Pass False to
+        disable, or any BlobCache-shaped object to override.
+        TPUFLOW_BLOB_CACHE=0 disables the auto-attach globally (local
+        storage never attaches one: the datastore already IS local disk).
+        """
         root = ds_root or storage_impl.get_datastore_root_from_config()
         self.flow_name = flow_name
         self.storage = storage_impl(root)
         self.ca_store = ContentAddressedStore(
             self.storage.path_join(flow_name, "data"), self.storage
         )
+        if blob_cache is None:
+            if (self.storage.TYPE != "local"
+                    and os.environ.get("TPUFLOW_BLOB_CACHE", "1") != "0"):
+                from ..client.filecache import FileCache
+
+                self.ca_store.set_blob_cache(FileCache())
+        elif blob_cache is not False:
+            self.ca_store.set_blob_cache(blob_cache)
 
     @property
     def ds_type(self):
@@ -150,7 +169,10 @@ class FlowDataStore(object):
         out = []
         for path, is_file in self.storage.list_content([self.flow_name]):
             name = self.storage.basename(path)
-            if not is_file and name not in ("data",):
+            # 'data' is the CAS; '_'-prefixed dirs are flow-level state
+            # (_checkpoints, ...) — neither is a run (gc would otherwise
+            # age them out as phantom runs)
+            if not is_file and name != "data" and not name.startswith("_"):
                 out.append(name)
         return out
 

@@ -87,6 +87,34 @@ def _to_host(arr):
     return arr
 
 
+def prefetch_to_host(obj, depth=0):
+    """Eagerly START device→host transfers for every jax array reachable
+    through common containers, without blocking on any of them.
+
+    The persist pipeline calls this once over the whole artifact set
+    before serialization begins: `copy_to_host_async` enqueues all the
+    D2H copies back-to-back on the device's transfer stream, so the
+    per-artifact `_to_host` calls that follow complete already-in-flight
+    copies instead of issuing cold, serialized ones. Best-effort by
+    design — an array that cannot prefetch (non-addressable shards, old
+    jax) simply pays the normal blocking transfer later.
+    """
+    if depth > 16:
+        return
+    if _is_jax_array(obj):
+        try:
+            obj.copy_to_host_async()
+        except Exception:
+            pass
+        return
+    if isinstance(obj, dict):
+        for v in obj.values():
+            prefetch_to_host(v, depth + 1)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            prefetch_to_host(v, depth + 1)
+
+
 def _npy_bytes(arr):
     """Tensor format: json header {dtype, shape} + raw C-order bytes.
 

@@ -255,8 +255,29 @@ class GCSStorage(DataStoreStorage):
                 results.append((self._unkey(p).rstrip("/"), False))
         return results
 
+    # at this many objects in a batch (or an announced stream, via
+    # len_hint), cross-object fan-out already saturates the NIC and
+    # per-object compose parallelism only multiplies streams + pays the
+    # compose/delete round-trips — same rule gsop.get_many applies on
+    # the download side (large objects transfer one at a time there)
+    COMPOSE_OFF_BATCH = 4
+    # ...EXCEPT for objects this many times over the ranged threshold:
+    # in a size-skewed batch (one multi-GB tensor among small metadata
+    # blobs) the peers finish long before the big object, so it keeps
+    # its part-compose fan-out regardless of batch size
+    COMPOSE_BIG_MULT = 4
+
     def save_bytes(self, path_and_bytes_iter, overwrite=False, len_hint=0):
         from concurrent.futures import ThreadPoolExecutor
+
+        items = list(path_and_bytes_iter)
+        if not items:
+            return
+        # len_hint can announce a LARGER stream than this call carries
+        # (the persist pipeline uploads one object per call from many
+        # workers): honor whichever signal is bigger
+        effective_batch = max(len(items), len_hint)
+        allow_compose = effective_batch < self.COMPOSE_OFF_BATCH
 
         def upload(item):
             path, payload = item
@@ -304,11 +325,13 @@ class GCSStorage(DataStoreStorage):
                 finally:
                     if hasattr(byte_obj, "close"):
                         byte_obj.close()
-            self.client.put_bytes(self._bucket_name, key, byte_obj)
+            compose_ok = allow_compose or (
+                len(byte_obj)
+                > self.client.ranged_threshold * self.COMPOSE_BIG_MULT
+            )
+            self.client.put_bytes(self._bucket_name, key, byte_obj,
+                                  allow_compose=compose_ok)
 
-        items = list(path_and_bytes_iter)
-        if not items:
-            return
         with ThreadPoolExecutor(max_workers=min(32, len(items))) as ex:
             list(ex.map(upload, items))
 

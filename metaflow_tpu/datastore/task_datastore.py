@@ -8,9 +8,11 @@ is a small JSON manifest mapping name → (content key, type tag).
 """
 
 import json
+import os
 import time
 from functools import wraps
 
+from .. import tracing
 from ..exception import TpuFlowDataMissing, MetaflowInternalError
 from . import serializers
 
@@ -166,18 +168,46 @@ class TaskDataStore(object):
 
     @only_if_not_done
     @require_mode("w")
-    def save_artifacts(self, artifacts_iter):
-        """Save {name: obj} pairs; dedup via CAS."""
-        names, blobs, tags = [], [], []
-        for name, obj in artifacts_iter:
-            payload, tag = serializers.serialize(obj)
-            names.append(name)
-            blobs.append(payload)
-            tags.append(tag)
-        results = self._ca_store.save_blobs(blobs)
-        for name, (uri, key), tag, blob in zip(names, results, tags, blobs):
-            self._objects[name] = key
-            self._info[name] = {"type_tag": tag, "size": len(blob)}
+    def save_artifacts(self, artifacts_iter, pipelined=None):
+        """Save {name: obj} pairs; dedup via CAS.
+
+        pipelined=None (default) picks the overlapped persist pipeline
+        (datastore/pipeline.py) for multi-artifact saves unless
+        TPUFLOW_PERSIST_PIPELINE=0; both paths produce byte-identical
+        CAS objects and manifests — the pipelined one overlaps
+        device→host transfer + serialization with upload."""
+        items = list(artifacts_iter)
+        if pipelined is None:
+            pipelined = (
+                len(items) > 1
+                and os.environ.get("TPUFLOW_PERSIST_PIPELINE", "1") != "0"
+            )
+        with tracing.span(
+            "persist.save_artifacts",
+            {"task": self.pathspec, "artifacts": len(items),
+             "pipelined": bool(pipelined)},
+        ):
+            if pipelined:
+                from .pipeline import persist_pipeline
+
+                for name, key, tag, size in persist_pipeline(
+                    items, self._ca_store
+                ):
+                    self._objects[name] = key
+                    self._info[name] = {"type_tag": tag, "size": size}
+                return
+            names, blobs, tags = [], [], []
+            for name, obj in items:
+                payload, tag = serializers.serialize(obj)
+                names.append(name)
+                blobs.append(payload)
+                tags.append(tag)
+            results = self._ca_store.save_blobs(blobs)
+            for name, (uri, key), tag, blob in zip(
+                names, results, tags, blobs
+            ):
+                self._objects[name] = key
+                self._info[name] = {"type_tag": tag, "size": len(blob)}
 
     @only_if_not_done
     @require_mode("w")
@@ -252,6 +282,7 @@ class TaskDataStore(object):
 
     def load_artifacts(self, names):
         """Yield (name, obj) for requested artifact names."""
+        names = list(names)  # callers may pass a generator; len() below
         keys = {}
         for name in names:
             if name not in self._objects:
@@ -259,11 +290,15 @@ class TaskDataStore(object):
                     "Artifact *%s* not found in task %s" % (name, self.pathspec)
                 )
             keys.setdefault(self._objects[name], []).append(name)
-        for key, blob in self._ca_store.load_blobs(list(keys)):
-            for name in keys[key]:
-                yield name, serializers.deserialize(
-                    blob, self._info[name]["type_tag"]
-                )
+        with tracing.span(
+            "persist.load_artifacts",
+            {"task": self.pathspec, "artifacts": len(names)},
+        ):
+            for key, blob in self._ca_store.load_blobs(list(keys)):
+                for name in keys[key]:
+                    yield name, serializers.deserialize(
+                        blob, self._info[name]["type_tag"]
+                    )
 
     def __contains__(self, name):
         return name in self._objects

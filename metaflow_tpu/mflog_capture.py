@@ -150,6 +150,16 @@ def capture(args, child_argv):
         # detection (it used to poll the rank directly). Keep draining
         # only while data is actually arriving.
         if rc is not None and (not open_streams or not events):
+            # final non-blocking drain: the child can write and exit in
+            # the window between the (empty) select above and poll() —
+            # those last bytes are still sitting in the pipes and would
+            # otherwise never be persisted
+            while open_streams:
+                events = sel.select(timeout=0)
+                if not events:
+                    break
+                for key, _ in events:
+                    drain(key.fileobj, key.data)
             break
     for name in partial:
         if partial[name]:

@@ -261,7 +261,15 @@ def _gmm_dw_call(x, dy, tile_group, tile_active, num_groups, block_s,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def gmm(x, w, tile_group, tile_active=None, block_s=BLOCK_S,
+def _gmm_prim(x, w, tile_group, tile_active, block_s, block_f, interpret):
+    """custom_vjp primal — all args resolved/positional (custom_vjp
+    cannot bind keyword-only params); the public gmm() wrapper below is
+    the only caller."""
+    return _gmm_call(x, w, tile_group, tile_active, block_s, block_f,
+                     interpret)
+
+
+def gmm(x, w, tile_group, *, tile_active=None, block_s=BLOCK_S,
         block_f=BLOCK_F, interpret=None):
     """y[i·bs:(i+1)·bs] = x[i·bs:(i+1)·bs] @ w[tile_group[i]].
 
@@ -271,13 +279,19 @@ def gmm(x, w, tile_group, tile_active=None, block_s=BLOCK_S,
     `tile_active`) — tiles marked 0 hold only zero padding and SKIP
     their MXU work in forward, dx and dw (compute stays proportional to
     real rows, the dropless point). None = treat every tile as active.
+
+    tile_active/block_s/block_f/interpret are KEYWORD-ONLY: tile_active
+    was inserted before block_s at one point, so a stale positional
+    caller `gmm(x, w, tg, 64)` meaning block_s=64 would silently pass 64
+    as the tile mask — keyword-only turns that into an immediate
+    TypeError instead.
     """
     if tile_active is None:
         tile_active = jnp.ones_like(tile_group)
     if interpret is None:
         interpret = _default_interpret()
     _check_bwd_blocks(w, block_f)
-    return _gmm_call(x, w, tile_group, tile_active, block_s, block_f,
+    return _gmm_prim(x, w, tile_group, tile_active, block_s, block_f,
                      interpret)
 
 
@@ -301,6 +315,9 @@ def _gmm_fwd(x, w, tile_group, tile_active, block_s, block_f, interpret):
         tile_active = jnp.ones_like(tile_group)
     if interpret is None:
         interpret = _default_interpret()
+    # under jax.grad custom_vjp routes HERE, not through the primal — the
+    # misconfigured-D fail-fast must fire in the differentiated case too
+    _check_bwd_blocks(w, block_f)
     y = _gmm_call(x, w, tile_group, tile_active, block_s, block_f,
                   interpret)
     return y, (x, w, tile_group, tile_active)
@@ -332,7 +349,7 @@ def _gmm_bwd(block_s, block_f, interpret, residuals, dy):
     return dx, dw, None, None
 
 
-gmm.defvjp(_gmm_fwd, _gmm_bwd)
+_gmm_prim.defvjp(_gmm_fwd, _gmm_bwd)
 
 
 def gmm_reference(x, w, tile_group, block_s=BLOCK_S):

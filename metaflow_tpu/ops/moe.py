@@ -247,9 +247,10 @@ def _gmm_dispatch_ffn(tokens, weights, idx, w_gate, w_up, w_down,
     layout = make_group_layout(e_flat, num_experts)
     x_pad = scatter_rows(tokens[t_flat], layout)
     tg, ta = layout["tile_group"], layout["tile_active"]
-    gate = activation(gmm(x_pad, w_gate, tg, ta))
-    up = gmm(x_pad, w_up, tg, ta)
-    y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg, ta)
+    gate = activation(gmm(x_pad, w_gate, tg, tile_active=ta))
+    up = gmm(x_pad, w_up, tg, tile_active=ta)
+    y_pad = gmm((gate * up).astype(tokens.dtype), w_down, tg,
+                tile_active=ta)
     y_slots = gather_rows(y_pad, layout) * w_flat[:, None]
     return y_slots.reshape(T, k, E).sum(axis=1)
 
@@ -367,9 +368,10 @@ def _gmm_ep_dispatch_ffn(x, router_w, w_gate, w_up, w_down, num_experts, k,
                                    row_valid=recv_ok.reshape(ep * c_send))
         x_pad = scatter_rows(rows, layout)
         tg, ta = layout["tile_group"], layout["tile_active"]
-        gate = activation(gmm(x_pad, wg, tg, ta))
-        up = gmm(x_pad, wu, tg, ta)
-        y_pad = gmm((gate * up).astype(xb.dtype), wd, tg, ta)
+        gate = activation(gmm(x_pad, wg, tg, tile_active=ta))
+        up = gmm(x_pad, wu, tg, tile_active=ta)
+        y_pad = gmm((gate * up).astype(xb.dtype), wd, tg,
+                    tile_active=ta)
         # invalid rows gathered from skipped tiles read zeros, exactly
         # what their (zero) data would have produced
         y_rows = gather_rows(y_pad, layout)

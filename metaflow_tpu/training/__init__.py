@@ -1,3 +1,4 @@
+from .checkpoint import AsyncCheckpointManager, Checkpoint
 from .data import STATE_KEY, ResumableTokenBatches, sharded_dataset
 from .train_step import (
     default_optimizer,
@@ -11,6 +12,8 @@ from .train_step import (
 )
 
 __all__ = [
+    "AsyncCheckpointManager",
+    "Checkpoint",
     "default_optimizer",
     "memory_efficient_optimizer",
     "make_train_state",

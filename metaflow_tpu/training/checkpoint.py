@@ -1,0 +1,271 @@
+"""Async training checkpoints through the content-addressed store.
+
+The @checkpoint decorator's orbax path (plugins/tpu/checkpoint_decorator)
+is synchronous: the train loop stalls for the whole serialize+upload
+wall-clock at every checkpoint step. This manager is the overlapped
+alternative for pipeline/SPMD training (the tail-latency lesson from
+arxiv 2011.03641): `save(state, step)` blocks only for the device→host
+snapshot — an eager `copy_to_host_async` fan-out followed by the gather —
+and hands serialization + CAS upload + manifest write to a background
+thread, so checkpoint upload overlaps the train steps that follow.
+
+Contract (see docs/persist_pipeline.md):
+
+  - `save(state, step)` returns once the snapshot is on the HOST. The
+    caller may immediately donate/overwrite the device buffers (the jit
+    train step's donate_argnums) — the background thread only touches
+    host numpy.
+  - One save is in flight at a time: `save` barriers on the previous
+    background persist first (so checkpoint bandwidth can never fall
+    behind by more than one snapshot's worth of RAM).
+  - A background failure is NEVER lost: it re-raises at the next
+    `save()`, `wait()` or `done()` call.
+  - Crash consistency: the per-step manifest is written only AFTER the
+    snapshot blob is fully in the CAS. A crash mid-upload leaves no
+    manifest, so `restore()` sees the previous complete checkpoint; a
+    torn checkpoint is unobservable.
+  - `restore(like=live_state)` re-places restored leaves onto the live
+    tree's shardings via train_step.reshard_like — the resume recipe for
+    a fresh process whose mesh differs from the saver's.
+"""
+
+import collections
+import json
+import threading
+import time
+
+import numpy as np
+
+from .. import tracing
+from ..datastore import serializers
+
+Checkpoint = collections.namedtuple("Checkpoint", ["state", "step", "extra"])
+
+
+class AsyncCheckpointManager(object):
+    """Checkpoints pytree train states into a flow datastore's CAS.
+
+    flow_datastore: a datastore.FlowDataStore (any storage backend).
+    name: logical stream name — one manager per trainer; manifests live
+          under <flow>/_checkpoints/<name>/step_<n>.json.
+    keep: retain only the newest N manifests (None = keep all). Blobs
+          stay in the CAS (content-addressed, shared, gc'd elsewhere).
+    """
+
+    def __init__(self, flow_datastore, name="default", keep=None):
+        self._storage = flow_datastore.storage
+        self._ca = flow_datastore.ca_store
+        self._prefix = self._storage.path_join(
+            flow_datastore.flow_name, "_checkpoints", name
+        )
+        self._keep = keep
+        self._thread = None
+        self._error = None
+        self._lock = threading.Lock()
+        # the most recent restore()'s Checkpoint — callers that went
+        # through make_trainer(checkpoint=...) read the resumed step and
+        # the data-iterator stamp here without re-downloading the state
+        self.last_restored = None
+
+    # ---------- write path ----------
+
+    def save(self, state, step, extra=None):
+        """Snapshot `state` (a pytree of arrays/scalars) for logical
+        `step` and return as soon as the snapshot is host-resident.
+        `extra` (JSON-able, e.g. the data iterator's resume stamp) rides
+        in the manifest. Serialization + upload happen in the background;
+        errors surface at the next save()/wait()/done()."""
+        self.wait()  # barrier on the previous in-flight persist
+        with tracing.span("checkpoint.snapshot", {"step": int(step)}):
+            host = _snapshot_to_host(state)
+        t = threading.Thread(
+            target=self._persist, args=(host, int(step), extra),
+            name="ckpt-persist", daemon=True,
+        )
+        with self._lock:
+            self._thread = t
+        t.start()
+
+    def _persist(self, host_state, step, extra):
+        try:
+            with tracing.span("checkpoint.persist", {"step": step}):
+                payload, tag = serializers.serialize(host_state)
+                # cacheable=False: a superseded snapshot in the shared
+                # LRU blob cache would only evict real artifact blobs
+                [(_uri, key)] = self._ca.save_blobs([payload],
+                                                    cacheable=False)
+                manifest = {
+                    "step": step,
+                    "key": key,
+                    "type_tag": tag,
+                    "size": len(payload),
+                    "time": time.time(),
+                }
+                if extra is not None:
+                    manifest["extra"] = extra
+                # manifest LAST: its existence asserts the blob is whole
+                self._storage.save_bytes(
+                    [(self._manifest_path(step),
+                      json.dumps(manifest).encode("utf-8"))],
+                    overwrite=True,
+                )
+                self._prune(keep_step=step)
+        except BaseException as ex:
+            with self._lock:
+                self._error = ex
+
+    def wait(self):
+        """Block until the in-flight persist (if any) completes; re-raise
+        its error. After wait() returns, the last save is durable."""
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join()
+            with self._lock:
+                if self._thread is t:
+                    self._thread = None
+        self._raise_pending()
+
+    def done(self):
+        """Non-blocking: True when no persist is in flight. Re-raises a
+        background failure instead of letting it rot."""
+        self._raise_pending()
+        with self._lock:
+            t = self._thread
+        if t is None:
+            return True
+        if t.is_alive():
+            return False
+        t.join()
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
+        self._raise_pending()
+        return True
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _prune(self, keep_step):
+        if not self._keep:
+            return
+        steps = self.steps()
+        # never prune the step just written, whatever the listing says
+        stale = [s for s in steps if s != keep_step][: max(
+            0, len(steps) - self._keep)]
+        if stale:
+            self._storage.delete([self._manifest_path(s) for s in stale])
+
+    # ---------- read path ----------
+
+    def _manifest_path(self, step):
+        return self._storage.path_join(self._prefix, "step_%d.json" % step)
+
+    def steps(self):
+        """Sorted steps with COMPLETE checkpoints (manifest present)."""
+        out = []
+        for path, is_file in self._storage.list_content([self._prefix]):
+            name = self._storage.basename(path)
+            if (is_file and name.startswith("step_")
+                    and name.endswith(".json")
+                    and name[5:-5].isdigit()):
+                out.append(int(name[5:-5]))
+        return sorted(out)
+
+    def latest_step(self):
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step=None, like=None):
+        """Load checkpoint `step` (default: latest complete one) as a
+        Checkpoint(state, step, extra), or None when none exist. With
+        `like` (a LIVE state tree of the same structure), restored leaves
+        are re-placed onto its shardings via reshard_like."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        manifest = self._load_manifest(step)
+        if manifest is None:
+            return None
+        with tracing.span("checkpoint.restore", {"step": step}):
+            state = None
+            # cacheable=False mirrors the save side: a one-shot multi-GB
+            # snapshot must not churn the shared artifact blob cache
+            for _key, blob in self._ca.load_blobs([manifest["key"]],
+                                                  cacheable=False):
+                state = serializers.deserialize(blob, manifest["type_tag"])
+            if like is not None:
+                from .train_step import reshard_like
+
+                state = reshard_like(state, like)
+        ck = Checkpoint(state, manifest["step"], manifest.get("extra"))
+        self.last_restored = ck
+        return ck
+
+    def _load_manifest(self, step):
+        with self._storage.load_bytes([self._manifest_path(step)]) as loaded:
+            for _path, local, _meta in loaded:
+                if local is None:
+                    return None
+                with open(local, "rb") as f:
+                    return json.loads(f.read().decode("utf-8"))
+        return None
+
+
+def _snapshot_to_host(tree):
+    """Host-resident numpy snapshot of a pytree: issue EVERY device
+    array's D2H copy first (transfers queue back-to-back and overlap),
+    then gather. Total wall-clock ≈ the single largest transfer, not the
+    sum — and the result is donation-safe: no live device buffers."""
+    serializers.prefetch_to_host(tree)
+    return _gather_to_host(tree)
+
+
+MAX_TREE_DEPTH = 64
+
+
+def _gather_to_host(obj, depth=0):
+    """Like serializers._pickle_safe but SNAPSHOTTING: device arrays come
+    to host, host numpy arrays are COPIED (the caller mutates/donates its
+    state right after save() returns — the background thread must never
+    alias it), and container types — optax namedtuples, dict subclasses —
+    are preserved so the restored tree's structure matches the live one."""
+    if depth > MAX_TREE_DEPTH:
+        # returning the sub-tree uncopied would silently break save()'s
+        # donation-safety contract (the background thread would read
+        # buffers the caller is about to donate/mutate) — fail in the
+        # caller's thread instead, where it is immediately visible
+        raise ValueError(
+            "checkpoint state nests deeper than %d levels — refusing to "
+            "snapshot (deeper leaves would alias live buffers)"
+            % MAX_TREE_DEPTH)
+    if serializers._is_jax_array(obj):
+        return serializers._to_host(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.copy()
+    if isinstance(obj, dict):
+        vals = {k: _gather_to_host(v, depth + 1) for k, v in obj.items()}
+        try:
+            clone = obj.copy()  # preserves OrderedDict/defaultdict
+            clone.update(vals)
+            return clone
+        except Exception:
+            return vals
+    if isinstance(obj, tuple):
+        vals = [_gather_to_host(v, depth + 1) for v in obj]
+        if hasattr(obj, "_fields"):  # namedtuple (optax states)
+            try:
+                return type(obj)._make(vals)
+            except Exception:
+                return tuple(vals)
+        try:
+            return type(obj)(vals)
+        except Exception:
+            return tuple(vals)
+    if isinstance(obj, list):
+        return [_gather_to_host(v, depth + 1) for v in obj]
+    return obj

@@ -94,8 +94,23 @@ class ResumableTokenBatches(object):
                     "cursor would address different tokens (same data, "
                     "batch_size and seq_len are required to resume)"
                     % (key, theirs, mine))
-        self._epoch = int(state["epoch"])
-        self._cursor = int(state["cursor"])
+        epoch = int(state["epoch"])
+        cursor = int(state["cursor"])
+        # a corrupted stamp must fail loudly, not silently truncate or
+        # shift the token stream: cursor == batches_per_epoch is the
+        # legal "last batch of the epoch" stamp, anything past it (or
+        # negative) addresses batches that don't exist
+        per_epoch = self.batches_per_epoch
+        if epoch < 0 or (self._epochs is not None and epoch > self._epochs):
+            raise ValueError(
+                "checkpointed stream epoch=%d out of range [0, %s] — "
+                "corrupted resume stamp" % (epoch, self._epochs))
+        if not 0 <= cursor <= per_epoch:
+            raise ValueError(
+                "checkpointed stream cursor=%d out of range [0, %d] — "
+                "corrupted resume stamp" % (cursor, per_epoch))
+        self._epoch = epoch
+        self._cursor = cursor
         return self
 
     def _order(self, epoch):

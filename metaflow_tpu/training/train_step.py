@@ -170,17 +170,31 @@ def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None):
 
 
 def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
-                 loss_fn=None):
+                 loss_fn=None, checkpoint=None):
     """One-stop builder: returns (state, train_step_fn, shardings) with a
     SINGLE shared optimizer — prefer this over calling make_train_state and
     make_train_step separately (mismatched optimizers give silently wrong or
-    crashing updates)."""
+    crashing updates).
+
+    checkpoint: an AsyncCheckpointManager (training/checkpoint.py). When
+    it holds a complete checkpoint, the freshly-initialized state is
+    replaced by the restored one re-placed onto the live shardings
+    (reshard_like) — so a preempted/retried run resumes instead of
+    restarting, and subsequent `checkpoint.save(state, step)` calls
+    overlap their upload with the train steps that follow. The resumed
+    step and the saved `extra` (e.g. the data iterator's resume stamp)
+    are available afterwards as `checkpoint.last_restored` — without
+    them a resumed run would silently restart its data stream."""
     optimizer = optimizer or default_optimizer()
     state, shardings = make_train_state(
         rng, cfg, mesh, model, optimizer=optimizer, rules=rules
     )
     step = make_train_step(cfg, mesh, model, optimizer=optimizer,
                            loss_fn=loss_fn)
+    if checkpoint is not None:
+        restored = checkpoint.restore(like=state)
+        if restored is not None:
+            state = restored.state
     return state, step, shardings
 
 

@@ -537,8 +537,8 @@ class TestGroupedMatmul:
         assert int(layout["tile_active"].sum()) == sum(
             -(-int(c) // 128) for c in per_group)
         x_pad = scatter_rows(rows, layout)
-        y = gmm(x_pad, w, layout["tile_group"], layout["tile_active"],
-                interpret=True)
+        y = gmm(x_pad, w, layout["tile_group"],
+                tile_active=layout["tile_active"], interpret=True)
         # valid rows exact vs the all-active oracle; invalid rows zero
         ref = gmm_reference(x_pad, w, layout["tile_group"])
         got = np.asarray(y[layout["dest"]])
@@ -559,9 +559,9 @@ class TestGroupedMatmul:
         layout = make_group_layout(gids, 1, block_s=128)
         x_pad = scatter_rows(rows, layout)
         tg, ta = layout["tile_group"], layout["tile_active"]
-        full = gmm(x_pad, w, tg, ta, interpret=True)
+        full = gmm(x_pad, w, tg, tile_active=ta, interpret=True)
         forced = ta.at[1].set(0)
-        skipped = gmm(x_pad, w, tg, forced, interpret=True)
+        skipped = gmm(x_pad, w, tg, tile_active=forced, interpret=True)
         assert np.abs(np.asarray(skipped[128:256])).max() == 0
         np.testing.assert_allclose(np.asarray(skipped[:128]),
                                    np.asarray(full[:128]), atol=1e-6)
@@ -577,6 +577,29 @@ class TestGroupedMatmul:
         tg = jnp.zeros((1,), jnp.int32)
         with pytest.raises(ValueError, match="backward"):
             gmm(x, w, tg, interpret=True)
+
+    def test_gmm_bwd_check_fires_under_grad(self):
+        """custom_vjp routes jax.grad through _gmm_fwd, not the primal —
+        the fail-fast must fire there too (ADVICE round 5)."""
+        from metaflow_tpu.ops.gmm import gmm
+
+        x = jnp.ones((128, 192), jnp.float32)
+        w = jnp.ones((2, 192, 128), jnp.float32)
+        tg = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(ValueError, match="backward"):
+            jax.grad(lambda w: jnp.sum(gmm(x, w, tg, interpret=True)))(w)
+
+    def test_gmm_rejects_positional_tuning_args(self):
+        """tile_active/block_s/block_f are keyword-only: a stale caller
+        passing block_s positionally must get a TypeError, not have its
+        block size silently repurposed as the tile mask."""
+        from metaflow_tpu.ops.gmm import gmm
+
+        x = jnp.ones((128, 64), jnp.float32)
+        w = jnp.ones((1, 64, 64), jnp.float32)
+        tg = jnp.zeros((1,), jnp.int32)
+        with pytest.raises(TypeError):
+            gmm(x, w, tg, 128, interpret=True)
 
     def test_gmm_refuses_expert_parallel_mesh(self):
         """gmm runs experts single-shard — on an 'expert' mesh it would

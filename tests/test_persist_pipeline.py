@@ -1,0 +1,307 @@
+"""Pipelined artifact persist + shared blob cache: equivalence vs the
+serial path (byte-identical CAS objects and manifests), bounded-memory
+streaming, cache hit/miss/eviction, in-flight dedup, and failure
+injection through the gsop engine (a background upload failure must
+surface, never be swallowed)."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fake_gcs import FakeGCSServer
+from metaflow_tpu.client.filecache import FileCache
+from metaflow_tpu.datastore import (
+    FlowDataStore,
+    GCSStorage,
+    LocalStorage,
+)
+from metaflow_tpu.datastore.pipeline import persist_pipeline
+
+
+@pytest.fixture()
+def flow_ds(tpuflow_root):
+    return FlowDataStore("PipeFlow", LocalStorage)
+
+
+def _artifacts():
+    rng = np.random.default_rng(7)
+    return [
+        ("small", 42),
+        ("text", "hello" * 100),
+        ("arr", np.arange(1000, dtype=np.float32)),
+        ("tree", {"w": rng.standard_normal((64, 64)),
+                  "b": [np.ones(8), {"x": np.zeros(3)}], "step": 9}),
+        ("big", rng.integers(0, 255, 1 << 20, dtype=np.uint8)),
+        ("dup", np.arange(1000, dtype=np.float32)),  # dedup vs 'arr'
+    ]
+
+
+def _walk_files(root):
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            full = os.path.join(dirpath, name)
+            with open(full, "rb") as f:
+                out[os.path.relpath(full, root)] = f.read()
+    return out
+
+
+class TestEquivalence:
+    def test_pipelined_matches_serial_bytes_and_manifest(self, tmp_path,
+                                                         monkeypatch):
+        """The acceptance bar: byte-identical CAS objects AND manifests
+        from both paths — verified on raw storage bytes, not via the
+        read API."""
+        roots = {}
+        for mode, pipelined in (("serial", False), ("pipe", True)):
+            root = str(tmp_path / mode)
+            monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL", root)
+            fds = FlowDataStore("EqFlow", LocalStorage)
+            ds = fds.get_task_datastore("1", "s", "t", attempt=0, mode="w")
+            ds.init_task()
+            ds.save_artifacts(_artifacts(), pipelined=pipelined)
+            ds.done()
+            roots[mode] = root
+        serial = _walk_files(roots["serial"])
+        pipe = _walk_files(roots["pipe"])
+        # the attempt/DONE markers embed timestamps; everything else —
+        # every CAS object and the artifacts manifest — must be identical
+        def stable(files):
+            return {p: b for p, b in files.items()
+                    if p.endswith("artifacts.json") or "/data/" in p}
+
+        s, p = stable(serial), stable(pipe)
+        assert set(s) == set(p)
+        assert len([k for k in s if "/data/" in k]) >= 5  # dedup: dup==arr
+        for path in s:
+            assert s[path] == p[path], "bytes differ at %s" % path
+
+    def test_roundtrip_through_pipeline(self, flow_ds):
+        ds = flow_ds.get_task_datastore("2", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts(_artifacts(), pipelined=True)
+        ds.done()
+        rd = flow_ds.get_task_datastore("2", "s", "t")
+        assert rd["small"] == 42
+        np.testing.assert_array_equal(rd["arr"], np.arange(1000,
+                                                           dtype=np.float32))
+        np.testing.assert_array_equal(rd["dup"], rd["arr"])
+        tree = rd["tree"]
+        assert tree["step"] == 9
+        np.testing.assert_array_equal(tree["b"][1]["x"], np.zeros(3))
+
+    def test_results_in_input_order(self, flow_ds):
+        arts = [("a%d" % i, np.full(100, i)) for i in range(20)]
+        out = persist_pipeline(arts, flow_ds.ca_store)
+        assert [name for name, *_ in out] == ["a%d" % i for i in range(20)]
+        # keys must match the serial path's for the same objects
+        from metaflow_tpu.datastore import serializers
+
+        for (name, key, tag, size), (aname, obj) in zip(out, arts):
+            payload, stag = serializers.serialize(obj)
+            assert stag == tag and len(payload) == size
+            assert flow_ds.ca_store.pack_blob(payload)[0] == key
+
+    def test_serialization_error_propagates(self, flow_ds):
+        class Unpicklable:
+            def __reduce__(self):
+                raise RuntimeError("cannot serialize this")
+
+        arts = [("ok%d" % i, i) for i in range(8)] + [("bad", Unpicklable())]
+        with pytest.raises(RuntimeError, match="cannot serialize"):
+            persist_pipeline(arts, flow_ds.ca_store)
+
+    def test_bounded_inflight_still_completes(self, flow_ds):
+        # budget smaller than one artifact: oversized payloads must be
+        # admitted alone (no deadlock), and everything still lands
+        arts = [("a%d" % i, np.full(64 << 10, i, dtype=np.uint8))
+                for i in range(6)]
+        out = persist_pipeline(arts, flow_ds.ca_store,
+                               max_inflight_bytes=1024)
+        assert len(out) == 6 and all(r is not None for r in out)
+
+
+class TestBlobCache:
+    def test_hit_miss_and_verification(self, tmp_path):
+        cache = FileCache(cache_dir=str(tmp_path / "c"))
+        assert cache.load_key("0" * 64) is None  # miss
+        import hashlib
+
+        blob = b"payload-bytes"
+        key = hashlib.sha256(blob).hexdigest()
+        cache.store_key(key, blob)
+        assert cache.load_key(key) == blob  # hit
+        # poisoned entry: sha mismatch → evicted, treated as miss
+        with open(cache._path(key), "wb") as f:
+            f.write(b"tampered")
+        assert cache.load_key(key) is None
+        assert not os.path.exists(cache._path(key))
+
+    def test_eviction_respects_cap_and_skips_locks(self, tmp_path):
+        import hashlib
+
+        cache = FileCache(cache_dir=str(tmp_path / "c"), max_size=4096)
+        keys = []
+        for i in range(8):
+            blob = bytes([i]) * 1024
+            key = hashlib.sha256(blob).hexdigest()
+            keys.append(key)
+            cache.store_key(key, blob)
+            time.sleep(0.01)  # distinct atimes → deterministic LRU order
+        # 8 KB stored against a 4 KB cap: oldest entries evicted
+        present = [k for k in keys if os.path.exists(cache._path(k))]
+        assert 0 < len(present) <= 4
+        assert present == keys[-len(present):]  # LRU: newest survive
+        # a HELD .lock sidecar must never be evicted nor counted...
+        with cache.key_lock(keys[-1]):
+            assert os.path.exists(cache._path(keys[-1]) + ".lock")
+            cache.store_key(hashlib.sha256(b"z" * 1024).hexdigest(),
+                            b"z" * 1024)
+            assert os.path.exists(cache._path(keys[-1]) + ".lock")
+        # ...and is unlinked on release (no unbounded inode growth)
+        assert not os.path.exists(cache._path(keys[-1]) + ".lock")
+
+    def test_write_through_on_save(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL",
+                           str(tmp_path / "root"))
+        cache = FileCache(cache_dir=str(tmp_path / "c"))
+        fds = FlowDataStore("WtFlow", LocalStorage, blob_cache=cache)
+        ds = fds.get_task_datastore("1", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        arr = np.arange(512, dtype=np.int64)
+        ds.save_artifacts([("x", arr), ("y", "hi")], pipelined=True)
+        ds.done()
+        key = ds._objects["x"]
+        # the payload is already on local cache disk, sha-verified
+        assert cache.load_key(key) is not None
+
+    def test_inflight_dedup_single_fetch(self, flow_ds, tmp_path):
+        """N concurrent readers of one cold key → ONE storage fetch; the
+        rest resolve from the cache under the key lock."""
+        ds = flow_ds.get_task_datastore("3", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts([("x", np.arange(4096))])
+        ds.done()
+        key = ds._objects["x"]
+
+        cas = flow_ds.ca_store
+        cache = FileCache(cache_dir=str(tmp_path / "dedup"))
+        cas.set_blob_cache(cache)
+
+        fetches = []
+        fetch_lock = threading.Lock()
+        orig_load = cas._storage.load_bytes
+
+        def counting_load(paths):
+            with fetch_lock:
+                fetches.append(list(paths))
+            time.sleep(0.05)  # widen the race window
+            return orig_load(paths)
+
+        cas._storage.load_bytes = counting_load
+        try:
+            results = []
+            threads = [
+                threading.Thread(
+                    target=lambda: results.append(
+                        dict(cas.load_blobs([key]))[key])
+                )
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            cas._storage.load_bytes = orig_load
+        assert len(results) == 4
+        assert len(set(results)) == 1
+        assert len(fetches) == 1, "concurrent readers re-downloaded"
+
+    def test_nested_load_same_thread_does_not_deadlock(self, flow_ds,
+                                                       tmp_path):
+        """load_blobs holds key locks for its generator lifetime; a
+        consumer triggering a nested load of an overlapping key from the
+        same thread must re-enter, not self-deadlock."""
+        ds = flow_ds.get_task_datastore("7", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts([("a", "aaa"), ("b", "bbb")])
+        ds.done()
+        cas = flow_ds.ca_store
+        cas.set_blob_cache(FileCache(cache_dir=str(tmp_path / "nest")))
+        keys = [ds._objects["a"], ds._objects["b"]]
+        done = []
+
+        def run():
+            for key, _blob in cas.load_blobs(keys):
+                # nested load of BOTH keys while the outer generator
+                # still holds their locks
+                assert len(dict(cas.load_blobs(keys))) == 2
+            done.append(True)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(15)
+        assert done, "nested same-thread load deadlocked"
+
+    def test_uncacheable_load_reads_through_without_storing(self, flow_ds,
+                                                            tmp_path):
+        ds = flow_ds.get_task_datastore("8", "s", "t", attempt=0, mode="w")
+        ds.init_task()
+        ds.save_artifacts([("x", np.arange(64))])
+        ds.done()
+        cas = flow_ds.ca_store
+        cache = FileCache(cache_dir=str(tmp_path / "nc"))
+        cas.set_blob_cache(cache)
+        key = ds._objects["x"]
+        [(k, _blob)] = list(cas.load_blobs([key], cacheable=False))
+        assert cache.load_key(key) is None  # read-through, no store
+        [(k, _blob)] = list(cas.load_blobs([key]))
+        assert cache.load_key(key) is not None
+
+    def test_flow_datastore_attaches_cache_for_remote_only(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_DATASTORE_SYSROOT_LOCAL",
+                           str(tmp_path / "root"))
+        monkeypatch.setenv("TPUFLOW_CLIENT_CACHE", str(tmp_path / "cc"))
+        local = FlowDataStore("LFlow", LocalStorage)
+        assert local.ca_store.blob_cache is None
+        with FakeGCSServer() as srv:
+            monkeypatch.setenv("TPUFLOW_GS_ENDPOINT", srv.endpoint)
+            remote = FlowDataStore("RFlow", GCSStorage,
+                                   ds_root="gs://b/x")
+            assert isinstance(remote.ca_store.blob_cache, FileCache)
+            monkeypatch.setenv("TPUFLOW_BLOB_CACHE", "0")
+            off = FlowDataStore("RFlow2", GCSStorage, ds_root="gs://b/x")
+            assert off.ca_store.blob_cache is None
+
+
+class TestFailureInjection:
+    def test_background_upload_failure_surfaces(self, tmp_path,
+                                                monkeypatch):
+        """A pipelined persist whose uploads die (gsop fault injection at
+        rate 1.0) must raise from save_artifacts — not silently write a
+        manifest over missing blobs."""
+        from metaflow_tpu import gsop
+
+        # keep the injected-failure retry loop fast
+        monkeypatch.setattr(gsop, "MAX_RETRIES", 2)
+        monkeypatch.setattr(gsop, "BACKOFF_BASE", 0.01)
+        monkeypatch.setenv("TPUFLOW_CLIENT_CACHE", str(tmp_path / "cc"))
+        with FakeGCSServer() as srv:
+            monkeypatch.setenv("TPUFLOW_GS_ENDPOINT", srv.endpoint)
+            fds = FlowDataStore("FailFlow", GCSStorage,
+                                ds_root="gs://fail-bucket/root",
+                                blob_cache=False)
+            fds.storage._gsclient = gsop.GSClient(
+                endpoint=srv.endpoint, inject_failure_rate=1.0)
+            ds = fds.get_task_datastore("1", "s", "t", attempt=0, mode="w")
+            arts = [("a%d" % i, np.full(1024, i)) for i in range(4)]
+            with pytest.raises(gsop.GSTransientError):
+                ds.save_artifacts(arts, pipelined=True)
