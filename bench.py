@@ -107,27 +107,13 @@ def bench_tokens_per_sec():
     }
 
 
-# bf16 peak TFLOP/s per chip, from published TPU specs (substring-matched
-# against jax Device.device_kind so "TPU v5 lite" and "TPU v5e" both hit)
-_TPU_PEAK_TFLOPS = [
-    ("v5p", 459.0),
-    ("v5e", 197.0),
-    ("v5 lite", 197.0),
-    ("v6e", 918.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-]
+def _chip_tables():
+    """(peak bf16 TFLOP/s, HBM GB/s) published-spec tables — the single
+    source of truth lives in training/metrics.py (imported lazily: bench
+    must not import jax before the TPU-tunnel probe)."""
+    from metaflow_tpu.training.metrics import TPU_HBM_GBPS, TPU_PEAK_TFLOPS
 
-# HBM bandwidth GB/s per chip, from the same published specs (used by
-# the hlo_estimate roofline; keep in step with _TPU_PEAK_TFLOPS)
-_TPU_HBM_GBPS = [
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5 lite", 819.0),
-    ("v6e", 1640.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-]
+    return TPU_PEAK_TFLOPS, TPU_HBM_GBPS
 
 
 def _mfu(tps_per_chip, params, cfg, seq, device_kind):
@@ -145,7 +131,8 @@ def _mfu(tps_per_chip, params, cfg, seq, device_kind):
     flops_per_token = 6.0 * n_params + 12.0 * cfg.n_layers * cfg.dim * seq
     achieved = tps_per_chip * flops_per_token / 1e12
     kind = (device_kind or "").lower()
-    peak = next((tf for sub, tf in _TPU_PEAK_TFLOPS if sub in kind), None)
+    peak_table, _hbm = _chip_tables()
+    peak = next((tf for sub, tf in peak_table if sub in kind), None)
     out = {
         "device_kind": device_kind,
         "model_tflops_per_chip": round(achieved, 2),
@@ -303,9 +290,9 @@ def bench_hlo_estimate():
     bytes_accessed = float(cost["bytes accessed"])
 
     chip = os.environ.get("BENCH_TARGET_CHIP", "v5e")
-    peak = next((tf for sub, tf in _TPU_PEAK_TFLOPS if sub in chip),
-                None)
-    hbm = next((bw for sub, bw in _TPU_HBM_GBPS if sub in chip), None)
+    peak_table, hbm_table = _chip_tables()
+    peak = next((tf for sub, tf in peak_table if sub in chip), None)
+    hbm = next((bw for sub, bw in hbm_table if sub in chip), None)
     if peak is None or hbm is None:
         raise SystemExit("no roofline constants for BENCH_TARGET_CHIP=%r"
                          % chip)
@@ -760,6 +747,113 @@ def bench_ckpt_overlap():
         }
 
 
+def bench_telemetry_overhead():
+    """Instrumented-vs-disabled train-step overhead of the flight
+    recorder (training.metrics.instrument_train_step emitting per-step
+    records through an active FlightRecorder, exactly the task-context
+    configuration). The headline number is the overhead in PERCENT of
+    steady-state step time — acceptance: ≤2%. Runs the real bench model
+    on TPU, the tiny config on CPU (where absolute step time is ~ms, the
+    WORST case for fixed per-step host overhead)."""
+    import tempfile
+
+    import jax
+
+    from metaflow_tpu import telemetry
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import (default_optimizer,
+                                       flops_per_token_dense,
+                                       instrument_train_step,
+                                       make_trainer,
+                                       memory_efficient_optimizer,
+                                       shard_batch)
+
+    n_devices = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig.bench_1b(
+            loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")))
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        steps, reps = 10, 2
+        optimizer = memory_efficient_optimizer(total_steps=1000)
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq = 4, 128
+        steps, reps = 20, 3
+        optimizer = default_optimizer(total_steps=1000)
+
+    mesh = create_mesh(MeshSpec.fsdp() if n_devices > 1 else MeshSpec.dp())
+    state, step, _ = make_trainer(
+        jax.random.PRNGKey(0), cfg, mesh, llama, optimizer=optimizer)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    data = shard_batch({"tokens": tokens}, mesh)
+
+    def loop(fn, state, n):
+        state, m = fn(state, data)  # warmup (compile on first rep)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = fn(state, data)
+        jax.block_until_ready(m["loss"])
+        return (time.perf_counter() - t0) / n, state
+
+    with mesh:
+        plain_dts = []
+        for _ in range(reps):
+            dt, state = loop(step, state, steps)
+            plain_dts.append(dt)
+        plain = min(plain_dts)
+
+        # instrumented: SAME compiled step, wrapped, with a live recorder
+        # persisting to a local datastore — the full task-context path
+        with tempfile.TemporaryDirectory() as root:
+            fds = FlowDataStore("BenchTelemetry", LocalStorage,
+                                ds_root=root)
+            telemetry.init_recorder(fds, "bench", "train", "1")
+            try:
+                n_params = llama.num_params(state["params"])
+                wrapped = instrument_train_step(
+                    step,
+                    tokens_per_step=batch * seq,
+                    flops_per_step=flops_per_token_dense(
+                        n_params, cfg.n_layers, cfg.dim, seq) * batch * seq,
+                )
+                instr_dts = []
+                for _ in range(reps):
+                    dt, state = loop(wrapped, state, steps)
+                    instr_dts.append(dt)
+                instr = min(instr_dts)
+                wrapped.telemetry.close()
+                records = len(telemetry.read_run_records(fds, "bench"))
+                summary = wrapped.telemetry.report()
+            finally:
+                telemetry.close_recorder()
+
+    overhead_pct = (instr - plain) / plain * 100 if plain > 0 else 0.0
+    return {
+        "metric": "telemetry_train_step_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "% of step time (instrumented vs disabled)",
+        "vs_baseline": 1.0,
+        "extra": {
+            "backend": jax.default_backend(),
+            "n_devices": n_devices,
+            "plain_step_ms": round(plain * 1000, 3),
+            "instrumented_step_ms": round(instr * 1000, 3),
+            "steps_per_rep": steps,
+            "reps": reps,
+            "records_emitted": records,
+            "batch": batch,
+            "seq": seq,
+            "instrumented_summary": summary,
+        },
+    }
+
+
 def _vs_baseline(value):
     base = os.environ.get("BENCH_BASELINE")
     if base:
@@ -881,11 +975,12 @@ if __name__ == "__main__":
                        os.environ.get("PYTHONPATH", "").split(os.pathsep))):
             _rerun_on_cpu(degraded=False)
         result = bench_hlo_estimate()
-    elif mode in ("decode", "moe"):
+    elif mode in ("decode", "moe", "telemetry"):
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             if _wait_for_tpu() is None:
                 _rerun_on_cpu()
-        result = bench_decode() if mode == "decode" else bench_moe()
+        result = {"decode": bench_decode, "moe": bench_moe,
+                  "telemetry": bench_telemetry_overhead}[mode]()
         if os.environ.get("BENCH_DEGRADED"):
             result["degraded"] = True
             result["degraded_reason"] = os.environ["BENCH_DEGRADED"]

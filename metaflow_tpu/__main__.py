@@ -369,6 +369,44 @@ def stubs(out_dir):
     click.echo("wrote %s" % generate(out_dir))
 
 
+@main.command(
+    help="Aggregate a run's flight-recorder telemetry: "
+         "`metrics FLOW/RUN_ID` (or `metrics FLOW RUN_ID`). Shows "
+         "per-task durations, training throughput (tokens/sec, MFU) "
+         "aggregated across gang ranks, and captured profiles — all "
+         "from datastore-persisted records, no worker disk needed.")
+@click.argument("flow_run")
+@click.argument("run_id", required=False)
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]),
+              help="Storage backend (default: configured default).")
+@click.option("--datastore-root", default=None,
+              help="Datastore root override.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the aggregation as JSON.")
+@click.option("--timeline", is_flag=True,
+              help="Per-train-step wall/tokens-per-sec/MFU series.")
+@click.option("--spans", default=0, type=int,
+              help="Show the N slowest timer spans of the run.")
+def metrics(flow_run, run_id, datastore, datastore_root, as_json,
+            timeline, spans):
+    from .cmd.metrics import show_metrics
+    from .datastore import STORAGE_BACKENDS, FlowDataStore
+    from . import metaflow_config as cfg
+
+    if run_id is None:
+        flow_name, _, run_id = flow_run.rpartition("/")
+        if not flow_name:
+            raise click.ClickException(
+                "specify a run as FLOW/RUN_ID (or: metrics FLOW RUN_ID)")
+    else:
+        flow_name = flow_run
+    storage_impl = STORAGE_BACKENDS[datastore or cfg.default_datastore()]
+    fds = FlowDataStore(flow_name, storage_impl, ds_root=datastore_root)
+    show_metrics(fds, run_id, as_json=as_json, timeline=timeline,
+                 spans=spans, echo=click.echo)
+
+
 @main.group(help="Local full-stack dev harness: fake GCS + metadata "
                  "service (the reference's metaflow-dev, containerless).")
 def devstack():

@@ -802,6 +802,28 @@ def make_cli(flow, state):
                      % ("%s/%s" % (step_name, task_id), word,
                         ds.attempt if ds.has_attempt() else "-", extra))
 
+    @start.command(help="Show a run's flight-recorder telemetry: per-task "
+                        "durations, training tokens/sec + MFU aggregated "
+                        "across gang ranks, slowest spans, captured "
+                        "profiles (datastore-persisted; works after the "
+                        "workers are gone).")
+    @click.argument("run-id", required=False)
+    @click.option("--json", "as_json", is_flag=True,
+                  help="Emit the aggregation as JSON.")
+    @click.option("--timeline", is_flag=True,
+                  help="Per-train-step wall/tokens-per-sec/MFU series.")
+    @click.option("--spans", default=0, type=int,
+                  help="Show the N slowest timer spans of the run.")
+    @click.pass_obj
+    def metrics(state, run_id, as_json, timeline, spans):
+        from .cmd.metrics import show_metrics
+
+        run_id = run_id or read_latest_run_id(flow.name)
+        if run_id is None:
+            raise TpuFlowException("No run found for %s." % flow.name)
+        show_metrics(state.flow_datastore, run_id, as_json=as_json,
+                     timeline=timeline, spans=spans, echo=print)
+
     @start.command(help="Garbage-collect old runs (keep the newest N) and "
                         "unreferenced CAS blobs.")
     @click.option("--keep", default=5, show_default=True,

@@ -1,0 +1,339 @@
+"""`tpuflow metrics <run>`: aggregate a run's flight-recorder records.
+
+Reads every telemetry record the run persisted to its datastore
+(`_telemetry/` prefix — all tasks, all gang ranks, all hosts) and renders:
+
+  - a summary: task table (duration/queue-time/rank/host), training
+    throughput (per-step wall time, tokens/sec, MFU) aggregated across
+    gang ranks, counters, compile stats, captured profiles
+  - `--timeline`: the per-train-step series
+  - `--spans N`: the N slowest timer spans of the run (why was it slow?)
+  - `--json`: the raw aggregation for tooling
+
+Entry points: `python -m metaflow_tpu metrics FLOW/RUN` (no flow file
+needed) and `python flow.py metrics [RUN]` (flow context known).
+"""
+
+import json
+import statistics
+
+from .. import telemetry
+
+
+def _pathspec(rec):
+    return "%s/%s/%s" % (rec["run_id"], rec["step"], rec["task_id"])
+
+
+def aggregate(records, profiles=None):
+    """Fold raw telemetry records into the per-run aggregation the
+    renderers and --json consume."""
+    tasks = {}
+    timers = {}
+    counters = {}
+    events = {}
+    train_steps = {}
+    train_summary = {}
+    ranks = set()
+    hosts = set()
+    traces = set()
+
+    for rec in records:
+        name = rec.get("name", "")
+        rtype = rec.get("type", "")
+        key = (rec.get("step", ""), str(rec.get("task_id", "")))
+        if rec.get("step") != "_runtime":
+            task = tasks.setdefault(key, {
+                "step": rec.get("step"), "task_id": rec.get("task_id"),
+                "rank": rec.get("rank", 0), "host": rec.get("host", ""),
+                "attempts": 0, "duration_ms": None, "queue_seconds": None,
+                "ok": None,
+            })
+            task["attempts"] = max(task["attempts"],
+                                   rec.get("attempt", 0) + 1)
+            ranks.add(rec.get("rank", 0))
+            hosts.add(rec.get("host", ""))
+        if rec.get("trace"):
+            traces.add(rec["trace"])
+
+        if rtype == "timer":
+            t = timers.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                         "max_ms": 0.0, "failures": 0,
+                                         "_samples": []})
+            ms = float(rec.get("ms", 0.0))
+            t["count"] += 1
+            t["total_ms"] += ms
+            t["max_ms"] = max(t["max_ms"], ms)
+            t["_samples"].append(ms)
+            if rec.get("ok") is False:
+                t["failures"] += 1
+            if name == "task.duration" and key in tasks:
+                tasks[key]["duration_ms"] = ms
+                tasks[key]["ok"] = rec.get("ok")
+            if name.endswith(".step") and "step_num" in rec:
+                data = rec.get("data") or {}
+                # aggregation key: (flow step, gang identity, step_num).
+                # Gang worker task ids derive from their control task
+                # ('<control>-node-<i>'), so ranks of ONE gang share a
+                # base and merge; foreach siblings / other train steps
+                # have different bases and must NOT be averaged together
+                base = str(rec.get("task_id", "")).split("-node-")[0]
+                s = train_steps.setdefault(
+                    (rec.get("step", ""), base, rec["step_num"]), {
+                        "ms": [], "tokens_per_sec": [], "mfu": [],
+                        "ranks": set(), "compile": False})
+                s["ms"].append(ms)
+                s["ranks"].add(rec.get("rank", 0))
+                if data.get("compile"):
+                    s["compile"] = True
+                if "tokens_per_sec" in data:
+                    s["tokens_per_sec"].append(data["tokens_per_sec"])
+                if "mfu" in data:
+                    s["mfu"].append(data["mfu"])
+        elif rtype == "counter":
+            counters[name] = counters.get(name, 0) + rec.get("inc", 1)
+        elif rtype == "gauge":
+            if name == "task.queue_seconds" and key in tasks:
+                tasks[key]["queue_seconds"] = rec.get("value")
+            if name.startswith("train.summary."):
+                train_summary.setdefault(
+                    name[len("train.summary."):], []).append(
+                        rec.get("value"))
+        elif rtype == "event":
+            events[name] = events.get(name, 0) + 1
+
+    # finalize timer stats
+    for t in timers.values():
+        samples = sorted(t.pop("_samples"))
+        t["p50_ms"] = round(samples[len(samples) // 2], 3)
+        t["total_ms"] = round(t["total_ms"], 3)
+        t["max_ms"] = round(t["max_ms"], 3)
+
+    # training series: aggregate ACROSS gang ranks per (group, step_num)
+    # — every rank times the same global step, so wall time is the mean
+    # (ranks disagree only by host jitter) and tokens/sec / MFU are rank
+    # means of the same global quantity. Distinct groups (foreach
+    # siblings, multiple train steps) stay separate rows.
+    groups = sorted({(step, base) for step, base, _n in train_steps})
+    timeline = []
+    for step, base, step_num in sorted(train_steps):
+        s = train_steps[(step, base, step_num)]
+        row = {"step_num": step_num,
+               "ms": round(statistics.mean(s["ms"]), 3),
+               "ranks": len(s["ranks"])}
+        if len(groups) > 1:
+            row["group"] = "%s/%s" % (step, base)
+        if s["compile"]:
+            row["compile"] = True
+        if s["tokens_per_sec"]:
+            row["tokens_per_sec"] = round(
+                statistics.mean(s["tokens_per_sec"]), 1)
+        if s["mfu"]:
+            row["mfu"] = round(statistics.mean(s["mfu"]), 4)
+        timeline.append(row)
+
+    train = {}
+    if timeline:
+        steady = [r for r in timeline if not r.get("compile")]
+        pick = steady or timeline
+        train = {
+            "steps": len(timeline),
+            "groups": len(groups),
+            "ranks": sorted(set().union(
+                *(row["ranks"] for row in train_steps.values()))),
+            "mean_step_ms": round(
+                statistics.mean(r["ms"] for r in pick), 3),
+            "p50_step_ms": round(
+                statistics.median(r["ms"] for r in pick), 3),
+        }
+        tps = [r["tokens_per_sec"] for r in pick if "tokens_per_sec" in r]
+        if tps:
+            train["tokens_per_sec"] = round(statistics.mean(tps), 1)
+        mfus = [r["mfu"] for r in pick if "mfu" in r]
+        if mfus:
+            train["mfu"] = round(statistics.mean(mfus), 4)
+        for key_name, values in train_summary.items():
+            vals = [v for v in values if isinstance(v, (int, float))]
+            if not vals:
+                continue
+            if key_name in ("compile_ms", "device_memory_peak_bytes"):
+                train["%s_max" % key_name] = max(vals)
+            elif key_name == "compiles":
+                train["compiles_total"] = int(sum(vals))
+
+    task_rows = sorted(
+        tasks.values(),
+        key=lambda t: (t["step"], str(t["task_id"])))
+    return {
+        "records": len(records),
+        "tasks": task_rows,
+        "ranks": sorted(ranks),
+        "hosts": sorted(hosts),
+        "trace_ids": sorted(traces),
+        "timers": {k: timers[k] for k in sorted(timers)},
+        "counters": dict(sorted(counters.items())),
+        "events": dict(sorted(events.items())),
+        "train": train,
+        "timeline": timeline,
+        "profiles": list(profiles or []),
+    }
+
+
+def slowest_spans(records, limit=10):
+    """The N slowest individual timer records, with their origin."""
+    spans = [r for r in records if r.get("type") == "timer"]
+    spans.sort(key=lambda r: r.get("ms", 0.0), reverse=True)
+    return [
+        {"name": r["name"], "ms": r.get("ms"),
+         "task": "%s/%s" % (r.get("step"), r.get("task_id")),
+         "rank": r.get("rank", 0), "ok": r.get("ok", True),
+         "step_num": r.get("step_num")}
+        for r in spans[:limit]
+    ]
+
+
+def load_run(flow_datastore, run_id):
+    """(records, profiles) of one run — the raw inputs to aggregate()."""
+    records = telemetry.read_run_records(flow_datastore, run_id)
+    profiles = telemetry.list_run_profiles(flow_datastore, run_id)
+    return records, profiles
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def _fmt_ms(ms):
+    if ms is None:
+        return "-"
+    if ms >= 60_000:
+        return "%.1fmin" % (ms / 60_000)
+    if ms >= 1000:
+        return "%.2fs" % (ms / 1000)
+    return "%.0fms" % ms
+
+
+def render_summary(run_id, agg, echo=print):
+    echo("Run %s: %d telemetry records, %d task(s), rank(s) %s, "
+         "host(s) %s"
+         % (run_id, agg["records"], len(agg["tasks"]),
+            ",".join(map(str, agg["ranks"])) or "-",
+            ",".join(agg["hosts"]) or "-"))
+    if agg["trace_ids"]:
+        echo("trace: %s" % ", ".join(agg["trace_ids"]))
+    if agg["tasks"]:
+        echo("")
+        echo("  %-24s %-5s %-9s %-9s %-8s %s"
+             % ("task", "rank", "duration", "queued", "attempts", "ok"))
+        for t in agg["tasks"]:
+            queued = ("%.2fs" % t["queue_seconds"]
+                      if t["queue_seconds"] is not None else "-")
+            echo("  %-24s %-5s %-9s %-9s %-8d %s"
+                 % ("%s/%s" % (t["step"], t["task_id"]), t["rank"],
+                    _fmt_ms(t["duration_ms"]), queued, t["attempts"],
+                    {True: "ok", False: "FAIL", None: "-"}[t["ok"]]))
+    train = agg["train"]
+    if train:
+        echo("")
+        note = ""
+        if train.get("groups", 1) > 1:
+            note = (" over %d separate training groups — per-group "
+                    "series: --timeline" % train["groups"])
+        echo("training (aggregated across %d rank(s)%s):"
+             % (len(train.get("ranks") or [0]), note))
+        line = ("  %d steps, %s/step (p50 %s)"
+                % (train["steps"], _fmt_ms(train["mean_step_ms"]),
+                   _fmt_ms(train["p50_step_ms"])))
+        if "tokens_per_sec" in train:
+            line += ", %.0f tokens/s" % train["tokens_per_sec"]
+        if "mfu" in train:
+            line += ", MFU %.1f%%" % (train["mfu"] * 100)
+        echo(line)
+        extras = []
+        if "compiles_total" in train:
+            extras.append("%d compile(s)" % train["compiles_total"])
+        if "compile_ms_max" in train:
+            extras.append("compile %s" % _fmt_ms(train["compile_ms_max"]))
+        if "device_memory_peak_bytes_max" in train:
+            extras.append("device mem peak %.1f MB"
+                          % (train["device_memory_peak_bytes_max"] / 2**20))
+        if extras:
+            echo("  " + ", ".join(extras))
+    if agg["counters"]:
+        echo("")
+        echo("counters:")
+        for name, total in agg["counters"].items():
+            echo("  %-40s %s" % (name, total))
+    interesting = [
+        (name, t) for name, t in agg["timers"].items()
+        if not name.endswith(".step")
+    ]
+    if interesting:
+        echo("")
+        echo("timers (aggregated):")
+        echo("  %-40s %6s %10s %10s %10s %s"
+             % ("name", "count", "total", "p50", "max", "failures"))
+        for name, t in sorted(interesting,
+                              key=lambda kv: -kv[1]["total_ms"]):
+            echo("  %-40s %6d %10s %10s %10s %s"
+                 % (name, t["count"], _fmt_ms(t["total_ms"]),
+                    _fmt_ms(t["p50_ms"]), _fmt_ms(t["max_ms"]),
+                    t["failures"] or ""))
+    if agg["profiles"]:
+        echo("")
+        echo("profiler captures:")
+        for p in agg["profiles"]:
+            echo("  %s" % p)
+
+
+def render_timeline(agg, echo=print):
+    if not agg["timeline"]:
+        echo("no per-step training records in this run")
+        return
+    grouped = any("group" in row for row in agg["timeline"])
+    header = "%8s %10s %14s %8s %6s %s" % ("step", "wall", "tokens/s",
+                                           "MFU", "ranks", "")
+    echo(("%-24s " % "group") + header if grouped else header)
+    for row in agg["timeline"]:
+        line = "%8d %10s %14s %8s %6d %s" % (
+            row["step_num"], _fmt_ms(row["ms"]),
+            ("%.0f" % row["tokens_per_sec"]
+             if "tokens_per_sec" in row else "-"),
+            ("%.1f%%" % (row["mfu"] * 100) if "mfu" in row else "-"),
+            row["ranks"], "compile" if row.get("compile") else "")
+        echo(("%-24s " % row.get("group", "")) + line if grouped
+             else line)
+
+
+def render_spans(records, limit, echo=print):
+    spans = slowest_spans(records, limit)
+    if not spans:
+        echo("no timer records in this run")
+        return
+    echo("%10s  %-40s %-22s %5s %s" % ("ms", "name", "task", "rank", "ok"))
+    for s in spans:
+        echo("%10.1f  %-40s %-22s %5d %s"
+             % (s["ms"], s["name"], s["task"], s["rank"],
+                "" if s["ok"] else "FAIL"))
+
+
+def show_metrics(flow_datastore, run_id, as_json=False, timeline=False,
+                 spans=0, echo=print):
+    """The shared CLI driver. Returns the aggregation dict."""
+    records, profiles = load_run(flow_datastore, run_id)
+    agg = aggregate(records, profiles)
+    if as_json:
+        agg["slowest_spans"] = slowest_spans(records, spans or 10)
+        echo(json.dumps(agg, indent=2, sort_keys=True, default=list))
+        return agg
+    if not records:
+        echo("no telemetry records found for run %s (was the run "
+             "executed with TPUFLOW_TELEMETRY=0?)" % run_id)
+        return agg
+    if timeline:
+        render_timeline(agg, echo=echo)
+    elif spans:
+        render_spans(records, spans, echo=echo)
+    else:
+        render_summary(run_id, agg, echo=echo)
+    return agg

@@ -15,7 +15,12 @@ import os
 import pickle
 import sys
 import tempfile
+import time
 import traceback
+
+# resolved at import time: forked children must never import (see module
+# docstring); the telemetry record is emitted by the PARENT after reaping
+from . import telemetry
 
 
 class WorkerFailed(Exception):
@@ -39,6 +44,25 @@ def parallel_map(fn, items, max_parallel=None, min_chunk=4):
     workers = []  # (pid, chunk_index, result_path)
     per_chunk = [None] * n_workers
     failed = []
+    t0 = time.perf_counter()
+    ok = False
+    try:
+        result = _forked_map(fn, items, chunks, n_workers, workers,
+                             per_chunk, failed)
+        ok = True
+        return result
+    finally:
+        # the record must land for exactly the failed maps too (mid-loop
+        # fork/mkstemp failure, worker death) — same contract as the
+        # system.py monitors
+        telemetry.emit(
+            "timer", "multicore.parallel_map",
+            ms=(time.perf_counter() - t0) * 1000, ok=ok,
+            data={"items": len(items), "workers": n_workers},
+        )
+
+
+def _forked_map(fn, items, chunks, n_workers, workers, per_chunk, failed):
     try:
         # spawning stays inside the try: a mid-loop mkstemp/fork failure
         # (ENOSPC, EAGAIN) must still reap the workers already forked —

@@ -17,6 +17,7 @@ import os
 import subprocess
 import sys
 
+from .. import telemetry, tracing
 from ..current import current, Parallel
 from ..decorators import StepDecorator
 from ..exception import TpuFlowException
@@ -192,6 +193,10 @@ class ParallelDecorator(StepDecorator):
             argv = self._replace_opt(argv, "--ubf-context", UBF_TASK)
             env = dict(os.environ)
             env["MF_PARALLEL_NODE_INDEX"] = str(node_index)
+            # trace context propagates into every rank: OTel spans (and
+            # flight-recorder records) from all gang workers join the
+            # control task's trace
+            tracing.inject_tracing_vars(env)
             procs.append(
                 subprocess.Popen(
                     capture_prefix + ["--task-id", task_id, "--"] + argv,
@@ -210,6 +215,10 @@ class ParallelDecorator(StepDecorator):
         flow._control_mapper_tasks = [
             "/".join((run_id, step_name, task_id)) for task_id in mapper_task_ids
         ]
+        telemetry.event(
+            "gang.spawned",
+            data={"num_parallel": num_parallel,
+                  "worker_tasks": mapper_task_ids[1:]})
         self._metadata.register_metadata(
             run_id,
             step_name,

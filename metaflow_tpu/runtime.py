@@ -21,6 +21,7 @@ import sys
 import time
 from collections import deque
 
+from . import telemetry, tracing
 from .datastore.task_datastore import MAX_ATTEMPTS
 from .exception import TpuFlowException
 from .metadata.metadata import MetaDatum
@@ -55,6 +56,7 @@ class _Task(object):
         "error_retries",
         "is_cloned",
         "origin_pathspec",
+        "queued_ts",
     )
 
     def __init__(self, step, task_id, input_paths, split_index=None, ctx=(),
@@ -73,6 +75,7 @@ class _Task(object):
         self.error_retries = 0
         self.is_cloned = False
         self.origin_pathspec = None
+        self.queued_ts = None
 
 
 class CLIArgs(object):
@@ -270,6 +273,18 @@ class NativeRuntime(object):
         self._runstate_thread = None
         self._runstate_gen = 0
 
+        # scheduler-scoped flight recorder: queue/launch/retry events land
+        # in the run's _telemetry/ prefix alongside the tasks' own records.
+        # All tasks (and gang ranks) of the run share ONE trace id —
+        # synthesized from the run id when no ambient TRACEPARENT exists
+        tracing.ensure_traceparent(self.run_id)
+        self._recorder = None
+        if telemetry.enabled():
+            self._recorder = telemetry.FlightRecorder(
+                flow_datastore, self.run_id, "_runtime", "scheduler",
+                attempt=0,
+            )
+
         # resume support: index the origin run's finished tasks
         self._origin_index = {}
         self._cloned_pathspecs = set()
@@ -316,6 +331,8 @@ class NativeRuntime(object):
                 if time.time() - last_beat > 10:
                     self._metadata.heartbeat()
                     last_beat = time.time()
+                    if self._recorder is not None:
+                        self._recorder.flush()
                 self._persist_runstate()
 
                 # reap finished workers
@@ -356,6 +373,18 @@ class NativeRuntime(object):
             sel.close()
             self._metadata.heartbeat()
             self._persist_runstate(force=True)
+            if self._recorder is not None:
+                try:
+                    self._recorder.event(
+                        "run.finished",
+                        data={"failed": self._failed,
+                              "tasks_run": self._finished_tasks,
+                              "tasks_cloned": self._cloned_tasks,
+                              "wall_seconds": round(
+                                  time.time() - start_time, 3)})
+                    self._recorder.close()
+                except Exception:
+                    pass  # observability must never fail the run
 
         if not hooks_ran:
             self._run_exit_hooks(success=not self._failed)
@@ -409,6 +438,7 @@ class NativeRuntime(object):
                 None, task.task_id, task.split_index, task.input_paths,
                 task.is_cloned, task.ubf_context,
             )
+        task.queued_ts = time.time()
         self._run_queue.append(task)
 
     def _pathspec(self, task):
@@ -499,15 +529,34 @@ class NativeRuntime(object):
                     "Task %s failed (attempt %d); retrying."
                     % (self._pathspec(task), task.attempt - 1)
                 )
+                if self._recorder is not None:
+                    self._recorder.event(
+                        "sched.task_retry",
+                        data={"pathspec": self._pathspec(task),
+                              "failed_attempt": task.attempt - 1,
+                              "next_attempt": task.attempt,
+                              "returncode": returncode})
+                task.queued_ts = time.time()
                 self._run_queue.append(task)
                 return
             self._echo("Task %s failed." % self._pathspec(task))
+            if self._recorder is not None:
+                self._recorder.event(
+                    "sched.task_failed",
+                    data={"pathspec": self._pathspec(task),
+                          "attempt": task.attempt,
+                          "returncode": returncode})
             self._failed = True
             # fail fast: drain the queue, let active workers finish
             self._run_queue.clear()
             return
 
         self._finished_tasks += 1
+        if self._recorder is not None:
+            self._recorder.event(
+                "sched.task_finished",
+                data={"pathspec": self._pathspec(task),
+                      "attempt": task.attempt})
         self._schedule_successors(task)
 
     def _load_result(self, task):
@@ -639,12 +688,25 @@ class NativeRuntime(object):
         self._metadata.register_task_id(
             self.run_id, task.step, task.task_id, 0
         )
+        if self._recorder is not None:
+            queue_s = (time.time() - task.queued_ts) if task.queued_ts else 0
+            self._recorder.event(
+                "sched.task_launched",
+                data={"pathspec": self._pathspec(task),
+                      "attempt": task.attempt,
+                      "queue_seconds": round(queue_s, 3)})
         if self._can_fork(task):
             proc = self._fork_worker(task)
         else:
             args = self._build_cli_args(task)
             env = dict(os.environ)
             env.update(args.env)
+            if task.queued_ts:
+                # tasks compute scheduler-queue time from this stamp
+                env["TPUFLOW_QUEUE_TS"] = repr(task.queued_ts)
+            # trace context rides into the task so all spans/records of
+            # the run join one trace
+            tracing.inject_tracing_vars(env)
             # own process group: terminating the task also reaps anything it
             # spawned (gang worker ranks, trampolined children) — a hung
             # rank must never outlive its control task
@@ -737,6 +799,10 @@ class NativeRuntime(object):
         interpreter round-trip."""
         from .task import MetaflowTask, TaskFailedException
 
+        if task.queued_ts:
+            # the fork child inherits the scheduler env; stamp the queue
+            # time the exec path passes via the subprocess env
+            os.environ["TPUFLOW_QUEUE_TS"] = repr(task.queued_ts)
         self._metadata.start_task_heartbeat(
             self._flow.name, self.run_id, task.step, task.task_id
         )

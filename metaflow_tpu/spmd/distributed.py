@@ -19,13 +19,27 @@ def initialize_from_current(timeout_ms=60_000):
         return False
     import jax
 
+    from .. import telemetry
+
     if jax.process_count() > 1:
         return False  # already initialized
-    jax.distributed.initialize(
-        coordinator_address="%s:%d" % (p.main_ip, p.coordinator_port),
-        num_processes=p.num_nodes,
-        process_id=p.node_index,
-    )
+    # rendezvous cost is a first-class launch metric: a slow rank (or a
+    # wedged coordinator) shows up as this timer in `tpuflow metrics`
+    with telemetry.timer(
+        "distributed.initialize",
+        data={"num_nodes": p.num_nodes, "node_index": p.node_index},
+    ):
+        jax.distributed.initialize(
+            coordinator_address="%s:%d" % (p.main_ip, p.coordinator_port),
+            num_processes=p.num_nodes,
+            process_id=p.node_index,
+        )
+    telemetry.event(
+        "distributed.initialized",
+        data={"process_index": jax.process_index(),
+              "process_count": jax.process_count(),
+              "local_devices": len(jax.local_devices()),
+              "global_devices": len(jax.devices())})
     return True
 
 
@@ -34,9 +48,13 @@ def initialize_from_env():
     discovers coordinator/world from the TPU metadata server."""
     import jax
 
+    from .. import telemetry
+
     if jax.process_count() > 1:
         return False
-    jax.distributed.initialize()
+    with telemetry.timer("distributed.initialize",
+                         data={"source": "tpu_metadata"}):
+        jax.distributed.initialize()
     return True
 
 

@@ -47,16 +47,35 @@ class DebugMonitor(BaseMonitor):
 
     @contextmanager
     def measure(self, name):
+        # the record must land even when the wrapped block raises (a
+        # failed task's timing is the interesting one); the exception
+        # always propagates
         start = time.time()
-        yield
-        sys.stderr.write(
-            "timer %s: %.1f ms\n" % (name, (time.time() - start) * 1000)
-        )
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            sys.stderr.write(
+                "timer %s: %.1f ms%s\n"
+                % (name, (time.time() - start) * 1000,
+                   "" if ok else " (failed)")
+            )
 
     @contextmanager
     def count(self, name):
-        yield
-        sys.stderr.write("counter %s: +1\n" % name)
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            sys.stderr.write(
+                "counter %s: +1%s\n" % (name, "" if ok else " (failed)")
+            )
 
     def gauge(self, name, value):
         sys.stderr.write("gauge %s: %s\n" % (name, value))
@@ -86,17 +105,33 @@ class FileMonitor(BaseMonitor):
 
     @contextmanager
     def measure(self, name):
+        # emit with ok:false and re-raise when the wrapped block fails —
+        # dropping the record entirely hid exactly the attempts worth
+        # timing (failed/retried ones)
         start = time.time()
-        yield
-        self._write(
-            {"type": "timer", "name": name,
-             "ms": round((time.time() - start) * 1000, 3)}
-        )
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            self._write(
+                {"type": "timer", "name": name,
+                 "ms": round((time.time() - start) * 1000, 3), "ok": ok}
+            )
 
     @contextmanager
     def count(self, name):
-        yield
-        self._write({"type": "counter", "name": name, "inc": 1})
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            self._write({"type": "counter", "name": name, "inc": 1,
+                         "ok": ok})
 
     def gauge(self, name, value):
         self._write({"type": "gauge", "name": name, "value": value})
@@ -129,14 +164,29 @@ EVENT_LOGGERS = {
 }
 
 
+def _resolve_kind(kind, registry, default_cls, what, env_var):
+    cls = registry.get(kind)
+    if cls is None:
+        # a typo'd env var must not silently disable telemetry
+        sys.stderr.write(
+            "warning: unknown %s kind %r (%s) — falling back to the "
+            "null implementation; known kinds: %s\n"
+            % (what, kind, env_var, ", ".join(sorted(registry)))
+        )
+        cls = default_cls
+    return cls()
+
+
 def get_monitor(kind=None):
     kind = kind or os.environ.get("TPUFLOW_MONITOR", "file")
-    return MONITORS.get(kind, BaseMonitor)()
+    return _resolve_kind(kind, MONITORS, BaseMonitor, "monitor",
+                         "TPUFLOW_MONITOR")
 
 
 def get_event_logger(kind=None):
     kind = kind or os.environ.get("TPUFLOW_EVENT_LOGGER", "file")
-    return EVENT_LOGGERS.get(kind, BaseEventLogger)()
+    return _resolve_kind(kind, EVENT_LOGGERS, BaseEventLogger,
+                         "event logger", "TPUFLOW_EVENT_LOGGER")
 
 
 def read_metrics(root=None):

@@ -12,6 +12,7 @@ import sys
 import time
 import traceback
 
+from . import telemetry
 from .current import current
 from .datastore.task_datastore import TaskDataStore
 from .exception import TaskPreempted, TpuFlowException, MetaflowInternalError
@@ -181,6 +182,28 @@ class MetaflowTask(object):
         else:
             raise MetaflowInternalError("run_id and task_id are required")
 
+        # flight recorder: every record from here on carries this task's
+        # full identity (run/step/task/attempt/rank/host) and persists to
+        # the run's datastore at finalization — replacing any recorder
+        # inherited across fork from the scheduler
+        recorder = telemetry.init_recorder(
+            self.flow_datastore, run_id, step_name, task_id,
+            attempt=retry_count,
+        )
+        if recorder is not None:
+            queued_ts = os.environ.get("TPUFLOW_QUEUE_TS")
+            if queued_ts:
+                try:
+                    recorder.gauge(
+                        "task.queue_seconds",
+                        round(max(0.0, time.time() - float(queued_ts)), 3),
+                    )
+                except ValueError:
+                    pass
+            if retry_count:
+                recorder.event("task.retry_attempt",
+                               data={"attempt": retry_count})
+
         flow = self.flow
         graph = flow._graph
         node = graph[step_name]
@@ -348,8 +371,11 @@ class MetaflowTask(object):
                 {"event": "task_start", "pathspec": output.pathspec,
                  "attempt": retry_count}
             )
-            with self.monitor.measure("metaflow.task.duration"):
-                self._exec_step_function(wrapped, step_func, inputs_obj)
+            telemetry.event("task.start",
+                            data={"pathspec": output.pathspec})
+            with telemetry.timer("task.user_code"):
+                with self.monitor.measure("metaflow.task.duration"):
+                    self._exec_step_function(wrapped, step_func, inputs_obj)
 
             for deco in decorators:
                 deco.task_post_step(
@@ -361,7 +387,13 @@ class MetaflowTask(object):
             exception = ex
             tb = traceback.format_exc()
             self.console_logger(tb)
+            telemetry.event(
+                "task.exception",
+                data={"type": type(ex).__name__,
+                      "preempted": isinstance(ex, TaskPreempted)})
             if isinstance(ex, TaskPreempted) and preemption.spot_notice:
+                telemetry.event("task.preempted",
+                                data={"spot_notice": True})
                 # record the preemption as queryable task metadata (the
                 # reference's spot sidecar writes the same kind of marker).
                 # Only for a REAL spot notice (monitor marker): a routine
@@ -394,43 +426,61 @@ class MetaflowTask(object):
             duration = int((time.time() - start_time) * 1000)
             task_ok = bool(getattr(flow, "_task_ok", False))
 
-            if task_ok:
-                # strip the big _parallel_ubf_iter marker before persist
-                flow.__dict__.pop("_cached_input", None)
-                output.persist(flow)
+            try:
+                if task_ok:
+                    # strip the big _parallel_ubf_iter marker before persist
+                    flow.__dict__.pop("_cached_input", None)
+                    output.persist(flow)
 
-            for deco in decorators:
+                for deco in decorators:
+                    try:
+                        deco.task_finished(
+                            step_name, flow, graph, task_ok, retry_count,
+                            max_user_code_retries,
+                        )
+                    except Exception as hook_ex:
+                        # a failed task_finished hook must fail the attempt
+                        # *attributably*: record the exception so the failure
+                        # path below raises and the worker exits nonzero —
+                        # otherwise the scheduler sees a "successful" task
+                        # with no DONE marker and fails the run with a
+                        # generic error
+                        task_ok = False
+                        self.console_logger(traceback.format_exc())
+                        # a suppressed (@catch) step exception is not the
+                        # cause of this failure — the hook error is
+                        if exception is None or suppressed:
+                            exception = hook_ex
+                            suppressed = False
+
+                self.metadata.register_metadata(
+                    run_id,
+                    step_name,
+                    task_id,
+                    [
+                        MetaDatum(
+                            "attempt_ok", json.dumps(task_ok),
+                            "internal_attempt_status",
+                            ["attempt_id:%d" % retry_count],
+                        ),
+                        MetaDatum("duration-ms", str(duration), "duration", []),
+                    ],
+                )
+            finally:
+                # the flight recorder's finalization flush: the task's
+                # start→end span (with the final ok verdict) plus any
+                # buffered tail persists even when persist/hooks raise —
+                # and an in-flight finalization exception (persist or
+                # metadata failure) downgrades the verdict, since the
+                # attempt IS about to fail
                 try:
-                    deco.task_finished(
-                        step_name, flow, graph, task_ok, retry_count,
-                        max_user_code_retries,
-                    )
-                except Exception as hook_ex:
-                    # a failed task_finished hook must fail the attempt
-                    # *attributably*: record the exception so the failure
-                    # path below raises and the worker exits nonzero —
-                    # otherwise the scheduler sees a "successful" task with
-                    # no DONE marker and fails the run with a generic error
-                    task_ok = False
-                    self.console_logger(traceback.format_exc())
-                    # a suppressed (@catch) step exception is not the cause
-                    # of this failure — the hook error is
-                    if exception is None or suppressed:
-                        exception = hook_ex
-                        suppressed = False
-
-            self.metadata.register_metadata(
-                run_id,
-                step_name,
-                task_id,
-                [
-                    MetaDatum(
-                        "attempt_ok", json.dumps(task_ok), "internal_attempt_status",
-                        ["attempt_id:%d" % retry_count],
-                    ),
-                    MetaDatum("duration-ms", str(duration), "duration", []),
-                ],
-            )
+                    finalize_exc = sys.exc_info()[1]
+                    telemetry.emit(
+                        "timer", "task.duration", ms=duration,
+                        ok=task_ok and finalize_exc is None)
+                    telemetry.close_recorder()
+                except Exception:
+                    pass  # observability must never fail the task
 
             if task_ok:
                 if self.ubf_context == UBF_CONTROL:

@@ -49,15 +49,42 @@ def _init():
 
 @contextmanager
 def span(name, attributes=None):
-    """Span context manager; no-op when tracing is disabled."""
+    """Span context manager; no-op when tracing is disabled.
+
+    Spans also tee into the run's flight recorder (telemetry.py) as timer
+    records when one is active — the `persist.*` spans around datastore
+    ops thereby land in `tpuflow metrics` without double instrumentation.
+    Exceptions are recorded on the span (ERROR status) and re-raised,
+    never swallowed into a clean span.
+    """
+    from . import telemetry
+
     tracer = _init()
     if tracer is None:
-        yield None
+        if telemetry.current_recorder() is None:
+            yield None
+            return
+        with telemetry.timer(name, data=_span_data(attributes)):
+            yield None
         return
-    with tracer.start_as_current_span(name) as s:
-        for k, v in (attributes or {}).items():
-            s.set_attribute(k, v)
-        yield s
+    # attributes at creation: samplers and processors see them at
+    # span-start, not after the fact
+    with telemetry.timer(name, data=_span_data(attributes)):
+        with tracer.start_as_current_span(
+            name, attributes=attributes or {}, record_exception=True,
+            set_status_on_exception=True,
+        ) as s:
+            yield s
+
+
+def _span_data(attributes):
+    if not attributes:
+        return None
+    # telemetry records are JSON: keep attribute values primitive
+    return {
+        k: (v if isinstance(v, (str, int, float, bool)) else str(v))
+        for k, v in attributes.items()
+    }
 
 
 def cli(name):
@@ -75,9 +102,18 @@ def cli(name):
 
 
 def inject_tracing_vars(env):
-    """Propagate trace context into a subprocess env (no-op when off)."""
+    """Propagate trace context into a subprocess env.
+
+    With an active OTel tracer the current span context is injected; with
+    tracing off, an ambient TRACEPARENT (set by a CI driver, a parent
+    scheduler, or ensure_traceparent) is still forwarded so all ranks of
+    a gang — and every task of a run — share one trace id in their
+    telemetry records."""
     tracer = _init()
     if tracer is None:
+        if _TRACEPARENT_VAR in os.environ:
+            env.setdefault(_TRACEPARENT_VAR,
+                           os.environ[_TRACEPARENT_VAR])
         return env
     try:
         from opentelemetry.propagate import inject
@@ -89,6 +125,22 @@ def inject_tracing_vars(env):
     except ImportError:
         pass
     return env
+
+
+def ensure_traceparent(seed):
+    """Make sure this process carries a W3C TRACEPARENT, synthesizing a
+    deterministic one from `seed` (the run id) when absent — so OTel
+    spans and telemetry records from every task/rank of a run join one
+    trace even without an OTel SDK in the tasks. Returns the value."""
+    existing = os.environ.get(_TRACEPARENT_VAR)
+    if existing:
+        return existing
+    import hashlib
+
+    digest = hashlib.sha256(("tpuflow-run:%s" % seed).encode()).hexdigest()
+    value = "00-%s-%s-01" % (digest[:32], digest[32:48])
+    os.environ[_TRACEPARENT_VAR] = value
+    return value
 
 
 def get_trace_id():

@@ -1,5 +1,11 @@
 from .checkpoint import AsyncCheckpointManager, Checkpoint
 from .data import STATE_KEY, ResumableTokenBatches, sharded_dataset
+from .metrics import (
+    TrainStepTelemetry,
+    flops_per_token_dense,
+    instrument_train_step,
+    peak_tflops,
+)
 from .train_step import (
     default_optimizer,
     memory_efficient_optimizer,
@@ -25,4 +31,8 @@ __all__ = [
     "ResumableTokenBatches",
     "sharded_dataset",
     "STATE_KEY",
+    "TrainStepTelemetry",
+    "instrument_train_step",
+    "flops_per_token_dense",
+    "peak_tflops",
 ]

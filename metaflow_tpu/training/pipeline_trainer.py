@@ -14,6 +14,7 @@ engines — here the schedule, the model and the mesh are one system.
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..models import llama
 from ..ops import rms_norm, rope_frequencies
 from ..spmd.pipeline import pipeline_train_interleaved
@@ -25,6 +26,17 @@ def pipeline_loss_and_grads(params, tokens, cfg, mesh,
     """Next-token loss + gradients for EVERY parameter of the Llama
     pytree, computed through the pipeline schedule. Returns
     (loss, grads) with grads shaped exactly like `params`."""
+    # this function body runs under jit TRACING (per-call records would
+    # never fire) — emit the schedule's configuration once per compile,
+    # which is exactly when it can change
+    telemetry.event(
+        "pipeline.trace",
+        data={"num_microbatches": num_microbatches,
+              "num_virtual_stages": num_virtual_stages,
+              "axis_name": axis_name,
+              "batch": int(tokens.shape[0]),
+              "seq": int(tokens.shape[1]) - 1,
+              "n_layers": int(cfg.n_layers)})
     inp, tgt = tokens[:, :-1], tokens[:, 1:]
     dt = llama.param_dtype(cfg)
     cos, sin = rope_frequencies(

@@ -170,11 +170,18 @@ def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None):
 
 
 def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
-                 loss_fn=None, checkpoint=None):
+                 loss_fn=None, checkpoint=None, telemetry=None):
     """One-stop builder: returns (state, train_step_fn, shardings) with a
     SINGLE shared optimizer — prefer this over calling make_train_state and
     make_train_step separately (mismatched optimizers give silently wrong or
     crashing updates).
+
+    telemetry: truthy wraps the returned step with
+    training.metrics.instrument_train_step so every call emits per-step
+    wall time (+ tokens/sec and MFU when the kwargs below are given)
+    through the run's flight recorder. Pass True for defaults or a dict of
+    instrument_train_step kwargs, e.g.
+    ``telemetry={"tokens_per_step": batch * seq, "flops_per_step": ...}``.
 
     checkpoint: an AsyncCheckpointManager (training/checkpoint.py). When
     it holds a complete checkpoint, the freshly-initialized state is
@@ -195,6 +202,11 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
         restored = checkpoint.restore(like=state)
         if restored is not None:
             state = restored.state
+    if telemetry:
+        from .metrics import instrument_train_step
+
+        kwargs = telemetry if isinstance(telemetry, dict) else {}
+        step = instrument_train_step(step, **kwargs)
     return state, step, shardings
 
 

@@ -1,0 +1,75 @@
+"""Gang training with the flight recorder on: every rank emits per-step
+train telemetry (wall time, tokens/sec, MFU) that persists to the run's
+datastore and aggregates per run via `tpuflow metrics`.
+
+Each rank trains its own local model (jax_distributed=False) — the
+cross-process collective path is covered by test_gang_jax_distributed_
+training; THIS flow is about multi-rank telemetry identity/aggregation."""
+
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.decorators import make_step_decorator
+from metaflow_tpu.plugins import STEP_DECORATORS
+
+tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
+
+
+class TelemetryTrainFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @tpu_parallel(jax_distributed=False)
+    @step
+    def train(self):
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
+        from metaflow_tpu.training import (
+            default_optimizer,
+            flops_per_token_dense,
+            make_trainer,
+            shard_batch,
+        )
+
+        cfg = llama.LlamaConfig.tiny()
+        mesh = create_mesh(MeshSpec.dp())  # local devices only
+        batch, seq = 4, 32
+        state, step_fn, _ = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama,
+            optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                        total_steps=10),
+            telemetry={
+                "tokens_per_step": batch * seq,
+                "memory_every": 2,
+            },
+        )
+        n_params = llama.num_params(state["params"])
+        step_fn.telemetry.flops_per_step = (
+            flops_per_token_dense(n_params, cfg.n_layers, cfg.dim, seq)
+            * batch * seq
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size
+        )
+        data = shard_batch({"tokens": tokens}, mesh)
+        with mesh:
+            for _ in range(3):
+                state, m = step_fn(state, data)
+        self.loss = float(m["loss"])
+        step_fn.telemetry.close()
+        self.rank = current.parallel.node_index
+        self.next(self.join)
+
+    @step
+    def join(self, inputs):
+        self.ranks = sorted(inp.rank for inp in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.ranks == [0, 1], self.ranks
+
+
+if __name__ == "__main__":
+    TelemetryTrainFlow()

@@ -286,6 +286,51 @@ _BY_KIND = {
     "JobSet": JOBSET_SCHEMA,
 }
 
+# ---------------------------------------------------------------------------
+# Flight-recorder telemetry records (metaflow_tpu/telemetry.py): the pinned
+# v1 record surface. additionalProperties: false — a field the recorder
+# invents (or a typo in an emit site) fails validation, which protects the
+# `tpuflow metrics` aggregator and any downstream dashboard from silent
+# field drift exactly like the Argo schemas protect the compiler.
+# ---------------------------------------------------------------------------
+
+_NUM = {"type": "number"}
+
+TELEMETRY_RECORD_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "type": {"enum": ["timer", "counter", "gauge", "event"]},
+        "name": _STR,
+        "ts": _NUM,
+        "run_id": _STR,
+        "step": _STR,
+        "task_id": _STR,
+        "attempt": _INT,
+        "rank": _INT,
+        "host": _STR,
+        "pid": _INT,
+        # by record type
+        "ms": _NUM,                       # timer
+        "ok": _BOOL,                      # timer
+        "inc": _NUM,                      # counter
+        "value": _NUM,                    # gauge
+        # training-step records
+        "step_num": _INT,
+        # W3C trace id joining all ranks/tasks of a run
+        "trace": {"type": "string", "pattern": "^[0-9a-f]{32}$"},
+        # free-form extras stay fenced inside one key
+        "data": {"type": "object"},
+    },
+    required=("v", "type", "name", "ts", "run_id", "step", "task_id",
+              "attempt", "rank", "host", "pid"),
+)
+
+
+def validate_telemetry_record(record):
+    """Validate one flight-recorder record against the pinned v1 schema."""
+    jsonschema.validate(record, TELEMETRY_RECORD_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
 
 def validate_manifest(manifest):
     """Validate one parsed manifest against its kind's pinned schema.

@@ -1,0 +1,363 @@
+"""Flight recorder: record schema, crash-safe flush, monitor/tracing
+satellites, TRACEPARENT propagation into step + gang-worker subprocesses,
+multi-rank aggregation in `tpuflow metrics`, profiler window capture."""
+
+import json
+import os
+
+import pytest
+
+from schema_validate import validate_telemetry_record
+
+TRACEPARENT = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    from metaflow_tpu import telemetry
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+    fds = FlowDataStore("TelFlow", LocalStorage, ds_root=str(tmp_path))
+    rec = telemetry.init_recorder(fds, "r1", "train", "7", attempt=1)
+    yield fds, rec
+    telemetry.close_recorder()
+
+
+class TestRecordSchema:
+    def test_every_record_kind_validates(self, recorder):
+        from metaflow_tpu import telemetry
+
+        fds, rec = recorder
+        with rec.timer("span.ok", step_num=3, data={"k": "v"}):
+            pass
+        with pytest.raises(ValueError):
+            with rec.timer("span.fail"):
+                raise ValueError("boom")
+        rec.counter("c", inc=2)
+        rec.gauge("g", 1.5)
+        rec.event("e", data={"x": 1})
+        rec.flush()
+        records = telemetry.read_run_records(fds, "r1")
+        assert len(records) == 5
+        for r in records:
+            validate_telemetry_record(r)
+        by_name = {r["name"]: r for r in records}
+        assert by_name["span.ok"]["ok"] is True
+        assert by_name["span.ok"]["step_num"] == 3
+        # the failing span still lands — with the failure verdict
+        assert by_name["span.fail"]["ok"] is False
+        assert by_name["c"]["inc"] == 2
+        # identity on every record
+        for r in records:
+            assert (r["run_id"], r["step"], r["task_id"], r["attempt"]) == (
+                "r1", "train", "7", 1)
+
+    def test_partial_flush_is_crash_safe(self, recorder):
+        from metaflow_tpu import telemetry
+
+        fds, rec = recorder
+        rec._flush_every = 3
+        for i in range(7):
+            rec.counter("c%d" % i)
+        # two auto-flushed parts persisted; the 1-record tail is NOT —
+        # exactly the crash-loss contract
+        assert len(telemetry.read_run_records(fds, "r1")) == 6
+        rec.flush()
+        assert len(telemetry.read_run_records(fds, "r1")) == 7
+
+    def test_helpers_are_noops_without_recorder(self):
+        from metaflow_tpu import telemetry
+
+        telemetry.close_recorder()
+        telemetry.counter("x")
+        telemetry.gauge("y", 1)
+        with telemetry.timer("z"):
+            pass
+        telemetry.flush()  # nothing raises
+
+
+class TestMonitorSatellites:
+    def test_file_monitor_emits_on_failure(self, tpuflow_root):
+        from metaflow_tpu.system import FileMonitor, read_metrics
+
+        mon = FileMonitor(root=tpuflow_root)
+        with pytest.raises(RuntimeError):
+            with mon.measure("doomed.timer"):
+                raise RuntimeError("boom")
+        with pytest.raises(RuntimeError):
+            with mon.count("doomed.counter"):
+                raise RuntimeError("boom")
+        records = {r["name"]: r for r in read_metrics(root=tpuflow_root)}
+        assert records["doomed.timer"]["ok"] is False
+        assert records["doomed.counter"]["ok"] is False
+
+    def test_unknown_kind_warns_to_stderr(self, capsys):
+        from metaflow_tpu.system import (BaseEventLogger, BaseMonitor,
+                                         get_event_logger, get_monitor)
+
+        mon = get_monitor("typod")
+        logger = get_event_logger("typod")
+        assert type(mon) is BaseMonitor
+        assert type(logger) is BaseEventLogger
+        err = capsys.readouterr().err
+        assert "typod" in err and "TPUFLOW_MONITOR" in err
+        assert "TPUFLOW_EVENT_LOGGER" in err
+
+
+class TestSpanTee:
+    def test_span_failure_lands_as_failed_timer(self, recorder,
+                                                monkeypatch):
+        import metaflow_tpu.tracing as tracing
+        from metaflow_tpu import telemetry
+
+        monkeypatch.delenv("TPUFLOW_OTEL_ENDPOINT", raising=False)
+        tracing._initialized = False
+        fds, _rec = recorder
+        with pytest.raises(KeyError):
+            with tracing.span("persist.save", {"task": "a/b/c"}):
+                raise KeyError("gone")
+        telemetry.flush()
+        records = [r for r in telemetry.read_run_records(fds, "r1")
+                   if r["name"] == "persist.save"]
+        assert records and records[0]["ok"] is False
+        assert records[0]["data"] == {"task": "a/b/c"}
+        validate_telemetry_record(records[0])
+
+    def test_inject_forwards_ambient_traceparent(self, monkeypatch):
+        import metaflow_tpu.tracing as tracing
+
+        monkeypatch.delenv("TPUFLOW_OTEL_ENDPOINT", raising=False)
+        tracing._initialized = False
+        monkeypatch.setenv("TRACEPARENT", TRACEPARENT)
+        env = tracing.inject_tracing_vars({"A": "1"})
+        assert env["TRACEPARENT"] == TRACEPARENT
+
+
+def _flow_datastore(flow_name, root):
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+    return FlowDataStore(flow_name, LocalStorage, ds_root=root)
+
+
+def _latest_run(root, flow_name):
+    with open(os.path.join(root, flow_name, "latest_run")) as f:
+        return f.read().strip()
+
+
+class TestRunTelemetry:
+    def test_linear_flow_records(self, run_flow, flows_dir, tpuflow_root):
+        """Every task of a run persists schema-valid records carrying the
+        ambient trace id and a scheduler queue-time gauge."""
+        from metaflow_tpu import telemetry
+
+        run_flow(os.path.join(flows_dir, "linear_flow.py"), "--quiet",
+                 "run", env_extra={"TRACEPARENT": TRACEPARENT})
+        run_id = _latest_run(tpuflow_root, "LinearFlow")
+        fds = _flow_datastore("LinearFlow", tpuflow_root)
+        records = telemetry.read_run_records(fds, run_id)
+        assert records
+        for r in records:
+            validate_telemetry_record(r)
+        by_step = {}
+        for r in records:
+            by_step.setdefault(r["step"], []).append(r)
+        # all three tasks + the scheduler reported, all in ONE trace
+        assert {"start", "middle", "end", "_runtime"} <= set(by_step)
+        assert {r.get("trace") for r in records} == {"ab" * 16}
+        for step_name in ("start", "middle", "end"):
+            names = {r["name"] for r in by_step[step_name]}
+            assert "task.duration" in names
+            assert "task.queue_seconds" in names
+            assert "task.user_code" in names
+        sched = {r["name"] for r in by_step["_runtime"]}
+        assert "sched.task_launched" in sched
+        assert "run.finished" in sched
+
+    def test_gang_ranks_share_trace_and_aggregate(self, run_flow,
+                                                  flows_dir, tpuflow_root):
+        """The tentpole acceptance path: a gang train run's per-step wall
+        time, tokens/sec and MFU aggregate across ALL ranks from
+        datastore-persisted records (no worker-local disk), and the
+        `metrics` CLI reports them."""
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.cmd.metrics import aggregate
+
+        flow_file = os.path.join(flows_dir, "telemetry_train_flow.py")
+        run_flow(flow_file, "--quiet", "run",
+                 env_extra={"TRACEPARENT": TRACEPARENT,
+                            # 1 device per rank keeps cross-process CPU
+                            # collectives fast (as in test_flows)
+                            "XLA_FLAGS":
+                                "--xla_force_host_platform_device_count=1",
+                            # CPU has no published peak: override so MFU
+                            # is exercised end to end
+                            "TPUFLOW_PEAK_TFLOPS": "0.5"})
+        run_id = _latest_run(tpuflow_root, "TelemetryTrainFlow")
+        fds = _flow_datastore("TelemetryTrainFlow", tpuflow_root)
+        records = telemetry.read_run_records(fds, run_id)
+        for r in records:
+            validate_telemetry_record(r)
+        # both gang ranks (control + worker subprocess) persisted records
+        train_recs = [r for r in records if r["step"] == "train"]
+        assert {r["rank"] for r in train_recs} == {0, 1}
+        # ... joined into one trace through the gang-spawn env
+        assert {r.get("trace") for r in records} == {"ab" * 16}
+
+        agg = aggregate(records)
+        train = agg["train"]
+        assert train["ranks"] == [0, 1]
+        assert train["steps"] >= 3
+        assert train["mean_step_ms"] > 0
+        assert train["tokens_per_sec"] > 0
+        assert 0 < train["mfu"] <= 1.5
+        # the timeline rows carry per-step wall + throughput from BOTH
+        # ranks
+        steady = [row for row in agg["timeline"]
+                  if not row.get("compile")]
+        assert steady and all(row["ranks"] == 2 for row in steady)
+
+        # the CLI surface over the same data: `python flow.py metrics
+        # <run> --json`
+        proc = run_flow(flow_file, "metrics", run_id, "--json")
+        payload = json.loads(proc.stdout)
+        assert payload["train"]["ranks"] == [0, 1]
+        assert payload["train"]["tokens_per_sec"] > 0
+        assert "mfu" in payload["train"]
+        assert payload["slowest_spans"]
+
+    def test_retry_records_attempt_events(self, run_flow, flows_dir,
+                                          tpuflow_root):
+        from metaflow_tpu import telemetry
+
+        run_flow(os.path.join(flows_dir, "retry_catch_flow.py"),
+                 "--quiet", "run",
+                 env_extra={"ATTEMPT_COUNT_FILE": os.path.join(
+                     tpuflow_root, "attempts")})
+        run_id = _latest_run(tpuflow_root, "RetryCatchFlow")
+        fds = _flow_datastore("RetryCatchFlow", tpuflow_root)
+        records = telemetry.read_run_records(fds, run_id)
+        names = {r["name"] for r in records}
+        assert "sched.task_retry" in names
+        assert "task.retry_attempt" in names
+        # failed attempts persist their task.duration with ok:false
+        failed = [r for r in records
+                  if r["name"] == "task.duration" and r["ok"] is False]
+        assert failed
+
+
+class TestAggregation:
+    def test_distinct_training_groups_stay_separate(self):
+        """Foreach siblings (same step name, different task ids) must not
+        be averaged into one series; gang ranks of ONE control task must."""
+        from metaflow_tpu.cmd.metrics import aggregate
+
+        def rec(task_id, rank, step_num, ms):
+            return {"v": 1, "type": "timer", "name": "train.step",
+                    "ts": 1.0, "run_id": "r", "step": "train",
+                    "task_id": task_id, "attempt": 0, "rank": rank,
+                    "host": "h", "pid": 1, "ms": ms, "ok": True,
+                    "step_num": step_num,
+                    "data": {"tokens_per_sec": 1000.0 / ms}}
+
+        records = [
+            # gang: control task 2 + its worker 2-node-1 → ONE group
+            rec("2", 0, 0, 100.0), rec("2-node-1", 1, 0, 102.0),
+            # a foreach sibling task 5 training a different model
+            rec("5", 0, 0, 900.0),
+        ]
+        agg = aggregate(records)
+        assert agg["train"]["groups"] == 2
+        rows = {row["group"]: row for row in agg["timeline"]}
+        assert rows["train/2"]["ranks"] == 2
+        assert rows["train/2"]["ms"] == 101.0  # rank mean, not 900-mixed
+        assert rows["train/5"]["ms"] == 900.0
+
+
+class TestProfilerCapture:
+    def test_window_trigger_uploads_artifact(self, recorder, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.training import instrument_train_step
+
+        monkeypatch.setenv("TPUFLOW_PROFILE_STEPS", "1:3")
+        fds, _rec = recorder
+        f = jax.jit(lambda s, b: (s + b.sum(), {"loss": b.mean()}))
+        wrapped = instrument_train_step(f, tokens_per_step=32)
+        s = jnp.zeros(())
+        for _ in range(5):
+            s, _m = wrapped(s, jnp.ones((4, 8)))
+        wrapped.telemetry.close()
+        profiles = telemetry.list_run_profiles(fds, "r1")
+        assert len(profiles) == 1 and profiles[0].endswith(".zip")
+        records = telemetry.read_run_records(fds, "r1")
+        captured = [r for r in records if r["name"] == "profile.captured"]
+        assert captured and captured[0]["data"]["artifact"] == profiles[0]
+        assert captured[0]["data"]["start_step"] == 1
+
+    def test_file_trigger(self, recorder, tmp_path, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        from metaflow_tpu import telemetry
+
+        monkeypatch.delenv("TPUFLOW_PROFILE_STEPS", raising=False)
+        fds, rec = recorder
+        request = tmp_path / "profile_request"
+        request.write_text("2")
+        trigger = telemetry.ProfileTrigger(
+            recorder=rec, request_file=str(request), check_every=0.0)
+        f = jax.jit(lambda x: x * 2)
+        for i in range(6):
+            trigger.on_step(i)
+            f(jnp.ones(4)).block_until_ready()
+        assert not request.exists()  # consumed when the capture started
+        assert telemetry.list_run_profiles(fds, "r1")
+
+    def test_inflight_capture_stopped_at_recorder_close(self, recorder,
+                                                        monkeypatch):
+        """A window that outlives the loop (or a telemetry=True user who
+        never calls close()) still uploads at task finalization."""
+        import jax
+        import jax.numpy as jnp
+
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.training import instrument_train_step
+
+        monkeypatch.setenv("TPUFLOW_PROFILE_STEPS", "1:100")
+        fds, _rec = recorder
+        f = jax.jit(lambda x: x * 2)
+        wrapped = instrument_train_step(f)
+        for _ in range(3):  # capture starts at step 1, never reaches 100
+            wrapped(jnp.ones(4))
+        telemetry.close_recorder()  # the task-finalization path
+        assert telemetry.list_run_profiles(fds, "r1")
+
+    def test_train_step_records_have_throughput(self, recorder):
+        import jax
+        import jax.numpy as jnp
+
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.training import instrument_train_step
+
+        fds, _rec = recorder
+        f = jax.jit(lambda s, b: (s + b.sum(), {"loss": b.mean()}))
+        wrapped = instrument_train_step(f, tokens_per_step=1024,
+                                        flops_per_step=1e9)
+        s = jnp.zeros(())
+        for _ in range(4):
+            s, _m = wrapped(s, jnp.ones((4, 8)))
+        wrapped.telemetry.close()
+        records = telemetry.read_run_records(fds, "r1")
+        steps = [r for r in records if r["name"] == "train.step"]
+        assert len(steps) == 4
+        steady = [r for r in steps if not (r.get("data") or {}).get(
+            "compile")]
+        assert steady
+        assert all(r["data"]["tokens_per_sec"] > 0 for r in steady)
+        # the first call compiled: flagged, and a compile timer exists
+        assert any(r["name"] == "train.compile" for r in records)
+        report = wrapped.telemetry.report()
+        assert report["compiles"] >= 1
+        assert report["steps"] >= 3
