@@ -1,0 +1,103 @@
+"""Static analysis beyond graph-shape lint: artifact dataflow and SPMD
+configuration checks that catch run-killing errors before a gang-scheduled
+TPU run burns hours of pod time (see docs/static-analysis.md).
+
+Entry points:
+
+  analyze_flow(flow_cls, graph=None)    -> AnalysisReport
+  pre_run_gate(flow, graph, echo)       -> None (warn) or raise (strict)
+
+The pre-run gate runs from NativeRuntime.execute() on every local run:
+findings are echoed as warnings by default; TPUFLOW_STRICT_CHECK=1
+promotes error-severity findings to a hard failure, and TPUFLOW_ANALYZE=0
+skips the gate entirely.
+"""
+
+import os
+
+from ..exception import TpuFlowException
+from .dataflow import ArtifactDataflow, analyze_artifacts
+from .extractor import extract_flow_facts
+from .report import ERROR, INFO, SEVERITIES, WARNING, AnalysisReport, Finding
+from .spmd_check import (
+    analyze_spmd,
+    check_logical_rules,
+    check_mesh_axes,
+    check_mesh_devices,
+    check_pipeline,
+)
+
+__all__ = [
+    "AnalysisError",
+    "AnalysisReport",
+    "Finding",
+    "SEVERITIES",
+    "ERROR",
+    "WARNING",
+    "INFO",
+    "analyze_flow",
+    "analyze_artifacts",
+    "analyze_spmd",
+    "check_logical_rules",
+    "check_mesh_axes",
+    "check_mesh_devices",
+    "check_pipeline",
+    "extract_flow_facts",
+    "pre_run_gate",
+]
+
+
+class AnalysisError(TpuFlowException):
+    headline = "Flow failed static analysis"
+
+    def __init__(self, report):
+        self.report = report
+        msgs = [f.render() for f in report.errors]
+        super().__init__(
+            msg="\n".join(msgs) + "\n(set TPUFLOW_STRICT_CHECK=0 to "
+            "demote these to warnings)")
+
+
+def analyze_flow(flow_cls, graph=None):
+    """Run the artifact dataflow + SPMD config analyses over a flow class.
+    Does not execute any user code; pure AST + graph inspection."""
+    if graph is None:
+        from ..graph import FlowGraph
+
+        graph = FlowGraph(flow_cls)
+    report = AnalysisReport(flow_cls.__name__)
+    report.steps_analyzed = list(graph.sorted_nodes())
+    facts = extract_flow_facts(flow_cls, graph)
+
+    report.analyses.append("artifact-dataflow")
+    report.extend(analyze_artifacts(flow_cls, graph, facts))
+    report.checks_run += 6  # finding families the dataflow pass covers
+
+    report.analyses.append("spmd-config")
+    report.extend(analyze_spmd(flow_cls, graph, facts))
+    report.checks_run += 5  # num_parallel/topology/mesh-axis/devices checks
+    return report
+
+
+def pre_run_gate(flow, graph, echo):
+    """Pre-run analysis gate (cli run/resume via NativeRuntime.execute):
+    warnings by default, TPUFLOW_STRICT_CHECK=1 promotes errors to a hard
+    failure, TPUFLOW_ANALYZE=0 disables."""
+    if os.environ.get("TPUFLOW_ANALYZE", "1") == "0":
+        return None
+    flow_cls = flow if isinstance(flow, type) else flow.__class__
+    try:
+        report = analyze_flow(flow_cls, graph)
+    except Exception as ex:
+        # the analyzer must never be the thing that kills a run
+        echo("    Static analysis skipped (%s: %s)"
+             % (type(ex).__name__, ex))
+        return None
+    strict = os.environ.get("TPUFLOW_STRICT_CHECK") == "1"
+    if report.errors and strict:
+        raise AnalysisError(report)
+    for f in report.sorted_findings():
+        tag = ("error (run `check --deep`; TPUFLOW_STRICT_CHECK=1 makes "
+               "this fatal)" if f.severity == ERROR else f.severity)
+        echo("    analysis %s: %s" % (tag, f.render()))
+    return report

@@ -1,0 +1,356 @@
+"""Fixpoint artifact dataflow over the FlowGraph.
+
+Artifact propagation model (mirrors task.py's runtime semantics exactly):
+
+  - a non-join step inherits every artifact of its single parent
+    (task.py: ``output._objects.update(primary_input._objects)``)
+  - a join step starts from a CLEAN SLATE — only what it sets itself or
+    pulls over with ``merge_artifacts`` survives (task.py: "joins start
+    from a clean slate"); Parameters/class attributes are always available
+  - switch branches, foreach bodies and gang (@parallel) steps propagate
+    like linear steps
+  - cycles through a recursive switch are handled by iterating to a
+    fixpoint (the may-set union is monotone, so it terminates)
+
+Findings produced (codes match docs/static-analysis.md):
+
+  use-before-set        (error)   read of an artifact no upstream path sets
+  ambiguous-join-read   (error)   artifact written divergently on joined
+                                  branches, read after the join without
+                                  merge_artifacts reconciling it
+  merge-outside-join    (error)   merge_artifacts in a non-join step
+  merge-include-missing (error)   include= names no joined branch produces
+  dead-artifact         (warning) written+persisted, dropped unread
+  gang-divergent-write  (warning) artifact assigned under a rank-dependent
+                                  branch of a @parallel step
+"""
+
+from .extractor import extract_flow_facts
+from .report import ERROR, WARNING, Finding
+
+
+def _class_names(flow_cls):
+    """Names that always resolve on the flow instance: methods, Parameters,
+    Config objects, properties, plain class attributes."""
+    return set(dir(flow_cls))
+
+
+class ArtifactDataflow(object):
+    def __init__(self, flow_cls, graph, facts=None):
+        self.flow_cls = flow_cls
+        self.graph = graph
+        self.facts = facts or extract_flow_facts(flow_cls, graph)
+        self.class_names = _class_names(flow_cls)
+        self.entries = {}
+        self.exits = {}
+        self.upstream = {}        # step -> set of steps that can reach it
+        self.wildcard = {}        # step -> bool (dynamic writes upstream)
+        self._solve()
+
+    # -- fixpoint ------------------------------------------------------------
+
+    def _preds(self, name):
+        return [p for p in self.graph[name].in_funcs if p in self.graph]
+
+    def _branch_avail(self, name):
+        """Artifacts any joined branch may carry into join `name`."""
+        avail = set()
+        for p in self._preds(name):
+            avail |= self.exits.get(p, set())
+        return avail
+
+    def _merge_set(self, merge, branch_avail):
+        if merge.unknown:
+            return set(branch_avail)
+        if merge.include is not None:
+            return set(merge.include) & branch_avail
+        if merge.exclude is not None:
+            return branch_avail - merge.exclude
+        return set(branch_avail)
+
+    def _simulate(self, name, entry):
+        env = set(entry)
+        facts = self.facts.get(name)
+        if facts is None:
+            return env
+        for e in facts.events:
+            if e.kind == "write":
+                env.add(e.name)
+            elif e.kind == "delete":
+                env.discard(e.name)
+            elif e.kind == "merge":
+                env |= self._merge_set(e, self._branch_avail(name))
+        return env
+
+    def _solve(self):
+        order = self.graph.sorted_nodes()
+        for name in order:
+            self.entries[name] = set()
+            self.exits[name] = set()
+            self.upstream[name] = set()
+            self.wildcard[name] = bool(
+                self.facts.get(name) and self.facts[name].wildcard_write)
+        changed = True
+        while changed:
+            changed = False
+            for name in order:
+                node = self.graph[name]
+                preds = self._preds(name)
+                entry = set()
+                if node.type != "join":
+                    for p in preds:
+                        entry |= self.exits[p]
+                up = set()
+                wc = bool(self.facts.get(name)
+                          and self.facts[name].wildcard_write)
+                for p in preds:
+                    up.add(p)
+                    up |= self.upstream[p]
+                    wc = wc or self.wildcard[p]
+                exit_ = self._simulate(name, entry)
+                if (entry != self.entries[name] or exit_ != self.exits[name]
+                        or up != self.upstream[name]
+                        or wc != self.wildcard[name]):
+                    self.entries[name] = entry
+                    self.exits[name] = exit_
+                    self.upstream[name] = up
+                    self.wildcard[name] = wc
+                    changed = True
+
+    # -- findings ------------------------------------------------------------
+
+    def findings(self):
+        out = []
+        for name in self.graph.sorted_nodes():
+            out.extend(self._step_findings(name))
+        out.extend(self._dead_artifacts())
+        return out
+
+    def _writers_of(self, artifact, upstream_steps):
+        """(step, lineno) pairs for upstream steps writing `artifact`."""
+        writers = []
+        for s in upstream_steps:
+            f = self.facts.get(s)
+            if not f:
+                continue
+            lines = [e.lineno for e in f.events
+                     if e.kind == "write" and e.name == artifact]
+            if lines:
+                writers.append((s, lines[-1]))
+        return sorted(writers)
+
+    def _divergent(self, writers):
+        """Writers on ≥2 sibling branches, or inside a foreach/gang body,
+        produce per-task values: a join cannot pick one deterministically."""
+        if len(writers) >= 2:
+            return True
+        for s, _ in writers:
+            node = self.graph[s]
+            for parent in node.split_parents:
+                if parent in self.graph and self.graph[parent].type in (
+                        "foreach", "split-parallel"):
+                    return True
+        return False
+
+    def _step_findings(self, name):
+        node = self.graph[name]
+        facts = self.facts.get(name)
+        if facts is None:
+            return []
+        out = []
+        env = set(self.entries[name])
+        branch_avail = None
+        if node.type == "join":
+            branch_avail = self._branch_avail(name) | self.class_names
+        reported = set()
+        suppress = self.wildcard[name]
+        is_parallel = node.parallel_step
+        for e in facts.events:
+            if e.kind == "read":
+                if (e.safe or e.name in env or e.name in self.class_names
+                        or suppress or e.name in reported):
+                    continue
+                reported.add(e.name)
+                out.append(self._classify_missing_read(node, facts, e))
+            elif e.kind == "input_read":
+                if branch_avail is None:
+                    continue  # inputs outside a join: runtime's problem
+                if (e.name in branch_avail or suppress
+                        or e.name in reported):
+                    continue
+                reported.add(e.name)
+                out.append(Finding(
+                    "use-before-set", ERROR,
+                    "Step *%s* reads artifact '%s' from its join inputs "
+                    "but no joined branch ever sets self.%s."
+                    % (name, e.name, e.name),
+                    step=name, artifact=e.name, lineno=e.lineno,
+                    source_file=facts.source_file))
+            elif e.kind == "write":
+                env.add(e.name)
+                if (is_parallel and e.rank_conditional
+                        and ("gdw", e.name) not in reported):
+                    reported.add(("gdw", e.name))
+                    out.append(Finding(
+                        "gang-divergent-write", WARNING,
+                        "Step *%s* is a gang (@parallel) step and assigns "
+                        "self.%s under a rank-dependent branch: ranks that "
+                        "skip the branch will not have the artifact, and "
+                        "the join's inputs will disagree. Assign it on "
+                        "every rank (or move the value into the join)."
+                        % (name, e.name),
+                        step=name, artifact=e.name, lineno=e.lineno,
+                        source_file=facts.source_file))
+            elif e.kind == "delete":
+                env.discard(e.name)
+            elif e.kind == "merge":
+                if node.type != "join":
+                    out.append(Finding(
+                        "merge-outside-join", ERROR,
+                        "Step *%s* calls merge_artifacts but is not a join "
+                        "step (it takes no *inputs* argument): the call "
+                        "raises at runtime." % name,
+                        step=name, lineno=e.lineno,
+                        source_file=facts.source_file))
+                    continue
+                env |= self._merge_set(e, self._branch_avail(name))
+                if (e.include is not None and e.include != "unknown"
+                        and not suppress):
+                    missing = sorted(
+                        set(e.include) - self._branch_avail(name)
+                        - self.class_names)
+                    for m in missing:
+                        out.append(Finding(
+                            "merge-include-missing", ERROR,
+                            "Step *%s* merges include=['%s'] but no joined "
+                            "branch ever sets self.%s: merge_artifacts "
+                            "raises at runtime." % (name, m, m),
+                            step=name, artifact=m, lineno=e.lineno,
+                            source_file=facts.source_file))
+        return out
+
+    def _classify_missing_read(self, node, facts, read):
+        name, artifact = node.name, read.name
+        writers = self._writers_of(artifact, self.upstream[name])
+        if not writers:
+            return Finding(
+                "use-before-set", ERROR,
+                "Step *%s* reads self.%s but no upstream path ever sets "
+                "it." % (name, artifact),
+                step=name, artifact=artifact, lineno=read.lineno,
+                source_file=facts.source_file)
+        where = ", ".join("*%s*" % s for s, _ in writers)
+        if self._divergent(writers):
+            return Finding(
+                "ambiguous-join-read", ERROR,
+                "Step *%s* reads self.%s, which is written divergently on "
+                "joined branches (%s) and not reconciled: joins start from "
+                "a clean slate, so reconcile it in the join with "
+                "merge_artifacts or an explicit assignment."
+                % (name, artifact, where),
+                step=name, artifact=artifact, lineno=read.lineno,
+                source_file=facts.source_file)
+        return Finding(
+            "use-before-set", ERROR,
+            "Step *%s* reads self.%s, which is set upstream in %s but "
+            "discarded by a join on the way (joins start from a clean "
+            "slate): carry it over with merge_artifacts or set it in the "
+            "join." % (name, artifact, where),
+            step=name, artifact=artifact, lineno=read.lineno,
+            source_file=facts.source_file)
+
+    # -- dead artifacts ------------------------------------------------------
+
+    def _dead_artifacts(self):
+        out = []
+        for name in self.graph.sorted_nodes():
+            node = self.graph[name]
+            facts = self.facts.get(name)
+            if facts is None or node.type == "end" or self.wildcard[name]:
+                continue
+            last_write = {}
+            for i, e in enumerate(facts.events):
+                if e.kind == "write":
+                    last_write[e.name] = i
+            for artifact, idx in sorted(last_write.items()):
+                if artifact in self.class_names:
+                    continue
+                if not self._write_consumed(name, artifact, idx):
+                    e = facts.events[idx]
+                    out.append(Finding(
+                        "dead-artifact", WARNING,
+                        "Step *%s* persists self.%s but nothing ever reads "
+                        "it before a join discards it: this is wasted "
+                        "persist bandwidth. Drop the assignment, or merge "
+                        "it past the join if it is meant to be consumed."
+                        % (name, artifact),
+                        step=name, artifact=artifact, lineno=e.lineno,
+                        source_file=facts.source_file))
+        return out
+
+    @staticmethod
+    def _kills(event, artifact):
+        """Whether this event definitely replaces/removes the inherited
+        value. A CONDITIONAL overwrite leaves the old value live on the
+        branch that skips it, so it must not end the liveness walk."""
+        if getattr(event, "name", None) != artifact:  # merges have no name
+            return False
+        if event.kind == "delete":
+            return True
+        return event.kind == "write" and not event.conditional
+
+    def _write_consumed(self, step, artifact, write_idx):
+        """True if the artifact written at facts[step].events[write_idx]
+        is ever read downstream, or survives to the *end* step (where the
+        client API can read it)."""
+        facts = self.facts[step]
+        for e in facts.events[write_idx + 1:]:
+            if e.kind == "read" and e.name == artifact:
+                return True
+            if e.kind == "delete" and e.name == artifact:
+                return True  # deleted before persist: nothing wasted
+        seen = set()
+        stack = [s for s in self.graph[step].out_funcs if s in self.graph]
+        while stack:
+            s = stack.pop()
+            if s in seen:
+                continue
+            seen.add(s)
+            node = self.graph[s]
+            f = self.facts.get(s)
+            if f is None or f.wildcard_write:
+                return True  # unknown code: assume consumed
+            stopped = False
+            if node.type == "join":
+                if any(e.kind == "input_read" and e.name == artifact
+                       for e in f.events):
+                    return True
+                covering = [i for i, e in enumerate(f.events)
+                            if e.kind == "merge" and e.covers(artifact)]
+                if not covering:
+                    continue  # dropped at this join, unread
+                # merged through: consider reads/overwrites after the merge
+                for e in f.events[covering[0] + 1:]:
+                    if e.kind == "read" and e.name == artifact:
+                        return True
+                    if self._kills(e, artifact):
+                        stopped = True
+                        break
+            else:
+                for e in f.events:
+                    if e.kind == "read" and e.name == artifact:
+                        return True
+                    if self._kills(e, artifact):
+                        stopped = True
+                        break
+            if stopped:
+                continue
+            if node.type == "end":
+                return True  # survived the whole flow: client-visible
+            stack.extend(o for o in node.out_funcs if o in self.graph)
+        return False
+
+
+def analyze_artifacts(flow_cls, graph, facts=None):
+    """Run the artifact dataflow pass; returns a list of Findings."""
+    return ArtifactDataflow(flow_cls, graph, facts).findings()

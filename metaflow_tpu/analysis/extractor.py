@@ -1,0 +1,730 @@
+"""AST fact extraction for the artifact dataflow analyzer.
+
+Walks each @step body (across the flow class MRO, subclass wins, same as
+graph.FlowGraph._create_nodes) and records, in source order:
+
+  - reads of ``self.<attr>`` (plain attribute loads, literal ``getattr``;
+    a ``getattr(self, 'x', default)`` or ``hasattr`` counts as a *safe*
+    read: it consumes the artifact for liveness but can never raise)
+  - writes of ``self.<attr>`` (assign / augassign / literal ``setattr``),
+    flagged when they happen under a branch, and additionally when that
+    branch's condition is rank-dependent (``current.parallel.node_index``,
+    ``jax.process_index()``, ...) — the signature of a gang-divergent write
+  - ``del self.<attr>``
+  - ``self.merge_artifacts(inputs, include=..., exclude=...)`` calls
+  - ``self.next(..., foreach='x' / condition='x')`` payload reads
+  - artifact reads through a join's ``inputs`` object (``inp.val``,
+    ``inputs.branch_step.val``, comprehensions over ``inputs``)
+  - ``MeshSpec`` construction with literal arguments (consumed by the SPMD
+    config checker)
+
+Dynamic attribute access (``setattr(self, name, v)`` with a non-literal
+name, ``self.__dict__`` / ``vars(self)`` manipulation) sets
+``wildcard_write`` which makes downstream use-before-set reporting shut up
+rather than guess.
+
+Underscore-prefixed attributes are framework-internal
+(flowspec.INTERNAL_ARTIFACTS_SET) and are ignored entirely.
+"""
+
+import ast
+import inspect
+import textwrap
+
+# attribute names whose value is rank-dependent inside a gang step
+_RANK_ATTRS = {"node_index", "process_index", "local_rank", "host_id"}
+# calls like jax.process_index() / jax.distributed... whose result is a rank
+_RANK_CALL_ATTRS = {"process_index", "process_idx", "host_id"}
+
+
+class Read(object):
+    __slots__ = ("name", "lineno", "safe")
+    kind = "read"
+
+    def __init__(self, name, lineno, safe=False):
+        self.name, self.lineno, self.safe = name, lineno, safe
+
+
+class Write(object):
+    __slots__ = ("name", "lineno", "conditional", "rank_conditional")
+    kind = "write"
+
+    def __init__(self, name, lineno, conditional=False,
+                 rank_conditional=False):
+        self.name, self.lineno = name, lineno
+        self.conditional = conditional
+        self.rank_conditional = rank_conditional
+
+
+class Delete(object):
+    __slots__ = ("name", "lineno")
+    kind = "delete"
+
+    def __init__(self, name, lineno):
+        self.name, self.lineno = name, lineno
+
+
+class Merge(object):
+    """A merge_artifacts call. include/exclude are None (not given),
+    a frozenset (literal), or the string 'unknown' (non-literal arg)."""
+    __slots__ = ("lineno", "include", "exclude")
+    kind = "merge"
+
+    def __init__(self, lineno, include=None, exclude=None):
+        self.lineno, self.include, self.exclude = lineno, include, exclude
+
+    @property
+    def unknown(self):
+        return self.include == "unknown" or self.exclude == "unknown"
+
+    def covers(self, name):
+        """Whether this merge would propagate artifact `name` (statically;
+        'unknown' args are assumed to cover everything)."""
+        if self.unknown:
+            return True
+        if self.include is not None:
+            return name in self.include
+        if self.exclude is not None:
+            return name not in self.exclude
+        return True
+
+
+class InputRead(object):
+    """Artifact read through a join's `inputs` (e.g. `inp.val`)."""
+    __slots__ = ("name", "lineno")
+    kind = "input_read"
+
+    def __init__(self, name, lineno):
+        self.name, self.lineno = name, lineno
+
+
+class MeshLiteral(object):
+    """A MeshSpec constructed with literal arguments inside a step body."""
+    __slots__ = ("preset", "args", "kwargs", "axes", "lineno")
+    kind = "mesh"
+
+    def __init__(self, preset, args, kwargs, axes, lineno):
+        self.preset = preset      # e.g. 'fsdp_tp' or '__init__'
+        self.args = args          # literal positional args (or None each)
+        self.kwargs = kwargs      # literal keyword args
+        self.axes = axes          # resolved axes dict, or None if unresolved
+        self.lineno = lineno
+
+
+class StepFacts(object):
+    __slots__ = ("step", "events", "wildcard_write", "lineno",
+                 "source_file", "mesh_literals", "self_calls")
+
+    def __init__(self, step, lineno, source_file):
+        self.step = step
+        self.events = []
+        self.wildcard_write = False
+        self.lineno = lineno
+        self.source_file = source_file
+        self.mesh_literals = []
+        # names of self.<method>() calls: non-step helper methods write
+        # artifacts on the step's behalf
+        self.self_calls = set()
+
+    @property
+    def writes(self):
+        return {e.name for e in self.events if e.kind == "write"}
+
+    @property
+    def reads(self):
+        return {e.name for e in self.events if e.kind == "read"}
+
+
+# sentinel distinguishing "not a literal" from literal falsy values
+# (None, [], ...) — conflating them turns merge_artifacts(include=[]) into
+# an assumed merge-everything, masking downstream use-before-set errors
+_NON_LITERAL = object()
+
+
+def _literal(node):
+    value = _literal_or_marker(node)
+    return None if value is _NON_LITERAL else value
+
+
+def _literal_or_marker(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return _NON_LITERAL
+
+
+def _name_set(value):
+    """Normalize a literal include/exclude value to a frozenset or
+    'unknown'."""
+    if value is None:
+        return None
+    if isinstance(value, (list, tuple, set, frozenset)) and all(
+            isinstance(v, str) for v in value):
+        return frozenset(value)
+    return "unknown"
+
+
+class _StepExtractor(object):
+    """One pass over a single step's FunctionDef."""
+
+    def __init__(self, facts, func_ast, step_names, offset,
+                 bind_inputs=True):
+        self.facts = facts
+        self.func = func_ast
+        self.step_names = step_names
+        self.offset = offset
+        # local names bound to rank-dependent values / to input stores
+        self.tainted = set()
+        self.input_names = set()
+        # self attrs assigned rank-dependent values (self.rank = ...)
+        self.tainted_attrs = set()
+        args = func_ast.args.args
+        # a join step's 2nd positional is `inputs`; helper methods' extra
+        # args are ordinary values
+        if bind_inputs and len(args) > 1:
+            self.input_names.add(args[1].arg)
+
+    def run(self):
+        for stmt in self.func.body:
+            self._stmt(stmt, cond=False, rank=False)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _ln(self, node):
+        return node.lineno + self.offset
+
+    def _emit_read(self, name, node, safe=False):
+        if not name.startswith("_"):
+            self.facts.events.append(Read(name, self._ln(node), safe=safe))
+
+    def _emit_write(self, name, node, cond, rank):
+        if not name.startswith("_"):
+            self.facts.events.append(
+                Write(name, self._ln(node), conditional=cond,
+                      rank_conditional=rank))
+
+    def _emit_input_read(self, name, node):
+        if not name.startswith("_"):
+            self.facts.events.append(InputRead(name, self._ln(node)))
+
+    # -- expressions --------------------------------------------------------
+
+    def _expr(self, node, cond=False, rank=False):
+        """Scan an expression, emitting events. Returns
+        (rank_tainted, input_derived)."""
+        if node is None:
+            return False, False
+        method = getattr(self, "_expr_%s" % type(node).__name__, None)
+        if method is not None:
+            return method(node, cond, rank)
+        # generic: scan children, propagate taint
+        tainted = False
+        for child in ast.iter_child_nodes(node):
+            t, _ = self._expr(child, cond, rank)
+            tainted = tainted or t
+        return tainted, False
+
+    def _expr_Name(self, node, cond, rank):
+        return node.id in self.tainted, node.id in self.input_names
+
+    def _expr_Attribute(self, node, cond, rank):
+        value = node.value
+        if isinstance(value, ast.Name) and value.id == "self":
+            if isinstance(node.ctx, ast.Load):
+                self._emit_read(node.attr, node)
+            return node.attr in self.tainted_attrs, False
+        t, derived = self._expr(value, cond, rank)
+        if derived:
+            if node.attr in self.step_names:
+                # inputs.<branch_step> -> still an input store
+                return t, True
+            self._emit_input_read(node.attr, node)
+            return t, False
+        if node.attr in _RANK_ATTRS:
+            return True, False
+        return t, False
+
+    def _expr_Subscript(self, node, cond, rank):
+        t, derived = self._expr(node.value, cond, rank)
+        ts, _ = self._expr(node.slice, cond, rank)
+        return t or ts, derived  # inputs[0] is an input store
+
+    def _expr_Call(self, node, cond, rank):
+        func = node.func
+        # self.<method>(...) special forms
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            if func.attr == "merge_artifacts":
+                self._call_merge(node)
+                return False, False
+            if func.attr == "next":
+                self._call_next(node, cond, rank)
+                return False, False
+            # a non-step helper method writes artifacts on this step's
+            # behalf — resolved against the class in extract_flow_facts
+            self.facts.self_calls.add(func.attr)
+        # getattr/setattr/hasattr/delattr on self with a literal name
+        if isinstance(func, ast.Name) and func.id in (
+                "getattr", "setattr", "hasattr", "delattr"):
+            handled = self._call_attr_builtin(func.id, node, cond, rank)
+            if handled:
+                return False, False
+        # vars(self) / self.__dict__ style dynamic access
+        if (isinstance(func, ast.Name) and func.id == "vars"
+                and node.args and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == "self"):
+            self.facts.wildcard_write = True
+            return False, False
+        # MeshSpec literal construction (for the SPMD config checker)
+        self._maybe_mesh_literal(node)
+        # rank-returning calls: jax.process_index() etc.
+        tainted = False
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _RANK_CALL_ATTRS):
+            tainted = True
+        t, _ = self._expr(func, cond, rank)
+        tainted = tainted or t
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Starred):
+                arg = arg.value
+            ta, _ = self._expr(arg, cond, rank)
+            tainted = tainted or ta
+        return tainted, False
+
+    def _expr_Lambda(self, node, cond, rank):
+        self._expr(node.body, True, rank)
+        return False, False
+
+    def _comprehension(self, node, cond, rank):
+        # comprehension targets live in their own scope: bindings derived
+        # from `inputs` must not leak onto same-named variables used later
+        saved = set(self.input_names)
+        try:
+            for gen in node.generators:
+                _, derived = self._expr(gen.iter, cond, rank)
+                if derived:
+                    for n in ast.walk(gen.target):
+                        if isinstance(n, ast.Name):
+                            self.input_names.add(n.id)
+                for if_ in gen.ifs:
+                    self._expr(if_, cond, rank)
+            for field in ("elt", "key", "value"):
+                child = getattr(node, field, None)
+                if child is not None:
+                    self._expr(child, cond, rank)
+        finally:
+            self.input_names = saved
+        return False, False
+
+    _expr_ListComp = _comprehension
+    _expr_SetComp = _comprehension
+    _expr_DictComp = _comprehension
+    _expr_GeneratorExp = _comprehension
+
+    # -- call special cases -------------------------------------------------
+
+    def _call_attr_builtin(self, builtin, node, cond, rank):
+        """getattr/setattr/hasattr/delattr(self, ...). Returns True when
+        the call targeted self and was fully handled."""
+        args = node.args
+        if not args or not (isinstance(args[0], ast.Name)
+                            and args[0].id == "self"):
+            return False
+        name = None
+        if len(args) > 1:
+            name = _literal(args[1])
+        if builtin == "setattr":
+            if isinstance(name, str):
+                self._emit_write(name, node, cond, rank)
+                if len(args) > 2:
+                    self._expr(args[2], cond, rank)
+            else:
+                self.facts.wildcard_write = True
+        elif builtin == "delattr":
+            if isinstance(name, str):
+                # underscore names are framework-internal: ignored, like
+                # every other event on them
+                if not name.startswith("_"):
+                    self.facts.events.append(Delete(name, self._ln(node)))
+            else:
+                self.facts.wildcard_write = True
+        elif builtin == "getattr":
+            if isinstance(name, str):
+                # 3-arg getattr has a default: can't raise
+                self._emit_read(name, node, safe=len(args) > 2)
+            for extra in args[2:]:
+                self._expr(extra, cond, rank)
+        elif builtin == "hasattr":
+            if isinstance(name, str):
+                self._emit_read(name, node, safe=True)
+        return True
+
+    def _call_merge(self, node):
+        def arg_set(expr):
+            value = _literal_or_marker(expr)
+            if value is _NON_LITERAL:
+                return "unknown"
+            return _name_set(value)  # literal None / [] keep their meaning
+
+        include = exclude = None
+        for kw in node.keywords:
+            if kw.arg == "include":
+                include = arg_set(kw.value)
+            elif kw.arg == "exclude":
+                exclude = arg_set(kw.value)
+        # positional form: merge_artifacts(inputs, exclude, include)
+        if len(node.args) > 1 and exclude is None:
+            exclude = arg_set(node.args[1])
+        if len(node.args) > 2 and include is None:
+            include = arg_set(node.args[2])
+        self.facts.events.append(Merge(self._ln(node), include, exclude))
+
+    def _call_next(self, node, cond, rank):
+        for kw in node.keywords:
+            value = _literal(kw.value)
+            if kw.arg in ("foreach", "condition") and isinstance(value, str):
+                self._emit_read(value, kw.value)
+            elif kw.arg not in ("foreach", "condition"):
+                self._expr(kw.value, cond, rank)
+        for arg in node.args:
+            self._expr(arg, cond, rank)
+
+    def _maybe_mesh_literal(self, node):
+        func = node.func
+        preset = None
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "MeshSpec"):
+            preset = func.attr
+        elif isinstance(func, ast.Name) and func.id == "MeshSpec":
+            preset = "__init__"
+        if preset is None:
+            return
+        args = [_literal(a) for a in node.args]
+        kwargs = {kw.arg: _literal(kw.value) for kw in node.keywords
+                  if kw.arg is not None}
+        axes = None
+        if preset == "__init__" and args and isinstance(args[0], dict):
+            axes = args[0]
+        self.facts.mesh_literals.append(
+            MeshLiteral(preset, args, kwargs, axes, self._ln(node)))
+
+    # -- statements ---------------------------------------------------------
+
+    def _stmt(self, node, cond, rank):
+        method = getattr(self, "_stmt_%s" % type(node).__name__, None)
+        if method is not None:
+            method(node, cond, rank)
+        else:
+            # generic statement: scan expressions, recurse into bodies
+            for field in ("value", "test", "exc", "cause", "msg"):
+                child = getattr(node, field, None)
+                if isinstance(child, ast.expr):
+                    self._expr(child, cond, rank)
+            for field in ("body", "orelse", "finalbody"):
+                for child in getattr(node, field, []) or []:
+                    if isinstance(child, ast.stmt):
+                        self._stmt(child, True, rank)
+
+    def _stmt_Expr(self, node, cond, rank):
+        self._expr(node.value, cond, rank)
+
+    def _stmt_Return(self, node, cond, rank):
+        self._expr(node.value, cond, rank)
+
+    def _stmt_Assert(self, node, cond, rank):
+        self._expr(node.test, cond, rank)
+        self._expr(node.msg, cond, rank)
+
+    def _assign_target(self, target, node, cond, rank, tainted, derived):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_target(elt, node, cond, rank, tainted, derived)
+            return
+        if isinstance(target, ast.Starred):
+            self._assign_target(target.value, node, cond, rank, tainted,
+                                derived)
+            return
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            if (target.attr == "__dict__"):
+                self.facts.wildcard_write = True
+                return
+            self._emit_write(target.attr, target, cond, rank)
+            if tainted:
+                self.tainted_attrs.add(target.attr)
+            else:
+                self.tainted_attrs.discard(target.attr)
+            return
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+            if derived:
+                self.input_names.add(target.id)
+            else:
+                self.input_names.discard(target.id)
+            return
+        # subscript / non-self attribute target: scan for reads
+        self._expr(target, cond, rank)
+
+    def _stmt_Assign(self, node, cond, rank):
+        tainted, derived = self._expr(node.value, cond, rank)
+        for target in node.targets:
+            self._assign_target(target, node, cond, rank, tainted, derived)
+
+    def _stmt_AnnAssign(self, node, cond, rank):
+        tainted, derived = self._expr(node.value, cond, rank)
+        self._assign_target(node.target, node, cond, rank, tainted, derived)
+
+    def _stmt_AugAssign(self, node, cond, rank):
+        self._expr(node.value, cond, rank)
+        target = node.target
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            self._emit_read(target.attr, target)
+            self._emit_write(target.attr, target, cond, rank)
+        else:
+            self._expr(target, cond, rank)
+
+    def _stmt_Delete(self, node, cond, rank):
+        for target in node.targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                if not target.attr.startswith("_"):
+                    self.facts.events.append(
+                        Delete(target.attr, self._ln(target)))
+            else:
+                self._expr(target, cond, rank)
+
+    def _stmt_If(self, node, cond, rank):
+        tainted, _ = self._expr(node.test, cond, rank)
+        inner_rank = rank or tainted
+        body_start = len(self.facts.events)
+        for child in node.body:
+            self._stmt(child, True, inner_rank)
+        body_end = len(self.facts.events)
+        for child in node.orelse:
+            self._stmt(child, True, inner_rank)
+        if tainted and not rank and node.orelse:
+            # exhaustive if/else over the rank: artifacts assigned on BOTH
+            # sides are set by every rank — not divergent
+            body_writes = {e.name
+                           for e in self.facts.events[body_start:body_end]
+                           if e.kind == "write"}
+            else_writes = {e.name for e in self.facts.events[body_end:]
+                           if e.kind == "write"}
+            for e in self.facts.events[body_start:]:
+                if e.kind == "write" and e.name in (body_writes
+                                                    & else_writes):
+                    e.rank_conditional = False
+
+    def _stmt_While(self, node, cond, rank):
+        tainted, _ = self._expr(node.test, cond, rank)
+        inner_rank = rank or tainted
+        for child in node.body:
+            self._stmt(child, True, inner_rank)
+        for child in node.orelse:
+            self._stmt(child, True, inner_rank)
+
+    def _stmt_For(self, node, cond, rank):
+        tainted, derived = self._expr(node.iter, cond, rank)
+        if derived:
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self.input_names.add(n.id)
+        else:
+            self._assign_target(node.target, node, cond, rank, tainted,
+                                False)
+        for child in node.body:
+            self._stmt(child, True, rank or tainted)
+        for child in node.orelse:
+            self._stmt(child, True, rank)
+
+    def _stmt_With(self, node, cond, rank):
+        for item in node.items:
+            self._expr(item.context_expr, cond, rank)
+            if item.optional_vars is not None:
+                self._assign_target(item.optional_vars, node, cond, rank,
+                                    False, False)
+        for child in node.body:
+            self._stmt(child, cond, rank)
+
+    def _stmt_Try(self, node, cond, rank):
+        for child in node.body:
+            self._stmt(child, cond, rank)
+        for handler in node.handlers:
+            for child in handler.body:
+                self._stmt(child, True, rank)
+        for child in node.orelse:
+            self._stmt(child, True, rank)
+        for child in node.finalbody:
+            self._stmt(child, cond, rank)
+
+    def _stmt_FunctionDef(self, node, cond, rank):
+        # nested helper: its body may read/write self when called
+        for child in node.body:
+            self._stmt(child, True, rank)
+
+    _stmt_AsyncFunctionDef = _stmt_FunctionDef
+
+    def _stmt_Raise(self, node, cond, rank):
+        self._expr(node.exc, cond, rank)
+        self._expr(node.cause, cond, rank)
+
+    def _stmt_Match(self, node, cond, rank):
+        tainted, _ = self._expr(node.subject, cond, rank)
+        inner_rank = rank or tainted
+        for case in node.cases:
+            if case.guard is not None:
+                self._expr(case.guard, cond, rank)
+            for child in case.body:
+                self._stmt(child, True, inner_rank)
+
+
+# decorators that write an artifact on the step they decorate
+_DECORATOR_WRITES = {
+    "catch": "var",
+}
+
+
+def _decorator_writes(node):
+    """Artifact names written implicitly by a step's decorators
+    (e.g. @catch(var='failed'))."""
+    names = []
+    for deco in node.decorators or []:
+        attr = _DECORATOR_WRITES.get(getattr(deco, "name", None))
+        if attr:
+            value = (getattr(deco, "attributes", None) or {}).get(attr)
+            if isinstance(value, str) and value:
+                names.append(value)
+    return names
+
+
+def _wrapper_artifacts(node):
+    """Artifacts written/read by @user_step_decorator generators wrapping
+    this step (user_decorators.py): their `flow.<attr>` assignments land on
+    the task like the step's own. Returns (writes, reads) name sets, or
+    (None, None) when a wrapper's source cannot be inspected (callers
+    should treat that as a wildcard write)."""
+    writes, reads = set(), set()
+    for deco in node.decorators or []:
+        gen_fn = getattr(deco, "gen_fn", None)
+        if gen_fn is None:
+            continue
+        try:
+            tree = ast.parse(textwrap.dedent(inspect.getsource(gen_fn)))
+            func = tree.body[0]
+        except (OSError, TypeError, SyntaxError, IndexError):
+            return None, None
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None, None
+        params = [a.arg for a in func.args.args]
+        if len(params) < 2:
+            continue
+        # the generator's 2nd positional is the flow; a nested replacement
+        # body's 1st positional is too (`yield body` protocol)
+        flow_names = {params[1]}
+        for n in ast.walk(func):
+            if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n is not func and n.args.args):
+                flow_names.add(n.args.args[0].arg)
+        for n in ast.walk(func):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id in flow_names
+                    and not n.attr.startswith("_")):
+                if isinstance(n.ctx, ast.Store):
+                    writes.add(n.attr)
+                elif isinstance(n.ctx, ast.Load):
+                    reads.add(n.attr)
+    return writes, reads
+
+
+def extract_flow_facts(flow_cls, graph):
+    """Return {step_name: StepFacts} for every step in the graph."""
+    from ..graph import walk_step_sources
+
+    step_names = set(graph.nodes)
+    facts = {}
+    helpers = {}
+    for _cls, class_ast, source_file, offset in walk_step_sources(flow_cls):
+        for item in class_ast.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name in step_names:
+                if item.name in facts:
+                    continue  # subclass override wins (MRO order)
+                sf = StepFacts(item.name, item.lineno + offset, source_file)
+                _StepExtractor(sf, item, step_names, offset).run()
+                facts[item.name] = sf
+            elif not item.name.startswith("__") and item.name not in helpers:
+                # non-step helper method: its self.<attr> writes land on
+                # whichever step calls it
+                hf = StepFacts(item.name, item.lineno + offset, source_file)
+                _StepExtractor(hf, item, step_names, offset,
+                               bind_inputs=False).run()
+                helpers[item.name] = hf
+    for name, sf in facts.items():
+        node = graph[name] if name in graph else None
+        # helper-call effects land at the top of the step's event list:
+        # positionally optimistic (may-analysis), which can only suppress
+        # findings, never invent them
+        h_writes, h_reads, h_wildcard, h_mesh = _helper_effects(
+            sf.self_calls, helpers)
+        sf.wildcard_write = sf.wildcard_write or h_wildcard
+        sf.mesh_literals.extend(h_mesh)
+        for e in reversed(h_writes):
+            sf.events.insert(
+                0, Write(e.name, e.lineno, conditional=True))
+        for e in h_reads:
+            sf.events.append(Read(e.name, e.lineno, safe=True))
+        if node is None:
+            continue
+        # decorator-implied writes land at the top too
+        for var in _decorator_writes(node):
+            sf.events.insert(0, Write(var, sf.lineno, conditional=True))
+        w_writes, w_reads = _wrapper_artifacts(node)
+        if w_writes is None:
+            sf.wildcard_write = True
+            continue
+        for var in sorted(w_writes):
+            sf.events.insert(0, Write(var, sf.lineno, conditional=True))
+        # wrapper reads run outside the step body: count them for liveness
+        # only (safe=True can never raise a use-before-set)
+        for var in sorted(w_reads):
+            sf.events.append(Read(var, sf.lineno, safe=True))
+    return facts
+
+
+def _helper_effects(called, helpers, _seen=None):
+    """Transitive (writes, reads, wildcard, mesh_literals) of the helper
+    methods in `called`, following helper→helper calls with a cycle
+    guard. Events keep the helper's own linenos so findings (e.g. a dead
+    artifact written inside a helper) point at the real assignment."""
+    writes, reads, mesh = [], [], []
+    wildcard = False
+    seen = _seen if _seen is not None else set()
+    for name in sorted(called):
+        hf = helpers.get(name)
+        if hf is None or name in seen:
+            continue
+        seen.add(name)
+        wildcard = wildcard or hf.wildcard_write
+        for e in hf.events:
+            if e.kind == "write":
+                writes.append(e)
+            elif e.kind == "read":
+                reads.append(e)
+        mesh.extend(hf.mesh_literals)
+        w2, r2, wc2, m2 = _helper_effects(hf.self_calls, helpers, seen)
+        writes.extend(w2)
+        reads.extend(r2)
+        mesh.extend(m2)
+        wildcard = wildcard or wc2
+    return writes, reads, wildcard, mesh

@@ -1,0 +1,133 @@
+"""Findings and report container for the static analyzer.
+
+Every finding carries a machine-readable code, a severity, and (when the
+fact it describes is anchored to source) the step name, artifact name, and
+absolute `source_file:lineno` location, so `check --json` output is
+directly consumable by editors and CI. The JSON surface is pinned in
+tests/schema_validate.py::CHECK_REPORT_SCHEMA.
+"""
+
+# severity order matters: index = rank, lower is worse
+SEVERITIES = ("error", "warning", "info")
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+
+class Finding(object):
+    __slots__ = ("code", "severity", "message", "step", "artifact",
+                 "lineno", "source_file")
+
+    def __init__(self, code, severity, message, step=None, artifact=None,
+                 lineno=None, source_file=None):
+        assert severity in SEVERITIES, severity
+        self.code = code
+        self.severity = severity
+        self.message = message
+        self.step = step
+        self.artifact = artifact
+        self.lineno = lineno
+        self.source_file = source_file
+
+    def to_dict(self):
+        return {
+            "code": self.code,
+            "severity": self.severity,
+            "message": self.message,
+            "step": self.step,
+            "artifact": self.artifact,
+            "lineno": self.lineno,
+            "source_file": self.source_file,
+        }
+
+    def location(self):
+        if self.source_file and self.lineno:
+            return "%s:%d" % (self.source_file, self.lineno)
+        return None
+
+    def render(self):
+        loc = self.location()
+        prefix = "[%s] %s" % (self.severity, self.code)
+        where = " (%s)" % loc if loc else ""
+        return "%s%s: %s" % (prefix, where, self.message)
+
+    def __repr__(self):
+        return "<Finding %s %s step=%s artifact=%s>" % (
+            self.severity, self.code, self.step, self.artifact)
+
+
+class AnalysisReport(object):
+    """Aggregated result of lint + dataflow + SPMD config analysis."""
+
+    def __init__(self, flow_name):
+        self.flow = flow_name
+        self.findings = []
+        self.analyses = []
+        self.steps_analyzed = []
+        self.checks_run = 0
+
+    def add(self, finding):
+        self.findings.append(finding)
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    def merge(self, other):
+        self.findings.extend(other.findings)
+        self.analyses.extend(a for a in other.analyses
+                             if a not in self.analyses)
+        for s in other.steps_analyzed:
+            if s not in self.steps_analyzed:
+                self.steps_analyzed.append(s)
+        self.checks_run += other.checks_run
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == WARNING]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    def counts(self):
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def sorted_findings(self):
+        rank = {s: i for i, s in enumerate(SEVERITIES)}
+        return sorted(
+            self.findings,
+            key=lambda f: (rank[f.severity], f.step or "", f.lineno or 0,
+                           f.code),
+        )
+
+    def to_dict(self):
+        return {
+            "v": 1,
+            "flow": self.flow,
+            "ok": self.ok,
+            "analyses": list(self.analyses),
+            "steps_analyzed": list(self.steps_analyzed),
+            "checks_run": self.checks_run,
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.sorted_findings()],
+        }
+
+    def render_lines(self):
+        """Human-readable summary; one line per finding plus a footer."""
+        lines = [f.render() for f in self.sorted_findings()]
+        counts = self.counts()
+        lines.append(
+            "%d check(s) across %d analysis pass(es) over %d step(s): "
+            "%d error(s), %d warning(s)."
+            % (self.checks_run, len(self.analyses),
+               len(self.steps_analyzed), counts["error"], counts["warning"])
+        )
+        return lines

@@ -1,0 +1,255 @@
+"""SPMD configuration checks: validate sharding-rule tables, mesh axis
+specs, gang sizes vs TPU topology tables, and pipeline stage counts BEFORE
+a gang launches (PAPERS.md: "Scaling Deep Learning Training with MPMD
+Pipeline Parallelism" makes static schedule/config validation a first-class
+precondition for multi-slice runs).
+
+Two surfaces:
+
+  - library checkers (`check_logical_rules`, `check_mesh_axes`,
+    `check_mesh_devices`, `check_pipeline`) usable directly from training
+    code or tests — each returns a list of problem strings;
+  - `analyze_spmd(flow_cls, graph, facts)` — the flow-level static pass
+    the `check --deep` CLI runs: validates literal `num_parallel` gang
+    sizes against `@tpu(topology=...)` host counts
+    (plugins/tpu/topologies.py) and literal `MeshSpec` constructions found
+    in step bodies against the canonical axis set and the topology's
+    device count.
+"""
+
+from .report import ERROR, WARNING, Finding
+
+# canonical mesh axis names; mirrors spmd.mesh.AXIS_ORDER (imported lazily
+# to keep the analyzer importable without jax — spmd/__init__ pulls jax in)
+_FALLBACK_AXIS_ORDER = ("pipeline", "data", "fsdp", "expert", "sequence",
+                        "tensor")
+
+
+def _axis_order():
+    try:
+        from ..spmd.mesh import AXIS_ORDER
+
+        return AXIS_ORDER
+    except Exception:
+        return _FALLBACK_AXIS_ORDER
+
+
+def _mesh_spec_cls():
+    try:
+        from ..spmd.mesh import MeshSpec
+
+        return MeshSpec
+    except Exception:
+        return None
+
+
+# -- library checkers --------------------------------------------------------
+
+
+def check_logical_rules(rules, axis_names):
+    """Validate a logical-axis rule table (spmd/sharding.py style) against
+    a mesh's axis names. Returns a list of problem strings."""
+    problems = []
+    axes = set(axis_names)
+    for logical, target in rules.items():
+        if target is None:
+            continue
+        targets = target if isinstance(target, tuple) else (target,)
+        for t in targets:
+            if t is None:
+                continue
+            if not isinstance(t, str):
+                problems.append(
+                    "rule %r -> %r: mesh axis must be a string or None"
+                    % (logical, target))
+            elif t not in axes:
+                problems.append(
+                    "rule %r -> %r references mesh axis %r, but the mesh "
+                    "only has axes %s"
+                    % (logical, target, t, sorted(axes)))
+    return problems
+
+
+def check_mesh_axes(axes):
+    """Validate a MeshSpec axes dict: known axis names, at most one -1
+    wildcard, positive sizes. Returns a list of problem strings."""
+    problems = []
+    known = set(_axis_order())
+    wild = [k for k, v in axes.items() if v == -1]
+    if len(wild) > 1:
+        problems.append(
+            "only one mesh axis may be -1 (absorb remaining devices), "
+            "got %s" % sorted(wild))
+    for name, size in axes.items():
+        if name not in known:
+            problems.append(
+                "unknown mesh axis %r: create_mesh silently drops axes "
+                "outside %s, so shardings referencing it replicate "
+                "instead" % (name, list(_axis_order())))
+        if not isinstance(size, int) or (size < 1 and size != -1):
+            problems.append(
+                "mesh axis %r has invalid size %r (positive int or -1)"
+                % (name, size))
+    return problems
+
+
+def check_mesh_devices(axes, n_devices):
+    """Validate that a MeshSpec axes dict can be resolved over n_devices
+    (mirrors MeshSpec.resolved without needing devices attached)."""
+    problems = []
+    sizes = {k: v for k, v in axes.items()
+             if isinstance(v, int) and v not in (0, 1)}
+    wild = [k for k, v in sizes.items() if v == -1]
+    fixed = 1
+    for v in sizes.values():
+        if v != -1:
+            fixed *= v
+    if wild:
+        if fixed and n_devices % fixed:
+            problems.append(
+                "%d devices not divisible by the fixed axes %s (product "
+                "%d)" % (n_devices, {k: v for k, v in sizes.items()
+                                     if v != -1}, fixed))
+    elif fixed != n_devices:
+        problems.append(
+            "mesh %s needs %d devices but the topology provides %d"
+            % (sizes, fixed, n_devices))
+    return problems
+
+
+def check_pipeline(n_layers, n_stages, num_microbatches=None,
+                   batch_size=None):
+    """Validate pipeline-parallel stage counts (spmd/pipeline.py): the
+    layer stack must split evenly into stages, the batch into
+    microbatches."""
+    problems = []
+    if n_stages < 1:
+        problems.append("n_stages must be >= 1, got %d" % n_stages)
+    elif n_layers % n_stages:
+        problems.append(
+            "%d layers do not split evenly into %d pipeline stages"
+            % (n_layers, n_stages))
+    if num_microbatches is not None:
+        if num_microbatches < 1:
+            problems.append(
+                "num_microbatches must be >= 1, got %d" % num_microbatches)
+        elif batch_size is not None and batch_size % num_microbatches:
+            problems.append(
+                "batch size %d not divisible by %d microbatches"
+                % (batch_size, num_microbatches))
+    return problems
+
+
+# -- flow-level static pass --------------------------------------------------
+
+
+def _tpu_topology(node):
+    for deco in node.decorators or []:
+        if getattr(deco, "name", None) == "tpu":
+            topo = (getattr(deco, "attributes", None) or {}).get("topology")
+            if topo:
+                return str(topo)
+    return None
+
+
+def _resolve_mesh_axes(mesh_literal):
+    """Resolve a MeshSpec literal (preset call or dict ctor) to an axes
+    dict, or None if not statically resolvable."""
+    if mesh_literal.axes is not None:
+        return mesh_literal.axes
+    if mesh_literal.preset == "__init__":
+        return None
+    MeshSpec = _mesh_spec_cls()
+    if MeshSpec is None:
+        return None
+    preset = getattr(MeshSpec, mesh_literal.preset, None)
+    if preset is None or any(a is None for a in mesh_literal.args) or any(
+            v is None for v in mesh_literal.kwargs.values()):
+        return None
+    try:
+        return dict(preset(*mesh_literal.args, **mesh_literal.kwargs).axes)
+    except Exception:
+        return None
+
+
+def analyze_spmd(flow_cls, graph, facts=None):
+    """Flow-level SPMD config checks; returns a list of Findings."""
+    from .extractor import extract_flow_facts
+    from ..plugins.tpu.topologies import TPU_TOPOLOGY_SELECTORS
+
+    facts = facts or extract_flow_facts(flow_cls, graph)
+    findings = []
+
+    # gang size of the split-parallel entering each gang step
+    gang_size = {}
+    for node in graph:
+        if node.parallel_foreach:
+            for out in node.out_funcs:
+                gang_size[out] = (node.num_parallel, node)
+
+    for node in graph:
+        f = facts.get(node.name)
+        loc = dict(step=node.name,
+                   lineno=f.lineno if f else node.func_lineno,
+                   source_file=f.source_file if f else node.source_file)
+
+        # literal num_parallel sanity (non-literals resolve at runtime)
+        if (node.parallel_foreach
+                and getattr(node, "num_parallel_literal", False)
+                and node.num_parallel < 1):
+            findings.append(Finding(
+                "num-parallel-invalid", ERROR,
+                "Step *%s* uses self.next(num_parallel=%d): a gang needs "
+                "at least one rank." % (node.name, node.num_parallel),
+                artifact=None, **loc))
+
+        topo = _tpu_topology(node)
+        n_devices = None
+        if topo is not None:
+            entry = TPU_TOPOLOGY_SELECTORS.get(topo)
+            if entry is None:
+                findings.append(Finding(
+                    "topology-unknown", WARNING,
+                    "Step *%s* requests TPU topology %r, which is not in "
+                    "the topology table (known: %s): the Argo compiler "
+                    "will refuse it and the runtime cannot validate the "
+                    "gang size against it."
+                    % (node.name, topo, ", ".join(
+                        sorted(TPU_TOPOLOGY_SELECTORS))),
+                    artifact=None, **loc))
+            else:
+                _, _, hosts, chips = entry
+                n_devices = hosts * chips
+                size, split_node = gang_size.get(node.name, (0, None))
+                if node.parallel_step and size and size != hosts:
+                    findings.append(Finding(
+                        "num-parallel-topology-mismatch", ERROR,
+                        "Step *%s* is a gang of num_parallel=%d but its "
+                        "@tpu topology %r has %d host(s): a multi-host "
+                        "slice needs exactly one rank per host, so the "
+                        "gang will never assemble."
+                        % (node.name, size, topo, hosts),
+                        artifact=None, **loc))
+
+        # literal MeshSpec constructions in the step body
+        if f is not None:
+            for ml in f.mesh_literals:
+                axes = _resolve_mesh_axes(ml)
+                if axes is None:
+                    continue
+                axis_problems = check_mesh_axes(axes)
+                for problem in axis_problems:
+                    findings.append(Finding(
+                        "mesh-axis-invalid", ERROR,
+                        "Step *%s*: %s" % (node.name, problem),
+                        step=node.name, lineno=ml.lineno,
+                        source_file=f.source_file))
+                if n_devices is not None and not axis_problems:
+                    for problem in check_mesh_devices(axes, n_devices):
+                        findings.append(Finding(
+                            "mesh-devices-mismatch", ERROR,
+                            "Step *%s*: %s (topology %r)"
+                            % (node.name, problem, topo),
+                            step=node.name, lineno=ml.lineno,
+                            source_file=f.source_file))
+    return findings

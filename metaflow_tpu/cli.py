@@ -947,12 +947,46 @@ def make_cli(flow, state):
                 )
             echo("gc done (%d runs kept)" % len(kept))
 
-    @start.command(help="Validate the flow graph.")
+    @start.command(help="Validate the flow graph. --deep adds artifact "
+                        "dataflow + SPMD config analysis; exits non-zero "
+                        "on any error-severity finding.")
+    @click.option("--deep", is_flag=True,
+                  help="Run the artifact dataflow and SPMD config "
+                       "analyzers on top of the graph lint.")
+    @click.option("--json", "as_json", is_flag=True,
+                  help="Emit a machine-readable report (schema pinned in "
+                       "tests/schema_validate.py).")
     @click.pass_obj
-    def check(state):
-        _finalize(state)
-        echo("Validating your flow...")
-        echo("    The graph looks good!")
+    def check(state, deep, as_json):
+        from .analysis import ERROR, AnalysisReport, Finding, analyze_flow
+        from .lint import LintWarn, linter
+
+        report = AnalysisReport(flow.name)
+        report.analyses.append("lint")
+        lint_ok = True
+        try:
+            _finalize(state)
+        except LintWarn as ex:
+            lint_ok = False
+            report.add(Finding(
+                "lint", ERROR, ex.message,
+                lineno=ex.lineno, source_file=ex.source_file))
+        report.checks_run += len(linter._checks)
+        graph = state.graph or flow._graph
+        report.steps_analyzed = list(graph.sorted_nodes())
+        if deep and lint_ok:
+            # a graph that fails shape lint has no reliable dataflow
+            report.merge(analyze_flow(flow.__class__, graph))
+        if as_json:
+            echo(json.dumps(report.to_dict(), indent=2))
+        else:
+            echo("Validating your flow...")
+            for line in report.render_lines():
+                echo("    %s" % line)
+            if report.ok:
+                echo("    The graph looks good!")
+        if not report.ok:
+            sys.exit(1)
 
     @start.command(help="Show the structure of the flow.")
     @click.pass_obj

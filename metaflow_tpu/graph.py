@@ -29,10 +29,38 @@ def deindent_docstring(doc):
     return textwrap.dedent(doc).strip()
 
 
+def walk_step_sources(flow_cls):
+    """Yield (cls, class_ast, source_file, lineno_offset) for every MRO
+    level of a flow class that defines @step methods, outermost subclass
+    first (callers apply subclass-wins themselves). `lineno_offset` rebases
+    the class AST's relative linenos to absolute file lines. Shared by the
+    graph builder and the static analyzer (analysis/extractor.py) so their
+    source locations can never drift apart."""
+    for cls in inspect.getmro(flow_cls):
+        if cls is object:
+            continue
+        # parsing a class costs a tokenize+compile of its whole source:
+        # skip MRO levels that define no steps (FlowSpec itself, mixins)
+        if not any(getattr(v, "is_step", False)
+                   for v in vars(cls).values()):
+            continue
+        try:
+            source_lines, class_lineno = inspect.getsourcelines(cls)
+            source_file = inspect.getsourcefile(cls)
+        except (OSError, TypeError):
+            continue
+        tree = ast.parse(textwrap.dedent("".join(source_lines))).body
+        if not tree or not isinstance(tree[0], ast.ClassDef):
+            continue
+        # ast lineno 1 == the class def line
+        yield cls, tree[0], source_file, class_lineno - 1
+
+
 class DAGNode(object):
     def __init__(self, func_ast, decos, wrappers, doc, source_file, lineno):
         self.name = func_ast.name
-        self.func_lineno = func_ast.lineno + (lineno or 0)
+        self._lineno_offset = lineno or 0
+        self.func_lineno = func_ast.lineno + self._lineno_offset
         self.source_file = source_file
         self.decorators = decos
         self.wrappers = wrappers
@@ -47,6 +75,7 @@ class DAGNode(object):
         self.num_args = 0
         self.foreach_param = None
         self.num_parallel = 0
+        self.num_parallel_literal = False
         self.parallel_step = False
         self.condition = None
         self.switch_cases = {}
@@ -109,7 +138,7 @@ class DAGNode(object):
 
             self.has_tail_next = True
             self.invalid_tail_next = True
-            self.tail_next_lineno = tail.value.lineno
+            self.tail_next_lineno = tail.value.lineno + self._lineno_offset
 
             keywords = dict(
                 (k.arg, k.value) for k in tail.value.keywords if k.arg is not None
@@ -143,8 +172,12 @@ class DAGNode(object):
                 elif "num_parallel" in keywords:
                     self.type = "split-parallel"
                     self.parallel_foreach = True
-                    # cardinality may be a runtime expression; literal if given
-                    self.num_parallel = literal_kw.get("num_parallel") or 0
+                    # cardinality may be a runtime expression; literal if
+                    # given. num_parallel_literal distinguishes a literal 0
+                    # (statically invalid) from a runtime expression
+                    lit = literal_kw.get("num_parallel")
+                    self.num_parallel = lit if isinstance(lit, int) else 0
+                    self.num_parallel_literal = isinstance(lit, int)
                     self.invalid_tail_next = False
                 return
             if len(keywords) == 0:
@@ -166,10 +199,14 @@ class DAGNode(object):
 
 
 class StepVisitor(ast.NodeVisitor):
-    def __init__(self, nodes, flow, source_file):
+    def __init__(self, nodes, flow, source_file, lineno_offset=0):
         self.nodes = nodes
         self.flow = flow
         self.source_file = source_file
+        # ast linenos are relative to the class source (line 1 == the
+        # class def); the offset rebases them to absolute file lines so
+        # lint/analysis findings carry editor-usable locations
+        self.lineno_offset = lineno_offset
         super().__init__()
 
     def visit_FunctionDef(self, node):
@@ -179,7 +216,8 @@ class StepVisitor(ast.NodeVisitor):
             wrappers = getattr(func, "wrappers", [])
             decos = getattr(func, "decorators", [])
             self.nodes[node.name] = DAGNode(
-                node, decos, wrappers, func.__doc__, self.source_file, 0
+                node, decos, wrappers, func.__doc__, self.source_file,
+                self.lineno_offset
             )
 
 
@@ -193,19 +231,9 @@ class FlowGraph(object):
 
     def _create_nodes(self, flow):
         nodes = {}
-        for cls in inspect.getmro(flow):
-            if cls is object:
-                continue
-            try:
-                source = inspect.getsource(cls)
-                source_file = inspect.getsourcefile(cls)
-            except (OSError, TypeError):
-                continue
-            tree = ast.parse(textwrap.dedent(source)).body
-            root = tree[0]
-            if not isinstance(root, ast.ClassDef):
-                continue
-            visitor = StepVisitor(nodes, flow, source_file)
+        for _cls, root, source_file, offset in walk_step_sources(flow):
+            visitor = StepVisitor(nodes, flow, source_file,
+                                  lineno_offset=offset)
             # only add steps not already defined by a subclass (MRO order)
             new_nodes = {}
             visitor.nodes = new_nodes
@@ -222,31 +250,37 @@ class FlowGraph(object):
                 node.type = "join"
 
     def _traverse_graph(self):
-        def traverse(node, seen, split_parents):
-            # split-switch executes one branch only: no join expected, so it
-            # does not open a split level
+        # iterative DFS (explicit worklist): deep or generated graphs must
+        # not hit Python's recursion limit during graph construction (the
+        # linter's traversals are iterative for the same reason)
+        if "start" not in self.nodes:
+            return
+        worklist = [("start", frozenset(), ())]
+        while worklist:
+            name, seen, split_parents = worklist.pop()
+            node = self.nodes[name]
+            # split-switch executes one branch only: no join expected, so
+            # it does not open a split level
             if node.type in ("split", "foreach", "split-parallel"):
-                node.split_parents = split_parents
-                split_parents = split_parents + [node.name]
+                node.split_parents = list(split_parents)
+                split_parents = split_parents + (node.name,)
             elif node.type == "join":
-                # ignore joins with empty split stacks (caught by the linter)
+                # ignore joins with empty split stacks (caught by the
+                # linter)
                 if split_parents:
-                    node.split_parents = split_parents[:-1]
+                    node.split_parents = list(split_parents[:-1])
                     self.nodes[split_parents[-1]].matching_join = node.name
                     split_parents = split_parents[:-1]
             else:
-                node.split_parents = split_parents
+                node.split_parents = list(split_parents)
 
             for n in node.out_funcs:
                 child = self.nodes.get(n)
                 if child is None:
                     continue
-                child.in_funcs.add(node.name)
+                child.in_funcs.add(name)
                 if n not in seen:
-                    traverse(child, seen + [n], split_parents)
-
-        if "start" in self.nodes:
-            traverse(self.nodes["start"], [], [])
+                    worklist.append((n, seen | {n}, split_parents))
 
         # infer parallel_foreach propagation: the step(s) inside a
         # split-parallel are parallel steps

@@ -27,6 +27,10 @@ class LintWarn(TpuFlowException):
         if source_file and lineno:
             msg = "%s:%d: %s" % (source_file, lineno, msg)
         super().__init__(msg=msg, lineno=None)
+        # structured location (the message embeds it for humans): consumed
+        # by `check --json` and editor integrations
+        self.lineno = lineno
+        self.source_file = source_file
 
 
 class FlowLinter(object):
@@ -195,26 +199,38 @@ def check_for_orphans(graph):
 @linter.check
 def check_for_acyclicity(graph):
     # Cycles are only allowed through a split-switch (recursive switch).
-    def visit(name, path):
-        node = graph[name]
-        for out in node.out_funcs:
-            if out not in graph:
-                continue
-            if out in path:
-                # a back-edge is legal iff some node in the cycle is a switch
-                cycle = path[path.index(out):] + [out]
-                if not any(graph[c].type == "split-switch" for c in cycle[:-1]):
-                    _err(
-                        "There is a loop in your flow: %s. A flow must be a "
-                        "directed acyclic graph (recursion is only allowed "
-                        "via a switch transition)." % "->".join(cycle),
-                        node,
-                    )
-            else:
-                visit(out, path + [out])
-
-    if "start" in graph:
-        visit("start", ["start"])
+    # Iterative DFS with an explicit path stack: deep or generated graphs
+    # (recursive-switch flows) must not blow Python's recursion limit
+    # inside the linter itself.
+    if "start" not in graph:
+        return
+    path = ["start"]
+    on_path = {"start"}
+    # stack of iterators over each path node's out-edges
+    stack = [iter(graph["start"].out_funcs)]
+    while stack:
+        out = next(stack[-1], None)
+        if out is None:
+            stack.pop()
+            on_path.discard(path.pop())
+            continue
+        if out not in graph:
+            continue
+        if out in on_path:
+            # a back-edge is legal iff some node in the cycle is a switch
+            node = graph[path[-1]]
+            cycle = path[path.index(out):] + [out]
+            if not any(graph[c].type == "split-switch" for c in cycle[:-1]):
+                _err(
+                    "There is a loop in your flow: %s. A flow must be a "
+                    "directed acyclic graph (recursion is only allowed "
+                    "via a switch transition)." % "->".join(cycle),
+                    node,
+                )
+        else:
+            path.append(out)
+            on_path.add(out)
+            stack.append(iter(graph[out].out_funcs))
 
 
 @linter.check
@@ -223,42 +239,47 @@ def check_split_join_balance(graph):
     reached with an empty split stack. (Reference: lint.py
     check_split_join_balance:294 — the subtlest invariant in the graph.)"""
 
-    def traverse(node, split_stack, seen):
-        if node.name in seen:
-            return
-        seen.add(node.name)
-        # split-switch executes exactly ONE branch, so it needs no join:
-        # treat it as linear for balance purposes
-        if node.type == "split":
-            split_stack = split_stack + ["split:%s" % node.name]
-        elif node.type == "foreach":
-            split_stack = split_stack + ["foreach:%s" % node.name]
-        elif node.type == "split-parallel":
-            split_stack = split_stack + ["parallel:%s" % node.name]
-        elif node.type == "join":
-            if not split_stack:
-                _err(
-                    "Step *%s* is a join (it takes an extra *inputs* "
-                    "argument) but there is no split or foreach to join."
-                    % node.name,
-                    node,
-                )
-            split_stack = split_stack[:-1]
-        elif node.type == "end":
-            if split_stack:
-                kind, split_name = split_stack[-1].split(":", 1)
-                _err(
-                    "Step *end* reached before the %s started at step "
-                    "*%s* was joined. Add a join step (def step(self, "
-                    "inputs)) before *end*." % (kind, split_name),
-                    node,
-                )
-        for out in node.out_funcs:
-            if out in graph:
-                traverse(graph[out], split_stack, seen)
-
+    # iterative DFS (explicit worklist): generated or deeply-recursive
+    # graphs must not die with RecursionError inside the linter. Same
+    # semantics as the recursive original: first visit of a node wins.
     if "start" in graph:
-        traverse(graph["start"], [], set())
+        seen = set()
+        worklist = [("start", ())]
+        while worklist:
+            name, split_stack = worklist.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            node = graph[name]
+            # split-switch executes exactly ONE branch, so it needs no
+            # join: treat it as linear for balance purposes
+            if node.type == "split":
+                split_stack = split_stack + ("split:%s" % node.name,)
+            elif node.type == "foreach":
+                split_stack = split_stack + ("foreach:%s" % node.name,)
+            elif node.type == "split-parallel":
+                split_stack = split_stack + ("parallel:%s" % node.name,)
+            elif node.type == "join":
+                if not split_stack:
+                    _err(
+                        "Step *%s* is a join (it takes an extra *inputs* "
+                        "argument) but there is no split or foreach to "
+                        "join." % node.name,
+                        node,
+                    )
+                split_stack = split_stack[:-1]
+            elif node.type == "end":
+                if split_stack:
+                    kind, split_name = split_stack[-1].split(":", 1)
+                    _err(
+                        "Step *end* reached before the %s started at step "
+                        "*%s* was joined. Add a join step (def step(self, "
+                        "inputs)) before *end*." % (kind, split_name),
+                        node,
+                    )
+            for out in node.out_funcs:
+                if out in graph:
+                    worklist.append((out, split_stack))
 
     # a join must join the steps of exactly one split level: all of its
     # in_funcs must share the same innermost split parent

@@ -297,6 +297,14 @@ class NativeRuntime(object):
 
     def execute(self):
         start_time = time.time()
+        # pre-run analysis gate: catch use-before-set / ambiguous-join /
+        # SPMD config errors BEFORE any gang is scheduled (warnings by
+        # default; TPUFLOW_STRICT_CHECK=1 makes error findings fatal,
+        # TPUFLOW_ANALYZE=0 skips). Failing here costs milliseconds;
+        # failing inside a pod-slice gang costs the whole reservation.
+        from .analysis import pre_run_gate
+
+        pre_run_gate(self._flow, self._graph, self._echo)
         for step_func in self._flow:
             for deco in step_func.decorators:
                 deco.runtime_init(self._flow, self._graph, None, self.run_id)

@@ -326,6 +326,55 @@ TELEMETRY_RECORD_SCHEMA = _obj(
 )
 
 
+# ---------------------------------------------------------------------------
+# `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
+# v1 surface for the static analyzer. additionalProperties: false — a field
+# the analyzer invents fails validation, protecting editor/CI consumers of
+# the report from silent drift.
+# ---------------------------------------------------------------------------
+
+_NULL_STR = {"type": ["string", "null"]}
+_NULL_INT = {"type": ["integer", "null"]}
+
+_FINDING = _obj(
+    {
+        "code": _STR,
+        "severity": {"enum": ["error", "warning", "info"]},
+        "message": _STR,
+        "step": _NULL_STR,
+        "artifact": _NULL_STR,
+        "lineno": _NULL_INT,
+        "source_file": _NULL_STR,
+    },
+    required=("code", "severity", "message"),
+)
+
+CHECK_REPORT_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "flow": _STR,
+        "ok": _BOOL,
+        "analyses": _arr({"enum": ["lint", "artifact-dataflow",
+                                   "spmd-config"]}),
+        "steps_analyzed": _arr(_STR),
+        "checks_run": _INT,
+        "counts": _obj(
+            {"error": _INT, "warning": _INT, "info": _INT},
+            required=("error", "warning", "info"),
+        ),
+        "findings": _arr(_FINDING),
+    },
+    required=("v", "flow", "ok", "analyses", "steps_analyzed",
+              "checks_run", "counts", "findings"),
+)
+
+
+def validate_check_report(report):
+    """Validate a `check --json` report against the pinned v1 schema."""
+    jsonschema.validate(report, CHECK_REPORT_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
 def validate_telemetry_record(record):
     """Validate one flight-recorder record against the pinned v1 schema."""
     jsonschema.validate(record, TELEMETRY_RECORD_SCHEMA,

@@ -1,0 +1,807 @@
+"""Artifact dataflow + SPMD config analyzer (metaflow_tpu/analysis/).
+
+Seeded-violation flows assert each finding family fires with the right
+step/artifact/line; the sweep test asserts ZERO error-severity findings
+over every shipped flow under tests/flows/ and tutorials/ (the analyzer's
+own regression gate: a new false positive, or a new example that violates
+the dataflow rules, fails here first).
+"""
+
+import glob
+import importlib.util
+import inspect
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.analysis import (
+    analyze_flow,
+    check_logical_rules,
+    check_mesh_axes,
+    check_mesh_devices,
+    check_pipeline,
+)
+from metaflow_tpu.graph import FlowGraph
+
+from schema_validate import validate_check_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _findings(flow_cls, code=None, severity=None):
+    report = analyze_flow(flow_cls)
+    out = report.sorted_findings()
+    if code is not None:
+        out = [f for f in out if f.code == code]
+    if severity is not None:
+        out = [f for f in out if f.severity == severity]
+    return out
+
+
+def _line_of(flow_cls, marker):
+    """Absolute file line of the (first) source line containing marker."""
+    lines, start = inspect.getsourcelines(flow_cls)
+    for i, line in enumerate(lines):
+        if marker in line:
+            return start + i
+    raise AssertionError("marker %r not in %s" % (marker, flow_cls))
+
+
+# ---------------------------------------------------------------------------
+# artifact dataflow: seeded violations
+# ---------------------------------------------------------------------------
+
+
+class NeverSetFlow(FlowSpec):
+    @step
+    def start(self):
+        self.x = 1
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.x, self.ghost)  # MARK-ghost
+
+
+def test_use_before_set_never_written():
+    found = _findings(NeverSetFlow, code="use-before-set")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error"
+    assert f.step == "end" and f.artifact == "ghost"
+    assert f.lineno == _line_of(NeverSetFlow, "MARK-ghost")
+    assert f.source_file and f.source_file.endswith("test_analysis.py")
+
+
+class AmbiguousJoinFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.a, self.b)
+
+    @step
+    def a(self):
+        self.val = 1
+        self.next(self.joiner)
+
+    @step
+    def b(self):
+        self.val = 2
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.val)  # MARK-val
+
+
+def test_ambiguous_join_read():
+    found = _findings(AmbiguousJoinFlow, code="ambiguous-join-read")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.artifact == "val"
+    assert f.step == "end"
+    assert f.lineno == _line_of(AmbiguousJoinFlow, "MARK-val")
+    assert "*a*" in f.message and "*b*" in f.message
+
+
+class DroppedAtJoinFlow(FlowSpec):
+    @step
+    def start(self):
+        self.cfg = "adam"  # written once, BEFORE the split
+        self.items = [1, 2]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.y = self.input
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.ys = [i.y for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.cfg)  # single upstream writer: not ambiguous
+
+
+def test_single_writer_dropped_at_join_is_use_before_set():
+    found = _findings(DroppedAtJoinFlow, severity="error")
+    assert [f.code for f in found] == ["use-before-set"], found
+    assert found[0].artifact == "cfg"
+    assert "discarded by a join" in found[0].message
+
+
+class MergeFixesFlow(DroppedAtJoinFlow):
+    @step
+    def joiner(self, inputs):
+        self.ys = [i.y for i in inputs]
+        self.merge_artifacts(inputs, exclude=["y"])
+        self.next(self.end)
+
+
+def test_merge_artifacts_reconciles():
+    assert _findings(MergeFixesFlow, severity="error") == []
+
+
+class DeadArtifactFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [1, 2]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.used = self.input
+        self.wasted = self.input * 100  # MARK-wasted
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.total = sum(i.used for i in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.total)
+
+
+def test_dead_artifact_warning():
+    found = _findings(DeadArtifactFlow, code="dead-artifact")
+    assert [f.artifact for f in found] == ["wasted"], found
+    f = found[0]
+    assert f.severity == "warning" and f.step == "body"
+    assert f.lineno == _line_of(DeadArtifactFlow, "MARK-wasted")
+    # the analyzer must not call artifacts that survive to *end* dead
+    assert _findings(DeadArtifactFlow, severity="error") == []
+
+
+class GangDivergentFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index  # fine: every rank sets it
+        if current.parallel.node_index == 0:
+            self.summary = "only rank 0 has this"  # MARK-divergent
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.ranks = [i.rank for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.ranks)
+
+
+def test_gang_divergent_write_warning():
+    found = _findings(GangDivergentFlow, code="gang-divergent-write")
+    assert [f.artifact for f in found] == ["summary"], found
+    f = found[0]
+    assert f.severity == "warning" and f.step == "train"
+    assert f.lineno == _line_of(GangDivergentFlow, "MARK-divergent")
+
+
+class RankViaLocalFlow(GangDivergentFlow):
+    @step
+    def train(self):
+        rank = current.parallel.node_index
+        self.rank = rank
+        if rank == 0:
+            self.summary = "tainted through a local variable"
+        self.next(self.joiner)
+
+
+def test_gang_divergent_write_through_local_taint():
+    found = _findings(RankViaLocalFlow, code="gang-divergent-write")
+    assert [f.artifact for f in found] == ["summary"], found
+
+
+class ExhaustiveRankBranchFlow(GangDivergentFlow):
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index
+        if current.parallel.node_index == 0:
+            self.mode = "leader"
+        else:
+            self.mode = "worker"  # every rank assigns: not divergent
+        self.next(self.joiner)
+
+
+def test_exhaustive_rank_branch_is_not_divergent():
+    assert _findings(ExhaustiveRankBranchFlow,
+                     code="gang-divergent-write") == []
+
+
+class MatchStatementFlow(FlowSpec):
+    @step
+    def start(self):
+        self.kind = "a"
+        match self.kind:
+            case "a":
+                self.x = 1
+            case _:
+                self.x = 2
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.x)
+
+
+def test_match_statement_writes_are_seen():
+    assert _findings(MatchStatementFlow, severity="error") == []
+
+
+class CompScopeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [1, 2]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.y = self.input
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.ys = [i.y for i in inputs]
+        # reusing `i` over a plain iterable must NOT read join inputs
+        self.reals = [i.real for i in [type("T", (), {"real": 1})()]]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.ys, self.reals)
+
+
+def test_comprehension_target_scope_does_not_leak():
+    assert _findings(CompScopeFlow, severity="error") == []
+
+
+class EmptyIncludeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.a, self.b)
+
+    @step
+    def a(self):
+        self.v = 1
+        self.next(self.joiner)
+
+    @step
+    def b(self):
+        self.v = 2
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.merge_artifacts(inputs, include=[])  # merges NOTHING
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.v)  # still unreconciled: must be flagged
+
+
+def test_empty_include_is_not_merge_everything():
+    found = _findings(EmptyIncludeFlow, code="ambiguous-join-read")
+    assert [f.artifact for f in found] == ["v"], found
+
+
+class MergeIncludeMissingFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.a, self.b)
+
+    @step
+    def a(self):
+        self.n = 1
+        self.next(self.joiner)
+
+    @step
+    def b(self):
+        self.n = 2
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.n = max(i.n for i in inputs)
+        self.merge_artifacts(inputs, include=["nope"])  # MARK-include
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.n)
+
+
+def test_merge_include_missing():
+    found = _findings(MergeIncludeMissingFlow, code="merge-include-missing")
+    assert [f.artifact for f in found] == ["nope"], found
+    assert found[0].severity == "error"
+    assert found[0].lineno == _line_of(MergeIncludeMissingFlow,
+                                       "MARK-include")
+
+
+class MergeOutsideJoinFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.middle)
+
+    @step
+    def middle(self):
+        self.merge_artifacts([])  # not a join: raises at runtime
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_merge_outside_join():
+    found = _findings(MergeOutsideJoinFlow, code="merge-outside-join")
+    assert len(found) == 1 and found[0].step == "middle"
+
+
+class InputsMissingArtifactFlow(FlowSpec):
+    @step
+    def start(self):
+        self.items = [1, 2]
+        self.next(self.body, foreach="items")
+
+    @step
+    def body(self):
+        self.got = self.input
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.xs = [i.never_set for i in inputs]  # MARK-inputs
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.xs)
+
+
+def test_join_inputs_read_of_unset_artifact():
+    found = _findings(InputsMissingArtifactFlow, code="use-before-set")
+    assert [f.artifact for f in found] == ["never_set"], found
+    assert found[0].step == "joiner"
+    assert found[0].lineno == _line_of(InputsMissingArtifactFlow,
+                                       "MARK-inputs")
+
+
+class CatchVarFlow(FlowSpec):
+    @metaflow_tpu.catch(var="boom")
+    @step
+    def start(self):
+        if True:
+            raise RuntimeError("x")
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(getattr(self, "boom", None), self.boom)
+
+
+def test_catch_var_counts_as_write():
+    assert _findings(CatchVarFlow, severity="error") == []
+
+
+class SwitchRecursionFlow(FlowSpec):
+    @step
+    def start(self):
+        self.n = 0
+        self.next(self.work)
+
+    @step
+    def work(self):
+        self.n += 1
+        self.done = "yes" if self.n > 3 else "no"
+        self.next({"yes": self.end, "no": self.work}, condition="done")
+
+    @step
+    def end(self):
+        print(self.n)
+
+
+def test_recursive_switch_fixpoint_no_false_positive():
+    assert _findings(SwitchRecursionFlow, severity="error") == []
+
+
+class SetattrWildcardFlow(FlowSpec):
+    @step
+    def start(self):
+        for name in ("a", "b"):
+            setattr(self, name, 1)  # dynamic: analyzer must not guess
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.a, self.b)
+
+
+def test_dynamic_setattr_suppresses_reporting():
+    assert _findings(SetattrWildcardFlow, severity="error") == []
+
+
+class DelFlow(FlowSpec):
+    @step
+    def start(self):
+        self.tmp = 1
+        self.keep = self.tmp + 1
+        del self.tmp
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.tmp)  # deleted upstream
+
+
+def test_deleted_artifact_read_is_use_before_set():
+    found = _findings(DelFlow, code="use-before-set")
+    assert [f.artifact for f in found] == ["tmp"], found
+
+
+class HelperMethodFlow(FlowSpec):
+    def build_model(self):
+        self.model = "weights"
+        self.layers = self.depth * 2
+
+    def setup(self):
+        self.depth = 4
+        self.build_model()  # helper calling a helper
+
+    @step
+    def start(self):
+        self.setup()
+        self.next(self.train)
+
+    @step
+    def train(self):
+        print(self.model, self.layers, self.depth)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_helper_method_writes_are_seen():
+    assert _findings(HelperMethodFlow, severity="error") == []
+
+
+class ConditionalOverwriteFlow(FlowSpec):
+    @step
+    def start(self):
+        self.x = 1
+        self.flag = False
+        self.next(self.mid)
+
+    @step
+    def mid(self):
+        if self.flag:
+            self.x = 0  # conditional: start's value still live otherwise
+        print(self.x)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_conditional_overwrite_does_not_kill_liveness():
+    assert _findings(ConditionalOverwriteFlow, code="dead-artifact") == []
+
+
+class UnderscoreDelattrFlow(FlowSpec):
+    @step
+    def start(self):
+        delattr(self, "_scratch")  # internal: must NOT wildcard the step
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.never_set)
+
+
+def test_underscore_delattr_does_not_suppress_findings():
+    found = _findings(UnderscoreDelattrFlow, code="use-before-set")
+    assert [f.artifact for f in found] == ["never_set"], found
+
+
+class ZeroGangFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=0)
+
+    @step
+    def train(self):
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_literal_zero_num_parallel_is_invalid():
+    found = _findings(ZeroGangFlow, code="num-parallel-invalid")
+    assert len(found) == 1 and found[0].severity == "error"
+    assert "num_parallel=0" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# SPMD config checks
+# ---------------------------------------------------------------------------
+
+
+def test_check_logical_rules_flags_unknown_axis():
+    rules = {"embed": "fsdp", "mlp": "bogus", "batch": ("data", "fsdp")}
+    problems = check_logical_rules(rules, ("data", "fsdp"))
+    assert len(problems) == 1 and "bogus" in problems[0]
+    assert check_logical_rules(rules, ("data", "fsdp", "bogus")) == []
+
+
+def test_check_logical_rules_accepts_shipped_tables():
+    from metaflow_tpu.spmd.sharding import FSDP_RULES, FSDP_TP_RULES, MOE_RULES
+
+    assert check_logical_rules(FSDP_RULES, ("data", "fsdp")) == []
+    assert check_logical_rules(FSDP_TP_RULES,
+                               ("data", "fsdp", "tensor")) == []
+    assert check_logical_rules(
+        MOE_RULES, ("data", "fsdp", "expert", "tensor")) == []
+
+
+def test_check_mesh_axes():
+    assert check_mesh_axes({"fsdp": -1, "tensor": 4}) == []
+    problems = check_mesh_axes({"bogus": -1})
+    assert len(problems) == 1 and "bogus" in problems[0]
+    problems = check_mesh_axes({"fsdp": -1, "data": -1})
+    assert any("-1" in p for p in problems)
+    problems = check_mesh_axes({"fsdp": 0})
+    assert any("size" in p for p in problems)
+
+
+def test_check_mesh_devices():
+    assert check_mesh_devices({"fsdp": -1, "tensor": 4}, 8) == []
+    assert check_mesh_devices({"fsdp": 8}, 8) == []
+    assert check_mesh_devices({"fsdp": -1, "tensor": 3}, 8)
+    assert check_mesh_devices({"fsdp": 4}, 8)
+
+
+def test_check_pipeline():
+    assert check_pipeline(8, 4, num_microbatches=8, batch_size=32) == []
+    assert check_pipeline(9, 4)  # layers don't split evenly
+    assert check_pipeline(8, 4, num_microbatches=0)
+    assert check_pipeline(8, 4, num_microbatches=5, batch_size=32)
+
+
+class BadMeshFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
+
+        mesh = create_mesh(MeshSpec({"bogus": -1}))  # MARK-mesh
+        self.ok = mesh is not None
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.oks = [i.ok for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.oks)
+
+
+def test_mesh_axis_mismatch_flagged_in_step_body():
+    found = _findings(BadMeshFlow, code="mesh-axis-invalid")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert f.lineno == _line_of(BadMeshFlow, "MARK-mesh")
+    assert "bogus" in f.message
+
+
+class BadGangSizeFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=3)
+
+    @metaflow_tpu.tpu(topology="v5p-16")  # 2 hosts, not 3
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.ranks = [i.rank for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.ranks)
+
+
+def test_num_parallel_topology_mismatch():
+    found = _findings(BadGangSizeFlow, code="num-parallel-topology-mismatch")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert "num_parallel=3" in f.message and "2 host(s)" in f.message
+
+
+class UnknownTopologyFlow(BadGangSizeFlow):
+    @metaflow_tpu.tpu(topology="v9z-99")
+    @step
+    def train(self):
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+
+def test_unknown_topology_is_a_warning():
+    found = _findings(UnknownTopologyFlow, code="topology-unknown")
+    assert len(found) == 1 and found[0].severity == "warning"
+    assert _findings(UnknownTopologyFlow, severity="error") == []
+
+
+# ---------------------------------------------------------------------------
+# report plumbing: schema, CLI exit codes, strict gate
+# ---------------------------------------------------------------------------
+
+
+def test_report_dict_validates_against_pinned_schema():
+    for cls in (NeverSetFlow, AmbiguousJoinFlow, DeadArtifactFlow,
+                BadMeshFlow, SwitchRecursionFlow):
+        validate_check_report(analyze_flow(cls).to_dict())
+
+
+def test_check_deep_json_cli(run_flow, flows_dir):
+    out = run_flow(os.path.join(flows_dir, "branch_flow.py"),
+                   "check", "--deep", "--json")
+    report = json.loads(out.stdout)
+    validate_check_report(report)
+    assert report["ok"] is True
+    assert report["flow"] == "BranchFlow"
+    assert set(report["analyses"]) == {"lint", "artifact-dataflow",
+                                       "spmd-config"}
+    assert "join" in report["steps_analyzed"]
+    assert report["checks_run"] > 20
+
+
+_BAD_FLOW_SRC = '''
+from metaflow_tpu import FlowSpec, step
+
+class SeededBadFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.never_written)
+
+if __name__ == "__main__":
+    SeededBadFlow()
+'''
+
+
+def test_check_deep_exits_nonzero_on_error(run_flow, tmp_path):
+    bad = tmp_path / "seeded_bad_flow.py"
+    bad.write_text(_BAD_FLOW_SRC)
+    out = run_flow(str(bad), "check", "--deep", "--json", expect_fail=True)
+    assert out.returncode != 0
+    report = json.loads(out.stdout)
+    validate_check_report(report)
+    assert report["ok"] is False
+    assert [f["code"] for f in report["findings"]] == ["use-before-set"]
+    # shallow check must still pass: the graph SHAPE is fine
+    out = run_flow(str(bad), "check")
+    assert out.returncode == 0
+
+
+def test_strict_gate_blocks_run(run_flow, tmp_path):
+    bad = tmp_path / "seeded_bad_flow.py"
+    bad.write_text(_BAD_FLOW_SRC)
+    out = run_flow(str(bad), "run", expect_fail=True,
+                   env_extra={"TPUFLOW_STRICT_CHECK": "1"})
+    assert out.returncode != 0
+    combined = out.stdout + out.stderr
+    assert "use-before-set" in combined
+    # the gate fires BEFORE any task launches
+    assert "Workflow starting" not in combined
+
+
+def test_lenient_gate_warns(run_flow, tmp_path):
+    flow = tmp_path / "warned_flow.py"
+    flow.write_text(_BAD_FLOW_SRC.replace(
+        "print(self.never_written)",
+        "print(getattr(self, 'never_written', None))"))
+    out = run_flow(str(flow), "run")
+    assert out.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# self-check: every shipped flow must analyze clean (zero error findings)
+# ---------------------------------------------------------------------------
+
+
+def _shipped_flow_files():
+    return sorted(
+        glob.glob(os.path.join(REPO, "tests", "flows", "*.py"))
+        + glob.glob(os.path.join(REPO, "tutorials", "*", "*.py"))
+    )
+
+
+def _load_flow_classes(path):
+    name = "analysis_sweep_" + os.path.basename(path)[:-3]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except Exception:
+        return []  # e.g. optional deps; covered by that flow's own test
+    return [v for v in vars(mod).values()
+            if isinstance(v, type) and issubclass(v, FlowSpec)
+            and v is not FlowSpec and v.__module__ == spec.name]
+
+
+@pytest.mark.parametrize("path", _shipped_flow_files(),
+                         ids=lambda p: os.path.relpath(p, REPO))
+def test_shipped_flows_analyze_clean(path):
+    """Zero-false-positive gate: error findings on a shipped example are a
+    regression in the analyzer OR a genuine bug in the example — either
+    must fail fast."""
+    for cls in _load_flow_classes(path):
+        report = analyze_flow(cls)
+        errors = report.errors
+        assert errors == [], (
+            "analyzer reports errors on shipped flow %s: %s"
+            % (path, [f.render() for f in errors]))
+        validate_check_report(report.to_dict())

@@ -271,3 +271,162 @@ def test_gang_followed_by_non_join_named_check():
 
     msg = _lint_error(GangNoJoin)
     assert "join" in msg.lower()
+
+
+def test_gang_cannot_be_a_join():
+    """check_parallel_rules: a num_parallel target that takes `inputs`
+    would be a join and a gang at once."""
+
+    class GangJoin(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.j, num_parallel=2)
+
+        @step
+        def j(self, inputs):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    msg = _lint_error(GangJoin)
+    assert "join" in msg and "gang" in msg
+
+
+def test_gang_must_have_single_target():
+    """check_parallel_rules: num_parallel fans out to exactly one step."""
+
+    class TwoTargets(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.a, self.b, num_parallel=2)
+
+        @step
+        def a(self):
+            self.next(self.j)
+
+        @step
+        def b(self):
+            self.next(self.j)
+
+        @step
+        def j(self, inputs):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    msg = _lint_error(TwoTargets)
+    assert "exactly one" in msg
+
+
+def test_switch_case_to_unknown_step():
+    class BadCase(FlowSpec):
+        @step
+        def start(self):
+            self.next({"x": self.missing, "y": self.end},
+                      condition="flag")
+
+        @step
+        def end(self):
+            pass
+
+    msg = _lint_error(BadCase).lower()
+    assert "unknown" in msg or "transition" in msg
+
+
+def test_recursive_switch_is_legal():
+    """The iterative acyclicity check must still allow back-edges whose
+    cycle passes through a switch (recursive-switch flows)."""
+
+    class Recursive(FlowSpec):
+        @step
+        def start(self):
+            self.n = 0
+            self.next(self.work)
+
+        @step
+        def work(self):
+            self.n += 1
+            self.verdict = "stop" if self.n > 2 else "again"
+            self.next(self.check)
+
+        @step
+        def check(self):
+            self.next({"again": self.work, "stop": self.end},
+                      condition="verdict")
+
+        @step
+        def end(self):
+            pass
+
+    lint(FlowGraph(Recursive))  # must not raise
+
+
+def test_lint_warn_keeps_structured_location():
+    """LintWarn must expose machine-readable lineno/source_file (consumed
+    by `check --json` and editors), not just format them into the
+    message."""
+
+    class BadName(FlowSpec):
+        @step
+        def start(self):
+            self.next(self.next_)
+
+        @step
+        def next_(self):
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    # rename to a reserved word post-hoc to hit check_reserved_words
+    graph = FlowGraph(BadName)
+    node = graph["next_"]
+    node.name = "next"
+    graph.nodes["next"] = node
+    with pytest.raises(LintWarn) as exc:
+        lint(graph)
+    err = exc.value
+    assert err.lineno == node.func_lineno
+    assert err.source_file == node.source_file
+    assert err.source_file.endswith("test_lint.py")
+    # the human-readable message still embeds file:line
+    assert "%s:%d" % (err.source_file, err.lineno) in str(err)
+
+
+def test_deep_generated_graph_does_not_recurse(tmp_path):
+    """check_for_acyclicity / check_split_join_balance (and graph
+    traversal) are iterative: a generated 600-step linear flow must lint
+    fine even under a recursion limit far below the graph depth."""
+    import importlib.util
+    import sys
+
+    n = 600
+    lines = ["from metaflow_tpu import FlowSpec, step", "",
+             "class DeepFlow(FlowSpec):"]
+    names = ["start"] + ["s%d" % i for i in range(n)] + ["end"]
+    for cur, nxt in zip(names, names[1:]):
+        lines += ["    @step",
+                  "    def %s(self):" % cur,
+                  "        self.next(self.%s)" % nxt,
+                  ""]
+    lines += ["    @step", "    def end(self):", "        pass", ""]
+    path = tmp_path / "deep_flow.py"
+    path.write_text("\n".join(lines))
+    spec = importlib.util.spec_from_file_location("deep_flow", str(path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+
+    limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(200)
+    try:
+        graph = FlowGraph(mod.DeepFlow)
+        lint(graph)  # must not raise RecursionError (or anything)
+    finally:
+        sys.setrecursionlimit(limit)
+    assert len(graph.nodes) == n + 2
