@@ -407,6 +407,59 @@ def metrics(flow_run, run_id, datastore, datastore_root, as_json,
                  spans=spans, echo=click.echo)
 
 
+@main.command(
+    help="Serve a trained run's checkpoint over HTTP with the "
+         "continuous-batching engine: `serve FLOW/RUN_ID` (or `serve "
+         "FLOW` for the newest successful run). Slot-based KV cache, "
+         "per-request admission/eviction, streamed token output, "
+         "graceful SIGTERM drain — docs/serving.md.")
+@click.argument("flow_run")
+@click.argument("run_id", required=False)
+@click.option("--step-name", default=None,
+              help="The @checkpoint step (auto-detected when unique).")
+@click.option("--ckpt-step", default=None, type=int,
+              help="Which saved step to serve (default: latest).")
+@click.option("--params-key", default="params",
+              help="Key of the weight pytree inside the checkpoint.")
+@click.option("--config-json", default=None,
+              help="Model config as a JSON file or inline object "
+                   "(default: the checkpoint's 'cfg' entry).")
+@click.option("--model", default="llama",
+              type=click.Choice(["llama", "mixtral"]),
+              help="Model family of the checkpoint.")
+@click.option("--host", default="127.0.0.1")
+@click.option("--port", default=8000, type=int)
+@click.option("--slots", default=8, type=int,
+              help="Concurrent sequences (KV-cache pool size).")
+@click.option("--max-seq-len", default=None, type=int,
+              help="KV-cache depth per slot (default: config max).")
+@click.option("--prefill-chunk", default=64, type=int,
+              help="Prompt tokens prefilled per chunk.")
+@click.option("--max-queue", default=64, type=int,
+              help="Queued requests before 429 backpressure.")
+@click.option("--mesh", "mesh_spec", default=None,
+              type=click.Choice(["dp", "fsdp", "fsdp_tp"]),
+              help="Shard params over a device mesh (training rules).")
+@click.option("--attn-impl", default="auto",
+              type=click.Choice(["auto", "dense", "chunked"]))
+def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
+          model, host, port, slots, max_seq_len, prefill_chunk, max_queue,
+          mesh_spec, attn_impl):
+    from .cmd.serve import serve as serve_impl
+    from .exception import TpuFlowException
+
+    try:
+        serve_impl(flow_run, run_id=run_id, step_name=step_name,
+                   ckpt_step=ckpt_step, params_key=params_key,
+                   config_json=config_json, model=model, host=host,
+                   port=port, slots=slots, max_seq_len=max_seq_len,
+                   prefill_chunk=prefill_chunk, max_queue=max_queue,
+                   mesh_spec=mesh_spec, attn_impl=attn_impl,
+                   echo=click.echo)
+    except TpuFlowException as ex:
+        raise click.ClickException(str(ex))
+
+
 @main.group(help="Local full-stack dev harness: fake GCS + metadata "
                  "service (the reference's metaflow-dev, containerless).")
 def devstack():

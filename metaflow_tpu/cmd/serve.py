@@ -1,0 +1,178 @@
+"""`tpuflow serve FLOW/RUN`: serve a trained run's checkpoint over HTTP.
+
+train -> checkpoint -> serve in one framework: the checkpoint comes off
+the run's datastore through inference/loading.load_run_checkpoint, the
+mesh/sharding reuses the training rule table (spmd/sharding.py), and the
+continuous-batching engine + scheduler + HTTP server come from
+metaflow_tpu/serving/. Telemetry lands in the SERVED run's
+`_telemetry/` prefix (step `_serve`), so `tpuflow metrics FLOW/RUN`
+shows serving TTFT/latency/occupancy next to the run's training
+records.
+"""
+
+import json
+import os
+
+from ..exception import TpuFlowException
+
+
+def build_config(restored, config_json=None, model="llama"):
+    """Resolve the model config for a restored checkpoint pytree.
+
+    Priority: --config-json (a file path or inline JSON object of
+    LlamaConfig/MixtralConfig field overrides) > a 'cfg'/'config' dict
+    the checkpoint itself carries. The named `model` family supplies the
+    dataclass."""
+    if model == "mixtral":
+        from ..models.mixtral import MixtralConfig as config_cls
+    elif model == "llama":
+        from ..models.llama import LlamaConfig as config_cls
+    else:
+        raise TpuFlowException("unknown model family %r" % (model,))
+    fields = None
+    if config_json:
+        if os.path.exists(config_json):
+            with open(config_json) as f:
+                fields = json.load(f)
+        else:
+            try:
+                fields = json.loads(config_json)
+            except ValueError:
+                raise TpuFlowException(
+                    "--config-json is neither a file nor valid JSON: %r"
+                    % (config_json,))
+    elif isinstance(restored, dict):
+        for key in ("cfg", "config"):
+            if isinstance(restored.get(key), dict):
+                fields = dict(restored[key])
+                break
+    if fields is None:
+        raise TpuFlowException(
+            "no model config: pass --config-json (LlamaConfig fields as "
+            "JSON) or checkpoint a 'cfg' dict next to the params")
+    if not isinstance(fields, dict):
+        raise TpuFlowException("model config must be a JSON object")
+    known = {f.name for f in config_cls.__dataclass_fields__.values()}
+    unknown = sorted(set(fields) - known)
+    if unknown:
+        raise TpuFlowException(
+            "unknown %s field(s): %s" % (config_cls.__name__,
+                                         ", ".join(unknown)))
+    return config_cls(**fields)
+
+
+def extract_params(restored, params_key="params"):
+    """The weight pytree inside a checkpoint: restored[params_key] when
+    present, else the whole tree (a bare-params checkpoint)."""
+    if isinstance(restored, dict) and params_key in restored:
+        return restored[params_key]
+    return restored
+
+
+def build_engine(params, cfg, slots=8, max_seq_len=None, prefill_chunk=64,
+                 mesh_spec=None, attn_impl="auto"):
+    """Shard params over a mesh (the training rule table) and build the
+    slot engine. mesh_spec: None, or a MeshSpec factory name
+    ('dp'|'fsdp'|'fsdp_tp')."""
+    from ..serving import SlotEngine
+
+    mesh = None
+    if mesh_spec:
+        import jax
+
+        from ..spmd import MeshSpec, create_mesh, shard_tree
+
+        factory = getattr(MeshSpec, mesh_spec, None)
+        if factory is None:
+            raise TpuFlowException(
+                "unknown mesh spec %r (want dp, fsdp or fsdp_tp)"
+                % (mesh_spec,))
+        mesh = create_mesh(factory() if mesh_spec != "fsdp_tp"
+                           else factory(min(2, len(jax.devices()))))
+        # the rule tree must come from the checkpoint's model family: a
+        # Mixtral tree has router/expert axes the Llama table lacks
+        from ..models import llama as llama_mod
+        from ..models import mixtral as mixtral_mod
+
+        model_mod = (mixtral_mod
+                     if isinstance(cfg, mixtral_mod.MixtralConfig)
+                     else llama_mod)
+        params = shard_tree(params, model_mod.logical_axes(cfg), mesh)
+    return SlotEngine(params, cfg, max_slots=slots,
+                      max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+                      mesh=mesh, attn_impl=attn_impl)
+
+
+def _init_serve_telemetry(flow_name, run_id):
+    """Record serving telemetry into the served run's datastore under a
+    synthetic `_serve` step, riding the existing FlightRecorder."""
+    from .. import telemetry
+    from .. import metaflow_config as cfg
+    from ..datastore import STORAGE_BACKENDS, FlowDataStore
+
+    if not telemetry.enabled():
+        return None
+    try:
+        storage = STORAGE_BACKENDS[cfg.default_datastore()]
+        fds = FlowDataStore(flow_name, storage)
+        return telemetry.init_recorder(fds, run_id, "_serve",
+                                       "server-%d" % os.getpid())
+    except Exception:
+        return None  # serving must come up even if telemetry cannot
+
+
+def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
+          params_key="params", config_json=None, model="llama",
+          host="127.0.0.1", port=8000, slots=8, max_seq_len=None,
+          prefill_chunk=64, max_queue=64, mesh_spec=None,
+          attn_impl="auto", echo=print, block=True):
+    """Load FLOW/RUN's checkpoint and serve it. Returns the running
+    ServingServer when block=False (tests); otherwise serves until
+    SIGTERM/SIGINT, draining in-flight requests before exit."""
+    from .. import telemetry
+    from ..inference import load_run_checkpoint
+    from ..serving import Scheduler, ServingServer
+
+    if run_id is None:
+        flow_name, _, run_id = flow_run.rpartition("/")
+        if not flow_name:
+            flow_name, run_id = flow_run, None
+    else:
+        flow_name = flow_run
+
+    if run_id is None:
+        # resolve the run HERE (not only inside load_run_checkpoint) so
+        # telemetry lands under the real run id, next to its training
+        # records — never under a synthetic label
+        from ..inference.loading import _latest_successful_run_id
+
+        run_id = _latest_successful_run_id(flow_name, None)
+        if run_id is None:
+            raise TpuFlowException(
+                "No successful run of %s to serve." % flow_name)
+    restored = load_run_checkpoint(flow_name, run_id=run_id,
+                                   step_name=step_name,
+                                   ckpt_step=ckpt_step)
+    cfg = build_config(restored, config_json=config_json, model=model)
+    params = extract_params(restored, params_key=params_key)
+    engine = build_engine(params, cfg, slots=slots,
+                          max_seq_len=max_seq_len,
+                          prefill_chunk=prefill_chunk,
+                          mesh_spec=mesh_spec, attn_impl=attn_impl)
+    _init_serve_telemetry(flow_name, run_id)
+    scheduler = Scheduler(engine, max_queue=max_queue)
+    server = ServingServer(scheduler, host=host, port=port)
+    echo("serving %s/%s on http://%s:%d  (%d slots x %d positions, "
+         "attn=%s)" % (flow_name, run_id, server.host,
+                       server.port, engine.max_slots, engine.max_seq_len,
+                       engine.attn_impl))
+    echo("  POST /v1/generate  {\"tokens\": [...], \"max_new_tokens\": N,"
+         " \"stream\": true}")
+    if not block:
+        server.start()
+        return server
+    try:
+        server.serve_forever()
+    finally:
+        telemetry.close_recorder()
+    echo("drained — all in-flight requests finished")

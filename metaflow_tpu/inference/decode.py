@@ -21,6 +21,7 @@ per-step all-gathers on ICI.
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -39,10 +40,32 @@ def init_kv_cache(cfg, batch_size, max_seq_len, dtype=None):
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def _query_positions(pos, T):
+    """Absolute query positions for T new tokens at offset `pos`.
+
+    pos is either a traced SCALAR (the whole batch decodes in lockstep —
+    generate()) or a traced [B] VECTOR (every batch row sits at its own
+    offset — the continuous-batching slot engine). Returns [T] or [B, T];
+    both shapes flow through apply_rope and the attention masks."""
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        return pos + jnp.arange(T)
+    return pos[:, None] + jnp.arange(T)[None, :]
+
+
+def _mask_positions(q_positions):
+    """[T] or [B, T] query positions -> broadcastable [*, 1, T, 1] for the
+    [B, H, T, S] logits layout."""
+    if q_positions.ndim == 1:
+        return q_positions[None, None, :, None]
+    return q_positions[:, None, :, None]
+
+
 def _cached_attention(q, cache_k, cache_v, pos):
     """q: [B, T, H, Hd] at absolute positions pos..pos+T-1; cache_k/v:
     [B, Smax, KV, Hd]. Keys at index i are visible to query t iff
     i <= pos + t (unfilled cache slots fall outside by construction).
+    pos: traced scalar, or [B] vector for per-slot offsets.
 
     Dense: touches the WHOLE [Smax] cache every step — fine at moderate
     max_seq, bandwidth-bound for long-context serving (use 'chunked')."""
@@ -53,13 +76,23 @@ def _cached_attention(q, cache_k, cache_v, pos):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     key_idx = jnp.arange(k.shape[1])[None, None, None, :]
-    q_pos = (pos + jnp.arange(T))[None, None, :, None]
+    q_pos = _mask_positions(_query_positions(pos, T))
     logits = jnp.where(key_idx <= q_pos, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-DECODE_CHUNK = 256
+def _default_decode_chunk():
+    try:
+        return max(1, int(os.environ.get("TPUFLOW_DECODE_CHUNK", "256")))
+    except ValueError:
+        return 256
+
+
+# KV-chunk size of the flash-decode path, and the pivot of the
+# attn_impl="auto" switchover (see generate()). Override with
+# TPUFLOW_DECODE_CHUNK=<n> (read once at import).
+DECODE_CHUNK = _default_decode_chunk()
 
 
 def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
@@ -77,7 +110,10 @@ def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
     chunk = min(chunk, Smax)
     scale = 1.0 / math.sqrt(Hd)
     qf = q.astype(jnp.float32)
-    n_chunks = (pos + T + chunk - 1) // chunk  # traced
+    # traced trip count; with per-slot [B] positions the loop runs to the
+    # DEEPEST slot's fill (shallower slots just mask the extra chunks)
+    n_chunks = (jnp.max(jnp.asarray(pos)) + T + chunk - 1) // chunk
+    q_pos = _mask_positions(_query_positions(pos, T))
 
     def body(i, carry):
         m, l, acc = carry
@@ -89,7 +125,6 @@ def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             k_blk.astype(jnp.float32)) * scale
         key_idx = (start + jnp.arange(chunk))[None, None, None, :]
-        q_pos = (pos + jnp.arange(T))[None, None, :, None]
         visible = (key_idx <= q_pos) & (key_idx >= i * chunk)
         logits = jnp.where(visible, logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -121,14 +156,23 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
     q = (h @ lp["wq"]).reshape(B, T, H, Hd)
     k = (h @ lp["wk"]).reshape(B, T, KV, Hd)
     v = (h @ lp["wv"]).reshape(B, T, KV, Hd)
-    positions = pos + jnp.arange(T)
+    positions = _query_positions(pos, T)
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
 
-    cache_k = jax.lax.dynamic_update_slice_in_dim(
-        cache_k, k.astype(cache_k.dtype), pos, axis=1)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(
-        cache_v, v.astype(cache_v.dtype), pos, axis=1)
+    if jnp.ndim(pos) == 0:
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, k.astype(cache_k.dtype), pos, axis=1)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, v.astype(cache_v.dtype), pos, axis=1)
+    else:
+        # per-slot offsets: every batch row writes its T new positions at
+        # its OWN cursor (lowered to a batched scatter)
+        _write = jax.vmap(
+            lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(
+                c, u, p, axis=0))
+        cache_k = _write(cache_k, k.astype(cache_k.dtype), pos)
+        cache_v = _write(cache_v, v.astype(cache_v.dtype), pos)
 
     if attn_impl == "chunked":
         attn = _chunked_cached_attention(q, cache_k, cache_v, pos)
@@ -164,8 +208,10 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
 def decode_forward(params, tokens, cache, pos, cfg, mesh=None,
                    attn_impl="dense"):
     """Forward over T new tokens at absolute position `pos` (a traced
-    scalar), reading and extending the cache. Works for any model in the
-    Llama family layout (Llama dense FFN, Mixtral MoE FFN).
+    scalar, or a traced [B] vector when every batch row decodes at its
+    own offset — the continuous-batching engine), reading and extending
+    the cache. Works for any model in the Llama family layout (Llama
+    dense FFN, Mixtral MoE FFN).
 
     tokens: [B, T] (T static: the prompt length for prefill, 1 per decode
     step). Returns (logits [B, T, vocab] fp32, updated cache)."""
@@ -220,7 +266,7 @@ def _sample(logits, temperature, rng, top_k=None, top_p=None):
 
 def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
              rng=None, eos_id=None, max_seq_len=None, mesh=None,
-             attn_impl="auto", top_k=None, top_p=None):
+             attn_impl="auto", top_k=None, top_p=None, prompt_len=None):
     """Generate max_new_tokens continuations of prompt_tokens [B, P].
 
     Pure jax (jit-friendly; max_new_tokens/temperature/eos_id/top_k/
@@ -230,8 +276,23 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
 
     attn_impl: 'dense' (whole-cache masked attention), 'chunked'
     (flash-decode: online softmax over only the filled prefix — the
-    long-context serving path), or 'auto' (chunked once the cache is
-    larger than 2 chunks).
+    long-context serving path), or 'auto'. The auto switchover picks
+    'chunked' once the KV cache is deeper than 2 * DECODE_CHUNK
+    positions (512 with the default chunk of 256): below that the whole
+    cache fits in two chunks and the dense einsum's single pass beats
+    the online-softmax loop's overhead; above it the chunked path's
+    O(filled) HBM traffic wins. DECODE_CHUNK — and therefore this
+    threshold — is overridable via TPUFLOW_DECODE_CHUNK (read once at
+    import).
+
+    prompt_len: None when prompt_tokens is exactly the prompt. A TRACED
+    scalar when prompt_tokens is right-PADDED to a longer static shape
+    (the pad-to-bucket serving path): prefill runs over the padded
+    length, the first token samples from the logits at prompt_len - 1,
+    and decode starts writing at prompt_len — causal masking keeps the
+    pad positions invisible until they are overwritten, so the output is
+    token-identical to the unpadded call. Positions [prompt_len, P) of
+    the returned array still hold the pad ids (callers slice them out).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
@@ -256,8 +317,15 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
 
     logits, cache = decode_forward(params, prompt_tokens, cache, 0, cfg,
                                    mesh=mesh, attn_impl=attn_impl)
+    if prompt_len is None:
+        last = logits[:, -1]
+        start_pos = jnp.int32(P)
+    else:
+        start_pos = jnp.asarray(prompt_len, jnp.int32)
+        last = jax.lax.dynamic_index_in_dim(logits, start_pos - 1, axis=1,
+                                            keepdims=False)
     rng, step_rng = jax.random.split(rng)
-    tok = _sample(logits[:, -1], temperature, step_rng, top_k, top_p)
+    tok = _sample(last, temperature, step_rng, top_k, top_p)
     done = (tok == eos_id) if eos_id is not None else None
 
     def step(carry, step_rng):
@@ -272,7 +340,7 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
 
     if max_new_tokens > 1:
         (cache, _, _, _), rest = jax.lax.scan(
-            step, (cache, tok, jnp.int32(P), done),
+            step, (cache, tok, start_pos, done),
             jax.random.split(rng, max_new_tokens - 1),
         )
         new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
@@ -282,17 +350,73 @@ def generate(params, prompt_tokens, cfg, max_new_tokens, temperature=0.0,
                            axis=1)
 
 
+def bucket_length(n, minimum=16, maximum=None):
+    """The smallest power-of-two >= n, floored at `minimum` — the shared
+    prompt-length bucketing policy of make_generator and the serving
+    engine, so both compile once per bucket instead of once per distinct
+    prompt length. `maximum` (e.g. the KV-cache depth) caps the bucket;
+    n must still fit."""
+    if n < 0:
+        raise ValueError("length must be >= 0, got %d" % n)
+    b = max(1, int(minimum))
+    while b < n:
+        b *= 2
+    if maximum is not None:
+        b = min(b, int(maximum))
+        if b < n:
+            raise ValueError(
+                "prompt length %d exceeds the bucket cap %d" % (n, maximum))
+    return b
+
+
+def pad_to_bucket(tokens, bucket=None, pad_id=0, minimum=16):
+    """Right-pad [B, P] prompt tokens to `bucket` (default: the
+    power-of-two bucket of P). Returns (padded [B, bucket], P)."""
+    tokens = jnp.asarray(tokens)
+    B, P = tokens.shape
+    if bucket is None:
+        bucket = bucket_length(P, minimum=minimum)
+    if bucket < P:
+        raise ValueError("bucket %d < prompt length %d" % (bucket, P))
+    if bucket == P:
+        return tokens, P
+    pad = jnp.full((B, bucket - P), pad_id, tokens.dtype)
+    return jnp.concatenate([tokens, pad], axis=1), P
+
+
 def make_generator(cfg, max_new_tokens, temperature=0.0, eos_id=None,
                    max_seq_len=None, attn_impl="auto", top_k=None,
-                   top_p=None):
+                   top_p=None, pad_id=0, min_bucket=16):
     """A jitted (params, prompt_tokens, rng) -> tokens generator with the
-    static knobs baked in — compile once, serve many."""
+    static knobs baked in — compile once per prompt-length BUCKET, serve
+    many.
+
+    Prompts are right-padded to power-of-two buckets (bucket_length, >=
+    min_bucket) and the true length rides along as a traced scalar, so
+    serving traffic with arbitrary prompt lengths triggers one compile
+    per (batch, bucket) instead of the silent recompile-per-length the
+    naive jit had. Outputs are token-identical to generate() on the
+    unpadded prompt. `gen.cache_size()` exposes the underlying jit cache
+    entry count (== compiles) for tests and capacity planning."""
 
     @functools.partial(jax.jit, static_argnames=())
-    def run(params, prompt_tokens, rng):
-        return generate(params, prompt_tokens, cfg, max_new_tokens,
+    def run(params, padded_prompt, prompt_len, rng):
+        return generate(params, padded_prompt, cfg, max_new_tokens,
                         temperature=temperature, rng=rng, eos_id=eos_id,
                         max_seq_len=max_seq_len, attn_impl=attn_impl,
-                        top_k=top_k, top_p=top_p)
+                        top_k=top_k, top_p=top_p, prompt_len=prompt_len)
 
-    return run
+    def gen(params, prompt_tokens, rng):
+        prompt_tokens = jnp.asarray(prompt_tokens)
+        B, P = prompt_tokens.shape
+        cap = max_seq_len - max_new_tokens if max_seq_len else None
+        bucket = bucket_length(P, minimum=min_bucket, maximum=cap)
+        padded, _ = pad_to_bucket(prompt_tokens, bucket, pad_id=pad_id)
+        out = run(params, padded, jnp.int32(P), rng)
+        if bucket == P:
+            return out
+        # drop the pad gap: [prompt | pad | new] -> [prompt | new]
+        return jnp.concatenate([out[:, :P], out[:, bucket:]], axis=1)
+
+    gen.cache_size = run._cache_size
+    return gen

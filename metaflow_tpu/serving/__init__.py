@@ -1,0 +1,22 @@
+"""Continuous-batching serving engine: slot-based KV cache, request
+scheduler, HTTP API. See docs/serving.md."""
+
+from .engine import SlotEngine, request_step_keys, sample_slots
+from .scheduler import (
+    DrainingError,
+    QueueFullError,
+    Request,
+    Scheduler,
+)
+from .server import ServingServer
+
+__all__ = [
+    "SlotEngine",
+    "request_step_keys",
+    "sample_slots",
+    "Request",
+    "Scheduler",
+    "QueueFullError",
+    "DrainingError",
+    "ServingServer",
+]

@@ -1,0 +1,380 @@
+"""Slot-based continuous-batching decode engine.
+
+The lockstep generate() path compiles ONE program per (batch, prompt
+bucket) and forces every sequence in a batch to start and finish
+together — real traffic with mixed prompt/output lengths leaves most of
+the MXU idle padding to the slowest request. This engine is the
+Orca/vLLM-lineage fix, shaped for TPUs: scheduling happens in Python,
+but every device step is one of a FIXED set of jitted programs, so the
+compiled-program residency that TPUs reward is preserved.
+
+Layout: a pool of B slots shares one static
+[layers, B, max_seq, kv_heads, head_dim] KV cache. Each slot holds at
+most one in-flight request and carries host-side state (pos, sampling
+knobs, per-token rng keys). Three compiled programs cover everything:
+
+  - prefill: write one PROMPT CHUNK of one slot into the cache
+    (single-slot cache view via dynamic_slice on the batch axis; chunk
+    padded to a power-of-two bucket, so compiles are bounded by
+    log2(prefill_chunk) regardless of prompt-length diversity)
+  - decode: advance ALL slots one token in one fused call — per-slot
+    positions (vector-pos decode_forward), per-slot dynamic_update_slice
+    cache writes, per-slot slot-masked sampling (greedy/temperature/
+    top-k/top-p as traced per-slot arrays, so one program serves every
+    sampling-config mix)
+  - first-token: sample the token the final prefill chunk's logits imply
+
+Slots never wait for each other: a finished slot is released and can be
+refilled while its neighbors keep decoding. Free/prefilling slots ride
+through the fused decode step as masked lanes — their writes land at
+their own cursor and are overwritten (prefill rewrites the range, decode
+overwrites pad garbage exactly one position before it would become
+visible), so no flag tensor is needed inside the compiled program.
+
+Token identity with generate(): same forward, same sampling ops (the
+per-slot sampler reproduces decode._sample row-for-row), same rng policy
+(request_step_keys mirrors generate's split sequence), so a request
+served through the engine emits exactly the tokens the lockstep path
+would give it alone — greedy case bit-exact (pinned by
+tests/test_serving.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.decode import (
+    DECODE_CHUNK,
+    bucket_length,
+    decode_forward,
+    init_kv_cache,
+)
+from ..ops.attention import NEG_INF
+
+
+def request_step_keys(rng, max_new_tokens):
+    """The per-token rng keys generate() would use: the first token
+    samples with split(rng)[1], tokens 1..n-1 with
+    split(split(rng)[0], n-1). Returns [max_new_tokens, 2] uint32."""
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    rng, first = jax.random.split(rng)
+    if max_new_tokens > 1:
+        rest = jax.random.split(rng, max_new_tokens - 1)
+        return np.concatenate(
+            [np.asarray(first)[None], np.asarray(rest)], axis=0)
+    return np.asarray(first)[None]
+
+
+def sample_slots(logits, keys, temperature, top_k, top_p):
+    """Per-slot sampling: [B, vocab] fp32 logits -> [B] int32, with
+    TRACED per-slot knobs (temperature[B], top_k[B] int32 — vocab size
+    disables, top_p[B] — 1.0 disables, keys[B, 2] uint32).
+
+    Row-for-row identical to decode._sample with the same scalar knobs:
+    same filter order (temperature scale, top_k, exclusive-mass top_p),
+    same tie handling, and vmap'd categorical over per-slot keys matches
+    the single-key batch-of-one call bit-for-bit."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    is_greedy = temperature <= 0.0
+    safe_t = jnp.where(is_greedy, 1.0, temperature)
+    lt = logits / safe_t[:, None]
+    k = jnp.clip(top_k, 1, V)
+    sorted_desc = -jnp.sort(-lt, axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    lt = jnp.where((k < V)[:, None] & (lt < kth), NEG_INF, lt)
+    order = jnp.argsort(-lt, axis=-1)
+    sorted_logits = jnp.take_along_axis(lt, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # EXCLUSIVE cumulative mass (decode._sample): the top token survives
+    before = jnp.cumsum(probs, axis=-1) - probs
+    drop_sorted = before >= top_p[:, None]
+    drop = jnp.zeros_like(drop_sorted).at[
+        jnp.arange(B)[:, None], order].set(drop_sorted)
+    lt = jnp.where((top_p < 1.0)[:, None] & drop, NEG_INF, lt)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(keys, lt)
+    return jnp.where(is_greedy, greedy, sampled.astype(jnp.int32))
+
+
+class SlotEngine(object):
+    """Fixed pool of decode slots over one shared static KV cache.
+
+    Host-side bookkeeping (which slot holds which request, positions,
+    sampling knobs) lives in numpy arrays; device work goes through the
+    three jitted programs described in the module docstring. The engine
+    is NOT thread-safe — exactly one scheduler loop drives it.
+    """
+
+    def __init__(self, params, cfg, max_slots=8, max_seq_len=None,
+                 prefill_chunk=64, mesh=None, attn_impl="auto",
+                 cache_dtype=None, pad_id=0, min_bucket=16):
+        if attn_impl not in ("auto", "dense", "chunked"):
+            raise ValueError("attn_impl must be 'auto', 'dense' or "
+                             "'chunked', got %r" % (attn_impl,))
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len or cfg.max_seq_len)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if self.prefill_chunk < 1:
+            # a 0-chunk engine would admit requests and never prefill
+            # them: the scheduler loop idles forever with full slots
+            raise ValueError("prefill_chunk must be >= 1, got %d"
+                             % self.prefill_chunk)
+        self.pad_id = int(pad_id)
+        self.min_bucket = min(int(min_bucket), self.prefill_chunk)
+        self.mesh = mesh
+        if attn_impl == "auto":
+            attn_impl = ("chunked" if self.max_seq_len > 2 * DECODE_CHUNK
+                         else "dense")
+        self.attn_impl = attn_impl
+        self._vocab = cfg.vocab_size
+
+        self._cache = init_kv_cache(cfg, self.max_slots, self.max_seq_len,
+                                    dtype=cache_dtype)
+        B = self.max_slots
+        # host-side per-slot state
+        self.pos = np.zeros(B, np.int32)          # next cache write index
+        self.active = np.zeros(B, bool)           # slot holds a request
+        self.decoding = np.zeros(B, bool)         # past prefill
+        self._tok = np.zeros(B, np.int32)         # last emitted token
+        self._temp = np.zeros(B, np.float32)
+        self._top_k = np.full(B, self._vocab, np.int32)
+        self._top_p = np.ones(B, np.float32)
+        self._keys = np.zeros((B, 2), np.uint32)  # current step key
+        self._step_keys = [None] * B              # [max_new, 2] per slot
+        self._key_cursor = np.zeros(B, np.int32)
+        self._prompt = [None] * B                 # remaining host prompt
+        self._prefill_cursor = np.zeros(B, np.int32)
+        # device mirrors of the decode-step inputs: steady-state decode
+        # re-uploads NOTHING (the jitted step advances tok/pos on device);
+        # slot membership or sampling-knob changes set _dirty and the
+        # next step re-stages from the host arrays above
+        self._dirty = True
+        self._d_tok = self._d_pos = self._d_mask = None
+        self._d_temp = self._d_top_k = self._d_top_p = None
+
+        def _prefill(params, cache, chunk_tokens, slot, start):
+            sub = {
+                name: jax.lax.dynamic_slice_in_dim(arr, slot, 1, axis=1)
+                for name, arr in cache.items()
+            }
+            logits, sub = decode_forward(
+                params, chunk_tokens, sub, start, cfg, mesh=mesh,
+                attn_impl=self.attn_impl)
+            cache = {
+                name: jax.lax.dynamic_update_slice_in_dim(
+                    cache[name], sub[name], slot, axis=1)
+                for name in cache
+            }
+            return logits, cache
+
+        def _advance(nxt, tok, pos, mask):
+            # decoding lanes take the new token and move their cursor;
+            # masked lanes (free / mid-prefill) hold still — the SAME
+            # update runs on the host mirrors, so no download is needed
+            tok = jnp.where(mask, nxt, tok)
+            pos = pos + mask.astype(jnp.int32)
+            return tok, pos
+
+        def _decode_sampled(params, cache, tok, pos, mask, keys, temp,
+                            top_k, top_p):
+            logits, cache = decode_forward(
+                params, tok[:, None], cache, pos, cfg, mesh=mesh,
+                attn_impl=self.attn_impl)
+            nxt = sample_slots(logits[:, 0], keys, temp, top_k, top_p)
+            tok, pos = _advance(nxt, tok, pos, mask)
+            return nxt, tok, pos, cache
+
+        def _decode_greedy(params, cache, tok, pos, mask):
+            # static fast path when every active slot is greedy: the full
+            # per-slot sampler (two sorts + scatter per step) costs ~2x a
+            # tiny forward on CPU; greedy traffic must not pay it
+            logits, cache = decode_forward(
+                params, tok[:, None], cache, pos, cfg, mesh=mesh,
+                attn_impl=self.attn_impl)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            tok, pos = _advance(nxt, tok, pos, mask)
+            return nxt, tok, pos, cache
+
+        def _first_token(logits, idx, key, temp, top_k, top_p):
+            last = jax.lax.dynamic_index_in_dim(logits, idx, axis=1,
+                                                keepdims=False)
+            return sample_slots(last, key[None], temp[None], top_k[None],
+                                top_p[None])[0]
+
+        # the cache is donated: the pool's KV state is the single largest
+        # buffer and every call replaces it wholesale
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
+        self._decode_sampled_fn = jax.jit(_decode_sampled,
+                                          donate_argnums=(1,))
+        self._decode_greedy_fn = jax.jit(_decode_greedy,
+                                         donate_argnums=(1,))
+        self._first_fn = jax.jit(_first_token)
+
+    # ---------- pool state ----------
+
+    def free_slots(self):
+        return [i for i in range(self.max_slots) if not self.active[i]]
+
+    def occupancy(self):
+        return float(self.active.sum()) / self.max_slots
+
+    def compile_counts(self):
+        """jit cache entries per program — each decode variant must stay
+        at <= 1, prefill at <= number of chunk buckets."""
+        return {
+            "prefill": self._prefill_fn._cache_size(),
+            "decode_greedy": self._decode_greedy_fn._cache_size(),
+            "decode_sampled": self._decode_sampled_fn._cache_size(),
+            "first_token": self._first_fn._cache_size(),
+        }
+
+    # ---------- slot lifecycle ----------
+
+    def admit(self, slot, prompt_tokens, max_new_tokens, temperature=0.0,
+              top_k=None, top_p=None, rng=0):
+        """Bind a request to a free slot; prefill starts on the next
+        prefill_step calls. prompt_tokens: 1-D int sequence."""
+        if self.active[slot]:
+            raise ValueError("slot %d is busy" % slot)
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                "prompt (%d) + max_new_tokens (%d) exceeds the engine's "
+                "max_seq_len (%d)" % (prompt.size, max_new_tokens,
+                                      self.max_seq_len))
+        self.active[slot] = True
+        self.decoding[slot] = False
+        self.pos[slot] = 0
+        self._prompt[slot] = prompt
+        self._prefill_cursor[slot] = 0
+        self._temp[slot] = float(temperature)
+        self._top_k[slot] = (self._vocab if top_k is None
+                             else min(int(top_k), self._vocab))
+        self._top_p[slot] = 1.0 if top_p is None else float(top_p)
+        self._step_keys[slot] = request_step_keys(rng, max_new_tokens)
+        self._key_cursor[slot] = 0
+        self._dirty = True
+
+    def release(self, slot):
+        """Reclaim a slot immediately; the stale cache contents stay and
+        are overwritten by the next occupant's prefill."""
+        self.active[slot] = False
+        self.decoding[slot] = False
+        self.pos[slot] = 0  # park the masked-lane write cursor
+        self._prompt[slot] = None
+        self._step_keys[slot] = None
+        self._temp[slot] = 0.0
+        self._top_k[slot] = self._vocab
+        self._top_p[slot] = 1.0
+        self._dirty = True
+
+    # ---------- device work ----------
+
+    def prefill_step(self, slot):
+        """Write the next prompt chunk of `slot` into the cache.
+
+        Returns (tokens_consumed, first_token_or_None): first_token is
+        the request's first sampled token, produced when the final chunk
+        lands (chunked prefill — long prompts spread over several calls
+        so decode steps for other slots interleave between them)."""
+        if not self.active[slot] or self.decoding[slot]:
+            raise ValueError("slot %d is not prefilling" % slot)
+        prompt = self._prompt[slot]
+        start = int(self._prefill_cursor[slot])
+        end = min(start + self.prefill_chunk, prompt.size)
+        chunk = prompt[start:end]
+        # cap the pad bucket at the cache edge: a bucketed write spilling
+        # past max_seq would be CLAMPED by dynamic_update_slice and
+        # silently rewrite earlier live positions
+        bucket = bucket_length(
+            chunk.size, minimum=self.min_bucket,
+            maximum=min(self.prefill_chunk, self.max_seq_len - start))
+        if bucket > chunk.size:
+            chunk = np.concatenate([
+                chunk, np.full(bucket - chunk.size, self.pad_id, np.int32)])
+        logits, self._cache = self._prefill_fn(
+            self.params, self._cache, jnp.asarray(chunk)[None],
+            jnp.int32(slot), jnp.int32(start))
+        self._prefill_cursor[slot] = end
+        # keep pos at the prefill cursor: a mid-prefill slot rides
+        # through fused decode steps as a masked lane whose write lands
+        # at pos — it must fall where the NEXT chunk overwrites it, not
+        # on already-written positions
+        self.pos[slot] = end
+        self._dirty = True
+        consumed = end - start
+        if end < prompt.size:
+            return consumed, None
+        # final chunk: the first generated token comes off these logits
+        first = self._first_fn(
+            logits, jnp.int32(prompt.size - 1 - start),
+            jnp.asarray(self._keys_for(slot)),
+            jnp.float32(self._temp[slot]), jnp.int32(self._top_k[slot]),
+            jnp.float32(self._top_p[slot]))
+        first = int(first)
+        self.decoding[slot] = True
+        self.pos[slot] = prompt.size
+        self._tok[slot] = first
+        self._key_cursor[slot] += 1
+        self._dirty = True
+        return consumed, first
+
+    def _keys_for(self, slot):
+        keys = self._step_keys[slot]
+        cursor = int(self._key_cursor[slot])
+        if cursor >= len(keys):
+            raise ValueError("slot %d ran past its key schedule" % slot)
+        return keys[cursor]
+
+    def decode_step(self):
+        """One fused decode step over the WHOLE pool. Returns a dict
+        {slot: token} for slots in the decode state; other slots ride
+        through as masked lanes (their writes are overwritten before
+        becoming visible). Advances pos/key cursors for decoding slots
+        only.
+
+        Steady state stays on device: tok/pos flow out of one jitted call
+        and back into the next; only the per-step sampling keys upload
+        (and only when a sampled slot is active). Host mirrors replay the
+        same masked advance, so they stay exact without a download."""
+        decoding = [i for i in range(self.max_slots) if self.decoding[i]]
+        if not decoding:
+            return {}
+        if self._dirty:
+            self._d_tok = jnp.asarray(self._tok)
+            self._d_pos = jnp.asarray(self.pos)
+            self._d_mask = jnp.asarray(self.decoding)
+            self._d_temp = jnp.asarray(self._temp)
+            self._d_top_k = jnp.asarray(self._top_k)
+            self._d_top_p = jnp.asarray(self._top_p)
+            self._dirty = False
+        if any(self._temp[i] > 0.0 for i in decoding):
+            for i in decoding:
+                self._keys[i] = self._keys_for(i)
+            out, self._d_tok, self._d_pos, self._cache = \
+                self._decode_sampled_fn(
+                    self.params, self._cache, self._d_tok, self._d_pos,
+                    self._d_mask, jnp.asarray(self._keys), self._d_temp,
+                    self._d_top_k, self._d_top_p)
+        else:
+            out, self._d_tok, self._d_pos, self._cache = \
+                self._decode_greedy_fn(
+                    self.params, self._cache, self._d_tok, self._d_pos,
+                    self._d_mask)
+        out = np.asarray(out)
+        tokens = {}
+        for i in decoding:
+            tokens[i] = int(out[i])
+            self._tok[i] = out[i]
+            self.pos[i] += 1
+            self._key_cursor[i] += 1
+        return tokens

@@ -1,0 +1,415 @@
+"""Request scheduler for the continuous-batching engine.
+
+The engine owns the device; this module owns time: a bounded request
+queue with backpressure, per-iteration admission into free slots, a
+token-budget prefill/decode interleave (long prompts prefill in chunks
+between decode steps instead of stalling every active request), per
+request deadlines and cancellation, and graceful drain for SIGTERM.
+
+One scheduler iteration (`step()`):
+
+  1. reap  — cancelled/deadline-expired requests free their slot NOW
+  2. admit — free slots refill from the queue head (FIFO)
+  3. prefill — up to `prefill_budget` prompt tokens, round-robin over
+     prefilling slots; a slot whose final chunk lands emits its first
+     token (TTFT) and joins the decode set
+  4. decode — ONE fused jitted step advances every decoding slot; eos /
+     max_new_tokens finishes a request and releases its slot immediately
+     (the next iteration's admit refills it — no lockstep)
+
+Telemetry rides the module-level flight-recorder helpers (no-ops
+outside a run context). The request lifecycle event schema is pinned in
+tests/schema_validate.py::SERVING_EVENT_DATA_SCHEMAS:
+
+  serve.request.queued / prefill / first_token / finished / cancelled
+
+plus serve.batch_occupancy + serve.queue_depth gauges and the
+serve.decode_step / serve.prefill_chunk timers.
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from .. import telemetry
+
+_request_ids = itertools.count(1)
+
+
+class QueueFullError(Exception):
+    """Backpressure: the request queue is at capacity."""
+
+
+class DrainingError(Exception):
+    """The scheduler is draining (SIGTERM) and admits no new requests."""
+
+
+class Request(object):
+    """One generation request: prompt tokens in, a stream of generated
+    tokens out (thread-safe queue the HTTP layer consumes)."""
+
+    def __init__(self, tokens, max_new_tokens, temperature=0.0, top_k=None,
+                 top_p=None, eos_id=None, rng=0, deadline=None,
+                 request_id=None):
+        self.id = str(request_id) if request_id is not None \
+            else "req-%d" % next(_request_ids)
+        self.tokens = [int(t) for t in tokens]
+        if not self.tokens:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.rng = rng
+        self.deadline = deadline  # absolute time.time(), or None
+        self.generated = []
+        self.token_times = []
+        self.state = "new"   # queued|prefill|decode|finished|cancelled
+        self.reason = None   # eos|length|cancelled|deadline|shutdown
+        self.slot = None
+        self.out = None      # created on submit
+        self.t_submit = None
+        self.t_admit = None
+        self.t_first = None
+        self.t_done = None
+        self.admit_iteration = None
+        self.finish_iteration = None
+        self._cancelled = threading.Event()
+
+    def cancel(self):
+        self._cancelled.set()
+
+    @property
+    def cancelled(self):
+        return self._cancelled.is_set()
+
+    def stream(self, timeout=None):
+        """Yield generated token ids as they land; raises TimeoutError if
+        the engine stalls past `timeout` between tokens. Terminates when
+        the request finishes (self.reason says why)."""
+        import queue as _q
+
+        while True:
+            try:
+                item = self.out.get(timeout=timeout)
+            except _q.Empty:
+                raise TimeoutError(
+                    "request %s: no token within %.1fs" % (self.id, timeout))
+            if item is None:  # terminal sentinel; reason is already set
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """Block until finished; returns the generated token list."""
+        for _ in self.stream(timeout=timeout):
+            pass
+        return list(self.generated)
+
+
+class Scheduler(object):
+    def __init__(self, engine, max_queue=64, prefill_budget=None):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        # per-iteration prefill token budget: enough to land one chunk
+        # per free slot by default, so admission keeps pace with decode
+        # without ever stalling active slots behind one long prompt
+        self.prefill_budget = int(engine.prefill_chunk * 2
+                                  if prefill_budget is None
+                                  else prefill_budget)
+        if self.prefill_budget < 1:
+            raise ValueError("prefill_budget must be >= 1, got %d"
+                             % self.prefill_budget)
+        self._queue = deque()
+        self._slots = {}          # slot index -> Request
+        self._cond = threading.Condition()
+        self._draining = False
+        self._stopped = False
+        self._thread = None
+        self.iteration = 0
+        self._prefill_rr = 0      # round-robin cursor over prefill slots
+        # stats
+        self.served = 0
+        self.cancelled_count = 0
+        self.decode_steps = 0
+        self._occupancy_sum = 0.0
+
+    # ---------- intake ----------
+
+    def submit(self, request):
+        """Enqueue a request; raises QueueFullError (backpressure) or
+        DrainingError (shutdown in progress)."""
+        import queue as _q
+
+        with self._cond:
+            if self._draining or self._stopped:
+                raise DrainingError("scheduler is draining")
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    "queue full (%d requests)" % len(self._queue))
+            request.out = _q.Queue()
+            request.state = "queued"
+            request.t_submit = time.time()
+            self._queue.append(request)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        telemetry.event("serve.request.queued", data={
+            "request_id": request.id, "queue_depth": depth,
+            "prompt_tokens": len(request.tokens),
+            "max_new_tokens": request.max_new_tokens})
+        telemetry.gauge("serve.queue_depth", depth)
+        return request
+
+    def cancel(self, request_id):
+        """Flag a queued or in-flight request; the next iteration reaps
+        it. Returns True if the id was found."""
+        with self._cond:
+            for req in list(self._queue) + list(self._slots.values()):
+                if req.id == request_id:
+                    req.cancel()
+                    self._cond.notify_all()
+                    return True
+        return False
+
+    # ---------- lifecycle helpers ----------
+
+    def _finish(self, req, reason):
+        if req.slot is not None:
+            self.engine.release(req.slot)
+            del self._slots[req.slot]
+        req.reason = reason
+        req.t_done = time.time()
+        req.finish_iteration = self.iteration
+        ok = reason in ("eos", "length")
+        req.state = "finished" if ok else "cancelled"
+        name = ("serve.request.finished" if ok
+                else "serve.request.cancelled")
+        data = {"request_id": req.id, "reason": reason,
+                "new_tokens": len(req.generated)}
+        if req.slot is not None:
+            data["slot"] = req.slot
+        if req.t_first is not None and req.t_submit is not None:
+            data["ttft_ms"] = round((req.t_first - req.t_submit) * 1000, 3)
+        if req.t_submit is not None:
+            data["total_ms"] = round((req.t_done - req.t_submit) * 1000, 3)
+        telemetry.event(name, data=data)
+        if ok:
+            self.served += 1
+        else:
+            self.cancelled_count += 1
+        req.out.put(None)
+
+    def _deliver(self, req, token):
+        now = time.time()
+        req.generated.append(token)
+        req.token_times.append(now)
+        if req.t_first is None:
+            req.t_first = now
+            telemetry.event("serve.request.first_token", data={
+                "request_id": req.id, "slot": req.slot,
+                "ttft_ms": round((now - req.t_submit) * 1000, 3)})
+        req.out.put(token)
+        if req.eos_id is not None and token == req.eos_id:
+            self._finish(req, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, "length")
+
+    def _reap(self, now):
+        for slot, req in list(self._slots.items()):
+            if req.cancelled:
+                self._finish(req, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                self._finish(req, "deadline")
+        with self._cond:
+            queued = list(self._queue)
+        for req in queued:
+            expired = (req.deadline is not None and now > req.deadline)
+            if req.cancelled or expired:
+                with self._cond:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                self._finish(req, "cancelled" if req.cancelled
+                             else "deadline")
+
+    def _admit(self):
+        free = self.engine.free_slots()
+        admitted = 0
+        for slot in free:
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            try:
+                self.engine.admit(
+                    slot, req.tokens, req.max_new_tokens,
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, rng=req.rng)
+            except ValueError as ex:
+                # oversized request: reject it, keep serving
+                req.reason = "rejected"
+                req.state = "cancelled"
+                req.error = str(ex)
+                telemetry.event("serve.request.cancelled", data={
+                    "request_id": req.id, "reason": "rejected"})
+                self.cancelled_count += 1
+                req.out.put(None)
+                continue
+            req.slot = slot
+            req.state = "prefill"
+            req.t_admit = time.time()
+            req.admit_iteration = self.iteration
+            self._slots[slot] = req
+            admitted += 1
+            telemetry.event("serve.request.prefill", data={
+                "request_id": req.id, "slot": slot,
+                "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)})
+        return admitted
+
+    def _prefill(self):
+        budget = self.prefill_budget
+        worked = False
+        while budget > 0:
+            slots = [s for s, r in sorted(self._slots.items())
+                     if r.state == "prefill"]
+            if not slots:
+                break
+            # round-robin so one long prompt cannot starve the others
+            self._prefill_rr += 1
+            slot = slots[self._prefill_rr % len(slots)]
+            req = self._slots[slot]
+            t0 = time.perf_counter()
+            consumed, first = self.engine.prefill_step(slot)
+            telemetry.emit(
+                "timer", "serve.prefill_chunk",
+                ms=(time.perf_counter() - t0) * 1000, ok=True,
+                data={"slot": slot, "tokens": consumed})
+            budget -= consumed
+            worked = True
+            if first is not None:
+                req.state = "decode"
+                self._deliver(req, first)
+        return worked
+
+    def _decode(self):
+        active = [r for r in self._slots.values() if r.state == "decode"]
+        if not active:
+            return False
+        t0 = time.perf_counter()
+        tokens = self.engine.decode_step()
+        telemetry.emit(
+            "timer", "serve.decode_step",
+            ms=(time.perf_counter() - t0) * 1000, ok=True,
+            data={"active": len(tokens)})
+        self.decode_steps += 1
+        self._occupancy_sum += self.engine.occupancy()
+        telemetry.gauge("serve.batch_occupancy", self.engine.occupancy())
+        for slot, token in tokens.items():
+            req = self._slots.get(slot)
+            if req is not None and req.state == "decode":
+                self._deliver(req, token)
+        return True
+
+    # ---------- the loop ----------
+
+    def step(self):
+        """One scheduler iteration; returns True if any work was done."""
+        self._reap(time.time())
+        admitted = self._admit()
+        prefilled = self._prefill()
+        decoded = self._decode()
+        self.iteration += 1
+        return bool(admitted or prefilled or decoded)
+
+    def pending(self):
+        with self._cond:
+            return len(self._queue) + len(self._slots)
+
+    def run_until_idle(self, max_iterations=None):
+        """Drive step() until queue and slots are empty (bench/tests —
+        no thread)."""
+        n = 0
+        while self.pending():
+            self.step()
+            n += 1
+            if max_iterations is not None and n >= max_iterations:
+                raise RuntimeError(
+                    "scheduler did not go idle in %d iterations"
+                    % max_iterations)
+        return n
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._stopped:
+                    break
+                if self._draining and not self._queue and not self._slots:
+                    break
+            if not self.step():
+                with self._cond:
+                    if (self._stopped
+                            or (self._draining and not self._queue
+                                and not self._slots)):
+                        break
+                    self._cond.wait(timeout=0.02)
+        # loop exit: anything still queued/in-flight dies with "shutdown"
+        with self._cond:
+            leftovers = list(self._queue) + list(self._slots.values())
+            self._queue.clear()
+        for req in leftovers:
+            self._finish(req, "shutdown")
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="tpuflow-serve-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, timeout=None):
+        """Graceful shutdown (SIGTERM): stop admitting NEW submissions,
+        finish everything already accepted, then stop the loop. Returns
+        True once the loop has exited."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            return not self._thread.is_alive()
+        # no thread: drive synchronously
+        self.run_until_idle()
+        return True
+
+    def stop(self):
+        """Hard stop: in-flight and queued requests finish as
+        'shutdown'."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def stats(self):
+        with self._cond:
+            depth = len(self._queue)
+            in_flight = len(self._slots)
+        return {
+            "queue_depth": depth,
+            "in_flight": in_flight,
+            "slots": self.engine.max_slots,
+            "occupancy": self.engine.occupancy(),
+            "mean_batch_occupancy": (
+                round(self._occupancy_sum / self.decode_steps, 4)
+                if self.decode_steps else 0.0),
+            "served": self.served,
+            "cancelled": self.cancelled_count,
+            "decode_steps": self.decode_steps,
+            "iterations": self.iteration,
+            "draining": self._draining,
+        }

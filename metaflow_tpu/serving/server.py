@@ -1,0 +1,251 @@
+"""HTTP front end for the continuous-batching engine (stdlib only).
+
+A ThreadingHTTPServer JSON API: each connection thread blocks on its
+request's token stream while the single scheduler loop drives the
+device, so hundreds of concurrent HTTP requests cost threads, not
+compiled programs.
+
+    POST /v1/generate   {"tokens": [1,2,3], "max_new_tokens": 16,
+                         "temperature": 0.0, "top_k": null,
+                         "top_p": null, "eos_id": null, "seed": 0,
+                         "deadline_ms": null, "stream": false}
+      -> 200 {"id", "tokens", "new_tokens", "reason", "usage"}
+      -> 200 chunked stream when "stream": true — one JSON line per
+         token {"token": t, "index": i}, then a terminal line
+         {"done": true, "reason": ..., "new_tokens": [...]}
+      -> 400 malformed body / oversized request
+      -> 429 queue full (backpressure)
+      -> 503 draining (graceful shutdown in progress)
+    GET /healthz        {"ok": true, "draining": false}
+    GET /v1/stats       scheduler + engine counters
+
+Graceful shutdown: SIGTERM (install_signal_handlers) flips /healthz to
+draining, rejects new work with 503, lets every accepted request finish
+(scheduler.drain), then stops the listener.
+"""
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .scheduler import DrainingError, QueueFullError, Request
+
+STREAM_TIMEOUT_S = 300.0
+
+
+def _request_from_payload(payload):
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    tokens = payload.get("tokens")
+    if (not isinstance(tokens, list) or not tokens
+            or not all(isinstance(t, int) for t in tokens)):
+        raise ValueError("'tokens' must be a non-empty list of ints")
+    deadline = None
+    if payload.get("deadline_ms") is not None:
+        import time
+
+        deadline = time.time() + float(payload["deadline_ms"]) / 1000.0
+    return Request(
+        tokens,
+        max_new_tokens=int(payload.get("max_new_tokens", 16)),
+        temperature=float(payload.get("temperature", 0.0)),
+        top_k=payload.get("top_k"),
+        top_p=payload.get("top_p"),
+        eos_id=payload.get("eos_id"),
+        rng=int(payload.get("seed", 0)),
+        deadline=deadline,
+        request_id=payload.get("request_id"),
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpuflow-serve/1"
+
+    # quiet by default; the scheduler's telemetry is the real log
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def scheduler(self):
+        return self.server.scheduler
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._json(200, {"ok": True,
+                             "draining": self.server.draining})
+            return
+        if self.path == "/v1/stats":
+            self._json(200, self.scheduler.stats())
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            self._json(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            req = _request_from_payload(payload)
+        except (ValueError, TypeError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        stream = bool(payload.get("stream", False))
+        try:
+            self.scheduler.submit(req)
+        except QueueFullError as ex:
+            self._json(429, {"error": str(ex)})
+            return
+        except DrainingError as ex:
+            self._json(503, {"error": str(ex)})
+            return
+        if stream:
+            self._stream(req)
+        else:
+            try:
+                tokens = req.result(timeout=STREAM_TIMEOUT_S)
+            except TimeoutError:
+                req.cancel()
+                self._json(504, {"error": "generation timed out"})
+                return
+            if req.reason == "rejected":
+                self._json(400, {"error": getattr(req, "error",
+                                                  "rejected")})
+                return
+            self._json(200, {
+                "id": req.id,
+                "tokens": req.tokens + tokens,
+                "new_tokens": tokens,
+                "reason": req.reason,
+                "usage": {"prompt_tokens": len(req.tokens),
+                          "new_tokens": len(tokens)},
+            })
+
+    # ---------- chunked streaming ----------
+
+    def _chunk(self, data):
+        self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+    def _stream(self, req):
+        import queue as _q
+
+        # hold the status line until the request's first event: a
+        # rejected request must get the same 400 the non-stream path
+        # returns, not a 200 with an error buried in the tail
+        try:
+            first = req.out.get(timeout=STREAM_TIMEOUT_S)
+        except _q.Empty:
+            req.cancel()
+            self._json(504, {"error": "generation timed out"})
+            return
+        if first is None and req.reason == "rejected":
+            self._json(400, {"error": getattr(req, "error", "rejected")})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            item, i = first, 0
+            while item is not None:
+                self._chunk(json.dumps(
+                    {"token": item, "index": i}).encode() + b"\n")
+                self.wfile.flush()
+                i += 1
+                try:
+                    item = req.out.get(timeout=STREAM_TIMEOUT_S)
+                except _q.Empty:
+                    raise TimeoutError()
+            self._chunk(json.dumps(
+                {"done": True, "reason": req.reason,
+                 "new_tokens": req.generated}).encode() + b"\n")
+            self._chunk(b"")  # terminal zero-length chunk
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            # client went away (or the engine stalled): free the slot
+            # and drop the keep-alive socket — a half-finished chunked
+            # response must not leave the client waiting on it
+            req.cancel()
+            self.close_connection = True
+
+
+class ServingServer(object):
+    """The listener + its scheduler, with graceful-drain plumbing."""
+
+    def __init__(self, scheduler, host="127.0.0.1", port=0):
+        self.scheduler = scheduler
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.scheduler = scheduler
+        self._httpd.draining = False
+        self._thread = None
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def draining(self):
+        return self._httpd.draining
+
+    def start(self):
+        self.scheduler.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="tpuflow-serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        """Blocking variant for the CLI: runs until SIGTERM/SIGINT."""
+        self.install_signal_handlers()
+        self.start()
+        try:
+            self._done = getattr(self, "_done", threading.Event())
+            self._done.wait()
+        except KeyboardInterrupt:
+            self.shutdown()
+
+    def install_signal_handlers(self):
+        self._done = threading.Event()
+
+        def _on_signal(_sig, _frame):
+            threading.Thread(target=self.shutdown, daemon=True).start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _on_signal)
+            except ValueError:
+                pass  # not the main thread (tests)
+
+    def shutdown(self, timeout=60.0):
+        """Graceful drain: flip /healthz, 503 new work, finish accepted
+        requests, stop the listener."""
+        self._httpd.draining = True
+        drained = self.scheduler.drain(timeout=timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if getattr(self, "_done", None) is not None:
+            self._done.set()
+        return drained
+
+    def close(self):
+        """Hard stop (tests)."""
+        self.scheduler.stop()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

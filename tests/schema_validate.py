@@ -327,6 +327,78 @@ TELEMETRY_RECORD_SCHEMA = _obj(
 
 
 # ---------------------------------------------------------------------------
+# Serving telemetry (metaflow_tpu/serving/scheduler.py): the pinned request
+# lifecycle event surface. Every serving record is first a v1 telemetry
+# record (TELEMETRY_RECORD_SCHEMA); the lifecycle events additionally pin
+# their `data` payloads here — a field the scheduler invents (or a renamed
+# one) fails validation, protecting dashboards keyed on TTFT/queue-wait.
+# ---------------------------------------------------------------------------
+
+SERVING_EVENT_DATA_SCHEMAS = {
+    "serve.request.queued": _obj(
+        {"request_id": _STR, "queue_depth": _INT, "prompt_tokens": _INT,
+         "max_new_tokens": _INT},
+        required=("request_id", "queue_depth", "prompt_tokens",
+                  "max_new_tokens"),
+    ),
+    "serve.request.prefill": _obj(
+        {"request_id": _STR, "slot": _INT, "queue_ms": _NUM},
+        required=("request_id", "slot", "queue_ms"),
+    ),
+    "serve.request.first_token": _obj(
+        {"request_id": _STR, "slot": _INT, "ttft_ms": _NUM},
+        required=("request_id", "slot", "ttft_ms"),
+    ),
+    "serve.request.finished": _obj(
+        {"request_id": _STR, "slot": _INT,
+         "reason": {"enum": ["eos", "length"]},
+         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM},
+        required=("request_id", "reason", "new_tokens"),
+    ),
+    "serve.request.cancelled": _obj(
+        {"request_id": _STR, "slot": _INT,
+         "reason": {"enum": ["cancelled", "deadline", "shutdown",
+                             "rejected"]},
+         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM},
+        required=("request_id", "reason"),
+    ),
+}
+
+# non-event serving records: gauges + timers the bench/metrics consume
+SERVING_METRIC_NAMES = {
+    "serve.queue_depth": "gauge",
+    "serve.batch_occupancy": "gauge",
+    "serve.decode_step": "timer",
+    "serve.prefill_chunk": "timer",
+}
+
+
+def validate_serving_record(record):
+    """Validate one serve.* flight-recorder record: base v1 record shape,
+    a pinned name, and (for lifecycle events) the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name in SERVING_EVENT_DATA_SCHEMAS:
+        if record.get("type") != "event":
+            raise jsonschema.ValidationError(
+                "%s must be an event record, got %r"
+                % (name, record.get("type")))
+        jsonschema.validate(record.get("data", {}),
+                            SERVING_EVENT_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+    elif name in SERVING_METRIC_NAMES:
+        if record.get("type") != SERVING_METRIC_NAMES[name]:
+            raise jsonschema.ValidationError(
+                "%s must be a %s record, got %r"
+                % (name, SERVING_METRIC_NAMES[name], record.get("type")))
+    else:
+        raise jsonschema.ValidationError(
+            "unknown serving record name %r (pinned: %s)"
+            % (name, sorted(SERVING_EVENT_DATA_SCHEMAS)
+               + sorted(SERVING_METRIC_NAMES)))
+
+
+# ---------------------------------------------------------------------------
 # `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
 # v1 surface for the static analyzer. additionalProperties: false — a field
 # the analyzer invents fails validation, protecting editor/CI consumers of

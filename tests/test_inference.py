@@ -162,6 +162,51 @@ class TestGenerate:
                                  top_p=0.8)[0]))
         assert seen == {0, 1}, seen
 
+    def test_top_k_composes_with_top_p(self, setup):
+        """Docstring promise: 'top_k filters first'. With
+        [0.4, 0.3, 0.2, 0.07, 0.03] and top_p=0.75 alone the nucleus is
+        {0, 1, 2} (exclusive mass before token 2 is 0.7 < 0.75); with
+        top_k=3 composed, the top-3 renormalize to [0.444, 0.333, 0.222]
+        and the mass before token 2 becomes 0.777 >= 0.75 — so the
+        nucleus SHRINKS to {0, 1}. Only the filter-then-renormalize
+        order produces that set."""
+        from metaflow_tpu.inference.decode import _sample
+
+        logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.07, 0.03]]))
+        alone, composed = set(), set()
+        for seed in range(60):
+            alone.add(int(_sample(logits, 1.0, jax.random.PRNGKey(seed),
+                                  top_p=0.75)[0]))
+            composed.add(int(_sample(logits, 1.0,
+                                     jax.random.PRNGKey(seed),
+                                     top_k=3, top_p=0.75)[0]))
+        assert alone == {0, 1, 2}, alone
+        assert composed == {0, 1}, composed
+
+    def test_generator_compiles_once_per_bucket(self, setup):
+        """make_generator pads prompts to power-of-two buckets: four
+        distinct prompt lengths in one bucket -> ONE compile; crossing
+        the bucket boundary -> exactly one more. Outputs stay identical
+        to the unpadded generate()."""
+        cfg, params, _ = setup
+        gen = make_generator(cfg, max_new_tokens=3)
+        for P in (5, 9, 12, 16):
+            toks = jax.random.randint(jax.random.PRNGKey(P), (2, P), 0,
+                                      cfg.vocab_size)
+            out = gen(params, toks, jax.random.PRNGKey(0))
+            ref = generate(params, toks, cfg, 3,
+                           rng=jax.random.PRNGKey(0))
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(ref))
+        assert gen.cache_size() == 1, \
+            "one compile must cover every prompt length in the bucket"
+        toks = jax.random.randint(jax.random.PRNGKey(17), (2, 17), 0,
+                                  cfg.vocab_size)
+        out = gen(params, toks, jax.random.PRNGKey(0))
+        ref = generate(params, toks, cfg, 3, rng=jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        assert gen.cache_size() == 2
+
     def test_undersized_max_seq_len_refused(self, setup):
         # dynamic_update_slice would clamp the write index and silently
         # corrupt the cache; must fail loudly up front

@@ -1,0 +1,514 @@
+"""Continuous-batching serving engine: token identity with lockstep
+generate, mid-flight slot admission/reclaim (no lockstep), cancellation/
+deadline/backpressure, SIGTERM drain, the HTTP API with streaming, the
+pinned serving telemetry schema, and the BENCH_MODE=serve gate."""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metaflow_tpu.inference import generate
+from metaflow_tpu.models import llama
+from metaflow_tpu.serving import (
+    QueueFullError,
+    Request,
+    Scheduler,
+    ServingServer,
+    SlotEngine,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def engine(setup):
+    """ONE engine for the module: its compiled programs are shared;
+    every test drains its requests, so slots come back free. Warmed so
+    latency-sensitive tests (deadline) never race a compile."""
+    cfg, params = setup
+    eng = SlotEngine(params, cfg, max_slots=4, max_seq_len=128,
+                     prefill_chunk=16)
+    warm = Scheduler(eng)
+    warm.submit(Request(list(range(1, 20)), max_new_tokens=2,
+                        temperature=0.5))
+    warm.run_until_idle(10_000)
+    return eng
+
+
+def _ref_tokens(params, cfg, req):
+    """What single-request lockstep generate() emits for this request,
+    trimmed at eos the way the engine reports it."""
+    out = generate(params, jnp.asarray(req.tokens)[None], cfg,
+                   req.max_new_tokens, temperature=req.temperature,
+                   top_k=req.top_k, top_p=req.top_p, eos_id=req.eos_id,
+                   rng=jax.random.PRNGKey(req.rng))
+    new = np.asarray(out)[0, len(req.tokens):].tolist()
+    if req.eos_id is not None and req.eos_id in new:
+        new = new[:new.index(req.eos_id) + 1]
+    return new
+
+
+class TestTokenIdentity:
+    def test_greedy_identical_to_generate(self, setup, engine):
+        """Any request through the engine == single-request generate,
+        bit-exact, across prompt lengths spanning 1..several prefill
+        chunks while slots interleave (the acceptance pin)."""
+        cfg, params = setup
+        sched = Scheduler(engine)
+        rng = np.random.default_rng(0)
+        reqs = []
+        for i, plen in enumerate([3, 16, 17, 40, 90, 7, 33, 64]):
+            toks = rng.integers(0, cfg.vocab_size, plen).tolist()
+            reqs.append(sched.submit(Request(
+                toks, max_new_tokens=int(rng.integers(1, 12)), rng=i)))
+        sched.run_until_idle(max_iterations=10_000)
+        for req in reqs:
+            assert req.reason == "length"
+            assert req.generated == _ref_tokens(params, cfg, req), \
+                "slot output diverged from lockstep generate"
+
+    def test_sampled_identical_to_generate(self, setup, engine):
+        """Same rng policy as generate (request_step_keys mirrors its
+        split sequence) -> the sampled path is token-identical too."""
+        cfg, params = setup
+        sched = Scheduler(engine)
+        reqs = []
+        for i, (tk, tp) in enumerate([(None, None), (20, None),
+                                      (None, 0.9), (20, 0.9)]):
+            toks = list(range(5 + i, 25 + i))
+            reqs.append(sched.submit(Request(
+                toks, max_new_tokens=6, temperature=0.8, top_k=tk,
+                top_p=tp, rng=100 + i)))
+        sched.run_until_idle(max_iterations=10_000)
+        for req in reqs:
+            assert req.generated == _ref_tokens(params, cfg, req)
+
+    def test_chunked_attn_identical_with_per_slot_positions(self, setup):
+        """The flash-decode path under a per-slot position VECTOR (its
+        traced trip count runs to the deepest slot; shallower slots mask
+        the extra chunks) — token-identical to dense lockstep."""
+        cfg, params = setup
+        eng = SlotEngine(params, cfg, max_slots=3, max_seq_len=128,
+                         prefill_chunk=16, attn_impl="chunked")
+        sched = Scheduler(eng)
+        rng = np.random.default_rng(2)
+        reqs = []
+        for i, plen in enumerate([90, 5, 33]):  # very different depths
+            toks = rng.integers(0, cfg.vocab_size, plen).tolist()
+            reqs.append(sched.submit(Request(toks, max_new_tokens=8,
+                                             rng=i)))
+        sched.run_until_idle(max_iterations=10_000)
+        for req in reqs:
+            assert req.generated == _ref_tokens(params, cfg, req)
+
+    def test_eos_frees_slot_early(self, setup, engine):
+        cfg, params = setup
+        # whatever greedy emits first becomes the eos id: the request
+        # must finish at 1 generated token, not max_new
+        probe = Scheduler(engine)
+        r0 = probe.submit(Request(list(range(1, 9)), max_new_tokens=1))
+        probe.run_until_idle(10_000)
+        eos = r0.generated[0]
+        sched = Scheduler(engine)
+        req = sched.submit(Request(list(range(1, 9)), max_new_tokens=10,
+                                   eos_id=eos))
+        sched.run_until_idle(10_000)
+        assert req.reason == "eos"
+        assert req.generated == [eos]
+        assert engine.free_slots() == list(range(engine.max_slots))
+
+    def test_one_compile_per_program(self, engine):
+        """The engine's compiled-program budget: prompt-length diversity
+        must not grow the jit caches past the bucket count."""
+        counts = engine.compile_counts()
+        assert counts["decode_greedy"] <= 1
+        assert counts["decode_sampled"] <= 1
+        # prefill chunk buckets: powers of two up to prefill_chunk
+        assert counts["prefill"] <= 3
+
+
+class TestContinuousBatching:
+    def test_mid_flight_admission_no_lockstep(self, setup, engine):
+        """More requests than slots, mixed lengths: later requests must
+        be ADMITTED while earlier ones are still decoding — i.e. some
+        admission happens after some finish, with others in flight."""
+        cfg, params = setup
+        sched = Scheduler(engine)
+        rng = np.random.default_rng(1)
+        reqs = []
+        for i in range(12):
+            plen = int(rng.integers(3, 40))
+            n = 3 if i % 3 else 20
+            reqs.append(sched.submit(Request(
+                rng.integers(0, cfg.vocab_size, plen).tolist(),
+                max_new_tokens=n, rng=i)))
+        sched.run_until_idle(max_iterations=10_000)
+        admits = [r.admit_iteration for r in reqs]
+        finishes = [r.finish_iteration for r in reqs]
+        assert all(r.reason == "length" for r in reqs)
+        # lockstep would admit everything before anything finishes (or
+        # in non-overlapping waves); continuous batching refills slots
+        # mid-flight: some admission strictly between the first and the
+        # last finish
+        assert max(admits) > min(finishes)
+        assert max(admits) < max(finishes)
+        # and outputs still match lockstep generate exactly
+        for req in reqs[:4]:
+            assert req.generated == _ref_tokens(params, cfg, req)
+
+    def test_occupancy_tracked(self, engine):
+        sched = Scheduler(engine)
+        for i in range(6):
+            sched.submit(Request(list(range(1, 10)), max_new_tokens=8,
+                                 rng=i))
+        sched.run_until_idle(10_000)
+        stats = sched.stats()
+        assert stats["decode_steps"] > 0
+        assert 0.0 < stats["mean_batch_occupancy"] <= 1.0
+
+
+class TestCancellationDeadlines:
+    def test_cancel_in_flight_frees_slot(self, setup, engine):
+        cfg, params = setup
+        sched = Scheduler(engine)
+        victim = sched.submit(Request(list(range(1, 20)),
+                                      max_new_tokens=100, rng=0))
+        other = sched.submit(Request(list(range(1, 10)),
+                                     max_new_tokens=4, rng=1))
+        # a few iterations: both admitted and decoding
+        for _ in range(6):
+            sched.step()
+        assert victim.state in ("prefill", "decode")
+        sched.cancel(victim.id)
+        sched.run_until_idle(10_000)
+        assert victim.reason == "cancelled"
+        assert other.reason == "length"
+        assert engine.free_slots() == list(range(engine.max_slots))
+
+    def test_deadline_frees_slot(self, engine):
+        sched = Scheduler(engine)
+        req = sched.submit(Request(list(range(1, 20)),
+                                   max_new_tokens=100,
+                                   deadline=time.time() + 3600))
+        # let it get properly in flight (deterministic on any box), then
+        # expire the deadline mid-generation
+        t0 = time.time()
+        while not req.generated and time.time() - t0 < 60:
+            sched.step()
+        assert req.generated, "request never started decoding"
+        req.deadline = time.time() - 0.001
+        while req.reason is None and time.time() - t0 < 60:
+            sched.step()
+        assert req.reason == "deadline"
+        assert len(req.generated) < 100  # cut off mid-generation
+        assert engine.free_slots() == list(range(engine.max_slots))
+
+    def test_queued_request_cancel(self, engine):
+        """Cancelling a request that never reached a slot."""
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(list(range(1, 10)),
+                                     max_new_tokens=30, rng=i))
+                for i in range(engine.max_slots + 2)]
+        last = reqs[-1]
+        sched.cancel(last.id)
+        sched.run_until_idle(10_000)
+        assert last.reason == "cancelled"
+        assert last.generated == []
+        assert all(r.reason == "length" for r in reqs[:-1])
+
+    def test_backpressure(self, engine):
+        sched = Scheduler(engine, max_queue=2)
+        sched.submit(Request([1, 2, 3], max_new_tokens=2))
+        sched.submit(Request([1, 2, 3], max_new_tokens=2))
+        with pytest.raises(QueueFullError):
+            sched.submit(Request([1, 2, 3], max_new_tokens=2))
+        sched.run_until_idle(10_000)
+
+    def test_oversized_request_rejected_not_served(self, engine):
+        sched = Scheduler(engine)
+        req = sched.submit(Request(list(range(1, 50)),
+                                   max_new_tokens=500))  # > max_seq_len
+        sched.run_until_idle(10_000)
+        assert req.reason == "rejected"
+        assert engine.free_slots() == list(range(engine.max_slots))
+
+
+def _post(port, payload, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", "/v1/generate", json.dumps(payload),
+                 {"Content-Type": "application/json"})
+    return conn, conn.getresponse()
+
+
+class TestHTTPServer:
+    @pytest.fixture()
+    def server(self, engine):
+        srv = ServingServer(Scheduler(engine), port=0).start()
+        yield srv
+        srv.close()
+
+    def test_round_trip(self, setup, server):
+        cfg, params = setup
+        conn, resp = _post(server.port, {
+            "tokens": list(range(1, 9)), "max_new_tokens": 5, "seed": 3})
+        assert resp.status == 200
+        body = json.loads(resp.read())
+        req = Request(list(range(1, 9)), max_new_tokens=5, rng=3)
+        assert body["new_tokens"] == _ref_tokens(params, cfg, req)
+        assert body["reason"] == "length"
+        assert body["usage"] == {"prompt_tokens": 8, "new_tokens": 5}
+        conn.close()
+
+    def test_streaming(self, server):
+        conn, resp = _post(server.port, {
+            "tokens": list(range(1, 9)), "max_new_tokens": 6,
+            "stream": True})
+        assert resp.status == 200
+        lines = [json.loads(l) for l in iter(resp.readline, b"")]
+        assert [l["index"] for l in lines[:-1]] == list(range(6))
+        assert lines[-1]["done"] and lines[-1]["reason"] == "length"
+        assert lines[-1]["new_tokens"] == [l["token"] for l in lines[:-1]]
+        conn.close()
+
+    def test_healthz_stats_and_errors(self, server):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        assert json.loads(conn.getresponse().read()) == {
+            "ok": True, "draining": False}
+        conn.request("GET", "/v1/stats")
+        stats = json.loads(conn.getresponse().read())
+        assert stats["slots"] == 4
+        conn.request("POST", "/v1/generate", json.dumps({"tokens": []}))
+        assert conn.getresponse().status == 400
+        conn.close()
+
+    def test_streamed_rejection_is_400(self, server):
+        """A rejected (oversized) request must 400 on the stream path
+        too — not 200 with the error buried in the tail."""
+        conn, resp = _post(server.port, {
+            "tokens": list(range(1, 60)), "max_new_tokens": 500,
+            "stream": True})
+        assert resp.status == 400
+        assert "error" in json.loads(resp.read())
+        conn.close()
+
+    def test_sigterm_drains_in_flight(self, setup, engine):
+        """SIGTERM mid-generation: the in-flight stream runs to
+        completion, new work is refused, the listener closes."""
+        srv = ServingServer(Scheduler(engine), port=0)
+        old = {sig: signal.getsignal(sig)
+               for sig in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            srv.install_signal_handlers()
+            srv.start()
+            conn, resp = _post(srv.port, {
+                "tokens": list(range(1, 20)), "max_new_tokens": 40,
+                "stream": True})
+            first = json.loads(resp.readline())
+            assert first["index"] == 0
+            os.kill(os.getpid(), signal.SIGTERM)
+            lines = [json.loads(l) for l in iter(resp.readline, b"")]
+            assert lines[-1]["done"] and lines[-1]["reason"] == "length"
+            assert len(lines[-1]["new_tokens"]) == 40  # all 40 arrived
+            conn.close()
+            # the listener is gone (or refusing) after the drain
+            deadline = time.time() + 30
+            refused = False
+            while time.time() < deadline and not refused:
+                try:
+                    c2 = http.client.HTTPConnection(
+                        "127.0.0.1", srv.port, timeout=2)
+                    c2.request("GET", "/healthz")
+                    body = json.loads(c2.getresponse().read())
+                    assert body["draining"] is True
+                    c2.close()
+                    time.sleep(0.05)
+                except (ConnectionRefusedError, OSError):
+                    refused = True
+            assert refused
+        finally:
+            for sig, handler in old.items():
+                signal.signal(sig, handler)
+
+
+class TestServingTelemetry:
+    def test_lifecycle_records_match_pinned_schema(self, engine,
+                                                   tmp_path):
+        """Every serve.* record the scheduler emits validates against
+        the pinned schema, and the full lifecycle is present."""
+        from schema_validate import (
+            SERVING_EVENT_DATA_SCHEMAS,
+            validate_serving_record,
+        )
+
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+        fds = FlowDataStore("ServeTelemetry", LocalStorage,
+                            ds_root=str(tmp_path))
+        telemetry.init_recorder(fds, "1", "_serve", "server-test")
+        try:
+            sched = Scheduler(engine)
+            reqs = [sched.submit(Request(list(range(1, 20)),
+                                         max_new_tokens=6, rng=i))
+                    for i in range(6)]
+            victim = sched.submit(Request(list(range(1, 9)),
+                                          max_new_tokens=100))
+            for _ in range(4):
+                sched.step()
+            sched.cancel(victim.id)
+            sched.run_until_idle(10_000)
+            assert all(r.reason == "length" for r in reqs)
+        finally:
+            telemetry.close_recorder()
+        records = telemetry.read_run_records(fds, "1")
+        serve = [r for r in records if r["name"].startswith("serve.")]
+        assert serve, "no serving telemetry landed"
+        for rec in serve:
+            validate_serving_record(rec)
+        names = {r["name"] for r in serve}
+        for lifecycle in SERVING_EVENT_DATA_SCHEMAS:
+            assert lifecycle in names, "missing %s" % lifecycle
+        assert "serve.batch_occupancy" in names
+        assert "serve.decode_step" in names
+        # TTFT rides the first_token + finished events
+        firsts = [r for r in serve
+                  if r["name"] == "serve.request.first_token"]
+        assert all(r["data"]["ttft_ms"] >= 0 for r in firsts)
+
+
+class TestServeCommand:
+    def test_train_checkpoint_serve_end_to_end(self, run_flow,
+                                               tpuflow_root, tmp_path):
+        """The full path behind `tpuflow serve FLOW/RUN`: a flow
+        checkpoints trained weights, serve() resolves the run, loads the
+        checkpoint, builds the engine, and answers HTTP with the exact
+        tokens lockstep generate() gives for those weights."""
+        import textwrap
+
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.cmd.serve import serve
+        from metaflow_tpu.inference import load_run_checkpoint
+
+        flow = tmp_path / "ckpt_serve_flow.py"
+        flow.write_text(textwrap.dedent("""
+            import metaflow_tpu
+            from metaflow_tpu import FlowSpec, current, step
+
+            class CkptServeFlow(FlowSpec):
+                @metaflow_tpu.checkpoint
+                @step
+                def start(self):
+                    import jax
+                    from metaflow_tpu.models import llama
+                    cfg = llama.LlamaConfig.tiny()
+                    params = llama.init_params(jax.random.PRNGKey(7),
+                                               cfg)
+                    current.checkpoint.save({"params": params}, step=0)
+                    self.next(self.end)
+
+                @step
+                def end(self):
+                    pass
+
+            if __name__ == "__main__":
+                CkptServeFlow()
+        """))
+        run_flow(str(flow), "run")
+        cfg_json = json.dumps({
+            "vocab_size": 512, "dim": 128, "n_layers": 2, "n_heads": 4,
+            "n_kv_heads": 2, "ffn_dim": 256, "max_seq_len": 256,
+            "rope_llama3_scaling": False, "dtype": "float32"})
+        srv = serve("CkptServeFlow", config_json=cfg_json, port=0,
+                    slots=2, max_seq_len=64, block=False,
+                    echo=lambda *a, **k: None)
+        try:
+            conn, resp = _post(srv.port, {
+                "tokens": list(range(1, 9)), "max_new_tokens": 4})
+            assert resp.status == 200
+            body = json.loads(resp.read())
+            conn.close()
+            restored = load_run_checkpoint("CkptServeFlow")
+            cfg = llama.LlamaConfig.tiny()
+            ref = generate(restored["params"],
+                           jnp.asarray([list(range(1, 9))]), cfg, 4,
+                           rng=jax.random.PRNGKey(0))
+            assert body["new_tokens"] == \
+                np.asarray(ref)[0, 8:].tolist()
+        finally:
+            srv.close()
+            telemetry.close_recorder()
+
+    def test_build_config_validation(self):
+        from metaflow_tpu.cmd.serve import build_config, extract_params
+        from metaflow_tpu.exception import TpuFlowException
+
+        cfg = build_config({"cfg": {"dim": 64, "n_layers": 1}})
+        assert cfg.dim == 64 and cfg.n_layers == 1
+        with pytest.raises(TpuFlowException, match="no model config"):
+            build_config({"params": {}})
+        with pytest.raises(TpuFlowException, match="unknown"):
+            build_config({}, config_json='{"not_a_field": 1}')
+        params = {"embed": 1}
+        assert extract_params({"params": params}) is params
+        assert extract_params(params) is params
+
+    def test_build_engine_shards_by_model_family(self):
+        """--mesh with a Mixtral checkpoint must use the Mixtral rule
+        tree (router/expert axes), not the Llama table."""
+        from metaflow_tpu.cmd.serve import build_engine
+        from metaflow_tpu.models import mixtral
+
+        cfg = mixtral.MixtralConfig.tiny()
+        params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+        eng = build_engine(params, cfg, slots=2, max_seq_len=64,
+                           mesh_spec="dp")
+        assert eng.mesh is not None
+
+
+class TestServeBench:
+    def test_bench_mode_serve_gate(self):
+        """BENCH_MODE=serve runs end to end and continuous batching
+        clears the 1.5x-vs-lockstep floor on the mixed-length trace."""
+        env = dict(os.environ)
+        env.update({
+            "BENCH_MODE": "serve", "BENCH_SKIP_PROBE": "1",
+            "BENCH_HISTORY": "0", "JAX_PLATFORMS": "cpu",
+            "JAX_PLATFORM_NAME": "cpu",
+        })
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(HERE)] +
+            [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon_site" not in p])
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(HERE),
+                                          "bench.py")],
+            env=env, capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["metric"] == "serve_tokens_per_s"
+        assert result["value"] > 0
+        subs = {s["metric"]: s["value"] for s in result["submetrics"]}
+        assert set(subs) == {"serve_p50_ms", "serve_p99_ms",
+                             "serve_batch_occupancy"}
+        assert subs["serve_p99_ms"] >= subs["serve_p50_ms"] > 0
+        assert 0 < subs["serve_batch_occupancy"] <= 1
+        assert result["extra"]["speedup_vs_lockstep"] >= 1.5, \
+            "continuous batching must beat lockstep by 1.5x: %s" % result
