@@ -9,6 +9,9 @@ tutorials/develop`). Subcommands:
     configure unset KEY          remove a key
     tutorials list|pull [DIR]    list / copy the tutorial episodes
     stubs [OUT_DIR]              generate .pyi type stubs
+    dataset build|info|list      sharded streaming corpora (docs/data.md)
+    metrics FLOW/RUN             aggregate a run's telemetry
+    serve FLOW/RUN               serve a checkpoint over HTTP
 """
 
 import os
@@ -458,6 +461,79 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
                    echo=click.echo)
     except TpuFlowException as ex:
         raise click.ClickException(str(ex))
+
+
+@main.group(help="Sharded streaming dataset corpora: pack token files "
+                 "into on-datastore shard blobs + manifest for "
+                 "StreamingTokenBatches (docs/data.md).")
+def dataset():
+    pass
+
+
+def _dataset_cmd(fn, *args, **kwargs):
+    from .exception import TpuFlowException
+
+    try:
+        return fn(*args, **kwargs)
+    except TpuFlowException as ex:
+        raise click.ClickException(str(ex))
+
+
+@dataset.command(name="build",
+                 help="Pack a token file (.npy, or raw binary with "
+                      "--dtype) into shards + manifest.")
+@click.argument("flow_name")
+@click.argument("name")
+@click.option("--input", "input_path", required=True,
+              type=click.Path(exists=True),
+              help="Token corpus: .npy or raw little-endian binary.")
+@click.option("--shard-tokens", default=4 * 1024 * 1024, type=int,
+              show_default=True, help="Tokens per shard blob.")
+@click.option("--dtype", default=None,
+              help="Token dtype (required for raw binary input; "
+                   "optional cast for .npy).")
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]),
+              help="Storage backend (default: configured default).")
+@click.option("--datastore-root", default=None,
+              help="Datastore root override.")
+@click.option("--overwrite", is_flag=True,
+              help="Rebuild over an existing dataset of this name.")
+def dataset_build(flow_name, name, input_path, shard_tokens, dtype,
+                  datastore, datastore_root, overwrite):
+    from .cmd.dataset import build_dataset
+
+    _dataset_cmd(build_dataset, flow_name, name, input_path, shard_tokens,
+                 dtype=dtype, datastore=datastore,
+                 datastore_root=datastore_root, overwrite=overwrite,
+                 echo=click.echo)
+
+
+@dataset.command(name="info", help="Show a dataset's manifest.")
+@click.argument("flow_name")
+@click.argument("name")
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]))
+@click.option("--datastore-root", default=None)
+@click.option("--json", "as_json", is_flag=True)
+def dataset_info_cmd(flow_name, name, datastore, datastore_root, as_json):
+    from .cmd.dataset import dataset_info
+
+    _dataset_cmd(dataset_info, flow_name, name, datastore=datastore,
+                 datastore_root=datastore_root, as_json=as_json,
+                 echo=click.echo)
+
+
+@dataset.command(name="list", help="List a flow's built datasets.")
+@click.argument("flow_name")
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]))
+@click.option("--datastore-root", default=None)
+def dataset_list_cmd(flow_name, datastore, datastore_root):
+    from .cmd.dataset import dataset_list
+
+    _dataset_cmd(dataset_list, flow_name, datastore=datastore,
+                 datastore_root=datastore_root, echo=click.echo)
 
 
 @main.group(help="Local full-stack dev harness: fake GCS + metadata "

@@ -1,0 +1,104 @@
+"""`tpuflow dataset build|info|list`: manage sharded on-datastore corpora.
+
+Packs a raw token file into the shard-blob + manifest format of
+metaflow_tpu/data/shards.py, through the flow's configured datastore —
+so a corpus built once on a fast box streams into every training gang
+host via StreamingTokenBatches. See docs/data.md.
+
+    python -m metaflow_tpu dataset build MyFlow wiki \
+        --input tokens.npy --shard-tokens 4194304
+    python -m metaflow_tpu dataset info MyFlow wiki
+    python -m metaflow_tpu dataset list MyFlow
+"""
+
+import json
+import os
+
+import numpy as np
+
+from ..data.shards import (
+    DatasetError,
+    build_corpus,
+    list_datasets,
+    load_manifest,
+)
+
+
+def open_flow_datastore(flow_name, datastore=None, datastore_root=None):
+    from .. import metaflow_config as cfg
+    from ..datastore import STORAGE_BACKENDS, FlowDataStore
+
+    storage_impl = STORAGE_BACKENDS[datastore or cfg.default_datastore()]
+    return FlowDataStore(flow_name, storage_impl, ds_root=datastore_root)
+
+
+def load_tokens(input_path, dtype=None):
+    """A 1-D token array from a corpus file: .npy (memory-mapped, so
+    multi-GB corpora shard at bounded RSS) or a raw binary dump
+    (--dtype required to decode it). --dtype on a .npy is applied
+    per-shard inside build_corpus, never as a whole-array cast that
+    would pull the memmap into RAM."""
+    if not os.path.exists(input_path):
+        raise DatasetError("input file %s does not exist" % input_path)
+    if input_path.endswith(".npy"):
+        tokens = np.load(input_path, mmap_mode="r")
+    else:
+        if dtype is None:
+            raise DatasetError(
+                "raw binary input needs --dtype (e.g. int32) to decode %s"
+                % input_path)
+        tokens = np.memmap(input_path, dtype=np.dtype(dtype), mode="r")
+    return tokens.reshape(-1)
+
+
+def build_dataset(flow_name, name, input_path, shard_tokens, dtype=None,
+                  datastore=None, datastore_root=None, overwrite=False,
+                  echo=print):
+    fds = open_flow_datastore(flow_name, datastore, datastore_root)
+    tokens = load_tokens(input_path, dtype=dtype)
+    manifest = build_corpus(fds, name, tokens, shard_tokens=shard_tokens,
+                            overwrite=overwrite, dtype=dtype)
+    echo("built dataset %s/%s: %d tokens in %d shard(s) of %d tokens "
+         "(%s), %.1f MB"
+         % (flow_name, name, manifest["total_tokens"],
+            manifest["n_shards"], manifest["shard_tokens"],
+            manifest["dtype"],
+            sum(s["bytes"] for s in manifest["shards"]) / 2**20))
+    return manifest
+
+
+def dataset_info(flow_name, name, datastore=None, datastore_root=None,
+                 as_json=False, echo=print):
+    fds = open_flow_datastore(flow_name, datastore, datastore_root)
+    manifest = load_manifest(fds, name)
+    if as_json:
+        echo(json.dumps(manifest, indent=2, sort_keys=True))
+        return manifest
+    echo("dataset %s/%s" % (flow_name, name))
+    echo("  dtype        %s" % manifest["dtype"])
+    echo("  total tokens %d" % manifest["total_tokens"])
+    echo("  shards       %d x %d tokens"
+         % (manifest["n_shards"], manifest["shard_tokens"]))
+    echo("  bytes        %d" % sum(s["bytes"] for s in manifest["shards"]))
+    for i, shard in enumerate(manifest["shards"]):
+        echo("  shard %-5d %8d tokens  %s" % (i, shard["tokens"],
+                                              shard["sha256"][:16]))
+    return manifest
+
+
+def dataset_list(flow_name, datastore=None, datastore_root=None,
+                 echo=print):
+    fds = open_flow_datastore(flow_name, datastore, datastore_root)
+    names = list_datasets(fds)
+    if not names:
+        echo("no datasets built for flow %s" % flow_name)
+        return names
+    for name in names:
+        manifest = load_manifest(fds, name, missing_ok=True)
+        if manifest is None:
+            echo("%-24s (no manifest)" % name)
+        else:
+            echo("%-24s %12d tokens  %4d shard(s)  %s"
+                 % (name, manifest["total_tokens"], manifest["n_shards"],
+                    manifest["dtype"]))
+    return names

@@ -80,6 +80,7 @@ def aggregate(records, profiles=None):
                 s = train_steps.setdefault(
                     (rec.get("step", ""), base, rec["step_num"]), {
                         "ms": [], "tokens_per_sec": [], "mfu": [],
+                        "input_stall_ms": [],
                         "ranks": set(), "compile": False})
                 s["ms"].append(ms)
                 s["ranks"].add(rec.get("rank", 0))
@@ -89,6 +90,8 @@ def aggregate(records, profiles=None):
                     s["tokens_per_sec"].append(data["tokens_per_sec"])
                 if "mfu" in data:
                     s["mfu"].append(data["mfu"])
+                if "input_stall_ms" in data:
+                    s["input_stall_ms"].append(data["input_stall_ms"])
         elif rtype == "counter":
             counters[name] = counters.get(name, 0) + rec.get("inc", 1)
         elif rtype == "gauge":
@@ -129,6 +132,9 @@ def aggregate(records, profiles=None):
                 statistics.mean(s["tokens_per_sec"]), 1)
         if s["mfu"]:
             row["mfu"] = round(statistics.mean(s["mfu"]), 4)
+        if s["input_stall_ms"]:
+            # worst rank: a gang step waits for its SLOWEST host's input
+            row["input_stall_ms"] = round(max(s["input_stall_ms"]), 3)
         timeline.append(row)
 
     train = {}
@@ -151,6 +157,16 @@ def aggregate(records, profiles=None):
         mfus = [r["mfu"] for r in pick if "mfu" in r]
         if mfus:
             train["mfu"] = round(statistics.mean(mfus), 4)
+        stalls = [r["input_stall_ms"] for r in pick
+                  if "input_stall_ms" in r]
+        if stalls:
+            train["input_stall_ms"] = round(statistics.mean(stalls), 3)
+            mean_ms = train["mean_step_ms"]
+            if mean_ms:
+                # the input-bound verdict: fraction of each step the host
+                # spent waiting on data instead of dispatching
+                train["input_stall_frac"] = round(
+                    train["input_stall_ms"] / mean_ms, 4)
         for key_name, values in train_summary.items():
             vals = [v for v in values if isinstance(v, (int, float))]
             if not vals:
@@ -248,6 +264,12 @@ def render_summary(run_id, agg, echo=print):
             line += ", %.0f tokens/s" % train["tokens_per_sec"]
         if "mfu" in train:
             line += ", MFU %.1f%%" % (train["mfu"] * 100)
+        if "input_stall_ms" in train:
+            line += ", input stall %s/step" % _fmt_ms(
+                train["input_stall_ms"])
+            if train.get("input_stall_frac", 0) >= 0.1:
+                line += " (INPUT-BOUND %.0f%%)" % (
+                    train["input_stall_frac"] * 100)
         echo(line)
         extras = []
         if "compiles_total" in train:
@@ -291,15 +313,17 @@ def render_timeline(agg, echo=print):
         echo("no per-step training records in this run")
         return
     grouped = any("group" in row for row in agg["timeline"])
-    header = "%8s %10s %14s %8s %6s %s" % ("step", "wall", "tokens/s",
-                                           "MFU", "ranks", "")
+    header = "%8s %10s %14s %8s %10s %6s %s" % (
+        "step", "wall", "tokens/s", "MFU", "stall", "ranks", "")
     echo(("%-24s " % "group") + header if grouped else header)
     for row in agg["timeline"]:
-        line = "%8d %10s %14s %8s %6d %s" % (
+        line = "%8d %10s %14s %8s %10s %6d %s" % (
             row["step_num"], _fmt_ms(row["ms"]),
             ("%.0f" % row["tokens_per_sec"]
              if "tokens_per_sec" in row else "-"),
             ("%.1f%%" % (row["mfu"] * 100) if "mfu" in row else "-"),
+            (_fmt_ms(row["input_stall_ms"])
+             if "input_stall_ms" in row else "-"),
             row["ranks"], "compile" if row.get("compile") else "")
         echo(("%-24s " % row.get("group", "")) + line if grouped
              else line)

@@ -11,6 +11,7 @@ local filesystem so the same code runs in tests and airgapped dev boxes.
         objs = gs.get_many(["a.npy", "b.npy"])
 """
 
+import itertools
 import os
 import shutil
 import tempfile
@@ -19,6 +20,24 @@ from concurrent.futures import ThreadPoolExecutor
 from .exception import TpuFlowException
 
 MAX_WORKERS = 32
+
+
+class GSBatchFailure(TpuFlowException):
+    """One or more keys of a get_many/put_many batch failed. The batch
+    runs to completion first (a transient failure on one key must not
+    abort 999 in-flight siblings); `failures` lists (key, exception)."""
+
+    headline = "Batched GCS operation partially failed"
+
+    def __init__(self, op, failures):
+        self.failures = failures
+        msg = "%s failed for %d key(s): %s" % (
+            op, len(failures),
+            "; ".join("%s (%s: %s)" % (k, type(e).__name__, e)
+                      for k, e in failures[:5]))
+        if len(failures) > 5:
+            msg += "; ... %d more" % (len(failures) - 5)
+        super(GSBatchFailure, self).__init__(msg)
 
 
 class GSObject(object):
@@ -58,6 +77,10 @@ class GS(object):
         self._root = root
         self._tmpdir = tempfile.mkdtemp(prefix="tpuflow_gs_",
                                         dir=tmproot)
+        # per-download sequence number: concurrent get()s of the SAME key
+        # must never share a scratch file while downloading
+        # (itertools.count is atomic under the GIL)
+        self._seq = itertools.count()
         self._is_gs = root.startswith("gs://")
         if self._is_gs:
             from .datastore.storage import GCSStorage
@@ -99,40 +122,65 @@ class GS(object):
     def get(self, key):
         import hashlib
 
-        # hash the key for the temp name: '/'-flattening would collide
-        # ('a/b' vs 'a_b') and concurrent get_many calls then race
+        # hash the key for the local name: '/'-flattening would collide
+        # ('a/b' vs 'a_b'). The download lands on a PER-CALL scratch path
+        # and is os.replace()d onto the per-key path: two concurrent
+        # fetches of the same key (overlapping get_many calls, or threads
+        # sharing one GS) never race shutil.copy onto one file — each
+        # writes its own scratch copy, the renames are atomic, and a
+        # reader only ever sees a complete blob. One file per KEY stays
+        # on disk, so a long-lived GS polling the same key doesn't
+        # accumulate copies until close().
         local = os.path.join(
-            self._tmpdir, hashlib.sha256(key.encode()).hexdigest()[:24]
-        )
+            self._tmpdir, hashlib.sha256(key.encode()).hexdigest()[:24])
+        scratch = "%s.%d" % (local, next(self._seq))
         if self._is_gs:
             with self._storage.load_bytes([key]) as loaded:
                 for _k, src, _m in loaded:
                     if src is None:
                         return GSObject(self._url(key), exists=False)
-                    shutil.copy(src, local)
+                    shutil.copy(src, scratch)
         else:
             src = self._url(key)
             if not os.path.exists(src):
                 return GSObject(self._url(key), exists=False)
-            shutil.copy(src, local)
-        return GSObject(self._url(key), path=local,
-                        size=os.path.getsize(local))
+            shutil.copy(src, scratch)
+        size = os.path.getsize(scratch)
+        os.replace(scratch, local)
+        return GSObject(self._url(key), path=local, size=size)
 
     # ---------- batched ops (the throughput path) ----------
 
     def put_many(self, key_obj_pairs):
         pairs = list(key_obj_pairs)
-        with ThreadPoolExecutor(
-            max_workers=min(MAX_WORKERS, max(1, len(pairs)))
-        ) as pool:
-            return list(pool.map(lambda kv: self.put(*kv), pairs))
+        return self._run_batch("put_many", lambda kv: self.put(*kv),
+                               pairs, key_of=lambda kv: kv[0])
 
     def get_many(self, keys):
-        keys = list(keys)
+        return self._run_batch("get_many", self.get, list(keys),
+                               key_of=lambda k: k)
+
+    def _run_batch(self, op, fn, items, key_of):
+        """Fan `fn` over `items`, letting EVERY transfer finish before
+        reporting: per-key exceptions are collected and raised together
+        as GSBatchFailure (with .failures), instead of the first failed
+        future aborting the whole pool.map mid-batch."""
+        if not items:
+            return []
         with ThreadPoolExecutor(
-            max_workers=min(MAX_WORKERS, max(1, len(keys)))
+            max_workers=min(MAX_WORKERS, len(items))
         ) as pool:
-            return list(pool.map(self.get, keys))
+            futures = [pool.submit(fn, item) for item in items]
+            results, failures = [], []
+            for item, fut in zip(items, futures):
+                try:
+                    results.append(fut.result())
+                except Exception as ex:
+                    failures.append((key_of(item), ex))
+                    results.append(None)
+        if failures:
+            raise GSBatchFailure(op, failures)
+        return results
 
     def list_paths(self, prefix=""):
         if self._is_gs:

@@ -42,8 +42,19 @@ class FakeGCSState(object):
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     state = None  # injected
+    # injected per-request latency (seconds): models a real object
+    # store's RTT so readahead/overlap machinery has latency to hide on
+    # loopback — time.sleep releases the GIL, so concurrent requests
+    # overlap their delays exactly like real network waits
+    latency_s = 0.0
 
     # ------------- helpers -------------
+
+    def _delay(self):
+        if self.latency_s:
+            import time
+
+            time.sleep(self.latency_s)
 
     def _send(self, status, body=b"", content_type="application/json",
               extra_headers=None):
@@ -78,6 +89,7 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------- routes -------------
 
     def do_GET(self):
+        self._delay()
         with self.state.lock:
             self.state.request_count += 1
         parsed = urllib.parse.urlparse(self.path)
@@ -101,6 +113,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": "no route %s" % parsed.path})
 
     def do_POST(self):
+        self._delay()
         with self.state.lock:
             self.state.request_count += 1
         parsed = urllib.parse.urlparse(self.path)
@@ -127,6 +140,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": "no route %s" % parsed.path})
 
     def do_DELETE(self):
+        self._delay()
         with self.state.lock:
             self.state.request_count += 1
         m = re.match(r"^/storage/v1/b/([^/]+)/o/([^/]+)$",
@@ -226,9 +240,11 @@ class _Handler(BaseHTTPRequestHandler):
 class FakeGCSServer(object):
     """Context manager: `with FakeGCSServer() as srv: ... srv.endpoint`."""
 
-    def __init__(self, port=0):
+    def __init__(self, port=0, latency_ms=0.0):
         self.state = FakeGCSState()
-        handler = type("BoundHandler", (_Handler,), {"state": self.state})
+        handler = type("BoundHandler", (_Handler,),
+                       {"state": self.state,
+                        "latency_s": float(latency_ms) / 1000.0})
         self.server = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.endpoint = "http://127.0.0.1:%d" % self.server.server_port
         self._thread = threading.Thread(
@@ -369,7 +385,7 @@ class _ReusePortHTTPServer(ThreadingHTTPServer):
         ThreadingHTTPServer.server_bind(self)
 
 
-def serve_cluster(workers, root, port=0):
+def serve_cluster(workers, root, port=0, latency_ms=0.0):
     """Pre-fork N worker processes all bound to ONE port via SO_REUSEPORT,
     state shared through `root`. Returns (endpoint, child pids); the
     caller owns cleanup (SIGTERM the pids). This exists so gsop benchmark
@@ -388,7 +404,8 @@ def serve_cluster(workers, root, port=0):
             try:
                 state = FakeGCSDiskState(root)
                 handler = type("BoundHandler", (_Handler,),
-                               {"state": state})
+                               {"state": state,
+                                "latency_s": float(latency_ms) / 1000.0})
                 srv = _ReusePortHTTPServer(("127.0.0.1", port), handler)
                 probe.close()
                 srv.serve_forever()
@@ -419,6 +436,7 @@ def main():
 
     workers = 1
     root = None
+    latency_ms = 0.0
     args = sys.argv[1:]
     while args:
         if args[0] == "--workers":
@@ -427,12 +445,17 @@ def main():
         elif args[0] == "--root":
             root = args[1]
             args = args[2:]
+        elif args[0] == "--latency-ms":
+            # injected per-request latency: benches use it to model a
+            # remote object store's RTT over loopback
+            latency_ms = float(args[1])
+            args = args[2:]
         else:
             print("unknown arg %s" % args[0], file=sys.stderr)
             return 2
 
     if workers <= 1:
-        srv = FakeGCSServer()
+        srv = FakeGCSServer(latency_ms=latency_ms)
         print(srv.endpoint, flush=True)
         srv._thread.start()
         try:
@@ -445,7 +468,7 @@ def main():
     if root is None:
         base = "/dev/shm" if os.path.isdir("/dev/shm") else None
         root = tempfile.mkdtemp(prefix="fake-gcs-", dir=base)
-    endpoint, pids = serve_cluster(workers, root)
+    endpoint, pids = serve_cluster(workers, root, latency_ms=latency_ms)
     print(endpoint, flush=True)
 
     def _bye(*_):

@@ -1,3 +1,4 @@
+from ..data import StreamingTokenBatches
 from .checkpoint import AsyncCheckpointManager, Checkpoint
 from .data import STATE_KEY, ResumableTokenBatches, sharded_dataset
 from .metrics import (
@@ -29,6 +30,7 @@ __all__ = [
     "reshard_like",
     "shard_batch",
     "ResumableTokenBatches",
+    "StreamingTokenBatches",
     "sharded_dataset",
     "STATE_KEY",
     "TrainStepTelemetry",

@@ -19,9 +19,13 @@ import threading
 
 import numpy as np
 
-# key under which ResumableTokenBatches stamps its resume state into each
-# batch dict; shard_iterator passes it through host-side (never deviced)
-STATE_KEY = "data_state"
+# canonical home: metaflow_tpu/data/ordering.py (shared with the
+# streaming loader); re-exported here for the existing import surface.
+# shard_iterator passes the stamp through host-side (never deviced).
+from ..data.ordering import (  # noqa: F401  (STATE_KEY re-export)
+    STATE_KEY,
+    hierarchical_window_order,
+)
 
 
 class ResumableTokenBatches(object):
@@ -43,13 +47,21 @@ class ResumableTokenBatches(object):
     """
 
     def __init__(self, data, batch_size, seq_len, *, seed=None,
-                 epochs=None, drop_last=True):
+                 epochs=None, drop_last=True, shard_windows=None):
+        """shard_windows: view the array as consecutive shards of this
+        many windows and shuffle hierarchically (shard order, then
+        windows within each shard) instead of globally — the EXACT order
+        a StreamingTokenBatches walks over the equivalent sharded corpus
+        (data/ordering.py), so the two are byte-identical for the same
+        seed. Default None keeps the historical global permutation."""
         self._data = np.asarray(data)
         self._batch_size = batch_size
         self._window = seq_len + 1
         self._seed = seed
         self._epochs = epochs
-        self._drop_last = drop_last
+        self._drop_last = bool(drop_last)
+        self._shard_windows = (None if shard_windows is None
+                               else int(shard_windows))
         self._epoch = 0
         self._cursor = 0  # batches already yielded in the current epoch
         n_windows = len(self._data) // self._window
@@ -70,11 +82,18 @@ class ResumableTokenBatches(object):
         JSON- and orbax-serializable). Carries the stream geometry too,
         so restoring onto a differently-shaped stream is a hard error,
         not a silently different token sequence."""
-        return {"epoch": int(self._epoch), "cursor": int(self._cursor),
-                "seed": self._seed,
-                "batch_size": int(self._batch_size),
-                "window": int(self._window),
-                "n_windows": int(self._n_windows)}
+        state = {"epoch": int(self._epoch), "cursor": int(self._cursor),
+                 "seed": self._seed,
+                 "batch_size": int(self._batch_size),
+                 "window": int(self._window),
+                 "n_windows": int(self._n_windows),
+                 # drop_last changes batches_per_epoch, so a stamp from a
+                 # drop_last=False stream must not restore into a
+                 # drop_last=True one (and vice versa)
+                 "drop_last": int(self._drop_last)}
+        if self._shard_windows is not None:
+            state["shard_windows"] = int(self._shard_windows)
+        return state
 
     def restore(self, state):
         """Position the stream just after the batch that carried `state`
@@ -94,6 +113,27 @@ class ResumableTokenBatches(object):
                     "cursor would address different tokens (same data, "
                     "batch_size and seq_len are required to resume)"
                     % (key, theirs, mine))
+        # drop_last changes batches_per_epoch: a mismatched stamp would
+        # restore into a stream whose cursor addresses different batches.
+        # Pre-drop_last stamps don't carry the key; skip only then.
+        theirs = state.get("drop_last")
+        if theirs is not None and bool(int(theirs)) != self._drop_last:
+            raise ValueError(
+                "checkpointed stream drop_last=%r != this stream's %r — "
+                "batches_per_epoch differs, the cursor would address "
+                "different batches" % (bool(int(theirs)), self._drop_last))
+        # a stamp without shard_windows came from a global-permutation
+        # stream (shard_windows=None): the orders differ, so None vs set
+        # is a mismatch, not a missing key
+        theirs = state.get("shard_windows")
+        if (theirs is None) != (self._shard_windows is None) or (
+                theirs is not None
+                and int(theirs) != self._shard_windows):
+            raise ValueError(
+                "checkpointed stream shard_windows=%r != this stream's %r "
+                "— the shuffle orders differ, restoring would produce a "
+                "different token sequence"
+                % (theirs, self._shard_windows))
         epoch = int(state["epoch"])
         cursor = int(state["cursor"])
         # a corrupted stamp must fail loudly, not silently truncate or
@@ -114,6 +154,11 @@ class ResumableTokenBatches(object):
         return self
 
     def _order(self, epoch):
+        if self._shard_windows is not None:
+            # hierarchical (shard order, then windows within shard): the
+            # shared pure function the streaming loader also walks
+            return hierarchical_window_order(
+                self._seed, epoch, self._n_windows, self._shard_windows)
         if self._seed is None:
             return np.arange(self._n_windows)
         rng = np.random.default_rng([int(self._seed), int(epoch)])
@@ -218,21 +263,32 @@ def prefetch(iterator, depth=2):
 
 
 def sharded_dataset(data, batch_size, seq_len, mesh, rng=None,
-                    prefetch_depth=2, seed=None, state=None, epochs=None):
+                    prefetch_depth=2, seed=None, state=None, epochs=None,
+                    drop_last=True, corpus=None):
     """Batching → mesh placement → background prefetch, composed.
 
     With `seed` (and optionally a checkpointed `state` stamp to resume
     from), batches come from ResumableTokenBatches and carry their
     STATE_KEY resume stamp; the legacy `rng` path is single-epoch and
-    unstamped."""
-    if seed is not None or state is not None:
+    unstamped.
+
+    corpus: a data.StreamingTokenBatches (or any source honoring the
+    same restore/iterate contract) — the on-datastore streaming path;
+    `data`/`seed`/`epochs`/`drop_last` are ignored (they live on the
+    corpus), `state` resumes it."""
+    if corpus is not None:
+        if state is not None:
+            corpus.restore(state)
+        source = iter(corpus)
+    elif seed is not None or state is not None:
         ds = ResumableTokenBatches(data, batch_size, seq_len,
                                    seed=seed if seed is not None
                                    else (state or {}).get("seed"),
-                                   epochs=epochs)
+                                   epochs=epochs, drop_last=drop_last)
         if state is not None:
             ds.restore(state)
         source = iter(ds)
     else:
-        source = token_batches(data, batch_size, seq_len, rng=rng)
+        source = token_batches(data, batch_size, seq_len, rng=rng,
+                               drop_last=drop_last)
     return prefetch(shard_iterator(source, mesh), depth=prefetch_depth)

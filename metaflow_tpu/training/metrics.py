@@ -122,6 +122,8 @@ class TrainStepTelemetry(object):
         self.compile_ms = 0.0
         self._compile_steps = set()
         self._prev_start = None
+        self._prev_return = None
+        self._stalls = []
         self._intervals = []
         self._mem_peak = 0
         self._per_chip = None  # (n_devices, peak_tflops) lazy
@@ -153,8 +155,16 @@ class TrainStepTelemetry(object):
         trigger = self._trigger()
         if trigger is not None:
             trigger.on_step(self.step_num)
+        # host time between the previous step's return and this call is
+        # the input stall: the train loop was blocked in next(iterator)
+        # (plus loop overhead) instead of dispatching — the signal that a
+        # run is INPUT-bound. It lands inside step N-1's wall interval,
+        # so it rides that step's record.
+        stall_s = (None if self._prev_return is None
+                   else now - self._prev_return)
         if self._prev_start is not None:
-            self._emit_step(self.step_num - 1, now - self._prev_start)
+            self._emit_step(self.step_num - 1, now - self._prev_start,
+                            stall_s=stall_s)
         self._prev_start = now
         return now
 
@@ -185,6 +195,7 @@ class TrainStepTelemetry(object):
                     step_num=self.step_num,
                     data={"peak": peak} if peak else None)
         self.step_num += 1
+        self._prev_return = time.perf_counter()
 
     def _flops_from_cost_analysis(self, step_fn, args, kwargs):
         """XLA cost-model FLOPs for the exact step — pays ONE extra
@@ -205,7 +216,7 @@ class TrainStepTelemetry(object):
             pass
         return None
 
-    def _emit_step(self, step_num, interval_s):
+    def _emit_step(self, step_num, interval_s, stall_s=None):
         if interval_s <= 0:
             return
         data = {}
@@ -216,6 +227,10 @@ class TrainStepTelemetry(object):
             data["compile"] = True
         else:
             self._intervals.append(interval_s)
+            if stall_s is not None:
+                self._stalls.append(stall_s)
+        if stall_s is not None:
+            data["input_stall_ms"] = round(stall_s * 1000, 3)
         if self.tokens_per_step:
             data["tokens_per_sec"] = round(
                 self.tokens_per_step / interval_s, 1)
@@ -245,6 +260,7 @@ class TrainStepTelemetry(object):
             self._profile.stop(self.step_num)
         summary = self.report()
         for key in ("steps", "mean_step_ms", "tokens_per_sec", "mfu",
+                    "input_stall_ms",
                     "compiles", "compile_ms", "device_memory_peak_bytes"):
             value = summary.get(key)
             if value is not None:
@@ -263,6 +279,9 @@ class TrainStepTelemetry(object):
             return out
         mean = sum(self._intervals) / len(self._intervals)
         out["mean_step_ms"] = round(mean * 1000, 3)
+        if self._stalls:
+            out["input_stall_ms"] = round(
+                sum(self._stalls) / len(self._stalls) * 1000, 3)
         if self.tokens_per_step:
             out["tokens_per_sec"] = round(self.tokens_per_step / mean, 1)
         if self.flops_per_step:

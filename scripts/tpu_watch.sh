@@ -62,6 +62,7 @@ while true; do
     commit_history "On-chip gmm block-size sweep"
     run_bench launch          BENCH_MODE=launch BENCH_DAEMON=1
     run_bench data            BENCH_MODE=data
+    run_bench gsop            BENCH_MODE=gsop
     commit_history "On-chip launch + data benches"
     echo "sweep_complete $(date -u +%FT%TZ)" >> "$STATUS"
     exit 0

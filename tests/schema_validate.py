@@ -399,6 +399,124 @@ def validate_serving_record(record):
 
 
 # ---------------------------------------------------------------------------
+# Streaming dataset subsystem (metaflow_tpu/data/): the pinned v1 corpus
+# manifest and the data-path telemetry surface. additionalProperties:
+# false on the manifest — a field the builder invents (or drops) fails
+# validation, protecting every reader of on-datastore corpora from
+# silent format drift.
+# ---------------------------------------------------------------------------
+
+_SHARD = _obj(
+    {
+        "key": {"type": "string", "pattern": "^[0-9a-f]{64}$"},
+        "tokens": _INT,
+        "bytes": _INT,
+        "sha256": {"type": "string", "pattern": "^[0-9a-f]{64}$"},
+    },
+    required=("key", "tokens", "bytes", "sha256"),
+)
+
+DATASET_MANIFEST_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "name": _STR,
+        # numpy dtype with EXPLICIT byte order ('<i4', '<u2', ...): a
+        # bare 'int32' would decode differently across producers
+        "dtype": {"type": "string", "pattern": "^[<|][a-z][0-9]+$"},
+        "total_tokens": _INT,
+        "shard_tokens": _INT,
+        "n_shards": _INT,
+        "shards": _arr(_SHARD),
+    },
+    required=("v", "name", "dtype", "total_tokens", "shard_tokens",
+              "n_shards", "shards"),
+)
+
+
+def validate_dataset_manifest(manifest):
+    """Validate a corpus manifest against the pinned v1 schema, plus the
+    cross-field invariants a JSON schema cannot express."""
+    jsonschema.validate(manifest, DATASET_MANIFEST_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+    if len(manifest["shards"]) != manifest["n_shards"]:
+        raise jsonschema.ValidationError(
+            "n_shards=%d but %d shard entries"
+            % (manifest["n_shards"], len(manifest["shards"])))
+    if sum(s["tokens"] for s in manifest["shards"]) \
+            != manifest["total_tokens"]:
+        raise jsonschema.ValidationError(
+            "shard token counts do not sum to total_tokens")
+
+
+# data.* flight-recorder records emitted by the reader/loader
+# (metaflow_tpu/data/reader.py, loader.py): pinned names + types, and
+# pinned data payloads where they exist.
+DATA_METRIC_NAMES = {
+    "data.shard_fetch": "timer",
+    "data.batch_wait": "timer",
+    "data.readahead_occupancy": "gauge",
+    "data.shard_retry": "counter",
+}
+
+DATA_RECORD_DATA_SCHEMAS = {
+    "data.shard_fetch": _obj(
+        {"shard": _INT, "bytes": _INT, "retried": _BOOL},
+        required=("shard", "bytes", "retried"),
+    ),
+    "data.readahead_occupancy": _obj(
+        {"bytes": _INT, "shards": _INT, "window_bytes": _INT},
+        required=("bytes", "shards", "window_bytes"),
+    ),
+    "data.shard_retry": _obj({"shard": _INT}, required=("shard",)),
+}
+
+
+def validate_data_record(record):
+    """Validate one data.* flight-recorder record: base v1 record shape,
+    a pinned name/type, and the pinned data payload where one exists."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name not in DATA_METRIC_NAMES:
+        raise jsonschema.ValidationError(
+            "unknown data record name %r (pinned: %s)"
+            % (name, sorted(DATA_METRIC_NAMES)))
+    if record.get("type") != DATA_METRIC_NAMES[name]:
+        raise jsonschema.ValidationError(
+            "%s must be a %s record, got %r"
+            % (name, DATA_METRIC_NAMES[name], record.get("type")))
+    if name in DATA_RECORD_DATA_SCHEMAS:
+        jsonschema.validate(record.get("data", {}),
+                            DATA_RECORD_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+
+
+# the train.step record's data payload (training/metrics.py::_emit_step):
+# pinned so `tpuflow metrics` aggregation keys (tokens_per_sec, mfu,
+# input_stall_ms) cannot drift silently.
+TRAIN_STEP_DATA_SCHEMA = _obj(
+    {
+        "tokens_per_sec": _NUM,
+        "tflops_per_chip": _NUM,
+        "mfu": _NUM,
+        "compile": _BOOL,
+        "input_stall_ms": _NUM,
+    },
+)
+
+
+def validate_train_step_record(record):
+    """Validate one <prefix>.step timer record incl. its data payload."""
+    validate_telemetry_record(record)
+    if record.get("type") != "timer" \
+            or not record.get("name", "").endswith(".step"):
+        raise jsonschema.ValidationError(
+            "expected a *.step timer record, got %s %r"
+            % (record.get("type"), record.get("name")))
+    jsonschema.validate(record.get("data", {}), TRAIN_STEP_DATA_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+# ---------------------------------------------------------------------------
 # `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
 # v1 surface for the static analyzer. additionalProperties: false — a field
 # the analyzer invents fails validation, protecting editor/CI consumers of

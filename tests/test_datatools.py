@@ -1,5 +1,7 @@
 """Datatools GS client (local-path mode; gs:// shares the same surface)."""
 
+import os
+
 import pytest
 
 from metaflow_tpu.datatools import GS
@@ -42,6 +44,79 @@ def test_no_tempfile_collision(tmp_path):
         assert objs[0].blob == b"slash"
         assert objs[1].blob == b"underscore"
         assert objs[0].path != objs[1].path
+
+
+def test_concurrent_get_same_key_no_partial_reads(tmp_path):
+    """Concurrent fetches of the SAME key must never expose a
+    half-copied blob: each downloads to its own scratch path and
+    os.replace()s atomically onto the per-key path — and repeated gets
+    leave ONE file per key behind, not one per call."""
+    import threading
+
+    with GS(gsroot=str(tmp_path / "store")) as gs:
+        payload = b"x" * 65536
+        gs.put("same/key", payload)
+        results = {}
+
+        def fetch(tag):
+            results[tag] = gs.get("same/key")
+
+        threads = [threading.Thread(target=fetch, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(o.blob == payload for o in results.values())
+        # a long-lived GS polling one key must not accumulate temp
+        # copies until close(): scratch files are renamed away
+        for _ in range(5):
+            assert gs.get("same/key").blob == payload
+        assert len(os.listdir(gs._tmpdir)) == 1
+
+
+def test_get_many_surfaces_per_key_errors(tmp_path):
+    """A failing key must not abort the batch: every transfer completes,
+    then GSBatchFailure reports exactly the failed keys."""
+    from metaflow_tpu.datatools import GSBatchFailure
+
+    class FlakyGS(GS):
+        def get(self, key):
+            if key.startswith("bad"):
+                raise OSError("injected fetch failure for %s" % key)
+            return super(FlakyGS, self).get(key)
+
+    with FlakyGS(gsroot=str(tmp_path / "store")) as gs:
+        for i in range(6):
+            gs.put("k%d" % i, b"v%d" % i)
+        with pytest.raises(GSBatchFailure) as err:
+            gs.get_many(["k0", "bad1", "k2", "bad3", "k4", "k5"])
+        failed = [k for k, _ex in err.value.failures]
+        assert failed == ["bad1", "bad3"]
+        assert all(isinstance(ex, OSError)
+                   for _k, ex in err.value.failures)
+        assert "bad1" in str(err.value)
+        # the healthy keys still transfer when no key fails
+        objs = gs.get_many(["k0", "k2"])
+        assert [o.blob for o in objs] == [b"v0", b"v2"]
+
+
+def test_put_many_surfaces_per_key_errors(tmp_path):
+    from metaflow_tpu.datatools import GSBatchFailure
+
+    class FlakyPutGS(GS):
+        def put(self, key, obj):
+            if key == "boom":
+                raise OSError("injected put failure")
+            return super(FlakyPutGS, self).put(key, obj)
+
+    with FlakyPutGS(gsroot=str(tmp_path / "store")) as gs:
+        with pytest.raises(GSBatchFailure) as err:
+            gs.put_many([("a", b"1"), ("boom", b"2"), ("c", b"3")])
+        assert [k for k, _ex in err.value.failures] == ["boom"]
+        # siblings of the failed key landed anyway
+        assert gs.get("a").blob == b"1"
+        assert gs.get("c").blob == b"3"
 
 
 def test_run_scoped_paths(tmp_path, tpuflow_root):
