@@ -1105,6 +1105,110 @@ def bench_telemetry_overhead():
     }
 
 
+def bench_sanitizer_overhead():
+    """Sanitized-vs-disabled train-step overhead of the collective
+    sanitizer (spmd/sanitizer.py: per-step signature journaling plus the
+    cross-rank barrier check at its default cadence, against a live peer
+    stream in the run datastore). The headline number is the overhead in
+    PERCENT of steady-state step time — acceptance: ≤3%. Runs the real
+    bench model on TPU, the tiny config on CPU (ms-scale steps: the
+    WORST case for fixed per-step host overhead)."""
+    import tempfile
+
+    import jax
+
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.spmd.sanitizer import GangSanitizer
+    from metaflow_tpu.training import (default_optimizer, make_trainer,
+                                       memory_efficient_optimizer,
+                                       shard_batch)
+
+    n_devices = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = llama.LlamaConfig.bench_1b(
+            loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")))
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        steps, reps = 10, 2
+        optimizer = memory_efficient_optimizer(total_steps=1000)
+    else:
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq = 4, 128
+        steps, reps = 30, 5
+        optimizer = default_optimizer(total_steps=1000)
+
+    mesh = create_mesh(MeshSpec.fsdp() if n_devices > 1 else MeshSpec.dp())
+    state, step, _ = make_trainer(
+        jax.random.PRNGKey(0), cfg, mesh, llama, optimizer=optimizer)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size)
+    data = shard_batch({"tokens": tokens}, mesh)
+
+    def loop(fn, state, n):
+        state, m = fn(state, data)  # warmup (compile on first rep)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = fn(state, data)
+        jax.block_until_ready(m["loss"])
+        return (time.perf_counter() - t0) / n, state
+
+    barrier_every = int(os.environ.get("TPUFLOW_SANITIZE_EVERY", "64"))
+    total_calls = reps * (steps + 1)
+    with mesh:
+        # sanitized: SAME compiled step, wrapped, with a live datastore
+        # and a lockstep PEER stream pre-published for every barrier the
+        # run will hit — the checker pays its real poll+load+compare
+        # cost. Plain/sanitized reps INTERLEAVE so host drift (shared CI
+        # boxes) cancels instead of landing on one side.
+        with tempfile.TemporaryDirectory() as root:
+            fds = FlowDataStore("BenchSanitize", LocalStorage, ds_root=root)
+            s0 = GangSanitizer(fds, "bench", rank=0, world=2,
+                               barrier_every=barrier_every,
+                               timeout_s=60, poll_s=0.001)
+            s1 = GangSanitizer(fds, "bench", rank=1, world=2)
+            b = 0
+            for i in range(total_calls):
+                s1.journal("step", "train_step", shape=(data,))
+                if (i + 1) % barrier_every == 0:
+                    s1.publish(b)
+                    b += 1
+            wrapped = s0.wrap_step(step)
+            plain_dts, san_dts = [], []
+            for _ in range(reps):
+                dt, state = loop(step, state, steps)
+                plain_dts.append(dt)
+                dt, state = loop(wrapped, state, steps)
+                san_dts.append(dt)
+            plain = min(plain_dts)
+            sanitized = min(san_dts)
+
+    overhead_pct = (sanitized - plain) / plain * 100 if plain > 0 else 0.0
+    return {
+        "metric": "sanitizer_train_step_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "% of step time (TPUFLOW_SANITIZE=1 vs off)",
+        "vs_baseline": 1.0,
+        "extra": {
+            "backend": jax.default_backend(),
+            "n_devices": n_devices,
+            "plain_step_ms": round(plain * 1000, 3),
+            "sanitized_step_ms": round(sanitized * 1000, 3),
+            "steps_per_rep": steps,
+            "reps": reps,
+            "barrier_every": barrier_every,
+            "barriers_run": s0._barriers,
+            "journal_entries": s0._seq,
+            "gate_pct": 3.0,
+            "batch": batch,
+            "seq": seq,
+        },
+    }
+
+
 def _vs_baseline(value):
     base = os.environ.get("BENCH_BASELINE")
     if base:
@@ -1230,13 +1334,14 @@ if __name__ == "__main__":
                        os.environ.get("PYTHONPATH", "").split(os.pathsep))):
             _rerun_on_cpu(degraded=False)
         result = bench_hlo_estimate()
-    elif mode in ("decode", "moe", "telemetry", "serve"):
+    elif mode in ("decode", "moe", "telemetry", "serve", "sanitize"):
         if os.environ.get("BENCH_SKIP_PROBE") != "1":
             if _wait_for_tpu() is None:
                 _rerun_on_cpu()
         result = {"decode": bench_decode, "moe": bench_moe,
                   "telemetry": bench_telemetry_overhead,
-                  "serve": bench_serve}[mode]()
+                  "serve": bench_serve,
+                  "sanitize": bench_sanitizer_overhead}[mode]()
         if os.environ.get("BENCH_DEGRADED"):
             result["degraded"] = True
             result["degraded_reason"] = os.environ["BENCH_DEGRADED"]

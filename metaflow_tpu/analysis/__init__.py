@@ -17,10 +17,13 @@ import os
 
 from ..exception import TpuFlowException
 from .dataflow import ArtifactDataflow, analyze_artifacts
+from .determinism import analyze_determinism, scan_paths
+from .divergence import analyze_divergence
 from .extractor import extract_flow_facts
 from .report import ERROR, INFO, SEVERITIES, WARNING, AnalysisReport, Finding
 from .spmd_check import (
     analyze_spmd,
+    check_hybrid_mesh,
     check_logical_rules,
     check_mesh_axes,
     check_mesh_devices,
@@ -37,13 +40,17 @@ __all__ = [
     "INFO",
     "analyze_flow",
     "analyze_artifacts",
+    "analyze_determinism",
+    "analyze_divergence",
     "analyze_spmd",
+    "check_hybrid_mesh",
     "check_logical_rules",
     "check_mesh_axes",
     "check_mesh_devices",
     "check_pipeline",
     "extract_flow_facts",
     "pre_run_gate",
+    "scan_paths",
 ]
 
 
@@ -75,7 +82,15 @@ def analyze_flow(flow_cls, graph=None):
 
     report.analyses.append("spmd-config")
     report.extend(analyze_spmd(flow_cls, graph, facts))
-    report.checks_run += 5  # num_parallel/topology/mesh-axis/devices checks
+    report.checks_run += 6  # num_parallel/topology/mesh/hybrid-mesh checks
+
+    report.analyses.append("gang-divergence")
+    report.extend(analyze_divergence(flow_cls, graph, facts))
+    report.checks_run += 3  # deadlock / compile-divergence / write-race
+
+    report.analyses.append("determinism")
+    report.extend(analyze_determinism(flow_cls, graph))
+    report.checks_run += 3  # artifact / data-order / checkpoint sinks
     return report
 
 

@@ -1,0 +1,503 @@
+"""Nondeterminism lint for the exact-resume contracts.
+
+The streaming dataset subsystem (data/) and the checkpoint paths promise
+byte-identical replay: a resume stamp of flat ints fully determines the
+rest of a stream, and a restored run retraces the original trajectory.
+That promise dies quietly the moment a wall clock, an unseeded RNG, a
+filesystem enumeration order, or a set's iteration order leaks into an
+artifact, a dataset-order seed, or a checkpoint payload — the original
+run and the resumed run silently diverge.
+
+This pass taints values from the canonical nondeterminism sources —
+
+  - ``time.time/time_ns/monotonic/perf_counter``, ``datetime.now`` etc.
+  - unseeded ``random.*`` / legacy global ``np.random.*`` calls, and
+    RNG objects built with ``default_rng()`` / ``Random()`` without a seed
+  - ``uuid.uuid1/3/4/5``
+  - unsorted ``os.listdir`` / ``glob.glob`` / ``scandir`` / ``iterdir``
+    enumeration (``sorted(...)`` launders the ORDER taint)
+  - iteration order of ``set`` values (set literals, ``set(...)``)
+
+— and reports it flowing into the resume-critical sinks:
+
+  nondeterministic-artifact   (warning)  tainted value persisted as a
+                                         ``self.<attr>`` artifact
+  nondeterministic-data-order (error)    tainted value reaches a dataset
+                                         ordering input: a loader ``seed=``
+                                         (data/ordering.py is a pure
+                                         function of it) or a STATE_KEY /
+                                         ``data_state`` stamp
+  nondeterministic-checkpoint (error)    tainted value reaches a
+                                         checkpoint payload (``ckpt.save``,
+                                         ``current.checkpoint.save``,
+                                         ``save_run_checkpoint``)
+
+Any finding whose source file lives under ``data/`` or is
+``training/checkpoint.py`` is an error regardless of sink: those modules
+ARE the exact-resume contract. ``scan_paths`` applies the same source
+rules to library modules directly (the analyzer's own data/ self-check).
+"""
+
+import ast
+import os
+
+from .extractor import _CKPT_RECEIVER_HINTS, _call_name
+from .extractor import _receiver_source as _receiver
+from .report import ERROR, WARNING, Finding
+
+# value-taint sources: attr (or bare) call names by receiver hint
+_TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns", "clock_gettime"}
+_DATETIME_FNS = {"now", "utcnow", "today"}
+_UUID_FNS = {"uuid1", "uuid3", "uuid4", "uuid5"}
+_RANDOM_FNS = {"random", "randint", "randrange", "choice", "choices",
+               "sample", "shuffle", "uniform", "gauss", "normalvariate",
+               "getrandbits", "randbytes",
+               # numpy legacy global RNG
+               "rand", "randn", "integers", "permutation", "normal",
+               "standard_normal", "bytes"}
+# order-taint sources: enumeration with no defined order
+_LISTING_FNS = {"listdir", "scandir", "iterdir", "walk", "rglob", "iglob"}
+# `glob` is both the module and the function name (glob.glob)
+_ORDER_CLEANSERS = {"sorted", "min", "max", "sum", "len", "frozenset",
+                    "set"}
+
+# sink call tables (_CKPT_RECEIVER_HINTS shared with extractor.py — the
+# two passes must agree on what a checkpoint receiver is)
+_DATA_ORDER_CALLS = {"ResumableTokenBatches", "StreamingTokenBatches",
+                     "sharded_dataset", "ShardReader", "epoch_shard_order",
+                     "shard_window_order", "hierarchical_window_order"}
+_DATA_ORDER_KWARGS = {"seed", "epoch", "shard_index", "host_index"}
+_STATE_KEYS = {"STATE_KEY", "data_state"}
+
+# taint reasons are strings; ORDER-flavored reasons carry this prefix so
+# cleansers (sorted, ...) can drop them while keeping value taint
+_ORDER = "order:"
+
+
+def _error_path(source_file):
+    """Only the library modules that ARE the exact-resume contract
+    escalate to error — anchored on the package root, so a USER flow
+    that merely lives under some directory named data/ is not force-
+    escalated by its checkout path."""
+    p = (source_file or "").replace(os.sep, "/")
+    return ("metaflow_tpu/data/" in p
+            or p.endswith("metaflow_tpu/training/checkpoint.py"))
+
+
+class _DetWalker(object):
+    """Nondeterminism taint over one function body."""
+
+    def __init__(self, func_name, offset, source_file, findings):
+        self.func_name = func_name
+        self.offset = offset
+        self.source_file = source_file
+        self.findings = findings
+        self.tainted = {}       # local name -> set of reasons
+        self.tainted_attrs = {}  # self.<attr> -> set of reasons
+        self.rng_names = set()   # names bound to UNSEEDED RNG objects
+        self.set_names = set()   # names bound to set values
+
+    # -- reporting ----------------------------------------------------------
+
+    def _ln(self, node):
+        return node.lineno + self.offset
+
+    def _report(self, code, severity, message, node, artifact=None):
+        if _error_path(self.source_file):
+            severity = ERROR
+        self.findings.append(Finding(
+            code, severity, message, step=self.func_name,
+            artifact=artifact, lineno=self._ln(node),
+            source_file=self.source_file))
+
+    @staticmethod
+    def _why(reasons):
+        return ", ".join(sorted(r[len(_ORDER):] if r.startswith(_ORDER)
+                                else r for r in reasons))
+
+    # -- taint of expressions ----------------------------------------------
+
+    def taint_of(self, node):
+        """The set of nondeterminism reasons carried by an expression."""
+        if node is None:
+            return set()
+        if isinstance(node, ast.Name):
+            out = set(self.tainted.get(node.id, ()))
+            if node.id in self.set_names:
+                out.add(_ORDER + "set iteration order")
+            return out
+        if isinstance(node, ast.Attribute):
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                return set(self.tainted_attrs.get(node.attr, ()))
+            return self.taint_of(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        if isinstance(node, (ast.Set,)):
+            # a set literal itself is a value; ORDER taint applies when
+            # it is iterated/listed, handled by the consumers below
+            out = set()
+            for elt in node.elts:
+                out |= self.taint_of(elt)
+            return out
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            out = set()
+            for gen in node.generators:
+                out |= self.iter_taint(gen.iter)
+            for field in ("elt", "key", "value"):
+                child = getattr(node, field, None)
+                if child is not None:
+                    out |= self.taint_of(child)
+            return out
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self.taint_of(child)
+        return out
+
+    def iter_taint(self, node):
+        """Taint carried by ITERATING an expression (adds set order)."""
+        out = self.taint_of(node)
+        if isinstance(node, ast.Set) or (
+                isinstance(node, ast.Call)
+                and _call_name(node.func) == "set"):
+            out.add(_ORDER + "set iteration order")
+        return out
+
+    def _call_taint(self, node):
+        name = _call_name(node.func)
+        receiver = _receiver(node.func)
+        arg_taint = set()
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Starred):
+                arg = arg.value
+            arg_taint |= self.taint_of(arg)
+
+        # cleansers drop ORDER taint (sorted(os.listdir(d)) is exact)
+        if name in _ORDER_CLEANSERS:
+            return {r for r in arg_taint if not r.startswith(_ORDER)}
+
+        # sources
+        if name in _TIME_FNS and (receiver in ("", "time")
+                                  or receiver.endswith("time")):
+            return arg_taint | {"time.%s" % name}
+        if name in _DATETIME_FNS and "date" in receiver:
+            return arg_taint | {"datetime.%s" % name}
+        if name in _UUID_FNS:
+            return arg_taint | {"uuid.%s" % name}
+        if name in _RANDOM_FNS and (
+                (("random" in receiver and not receiver.startswith("jax"))
+                 or receiver in self._rng_receivers())):
+            # jax.random is explicitly excluded: every call takes a
+            # PRNGKey, so it is deterministic by construction
+            return arg_taint | {"unseeded %s.%s"
+                                % (receiver or "random", name)}
+        if name in _LISTING_FNS or (name in ("glob",)
+                                    and receiver in ("", "glob")):
+            mod = receiver or ("glob" if name in ("glob", "iglob")
+                               else "os")
+            return arg_taint | {_ORDER + "unsorted %s.%s()" % (mod, name)}
+        if name == "list" or name == "tuple":
+            # list(<set>) freezes the (nondeterministic) iteration order
+            inner = set()
+            for arg in node.args:
+                inner |= self.iter_taint(arg)
+            return arg_taint | inner
+        return arg_taint
+
+    def _rng_receivers(self):
+        return self.rng_names
+
+    def _is_unseeded_rng_ctor(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        name = _call_name(node.func)
+        if name == "default_rng" and not node.args and not node.keywords:
+            return True
+        if name in ("Random", "SystemRandom") and not node.args:
+            return True
+        return False
+
+    def _is_set_valued(self, node):
+        return isinstance(node, ast.Set) or (
+            isinstance(node, ast.Call)
+            and _call_name(node.func) == "set")
+
+    # -- sinks --------------------------------------------------------------
+
+    def _check_call_sinks(self, node):
+        name = _call_name(node.func)
+        receiver = _receiver(node.func)
+        # checkpoint payloads
+        is_ckpt_save = (
+            name == "save_run_checkpoint"
+            or (name == "save"
+                and any(h in receiver for h in _CKPT_RECEIVER_HINTS)))
+        if is_ckpt_save:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Starred):
+                    arg = arg.value
+                reasons = self.taint_of(arg)
+                if reasons:
+                    self._report(
+                        "nondeterministic-checkpoint", ERROR,
+                        "*%s* feeds a nondeterministic value (%s) into a "
+                        "checkpoint payload: a resumed run cannot retrace "
+                        "the original trajectory. Derive it from the "
+                        "(seeded, stepped) training state instead."
+                        % (self.func_name, self._why(reasons)), node)
+                    return
+        # dataset-order seeds
+        if name in _DATA_ORDER_CALLS:
+            tainted_args = []
+            for kw in node.keywords:
+                if kw.arg in _DATA_ORDER_KWARGS:
+                    reasons = self.taint_of(kw.value)
+                    if reasons:
+                        tainted_args.append((kw.arg, reasons))
+            for arg, reasons in tainted_args:
+                self._report(
+                    "nondeterministic-data-order", ERROR,
+                    "*%s* passes a nondeterministic value (%s) as %s(%s=): "
+                    "the shuffle orders in data/ordering.py are pure "
+                    "functions of it, so exact resume becomes impossible. "
+                    "Use a fixed or Parameter-supplied seed."
+                    % (self.func_name, self._why(reasons), name, arg),
+                    node)
+
+    def _check_state_key_store(self, target, reasons, node):
+        """subscript store into a STATE_KEY / data_state slot."""
+        if not reasons or not isinstance(target, ast.Subscript):
+            return False
+        sl = target.slice
+        key = None
+        if isinstance(sl, ast.Name):
+            key = sl.id
+        elif isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            key = sl.value
+        if key in _STATE_KEYS:
+            self._report(
+                "nondeterministic-data-order", ERROR,
+                "*%s* stores a nondeterministic value (%s) into the "
+                "dataset resume stamp (%s): restore() will land on a "
+                "different token stream than the original run."
+                % (self.func_name, self._why(reasons), key), node)
+            return True
+        return False
+
+    # -- statements ---------------------------------------------------------
+
+    def run(self, body):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _bind(self, target, value_node, reasons):
+        if isinstance(target, ast.Name):
+            if self._is_unseeded_rng_ctor(value_node):
+                self.rng_names.add(target.id)
+            else:
+                self.rng_names.discard(target.id)
+            if self._is_set_valued(value_node):
+                self.set_names.add(target.id)
+            else:
+                self.set_names.discard(target.id)
+            if reasons:
+                self.tainted[target.id] = set(reasons)
+            else:
+                self.tainted.pop(target.id, None)
+        elif (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            if not target.attr.startswith("_"):
+                if reasons:
+                    self._report(
+                        "nondeterministic-artifact", WARNING,
+                        "*%s* persists a nondeterministic value (%s) as "
+                        "artifact self.%s: two runs of the same flow "
+                        "produce different artifacts, and exact resume "
+                        "replays a different value."
+                        % (self.func_name, self._why(reasons),
+                           target.attr),
+                        target, artifact=target.attr)
+                    self.tainted_attrs[target.attr] = set(reasons)
+                else:
+                    self.tainted_attrs.pop(target.attr, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, None, reasons)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, None, reasons)
+
+    def _stmt(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.run(node.body)
+            return
+        if isinstance(node, ast.Assign):
+            # sink calls live on assignment RHS in the common form
+            # (`loader = StreamingTokenBatches(..., seed=...)`) — scan
+            # for them BEFORE binding the result
+            self._scan_expr(node.value)
+            reasons = self.taint_of(node.value)
+            # elementwise tuple unpacking, mirroring the rank-taint fix
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], (ast.Tuple, ast.List))
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                    and len(node.targets[0].elts)
+                    == len(node.value.elts)):
+                for tgt, val in zip(node.targets[0].elts,
+                                    node.value.elts):
+                    self._bind(tgt, val, self.taint_of(val))
+                return
+            for target in node.targets:
+                if self._check_state_key_store(target, reasons, node):
+                    continue
+                self._bind(target, node.value, reasons)
+            return
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._scan_expr(node.value)
+            reasons = self.taint_of(node.value)
+            if not self._check_state_key_store(node.target, reasons, node):
+                self._bind(node.target, node.value, reasons)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._scan_expr(node.value)
+            reasons = self.taint_of(node.value)
+            if reasons:
+                if isinstance(node.target, ast.Name):
+                    self.tainted.setdefault(node.target.id,
+                                            set()).update(reasons)
+                elif (isinstance(node.target, ast.Attribute)
+                        and isinstance(node.target.value, ast.Name)
+                        and node.target.value.id == "self"):
+                    self._bind(node.target, node.value, reasons)
+            return
+        if isinstance(node, ast.For):
+            self._scan_expr(node.iter)
+            reasons = self.iter_taint(node.iter)
+            self._bind(node.target, None, reasons)
+            for child in node.body + node.orelse:
+                self._stmt(child)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self._scan_expr(getattr(node, "test", None))
+            for child in (node.body + node.orelse):
+                self._stmt(child)
+            return
+        if isinstance(node, ast.Try):
+            for child in (node.body + node.orelse + node.finalbody):
+                self._stmt(child)
+            for handler in node.handlers:
+                for child in handler.body:
+                    self._stmt(child)
+            return
+        if isinstance(node, ast.With):
+            for item in node.items:
+                self._scan_expr(item.context_expr)
+            for child in node.body:
+                self._stmt(child)
+            return
+        if isinstance(node, ast.Expr):
+            self._scan_expr(node.value)
+            return
+        if isinstance(node, ast.Return):
+            self._scan_expr(node.value)
+            return
+        # generic: scan expressions for sink calls
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child)
+
+    def _scan_expr(self, node):
+        if node is None:
+            return
+        for call in [n for n in ast.walk(node) if isinstance(n, ast.Call)]:
+            self._check_call_sinks(call)
+
+
+def analyze_determinism(flow_cls, graph):
+    """Run the nondeterminism lint over every step body (and helper
+    method) of a flow class; returns a list of Findings. (Taint here is
+    its own walk — the extractor's rank-taint facts are a different
+    lattice, so there is nothing to reuse from them.)"""
+    from ..graph import walk_step_sources
+
+    findings = []
+    seen = set()
+    for _cls, class_ast, source_file, offset in walk_step_sources(flow_cls):
+        for item in class_ast.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name.startswith("__") or item.name in seen:
+                continue
+            seen.add(item.name)
+            walker = _DetWalker(item.name, offset, source_file, findings)
+            walker.run(item.body)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# library-module scan: the analyzer's own self-check over data/ and the
+# checkpoint path (scripts/analyze_all.sh + tests run this)
+# ---------------------------------------------------------------------------
+
+
+def scan_paths(paths):
+    """Blunt, zero-false-positive-biased nondeterminism scan over library
+    source files: unseeded global RNG calls, uuid, and DIRECT iteration/
+    return of an unsorted filesystem enumeration. Severity is error for
+    files under data/ or training/checkpoint.py, warning elsewhere."""
+    findings = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError) as ex:
+            findings.append(Finding(
+                "determinism-scan-error", WARNING,
+                "could not scan %s: %s" % (path, ex), source_file=path))
+            continue
+        severity = ERROR if _error_path(path) else WARNING
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                receiver = _receiver(node.func)
+                if name in _UUID_FNS:
+                    findings.append(Finding(
+                        "nondeterministic-source", severity,
+                        "uuid.%s() in library code: ids must derive from "
+                        "run/task identity to keep replay exact" % name,
+                        lineno=node.lineno, source_file=path))
+                elif (name in _RANDOM_FNS
+                        and receiver in ("random", "np.random",
+                                         "numpy.random")):
+                    findings.append(Finding(
+                        "nondeterministic-source", severity,
+                        "unseeded global %s.%s() in library code: use a "
+                        "seeded np.random.default_rng / jax PRNGKey"
+                        % (receiver, name),
+                        lineno=node.lineno, source_file=path))
+                elif (name == "default_rng" and not node.args
+                        and not node.keywords):
+                    findings.append(Finding(
+                        "nondeterministic-source", severity,
+                        "np.random.default_rng() without a seed in "
+                        "library code", lineno=node.lineno,
+                        source_file=path))
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if (isinstance(it, ast.Call)
+                        and (_call_name(it.func) in _LISTING_FNS
+                             or (_call_name(it.func) == "glob"
+                                 and _receiver(it.func)
+                                 in ("", "glob")))):
+                    findings.append(Finding(
+                        "nondeterministic-source", severity,
+                        "iterating %s() directly: filesystem enumeration "
+                        "order is undefined — wrap it in sorted()"
+                        % ast.unparse(it.func),
+                        lineno=it.lineno, source_file=path))
+    return findings

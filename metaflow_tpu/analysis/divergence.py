@@ -1,0 +1,133 @@
+"""Gang-divergence static pass: the deadly failure class of SPMD gangs.
+
+A gang (@parallel / num_parallel) step is ONE logical program running as N
+rank processes. The silent multi-hour hang happens when ranks diverge on
+which collective / jit program they execute next: the ranks that entered a
+psum (or an orbax multihost save, or a fresh compile) block forever on the
+ranks that skipped it. This pass reports that class BEFORE launch, using
+the rank-taint machinery in extractor.py (``_RANK_ATTRS``, GangCall
+events) extended interprocedurally across ``self.<helper>()`` closures and
+into the known collective-bearing library calls (spmd/sharding.py mesh +
+constraint ops, training/train_step.py trainer programs,
+training/checkpoint.py + ``current.checkpoint`` orbax saves,
+data/loader.py per-host lockstep streams, telemetry flush).
+
+Finding classes (codes match docs/static-analysis.md):
+
+  gang-divergent-collective (error)   a collective / gang-wide barrier is
+                                      guarded by rank-dependent control
+                                      flow: skipping ranks deadlock the
+                                      gang. Soft entries (telemetry flush)
+                                      and gangs that explicitly run
+                                      without jax.distributed degrade to
+                                      warnings — there is no cross-rank
+                                      program to hang.
+  gang-divergent-compile    (error)   a rank-tainted value flows into a
+                                      compile-shaping argument (MeshSpec
+                                      axes, create_mesh/create_hybrid_mesh,
+                                      make_train_step/make_trainer, jit):
+                                      every rank compiles a DIFFERENT
+                                      program — the gang desyncs at the
+                                      first collective inside it.
+  gang-shared-write-race    (error)   a rank-divergent payload is written
+                                      to a run-level datastore key that
+                                      does NOT incorporate the rank: N
+                                      ranks race last-writer-wins on one
+                                      key (upgraded from the PR-3-era
+                                      blanket warning; the elementwise
+                                      taint fixes make it precise enough
+                                      to be an error).
+
+The runtime sanitizer (spmd/sanitizer.py) is the dynamic complement: what
+this pass cannot prove, the sanitizer catches at the first step barrier.
+"""
+
+from .extractor import extract_flow_facts
+from .report import ERROR, WARNING, Finding
+
+
+def _jax_distributed(node):
+    """Whether this gang step runs a cross-rank jax.distributed program.
+    ``@tpu_parallel(jax_distributed=False)`` gangs are N independent
+    processes: nothing can deadlock on a skipped collective (shared
+    datastore writes still race)."""
+    for deco in node.decorators or []:
+        if getattr(deco, "name", None) == "tpu_parallel":
+            attrs = getattr(deco, "attributes", None) or {}
+            if attrs.get("jax_distributed") is False:
+                return False
+    return True
+
+
+def analyze_divergence(flow_cls, graph, facts=None):
+    """Run the gang-divergence pass; returns a list of Findings."""
+    facts = facts or extract_flow_facts(flow_cls, graph)
+    findings = []
+    for node in graph:
+        if not node.parallel_step:
+            continue
+        f = facts.get(node.name)
+        if f is None:
+            continue
+        distributed = _jax_distributed(node)
+        reported = set()
+        for e in f.gang_calls:
+            key = (e.role, e.func, e.lineno)
+            if key in reported:
+                continue
+            loc = dict(step=node.name, lineno=e.lineno,
+                       source_file=f.source_file)
+            if e.role == "collective" and e.rank_cond:
+                reported.add(key)
+                if e.soft:
+                    findings.append(Finding(
+                        "gang-divergent-collective", WARNING,
+                        "Step *%s* is a gang step and calls %s() under "
+                        "rank-dependent control flow: the skipping ranks' "
+                        "journals/telemetry fall out of lockstep with the "
+                        "rest of the gang (the program itself survives)."
+                        % (node.name, e.func), **loc))
+                else:
+                    findings.append(Finding(
+                        "gang-divergent-collective",
+                        ERROR if distributed else WARNING,
+                        "Step *%s* is a gang (@parallel) step and reaches "
+                        "the collective-bearing call %s() under "
+                        "rank-dependent control flow: ranks that skip it "
+                        "%s. Execute it on every rank, or move the "
+                        "rank-specific work outside the collective path."
+                        % (node.name, e.func,
+                           "leave the others blocked in the collective "
+                           "forever — the silent multi-hour hang"
+                           if distributed else
+                           "diverge from the gang's lockstep (this gang "
+                           "runs without jax.distributed, so it cannot "
+                           "deadlock, but the ranks no longer execute "
+                           "one program)"), **loc))
+            elif e.role == "compile":
+                reported.add(key)
+                findings.append(Finding(
+                    "gang-divergent-compile",
+                    ERROR if distributed else WARNING,
+                    "Step *%s* is a gang step and feeds a rank-dependent "
+                    "value into %s(): each rank builds a DIFFERENT "
+                    "program/mesh, so the gang desyncs at the first "
+                    "collective inside it%s. Compile-shaping arguments "
+                    "(mesh axes, static args) must be identical on every "
+                    "rank." % (
+                        node.name, e.func,
+                        " (multi-host compile fan-in will hang or crash)"
+                        if distributed else ""), **loc))
+            elif (e.role == "shared_write" and e.payload_tainted
+                    and not e.key_tainted and not e.rank_cond):
+                reported.add(key)
+                findings.append(Finding(
+                    "gang-shared-write-race", ERROR,
+                    "Step *%s* is a gang step where every rank writes a "
+                    "rank-dependent payload through %s() to the SAME "
+                    "run-level datastore key: N ranks race "
+                    "last-writer-wins, and which rank's value survives "
+                    "is a scheduling accident. Put the rank in the key, "
+                    "or write from exactly one rank."
+                    % (node.name, e.func), **loc))
+    return findings

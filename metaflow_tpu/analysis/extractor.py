@@ -36,6 +36,85 @@ _RANK_ATTRS = {"node_index", "process_index", "local_rank", "host_id"}
 # calls like jax.process_index() / jax.distributed... whose result is a rank
 _RANK_CALL_ATTRS = {"process_index", "process_idx", "host_id"}
 
+# ---------------------------------------------------------------------------
+# gang-consistency call knowledge (consumed by analysis/divergence.py)
+# ---------------------------------------------------------------------------
+# Calls that ARE (or transitively contain) a gang-wide collective, barrier,
+# or lockstep-compiled program: every rank must reach them the same number
+# of times in the same order. Executing one under rank-dependent control
+# flow is the static signature of the silent-hang class (the gang blocks in
+# a collective some ranks never enter). Values: "hard" — skipping ranks
+# deadlock the gang; "soft" — skipping only desyncs observability streams
+# (the runtime sanitizer's journal), not the program itself.
+_COLLECTIVE_CALLS = {
+    # jax / jax.lax collective primitives
+    "psum": "hard", "pmean": "hard", "pmax": "hard", "pmin": "hard",
+    "all_gather": "hard", "all_to_all": "hard", "ppermute": "hard",
+    "pshuffle": "hard", "psum_scatter": "hard",
+    # jax.experimental.multihost_utils
+    "sync_global_devices": "hard", "broadcast_one_to_all": "hard",
+    "process_allgather": "hard",
+    # spmd/sharding.py + mesh construction: tracing/compiling the global
+    # program is itself gang-wide (compile fan-in over all hosts)
+    "shard_batch": "hard", "shard_tree": "hard", "constrain": "hard",
+    "create_mesh": "hard", "create_hybrid_mesh": "hard",
+    # training/train_step.py: trainer construction inits the sharded
+    # state; invoking the compiled step launches the global program
+    "make_trainer": "hard", "make_train_step": "hard",
+    "make_eval_step": "hard", "train_step": "hard", "step_fn": "hard",
+    "eval_step": "hard",
+    # data/loader.py per-host slicing: hosts must advance the stream in
+    # lockstep or "batch N" names different tokens on different ranks
+    "sharded_dataset": "hard", "shard_iterator": "hard",
+    "StreamingTokenBatches": "hard",
+    # module-level checkpoint helpers
+    "save_run_checkpoint": "hard", "load_run_checkpoint": "hard",
+}
+# attr calls that are gang-wide only on a checkpoint-shaped receiver
+# (current.checkpoint.save / ckpt.restore: orbax multihost barrier)
+_CKPT_ATTRS = {"save", "restore", "wait"}
+_CKPT_RECEIVER_HINTS = ("ckpt", "checkpoint")
+# attr calls that are lockstep-soft on a telemetry-shaped receiver
+# (rank-guarding a flush only desyncs journals, never the program)
+_SOFT_RECEIVER_CALLS = {"flush": ("telemetry", "recorder")}
+# calls whose arguments become compile-shaping state (mesh shapes, jit
+# static args): a rank-tainted argument means each rank compiles a
+# DIFFERENT program — compile-divergence, distinct from control-flow skew
+_COMPILE_CALLS = {"MeshSpec", "create_mesh", "create_hybrid_mesh",
+                  "make_trainer", "make_train_step", "make_eval_step",
+                  "jit"}
+# shared-datastore writes visible to the whole gang:
+#   name -> (key positional index, key kwarg name, payload positional index)
+_SHARED_WRITE_CALLS = {
+    "save_artifact": (0, "name", 1),
+    "save_bytes": (0, None, 0),
+}
+# ckpt.save(payload, step=...) — payload is arg 0, the key is the step
+_CKPT_SAVE_KEY_KWARG = "step"
+
+
+def _call_name(func):
+    """The rightmost name of a call target: `jax.lax.psum` -> 'psum',
+    `psum` -> 'psum'. Returns None for computed targets."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _receiver_source(func):
+    """Dotted source of an attr call's receiver ('current.checkpoint' for
+    current.checkpoint.save), lowercased, '' when not a plain chain."""
+    parts = []
+    node = func.value if isinstance(func, ast.Attribute) else None
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
 
 class Read(object):
     __slots__ = ("name", "lineno", "safe")
@@ -100,20 +179,64 @@ class InputRead(object):
 
 class MeshLiteral(object):
     """A MeshSpec constructed with literal arguments inside a step body."""
-    __slots__ = ("preset", "args", "kwargs", "axes", "lineno")
+    __slots__ = ("preset", "args", "kwargs", "axes", "lineno", "in_hybrid")
     kind = "mesh"
 
-    def __init__(self, preset, args, kwargs, axes, lineno):
+    def __init__(self, preset, args, kwargs, axes, lineno, in_hybrid=False):
         self.preset = preset      # e.g. 'fsdp_tp' or '__init__'
         self.args = args          # literal positional args (or None each)
         self.kwargs = kwargs      # literal keyword args
         self.axes = axes          # resolved axes dict, or None if unresolved
         self.lineno = lineno
+        # constructed as the ICI spec of a create_hybrid_mesh call: its
+        # axes cover PER-SLICE devices, so whole-topology device checks
+        # must not apply (the hybrid checker owns that arithmetic)
+        self.in_hybrid = in_hybrid
+
+
+class HybridMeshLiteral(object):
+    """A create_hybrid_mesh(...) call with statically-known arguments."""
+    __slots__ = ("ici_axes", "dcn_axis", "num_slices", "lineno")
+    kind = "hybrid_mesh"
+
+    def __init__(self, ici_axes, dcn_axis, num_slices, lineno):
+        self.ici_axes = ici_axes      # per-slice axes dict, or None
+        self.dcn_axis = dcn_axis      # axis name string (default 'data')
+        self.num_slices = num_slices  # int, or None if not literal
+        self.lineno = lineno
+
+
+class GangCall(object):
+    """A call relevant to gang consistency (analysis/divergence.py).
+
+    role: 'collective'   — gang-wide op; rank_cond=True is the deadlock
+                           class (some ranks skip it)
+          'compile'      — rank-tainted value flowed into a compile-
+                           shaping argument (mesh axes, jit static args):
+                           ranks build DIFFERENT programs
+          'shared_write' — write to a run-level datastore key; a rank-
+                           tainted payload under a rank-shared key is a
+                           last-writer-wins race
+    """
+    __slots__ = ("func", "lineno", "role", "rank_cond", "soft",
+                 "key_tainted", "payload_tainted")
+    kind = "gang_call"
+
+    def __init__(self, func, lineno, role, rank_cond=False, soft=False,
+                 key_tainted=False, payload_tainted=False):
+        self.func = func
+        self.lineno = lineno
+        self.role = role
+        self.rank_cond = rank_cond
+        self.soft = soft
+        self.key_tainted = key_tainted
+        self.payload_tainted = payload_tainted
 
 
 class StepFacts(object):
     __slots__ = ("step", "events", "wildcard_write", "lineno",
-                 "source_file", "mesh_literals", "self_calls")
+                 "source_file", "mesh_literals", "hybrid_literals",
+                 "self_calls", "returns_rank")
 
     def __init__(self, step, lineno, source_file):
         self.step = step
@@ -122,9 +245,22 @@ class StepFacts(object):
         self.lineno = lineno
         self.source_file = source_file
         self.mesh_literals = []
+        self.hybrid_literals = []
         # names of self.<method>() calls: non-step helper methods write
         # artifacts on the step's behalf
         self.self_calls = set()
+        # helper summary: does a Return carry a rank-tainted value?
+        self.returns_rank = False
+
+    @property
+    def gang_calls(self):
+        return [e for e in self.events if e.kind == "gang_call"]
+
+    def first_collective(self):
+        for e in self.gang_calls:
+            if e.role == "collective" and not e.soft:
+                return e
+        return None
 
     @property
     def writes(self):
@@ -168,7 +304,8 @@ class _StepExtractor(object):
     """One pass over a single step's FunctionDef."""
 
     def __init__(self, facts, func_ast, step_names, offset,
-                 bind_inputs=True):
+                 bind_inputs=True, helper_rank_returns=None,
+                 helper_collectives=None):
         self.facts = facts
         self.func = func_ast
         self.step_names = step_names
@@ -178,6 +315,14 @@ class _StepExtractor(object):
         self.input_names = set()
         # self attrs assigned rank-dependent values (self.rank = ...)
         self.tainted_attrs = set()
+        # interprocedural helper summaries (fixpointed by
+        # extract_flow_facts): helper name -> returns a rank value /
+        # helper name -> name of a collective it (transitively) contains
+        self.helper_rank_returns = helper_rank_returns or {}
+        self.helper_collectives = helper_collectives or {}
+        # scanning the args of a create_hybrid_mesh call: inner MeshSpec
+        # literals resolve over per-slice devices, not the whole topology
+        self._in_hybrid = False
         args = func_ast.args.args
         # a join step's 2nd positional is `inputs`; helper methods' extra
         # args are ordinary values
@@ -264,6 +409,15 @@ class _StepExtractor(object):
             # a non-step helper method writes artifacts on this step's
             # behalf — resolved against the class in extract_flow_facts
             self.facts.self_calls.add(func.attr)
+            # interprocedural: a rank-guarded call to a helper that
+            # (transitively) contains a collective skips the collective
+            # on the ranks that skip the call — report at the CALL site
+            if rank:
+                inner = self.helper_collectives.get(func.attr)
+                if inner:
+                    self.facts.events.append(GangCall(
+                        "%s (via self.%s)" % (inner, func.attr),
+                        self._ln(node), "collective", rank_cond=True))
         # getattr/setattr/hasattr/delattr on self with a literal name
         if isinstance(func, ast.Name) and func.id in (
                 "getattr", "setattr", "hasattr", "delattr"):
@@ -276,21 +430,142 @@ class _StepExtractor(object):
                 and node.args[0].id == "self"):
             self.facts.wildcard_write = True
             return False, False
-        # MeshSpec literal construction (for the SPMD config checker)
+        # MeshSpec / create_hybrid_mesh literal construction (SPMD checks)
         self._maybe_mesh_literal(node)
-        # rank-returning calls: jax.process_index() etc.
+        in_hybrid = self._maybe_hybrid_literal(node)
+        # rank-returning calls: jax.process_index() etc., plus helper
+        # methods whose Return carries a rank (fixpointed summary)
         tainted = False
+        name = _call_name(func)
+        if name in _RANK_CALL_ATTRS:
+            tainted = True
         if (isinstance(func, ast.Attribute)
-                and func.attr in _RANK_CALL_ATTRS):
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and self.helper_rank_returns.get(func.attr)):
             tainted = True
         t, _ = self._expr(func, cond, rank)
         tainted = tainted or t
-        for arg in list(node.args) + [kw.value for kw in node.keywords]:
-            if isinstance(arg, ast.Starred):
-                arg = arg.value
-            ta, _ = self._expr(arg, cond, rank)
-            tainted = tainted or ta
+        # scan each argument separately: gang-call classification needs
+        # PER-ARGUMENT taint (which arg is the key, which the payload)
+        arg_taints = []
+        saved_hybrid = self._in_hybrid
+        self._in_hybrid = saved_hybrid or in_hybrid
+        try:
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    arg = arg.value
+                ta, _ = self._expr(arg, cond, rank)
+                arg_taints.append(ta)
+                tainted = tainted or ta
+            kw_taints = {}
+            for kw in node.keywords:
+                ta, _ = self._expr(kw.value, cond, rank)
+                if kw.arg is not None:
+                    kw_taints[kw.arg] = ta
+                tainted = tainted or ta
+        finally:
+            self._in_hybrid = saved_hybrid
+        self._maybe_gang_call(node, name, rank, arg_taints, kw_taints)
         return tainted, False
+
+    def _maybe_gang_call(self, node, name, rank, arg_taints, kw_taints):
+        """Record collective / compile / shared-write events for calls in
+        the gang-consistency tables (analysis/divergence.py consumes)."""
+        if name is None:
+            return
+        ln = self._ln(node)
+        any_arg_tainted = any(arg_taints) or any(kw_taints.values())
+        receiver = _receiver_source(node.func)
+
+        if name in _COLLECTIVE_CALLS:
+            self.facts.events.append(GangCall(
+                name, ln, "collective", rank_cond=rank,
+                soft=_COLLECTIVE_CALLS[name] != "hard"))
+        elif name in _CKPT_ATTRS and any(
+                h in receiver for h in _CKPT_RECEIVER_HINTS):
+            self.facts.events.append(GangCall(
+                "%s.%s" % (receiver, name) if receiver else name,
+                ln, "collective", rank_cond=rank))
+            if name == "save":
+                key_tainted = kw_taints.get(
+                    _CKPT_SAVE_KEY_KWARG,
+                    arg_taints[1] if len(arg_taints) > 1 else False)
+                payload_tainted = bool(arg_taints and arg_taints[0])
+                self.facts.events.append(GangCall(
+                    "%s.save" % (receiver or "ckpt"), ln, "shared_write",
+                    rank_cond=rank, key_tainted=key_tainted,
+                    payload_tainted=payload_tainted))
+        elif name in _SOFT_RECEIVER_CALLS:
+            hints = _SOFT_RECEIVER_CALLS[name]
+            if receiver and any(h in receiver for h in hints):
+                self.facts.events.append(GangCall(
+                    "%s.%s" % (receiver, name), ln, "collective",
+                    rank_cond=rank, soft=True))
+
+        if name in _COMPILE_CALLS and any_arg_tainted:
+            self.facts.events.append(GangCall(
+                name, ln, "compile", rank_cond=True))
+
+        if name in _SHARED_WRITE_CALLS:
+            key_idx, key_kwarg, payload_idx = _SHARED_WRITE_CALLS[name]
+            # save_bytes takes a LIST of (key, payload) tuples: a single
+            # argument index cannot separate the two, so probe the tuple
+            # elements when the list is literal (else stay conservative:
+            # equal flags can never report a race)
+            pair_taints = (self._pairwise_taints(node.args[0])
+                           if name == "save_bytes" and node.args else None)
+            if pair_taints is not None:
+                key_tainted, payload_tainted = pair_taints
+            else:
+                key_tainted = False
+                if key_kwarg is not None and key_kwarg in kw_taints:
+                    key_tainted = kw_taints[key_kwarg]
+                elif key_idx < len(arg_taints):
+                    key_tainted = arg_taints[key_idx]
+                payload_tainted = (payload_idx < len(arg_taints)
+                                   and arg_taints[payload_idx]) or any(
+                    kw_taints.get(k, False) for k in ("payload", "value"))
+            self.facts.events.append(GangCall(
+                name, ln, "shared_write", rank_cond=rank,
+                key_tainted=key_tainted, payload_tainted=payload_tainted))
+
+    def _pairwise_taints(self, node):
+        """(key_tainted, payload_tainted) over a literal list of
+        (key, payload) tuples — save_bytes' argument shape. None when the
+        argument is not a literal pair list."""
+        if not isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            return None
+        key_tainted = payload_tainted = False
+        seen = False
+        for elt in node.elts:
+            if isinstance(elt, ast.Starred):
+                elt = elt.value
+            if (isinstance(elt, (ast.Tuple, ast.List))
+                    and len(elt.elts) == 2):
+                seen = True
+                key_tainted = key_tainted or self._probe_taint(elt.elts[0])
+                payload_tainted = (payload_tainted
+                                   or self._probe_taint(elt.elts[1]))
+        return (key_tainted, payload_tainted) if seen else None
+
+    def _probe_taint(self, node):
+        """Event-free rank-taint check over a sub-expression (safe to
+        re-walk arguments the main scan already emitted events for)."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in self.tainted:
+                return True
+            if isinstance(n, ast.Attribute):
+                if (isinstance(n.value, ast.Name)
+                        and n.value.id == "self"
+                        and n.attr in self.tainted_attrs):
+                    return True
+                if n.attr in _RANK_ATTRS:
+                    return True
+            if (isinstance(n, ast.Call)
+                    and _call_name(n.func) in _RANK_CALL_ATTRS):
+                return True
+        return False
 
     def _expr_Lambda(self, node, cond, rank):
         self._expr(node.body, True, rank)
@@ -408,7 +683,55 @@ class _StepExtractor(object):
         if preset == "__init__" and args and isinstance(args[0], dict):
             axes = args[0]
         self.facts.mesh_literals.append(
-            MeshLiteral(preset, args, kwargs, axes, self._ln(node)))
+            MeshLiteral(preset, args, kwargs, axes, self._ln(node),
+                        in_hybrid=self._in_hybrid))
+
+    def _maybe_hybrid_literal(self, node):
+        """Capture a create_hybrid_mesh(...) call; returns True when the
+        call matched (so inner MeshSpec literals get in_hybrid=True)."""
+        if _call_name(node.func) != "create_hybrid_mesh":
+            return False
+        ici_axes = None
+        if node.args:
+            ici = node.args[0]
+            first = _literal(ici)
+            if isinstance(first, dict):
+                ici_axes = first
+            elif isinstance(ici, ast.Call):
+                # MeshSpec preset / ctor: resolve like the SPMD checker
+                probe = StepFacts(self.facts.step, 0, self.facts.source_file)
+                saved, self.facts = self.facts, probe
+                try:
+                    self._maybe_mesh_literal(ici)
+                finally:
+                    self.facts = saved
+                if probe.mesh_literals:
+                    ici_axes = probe.mesh_literals[0]
+        dcn_axis = "data"
+        num_slices = None
+        dcn_kw = slices_kw = False
+        for kw in node.keywords:
+            if kw.arg == "dcn_axis":
+                value = _literal(kw.value)
+                dcn_axis = value if isinstance(value, str) else None
+                dcn_kw = True
+            elif kw.arg == "num_slices":
+                value = _literal(kw.value)
+                num_slices = value if isinstance(value, int) else None
+                slices_kw = True
+        # positional: create_hybrid_mesh(ici, dcn_axis, num_slices) —
+        # each positional is consumed unless its keyword form was given
+        if len(node.args) > 1 and not dcn_kw:
+            value = _literal(node.args[1])
+            dcn_axis = value if isinstance(value, str) else None
+        if len(node.args) > 2 and not slices_kw:
+            value = _literal(node.args[2])
+            if isinstance(value, int):
+                num_slices = value
+        self.facts.hybrid_literals.append(
+            HybridMeshLiteral(ici_axes, dcn_axis, num_slices,
+                              self._ln(node)))
+        return True
 
     # -- statements ---------------------------------------------------------
 
@@ -431,7 +754,10 @@ class _StepExtractor(object):
         self._expr(node.value, cond, rank)
 
     def _stmt_Return(self, node, cond, rank):
-        self._expr(node.value, cond, rank)
+        tainted, _ = self._expr(node.value, cond, rank)
+        if tainted:
+            # helper summary: callers of this method receive a rank value
+            self.facts.returns_rank = True
 
     def _stmt_Assert(self, node, cond, rank):
         self._expr(node.test, cond, rank)
@@ -472,6 +798,19 @@ class _StepExtractor(object):
         self._expr(target, cond, rank)
 
     def _stmt_Assign(self, node, cond, rank):
+        # elementwise tuple unpacking: `rank, n = jax.process_index(), 4`
+        # must taint `rank` but NOT `n` (blanket taint turned every
+        # sibling binding rank-conditional — the old false-positive class)
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], (ast.Tuple, ast.List))
+                and isinstance(node.value, (ast.Tuple, ast.List))
+                and len(node.targets[0].elts) == len(node.value.elts)
+                and not any(isinstance(e, ast.Starred)
+                            for e in node.targets[0].elts)):
+            for tgt, val in zip(node.targets[0].elts, node.value.elts):
+                tainted, derived = self._expr(val, cond, rank)
+                self._assign_target(tgt, node, cond, rank, tainted, derived)
+            return
         tainted, derived = self._expr(node.value, cond, rank)
         for target in node.targets:
             self._assign_target(target, node, cond, rank, tainted, derived)
@@ -481,13 +820,20 @@ class _StepExtractor(object):
         self._assign_target(node.target, node, cond, rank, tainted, derived)
 
     def _stmt_AugAssign(self, node, cond, rank):
-        self._expr(node.value, cond, rank)
+        tainted, _ = self._expr(node.value, cond, rank)
         target = node.target
         if (isinstance(target, ast.Attribute)
                 and isinstance(target.value, ast.Name)
                 and target.value.id == "self"):
             self._emit_read(target.attr, target)
             self._emit_write(target.attr, target, cond, rank)
+            if tainted:
+                # r += rank makes the attr rank-dependent; an augassign
+                # never CLEARS taint (the old value still contributes)
+                self.tainted_attrs.add(target.attr)
+        elif isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
         else:
             self._expr(target, cond, rank)
 
@@ -647,43 +993,99 @@ def _wrapper_artifacts(node):
 
 
 def extract_flow_facts(flow_cls, graph):
-    """Return {step_name: StepFacts} for every step in the graph."""
+    """Return {step_name: StepFacts} for every step in the graph.
+
+    Extraction is two-phase so the rank-taint machinery is
+    interprocedural across ``self.<helper>()`` closures: helper methods
+    are extracted FIRST and summarized (does the helper return a rank
+    value? does it transitively contain a collective-bearing call?) to a
+    fixpoint, then step bodies are extracted with those summaries in
+    hand — a rank-guarded call to a collective-bearing helper reports at
+    the call site, and ``rank = self.my_rank()`` taints like a direct
+    ``jax.process_index()``."""
     from ..graph import walk_step_sources
 
     step_names = set(graph.nodes)
-    facts = {}
-    helpers = {}
+    step_items = {}
+    helper_items = {}
     for _cls, class_ast, source_file, offset in walk_step_sources(flow_cls):
         for item in class_ast.body:
             if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             if item.name in step_names:
-                if item.name in facts:
-                    continue  # subclass override wins (MRO order)
-                sf = StepFacts(item.name, item.lineno + offset, source_file)
-                _StepExtractor(sf, item, step_names, offset).run()
-                facts[item.name] = sf
-            elif not item.name.startswith("__") and item.name not in helpers:
-                # non-step helper method: its self.<attr> writes land on
-                # whichever step calls it
-                hf = StepFacts(item.name, item.lineno + offset, source_file)
-                _StepExtractor(hf, item, step_names, offset,
-                               bind_inputs=False).run()
-                helpers[item.name] = hf
+                # subclass override wins (MRO order)
+                step_items.setdefault(item.name,
+                                      (item, offset, source_file))
+            elif not item.name.startswith("__"):
+                helper_items.setdefault(item.name,
+                                        (item, offset, source_file))
+
+    # phase 1: helper summaries to a fixpoint. Both maps only ever grow
+    # (monotone), so |helpers| rounds bound the iteration. A helper's
+    # extraction depends only on the summaries of the helpers IT calls,
+    # so each round re-extracts just the callers of freshly-summarized
+    # helpers (the common no-chain case settles in one sweep).
+    helpers = {}
+    rank_returns = {}
+    collectives = {}
+    pending = set(helper_items)
+    for _round in range(max(1, len(helper_items))):
+        changed = set()
+        for name in sorted(pending):
+            item, offset, source_file = helper_items[name]
+            hf = StepFacts(name, item.lineno + offset, source_file)
+            _StepExtractor(hf, item, step_names, offset, bind_inputs=False,
+                           helper_rank_returns=rank_returns,
+                           helper_collectives=collectives).run()
+            helpers[name] = hf
+            if hf.returns_rank and not rank_returns.get(name):
+                rank_returns[name] = True
+                changed.add(name)
+            first = hf.first_collective()
+            if first is not None and name not in collectives:
+                collectives[name] = first.func
+                changed.add(name)
+        # helper->helper collective containment is transitive
+        for name, hf in helpers.items():
+            if name in collectives:
+                continue
+            if any(c in collectives for c in hf.self_calls):
+                inner = next(collectives[c] for c in sorted(hf.self_calls)
+                             if c in collectives)
+                collectives[name] = inner
+                changed.add(name)
+        if not changed:
+            break
+        pending = {name for name, hf in helpers.items()
+                   if hf.self_calls & changed}
+
+    # phase 2: step bodies, with helper summaries in hand
+    facts = {}
+    for name, (item, offset, source_file) in step_items.items():
+        sf = StepFacts(name, item.lineno + offset, source_file)
+        _StepExtractor(sf, item, step_names, offset,
+                       helper_rank_returns=rank_returns,
+                       helper_collectives=collectives).run()
+        facts[name] = sf
     for name, sf in facts.items():
         node = graph[name] if name in graph else None
         # helper-call effects land at the top of the step's event list:
         # positionally optimistic (may-analysis), which can only suppress
         # findings, never invent them
-        h_writes, h_reads, h_wildcard, h_mesh = _helper_effects(
-            sf.self_calls, helpers)
+        (h_writes, h_reads, h_wildcard, h_mesh, h_gang,
+         h_hybrid) = _helper_effects(sf.self_calls, helpers)
         sf.wildcard_write = sf.wildcard_write or h_wildcard
         sf.mesh_literals.extend(h_mesh)
+        sf.hybrid_literals.extend(h_hybrid)
         for e in reversed(h_writes):
             sf.events.insert(
                 0, Write(e.name, e.lineno, conditional=True))
         for e in h_reads:
             sf.events.append(Read(e.name, e.lineno, safe=True))
+        # gang-relevant calls inside helpers keep their own linenos (a
+        # rank-guarded collective inside a helper points at the helper's
+        # line; ordering is irrelevant to the divergence pass)
+        sf.events.extend(h_gang)
         if node is None:
             continue
         # decorator-implied writes land at the top too
@@ -703,11 +1105,12 @@ def extract_flow_facts(flow_cls, graph):
 
 
 def _helper_effects(called, helpers, _seen=None):
-    """Transitive (writes, reads, wildcard, mesh_literals) of the helper
-    methods in `called`, following helper→helper calls with a cycle
-    guard. Events keep the helper's own linenos so findings (e.g. a dead
-    artifact written inside a helper) point at the real assignment."""
-    writes, reads, mesh = [], [], []
+    """Transitive (writes, reads, wildcard, mesh_literals, gang_calls,
+    hybrid_literals) of the helper methods in `called`, following
+    helper→helper calls with a cycle guard. Events keep the helper's own
+    linenos so findings (e.g. a dead artifact written inside a helper)
+    point at the real assignment."""
+    writes, reads, mesh, gang, hybrid = [], [], [], [], []
     wildcard = False
     seen = _seen if _seen is not None else set()
     for name in sorted(called):
@@ -721,10 +1124,16 @@ def _helper_effects(called, helpers, _seen=None):
                 writes.append(e)
             elif e.kind == "read":
                 reads.append(e)
+            elif e.kind == "gang_call":
+                gang.append(e)
         mesh.extend(hf.mesh_literals)
-        w2, r2, wc2, m2 = _helper_effects(hf.self_calls, helpers, seen)
+        hybrid.extend(hf.hybrid_literals)
+        w2, r2, wc2, m2, g2, h2 = _helper_effects(
+            hf.self_calls, helpers, seen)
         writes.extend(w2)
         reads.extend(r2)
         mesh.extend(m2)
+        gang.extend(g2)
+        hybrid.extend(h2)
         wildcard = wildcard or wc2
-    return writes, reads, wildcard, mesh
+    return writes, reads, wildcard, mesh, gang, hybrid

@@ -117,6 +117,66 @@ def check_mesh_devices(axes, n_devices):
     return problems
 
 
+def check_hybrid_mesh(ici_axes, dcn_axis="data", num_slices=None,
+                      n_devices=None, n_hosts=None):
+    """Validate a create_hybrid_mesh-style configuration: per-slice ICI
+    axes + a DCN axis spanning `num_slices` slices (spmd/mesh.py). Returns
+    a list of problem strings.
+
+    n_devices / n_hosts: whole-topology totals (hosts * chips from the
+    @tpu topology table) when known. A slice boundary is a host boundary
+    (DCN links hosts, ICI links chips within a slice), so num_slices must
+    divide the host count and the per-slice device count must be covered
+    by the ICI axes — the pre-flight arithmetic an MPMD stage/topology
+    check needs (ROADMAP item 3)."""
+    problems = []
+    known = set(_axis_order())
+    if dcn_axis is not None and dcn_axis not in known:
+        problems.append(
+            "DCN axis %r is not a canonical mesh axis %s: shardings "
+            "referencing it replicate instead of crossing slices"
+            % (dcn_axis, list(_axis_order())))
+    if ici_axes is not None:
+        problems.extend(check_mesh_axes(ici_axes))
+        if (dcn_axis is not None
+                and ici_axes.get(dcn_axis) not in (None, 1)):
+            problems.append(
+                "ICI spec assigns size %r to %r, but %r is the DCN axis: "
+                "create_hybrid_mesh strips it from the per-slice axes, so "
+                "those devices are silently dropped from the ICI plan"
+                % (ici_axes[dcn_axis], dcn_axis, dcn_axis))
+    if num_slices is not None:
+        if num_slices < 1:
+            problems.append(
+                "num_slices must be >= 1, got %d" % num_slices)
+        elif num_slices > 1:
+            if n_hosts is not None and n_hosts % num_slices:
+                problems.append(
+                    "%d slices do not align to %d host(s): a slice "
+                    "boundary is a host boundary (DCN links hosts)"
+                    % (num_slices, n_hosts))
+            if n_devices is not None:
+                if n_devices % num_slices:
+                    problems.append(
+                        "%d devices not divisible into %d slices"
+                        % (n_devices, num_slices))
+                elif ici_axes is not None and not problems:
+                    per_slice = n_devices // num_slices
+                    ici = {k: v for k, v in ici_axes.items()
+                           if k != dcn_axis}
+                    # empty per-slice plan = pure data parallelism over
+                    # slices: create_hybrid_mesh has the DCN axis absorb
+                    # the per-slice devices too (mesh.py special case),
+                    # so there is nothing to cover
+                    for p in (check_mesh_devices(ici, per_slice)
+                              if ici else []):
+                        problems.append(
+                            "per-slice ICI plan: %s (each of the %d "
+                            "slices holds %d devices)"
+                            % (p, num_slices, per_slice))
+    return problems
+
+
 def check_pipeline(n_layers, n_stages, num_microbatches=None,
                    batch_size=None):
     """Validate pipeline-parallel stage counts (spmd/pipeline.py): the
@@ -244,7 +304,10 @@ def analyze_spmd(flow_cls, graph, facts=None):
                         "Step *%s*: %s" % (node.name, problem),
                         step=node.name, lineno=ml.lineno,
                         source_file=f.source_file))
-                if n_devices is not None and not axis_problems:
+                # a spec consumed by create_hybrid_mesh covers PER-SLICE
+                # devices: the hybrid checker below owns that arithmetic
+                if (n_devices is not None and not axis_problems
+                        and not ml.in_hybrid):
                     for problem in check_mesh_devices(axes, n_devices):
                         findings.append(Finding(
                             "mesh-devices-mismatch", ERROR,
@@ -252,4 +315,22 @@ def analyze_spmd(flow_cls, graph, facts=None):
                             % (node.name, problem, topo),
                             step=node.name, lineno=ml.lineno,
                             source_file=f.source_file))
+            hosts = None
+            if topo is not None and topo in TPU_TOPOLOGY_SELECTORS:
+                hosts = TPU_TOPOLOGY_SELECTORS[topo][2]
+            for hl in f.hybrid_literals:
+                ici = hl.ici_axes
+                if ici is not None and not isinstance(ici, dict):
+                    ici = _resolve_mesh_axes(ici)  # MeshLiteral form
+                for problem in check_hybrid_mesh(
+                        ici, dcn_axis=hl.dcn_axis,
+                        num_slices=hl.num_slices,
+                        n_devices=n_devices, n_hosts=hosts):
+                    findings.append(Finding(
+                        "hybrid-mesh-invalid", ERROR,
+                        "Step *%s*: create_hybrid_mesh(...): %s%s"
+                        % (node.name, problem,
+                           " (topology %r)" % topo if topo else ""),
+                        step=node.name, lineno=hl.lineno,
+                        source_file=f.source_file))
     return findings

@@ -86,6 +86,15 @@ class StreamingTokenBatches(object):
         self._epoch = 0
         self._shard_cursor = 0
         self._window_cursor = 0
+        # collective-sanitizer hook (spmd/sanitizer.py), env-gated so the
+        # data package never pulls the spmd package (jax) in by default.
+        # Only lockstep-identical geometry is journaled — never the
+        # host-specific cursors (per-host slices are disjoint BY DESIGN).
+        self._sanitizer = None
+        if _env_int("TPUFLOW_SANITIZE", 0) == 1:
+            from ..spmd import sanitizer
+
+            self._sanitizer = sanitizer
 
     # ---------- geometry ----------
 
@@ -220,7 +229,12 @@ class StreamingTokenBatches(object):
                                 "timer", "data.batch_wait",
                                 ms=(time.perf_counter() - t_batch) * 1000,
                                 ok=True)
-                            yield {"tokens": np.stack(buf),
+                            batch = np.stack(buf)
+                            if self._sanitizer is not None:
+                                self._sanitizer.journal(
+                                    "data", "batch", shape=batch,
+                                    key=self._epoch)
+                            yield {"tokens": batch,
                                    STATE_KEY: self.state()}
                             yielded = True
                             buf = []
@@ -234,7 +248,11 @@ class StreamingTokenBatches(object):
                 telemetry.emit(
                     "timer", "data.batch_wait",
                     ms=(time.perf_counter() - t_batch) * 1000, ok=True)
-                yield {"tokens": np.stack(buf), STATE_KEY: self.state()}
+                batch = np.stack(buf)
+                if self._sanitizer is not None:
+                    self._sanitizer.journal("data", "batch", shape=batch,
+                                            key=self._epoch)
+                yield {"tokens": batch, STATE_KEY: self.state()}
                 yielded = True
             if not yielded and self._epochs is None and from_start:
                 # an epoch consumed from its start produced NO batch (this

@@ -13,6 +13,7 @@ from .distributed import (
     initialize_from_env,
     process_info,
 )
+from . import sanitizer
 
 __all__ = [
     "MeshSpec",
@@ -29,4 +30,5 @@ __all__ = [
     "initialize_from_current",
     "initialize_from_env",
     "process_info",
+    "sanitizer",
 ]

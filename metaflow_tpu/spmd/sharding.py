@@ -12,6 +12,8 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from . import sanitizer
+
 # rule tables: logical axis name -> mesh axis (None = replicate).
 # 'fsdp' shards the *parameter* dim that is largest/most even; 'tensor'
 # shards the dim contracted inside the layer (megatron pattern).
@@ -112,12 +114,20 @@ def tree_shardings(logical_tree, mesh, rules=None):
 
 def shard_tree(tree, logical_tree, mesh, rules=None):
     """Device-put a pytree according to its logical axes."""
+    sanitizer.journal("collective", "shard_tree", axes=mesh.axis_names,
+                      shape=tree)
     shardings = tree_shardings(logical_tree, mesh, rules)
     return jax.device_put(tree, shardings)
 
 
 def constrain(x, logical_axes, mesh, rules=None):
-    """with_sharding_constraint via logical axes (use inside jitted fns)."""
+    """with_sharding_constraint via logical axes (use inside jitted fns).
+
+    The sanitizer journal entry lands at TRACE time (once per compile,
+    not per step) — which is exactly the signal wanted: ranks tracing
+    different programs produce different constraint streams."""
+    sanitizer.journal("collective", "constrain", axes=logical_axes,
+                      shape=x)
     rules = rules or rules_for_mesh(mesh)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, spec_for(logical_axes, rules))

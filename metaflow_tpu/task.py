@@ -190,6 +190,15 @@ class MetaflowTask(object):
             self.flow_datastore, run_id, step_name, task_id,
             attempt=retry_count,
         )
+        # collective sanitizer (spmd/sanitizer.py): each rank of a gang
+        # journals its collective/write signature stream for cross-rank
+        # desync checks. Env-gated lazy import — the spmd package pulls
+        # jax in, which a non-sanitizing task must not pay for.
+        if os.environ.get("TPUFLOW_SANITIZE", "0") == "1":
+            from .spmd import sanitizer as _sanitizer
+
+            _sanitizer.install(self.flow_datastore, run_id,
+                               step_name=step_name)
         if recorder is not None:
             queued_ts = os.environ.get("TPUFLOW_QUEUE_TS")
             if queued_ts:
@@ -479,6 +488,10 @@ class MetaflowTask(object):
                         "timer", "task.duration", ms=duration,
                         ok=task_ok and finalize_exc is None)
                     telemetry.close_recorder()
+                    if os.environ.get("TPUFLOW_SANITIZE", "0") == "1":
+                        from .spmd import sanitizer as _sanitizer
+
+                        _sanitizer.uninstall()
                 except Exception:
                     pass  # observability must never fail the task
 

@@ -42,6 +42,19 @@ from ..datastore import serializers
 Checkpoint = collections.namedtuple("Checkpoint", ["state", "step", "extra"])
 
 
+def _sanitize_journal(kind, name, key=None):
+    """Journal a shared-write signature into the collective sanitizer
+    (spmd/sanitizer.py) when TPUFLOW_SANITIZE=1. Imported lazily so this
+    module stays importable without pulling the spmd package (jax) in."""
+    import os
+
+    if os.environ.get("TPUFLOW_SANITIZE", "0") != "1":
+        return
+    from ..spmd import sanitizer
+
+    sanitizer.journal(kind, name, key=key)
+
+
 class AsyncCheckpointManager(object):
     """Checkpoints pytree train states into a flow datastore's CAS.
 
@@ -75,6 +88,7 @@ class AsyncCheckpointManager(object):
         `extra` (JSON-able, e.g. the data iterator's resume stamp) rides
         in the manifest. Serialization + upload happen in the background;
         errors surface at the next save()/wait()/done()."""
+        _sanitize_journal("write", "checkpoint.save", key=int(step))
         self.wait()  # barrier on the previous in-flight persist
         with tracing.span("checkpoint.snapshot", {"step": int(step)}):
             host = _snapshot_to_host(state)

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..spmd import sanitizer
 from ..spmd import sharding as shd
 
 
@@ -193,6 +194,11 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
     are available afterwards as `checkpoint.last_restored` — without
     them a resumed run would silently restart its data stream."""
     optimizer = optimizer or default_optimizer()
+    # compile-shaping state: every rank must build the SAME mesh/program
+    # (analysis/divergence.py's gang-divergent-compile class, verified at
+    # runtime by the sanitizer barrier)
+    sanitizer.journal("compile", "make_trainer", axes=mesh.axis_names,
+                      key=str(dict(mesh.shape)))
     state, shardings = make_train_state(
         rng, cfg, mesh, model, optimizer=optimizer, rules=rules
     )
@@ -207,6 +213,13 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
 
         kwargs = telemetry if isinstance(telemetry, dict) else {}
         step = instrument_train_step(step, **kwargs)
+    # sanitizer wraps OUTERMOST: the instrumentation must keep seeing the
+    # raw jitted step (its jit-cache probe and cost-analysis .lower() die
+    # on a plain wrapper); the .telemetry handle stays reachable
+    wrapped = sanitizer.wrap_step(step)
+    if wrapped is not step and hasattr(step, "telemetry"):
+        wrapped.telemetry = step.telemetry
+    step = wrapped
     return state, step, shardings
 
 
@@ -233,6 +246,8 @@ def shard_batch(batch, mesh):
 
     from ..spmd.mesh import data_axes
 
+    sanitizer.journal("collective", "shard_batch", axes=mesh.axis_names,
+                      shape=batch)
     axes = data_axes(mesh)
     batch_spec = axes if axes else None
     seq_size = mesh.shape.get("sequence", 1)

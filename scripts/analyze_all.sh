@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Static-analysis sweep: run `check --deep --json` over shipped flows and
+# exit non-zero when ANY flow reports an error-severity finding.
+#
+# Usage:
+#   scripts/analyze_all.sh              # all tests/flows/ + tutorials/
+#   scripts/analyze_all.sh FLOW.py ...  # just the given flow files
+#
+# A flow file that cannot even load in this environment (optional deps,
+# not a flow entrypoint) is SKIPPED loudly — the in-process sweep in
+# tests/test_analysis.py applies the same rule. CI wires this as the
+# analyzer regression gate: a new false positive on a shipped flow, or a
+# genuine dataflow/SPMD/divergence bug in a new example, fails here.
+
+set -u
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
+PY="${PYTHON:-python3}"
+command -v "$PY" >/dev/null 2>&1 || PY=python
+
+if [ "$#" -gt 0 ]; then
+    files=("$@")
+else
+    files=("$ROOT"/tests/flows/*.py "$ROOT"/tutorials/*/*.py)
+fi
+
+fail=0 checked=0 skipped=0
+for f in "${files[@]}"; do
+    base="$(basename "$f")"
+    case "$base" in
+        _*) continue ;;  # templates are not standalone flows
+    esac
+    out="$(cd "$(dirname "$f")" && "$PY" "$base" check --deep --json 2>/dev/null)"
+    rc=$?
+    if [ $rc -eq 0 ]; then
+        checked=$((checked + 1))
+        continue
+    fi
+    # non-zero exit: either a report with error findings (JSON on stdout)
+    # or a flow that failed to load at all
+    if printf '%s' "$out" | "$PY" -c 'import json,sys; json.load(sys.stdin)' \
+            2>/dev/null; then
+        checked=$((checked + 1))
+        fail=1
+        echo "ERROR findings in $f:" >&2
+        printf '%s' "$out" | "$PY" -c '
+import json, sys
+report = json.load(sys.stdin)
+for x in report["findings"]:
+    if x["severity"] == "error":
+        print("  [%s] %s:%s %s" % (
+            x["code"], x.get("source_file"), x.get("lineno"),
+            x["message"][:140]), file=sys.stderr)
+'
+    else
+        skipped=$((skipped + 1))
+        echo "skip (unloadable here): $f" >&2
+    fi
+done
+
+echo "analyze_all: ${checked} flow(s) checked, ${skipped} skipped, fail=${fail}"
+[ "$checked" -gt 0 ] || { echo "analyze_all: nothing checked" >&2; fail=1; }
+exit $fail

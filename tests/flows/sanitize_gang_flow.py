@@ -1,0 +1,64 @@
+"""Sanitizer e2e fixture: a 2-rank local gang with an INJECTED
+rank-divergent collective — rank 0 journals a psum signature that rank 1
+skips. Run with TPUFLOW_SANITIZE=1 (tests/test_sanitizer.py drives it):
+the checker rank's barrier must dump a desync report to the run's
+`_telemetry/sanitize/` prefix naming the psum and the diverging rank,
+within the barrier timeout. The same divergence shape is seeded
+statically in tests/test_analysis.py::RankGuardedPsumFlow — a confirmed
+runtime divergence and its static signature stay paired as fixtures."""
+
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.decorators import make_step_decorator
+from metaflow_tpu.plugins import STEP_DECORATORS
+
+# plain gang, no jax.distributed: the divergence is injected into the
+# sanitizer journal directly, no chip or collective runtime needed
+tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
+
+
+class SanitizeGangFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @tpu_parallel(jax_distributed=False)
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import sanitizer
+
+        rank = current.parallel.node_index
+        s = sanitizer.current()
+        self.sanitizing = s is not None
+        self.desync_status = None
+        if s is not None:
+            s.journal("collective", "shard_batch", axes=("data",))
+            if rank == 0:
+                # rank 1 never journals this signature: the injected
+                # rank-divergent collective
+                s.journal("collective", "psum", axes=("data",))
+            s.journal("step", "train_step")
+            try:
+                s.barrier(0)
+            except sanitizer.GangDesyncError as ex:
+                self.desync_status = ex.report["status"]
+        self.rank = rank
+        self.next(self.join_gang)
+
+    @step
+    def join_gang(self, inputs):
+        self.statuses = sorted(
+            i.desync_status for i in inputs
+            if i.desync_status is not None)
+        self.sanitizing = all(i.sanitizing for i in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        if self.sanitizing:
+            # the checker rank must have caught the injected divergence
+            assert self.statuses == ["desync"], self.statuses
+        print("sanitize gang done:", self.statuses)
+
+
+if __name__ == "__main__":
+    SanitizeGangFlow()

@@ -517,6 +517,86 @@ def validate_train_step_record(record):
 
 
 # ---------------------------------------------------------------------------
+# Collective sanitizer (metaflow_tpu/spmd/sanitizer.py): the pinned v1
+# surfaces for the per-rank signature streams published at step barriers
+# and the desync report the checker writes to _telemetry/sanitize/ when a
+# gang diverges or a rank never reports. additionalProperties: false —
+# the desync report is the artifact an operator (or a doctor CLI) reads
+# to turn "the gang hung" into a one-line diagnosis; its fields must not
+# drift silently.
+# ---------------------------------------------------------------------------
+
+SANITIZE_STREAM_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "rank": _INT,
+        "world": _INT,
+        "barrier": _INT,
+        # total signatures journaled since install (the rolling window
+        # holds the tail: [window_start, count))
+        "count": _INT,
+        "window_start": _INT,
+        "sigs": _arr(_STR),
+        "ts": _NUM,
+    },
+    required=("v", "rank", "world", "barrier", "count", "window_start",
+              "sigs", "ts"),
+)
+
+SANITIZE_REPORT_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "run_id": _STR,
+        "step": _STR,
+        "barrier": _INT,
+        "world": _INT,
+        "status": {"enum": ["ok", "desync", "timeout"]},
+        "ranks_reported": _arr(_INT),
+        "missing_ranks": _arr(_INT),
+        "counts": {"type": "object", "additionalProperties": _INT},
+        # first sequence number where the ranks disagree; per-rank the
+        # signature executed there (null = that rank never reached it)
+        "first_divergence": {
+            "oneOf": [
+                {"type": "null"},
+                _obj(
+                    {"seq": _INT,
+                     "ops": {"type": "object",
+                             "additionalProperties":
+                                 {"type": ["string", "null"]}}},
+                    required=("seq", "ops"),
+                ),
+            ],
+        },
+        "diverged_ranks": _arr(_INT),
+        "ts": _NUM,
+    },
+    required=("v", "run_id", "step", "barrier", "world", "status",
+              "ranks_reported", "missing_ranks", "counts",
+              "first_divergence", "diverged_ranks", "ts"),
+)
+
+
+def validate_sanitize_stream(payload):
+    """Validate one published per-rank signature stream."""
+    jsonschema.validate(payload, SANITIZE_STREAM_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+def validate_sanitize_report(report):
+    """Validate a sanitizer barrier/desync report, plus the cross-field
+    invariants a JSON schema cannot express."""
+    jsonschema.validate(report, SANITIZE_REPORT_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+    if report["status"] == "timeout" and not report["missing_ranks"]:
+        raise jsonschema.ValidationError(
+            "timeout report must name the missing rank(s)")
+    if report["status"] == "desync" and not report["first_divergence"]:
+        raise jsonschema.ValidationError(
+            "desync report must name the first diverging op")
+
+
+# ---------------------------------------------------------------------------
 # `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
 # v1 surface for the static analyzer. additionalProperties: false — a field
 # the analyzer invents fails validation, protecting editor/CI consumers of
@@ -545,7 +625,8 @@ CHECK_REPORT_SCHEMA = _obj(
         "flow": _STR,
         "ok": _BOOL,
         "analyses": _arr({"enum": ["lint", "artifact-dataflow",
-                                   "spmd-config"]}),
+                                   "spmd-config", "gang-divergence",
+                                   "determinism"]}),
         "steps_analyzed": _arr(_STR),
         "checks_run": _INT,
         "counts": _obj(

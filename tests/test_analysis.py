@@ -690,6 +690,618 @@ def test_unknown_topology_is_a_warning():
     assert _findings(UnknownTopologyFlow, severity="error") == []
 
 
+class HybridMeshFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=4)
+
+    @metaflow_tpu.tpu(topology="v5p-32")  # 4 hosts x 4 chips = 16 devices
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec, create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(MeshSpec({"fsdp": 8}), num_slices=2)
+        self.ok = mesh is not None
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.oks = [i.ok for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.oks)
+
+
+def test_hybrid_mesh_valid_and_inner_spec_exempt():
+    """A per-slice ICI spec must NOT be validated against the WHOLE
+    topology's device count (8 != 16 would be a false positive)."""
+    assert _findings(HybridMeshFlow, severity="error") == []
+
+
+class BadHybridSlicesFlow(HybridMeshFlow):
+    @metaflow_tpu.tpu(topology="v5p-32")
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec, create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(MeshSpec({"fsdp": 8}),  # MARK-hybrid
+                                  num_slices=3)
+        self.ok = mesh is not None
+        self.next(self.joiner)
+
+
+def test_hybrid_mesh_slices_vs_topology():
+    found = _findings(BadHybridSlicesFlow, code="hybrid-mesh-invalid")
+    assert found, "expected hybrid-mesh findings"
+    assert all(f.severity == "error" and f.step == "train" for f in found)
+    assert found[0].lineno == _line_of(BadHybridSlicesFlow, "MARK-hybrid")
+    msgs = " ".join(f.message for f in found)
+    assert "3 slices" in msgs or "into 3 slices" in msgs
+
+
+class BadHybridCoverageFlow(HybridMeshFlow):
+    @metaflow_tpu.tpu(topology="v5p-32")
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec, create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(MeshSpec({"fsdp": 4}), num_slices=2)
+        self.ok = mesh is not None
+        self.next(self.joiner)
+
+
+def test_hybrid_mesh_per_slice_coverage():
+    found = _findings(BadHybridCoverageFlow, code="hybrid-mesh-invalid")
+    assert len(found) == 1, found
+    assert "per-slice ICI plan" in found[0].message
+
+
+def test_check_hybrid_mesh_unit():
+    from metaflow_tpu.analysis import check_hybrid_mesh
+
+    # clean: 2 slices x 8 devices, fsdp wildcard absorbs per-slice
+    assert check_hybrid_mesh({"fsdp": -1, "tensor": 4}, num_slices=2,
+                             n_devices=16, n_hosts=4) == []
+    # unknown DCN axis name
+    assert any("DCN axis" in p for p in check_hybrid_mesh(
+        {"fsdp": -1}, dcn_axis="bogus", num_slices=2))
+    # DCN axis sized inside the ICI spec is silently stripped at runtime
+    assert any("strips" in p for p in check_hybrid_mesh(
+        {"data": 4, "fsdp": -1}, dcn_axis="data", num_slices=2))
+    # slices must align to host boundaries
+    assert any("host" in p for p in check_hybrid_mesh(
+        {"fsdp": -1}, num_slices=3, n_hosts=4))
+    # devices must divide into slices
+    assert any("divisible" in p for p in check_hybrid_mesh(
+        {"fsdp": -1}, num_slices=3, n_devices=16))
+    # fixed ICI axes must cover the per-slice devices
+    assert any("per-slice" in p for p in check_hybrid_mesh(
+        {"fsdp": 4}, num_slices=2, n_devices=16))
+    # num_slices < 1 is nonsense
+    assert any("num_slices" in p for p in check_hybrid_mesh(
+        {"fsdp": -1}, num_slices=0))
+    # pure data parallelism over slices: stripping the DCN axis leaves
+    # an EMPTY per-slice plan, which create_hybrid_mesh supports (the
+    # DCN axis absorbs the per-slice devices) — not a coverage error
+    assert check_hybrid_mesh({"data": 1}, dcn_axis="data", num_slices=2,
+                             n_devices=16, n_hosts=4) == []
+
+
+class PositionalDcnAxisFlow(HybridMeshFlow):
+    @metaflow_tpu.tpu(topology="v5p-32")
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec, create_hybrid_mesh
+
+        mesh = create_hybrid_mesh(MeshSpec({"fsdp": 8}),  # MARK-posdcn
+                                  "bogus", num_slices=2)
+        self.ok = mesh is not None
+        self.next(self.joiner)
+
+
+def test_positional_dcn_axis_is_parsed():
+    """Regression: a POSITIONAL dcn_axis must be consumed even when
+    num_slices arrives as a keyword (the parse was gated on num_slices
+    being absent, silently dropping the axis)."""
+    found = _findings(PositionalDcnAxisFlow, code="hybrid-mesh-invalid")
+    assert found, "positional dcn_axis was dropped"
+    assert any("DCN axis" in f.message for f in found)
+    assert found[0].lineno == _line_of(PositionalDcnAxisFlow,
+                                       "MARK-posdcn")
+
+
+# ---------------------------------------------------------------------------
+# gang-divergence pass: seeded violations (analysis/divergence.py)
+# ---------------------------------------------------------------------------
+
+
+class RankGuardedPsumFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        import jax
+
+        x = 1
+        if current.parallel.node_index == 0:
+            jax.lax.psum(x, "data")  # MARK-psum
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.ranks = [i.rank for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.ranks)
+
+
+def test_rank_guarded_collective_is_deadlock_error():
+    found = _findings(RankGuardedPsumFlow,
+                      code="gang-divergent-collective")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert f.lineno == _line_of(RankGuardedPsumFlow, "MARK-psum")
+    assert "psum" in f.message and "hang" in f.message
+
+
+class RankGuardedHelperFlow(RankGuardedPsumFlow):
+    def all_reduce(self):
+        import jax
+
+        jax.lax.psum(1, "data")
+
+    @step
+    def train(self):
+        if current.parallel.node_index == 0:
+            self.all_reduce()  # MARK-helper
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+
+def test_rank_guarded_collective_through_helper():
+    """Interprocedural: the collective hides inside a self.<helper>()
+    closure; the finding lands at the CALL site."""
+    found = _findings(RankGuardedHelperFlow,
+                      code="gang-divergent-collective")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error"
+    assert f.lineno == _line_of(RankGuardedHelperFlow, "MARK-helper")
+    assert "psum" in f.message and "all_reduce" in f.message
+
+
+class RankGuardedFlushFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu import telemetry
+
+        if current.parallel.node_index == 0:
+            telemetry.flush()  # MARK-flush: soft, journals only
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+
+def test_rank_guarded_telemetry_flush_is_soft_warning():
+    found = _findings(RankGuardedFlushFlow,
+                      code="gang-divergent-collective")
+    assert len(found) == 1, found
+    assert found[0].severity == "warning"
+    assert _findings(RankGuardedFlushFlow, severity="error") == []
+
+
+class LocalGangCkptFlow(RankGuardedPsumFlow):
+    @metaflow_tpu.tpu_parallel(jax_distributed=False)
+    @step
+    def train(self):
+        ckpt = None
+        if current.parallel.node_index == 0:
+            ckpt.save({"w": 1}, step=1)  # local gang: cannot deadlock
+        self.rank = current.parallel.node_index
+        self.next(self.joiner)
+
+
+def test_local_gang_rank_guarded_save_downgrades_to_warning():
+    """A gang that declares jax_distributed=False has no cross-rank
+    program: the rank-guarded save is a lockstep warning, not the
+    deadlock error — the precision case preempt_gang_flow.py ships."""
+    found = _findings(LocalGangCkptFlow, code="gang-divergent-collective")
+    assert len(found) == 1, found
+    assert found[0].severity == "warning"
+    assert _findings(LocalGangCkptFlow, severity="error") == []
+
+
+class CompileDivergentFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import MeshSpec
+
+        rank = current.parallel.node_index
+        spec = MeshSpec({"fsdp": 1 + rank})  # MARK-compile
+        self.rank = rank
+        self.ok = spec is not None
+        self.next(self.joiner)
+
+
+def test_rank_tainted_mesh_is_compile_divergence_error():
+    found = _findings(CompileDivergentFlow, code="gang-divergent-compile")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert f.lineno == _line_of(CompileDivergentFlow, "MARK-compile")
+    assert "MeshSpec" in f.message
+
+
+class SharedWriteRaceFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu import telemetry
+
+        rank = current.parallel.node_index
+        rec = telemetry.current_recorder()
+        rec.save_artifact("probe", rank)  # MARK-race
+        self.rank = rank
+        self.next(self.joiner)
+
+
+def test_rank_divergent_payload_same_key_is_race_error():
+    found = _findings(SharedWriteRaceFlow, code="gang-shared-write-race")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert f.lineno == _line_of(SharedWriteRaceFlow, "MARK-race")
+
+
+class RankKeyedWriteFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu import telemetry
+
+        rank = current.parallel.node_index
+        rec = telemetry.current_recorder()
+        rec.save_artifact(f"probe_{rank}", rank)  # rank IN the key: fine
+        self.rank = rank
+        self.next(self.joiner)
+
+
+def test_rank_in_fstring_key_suppresses_race():
+    """Satellite regression: rank taint must propagate INTO f-string key
+    expressions — a rank-keyed write is one-writer-per-key, not a race."""
+    assert _findings(RankKeyedWriteFlow,
+                     code="gang-shared-write-race") == []
+
+
+class SaveBytesPairRaceFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu.datastore import LocalStorage
+
+        rank = current.parallel.node_index
+        store = LocalStorage("scratch")
+        payload = ("rank %d" % rank).encode()
+        store.save_bytes([("gang_report", payload)])  # MARK-pairs
+        self.rank = rank
+        self.next(self.joiner)
+
+
+def test_save_bytes_pair_race_separates_key_and_payload():
+    """Regression: save_bytes takes a LIST of (key, payload) tuples — the
+    pair elements must be probed separately (a single argument index made
+    key_tainted == payload_tainted, so the race could never fire)."""
+    found = _findings(SaveBytesPairRaceFlow,
+                      code="gang-shared-write-race")
+    assert len(found) == 1, found
+    assert found[0].severity == "error"
+    assert found[0].lineno == _line_of(SaveBytesPairRaceFlow, "MARK-pairs")
+
+
+class SaveBytesRankKeyFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        from metaflow_tpu.datastore import LocalStorage
+
+        rank = current.parallel.node_index
+        store = LocalStorage("scratch")
+        payload = ("rank %d" % rank).encode()
+        store.save_bytes([(f"gang_report_{rank}", payload)])
+        self.rank = rank
+        self.next(self.joiner)
+
+
+def test_save_bytes_rank_in_pair_key_suppresses_race():
+    """The rank in the PAIR's key element makes it one-writer-per-key."""
+    assert _findings(SaveBytesRankKeyFlow,
+                     code="gang-shared-write-race") == []
+
+
+class TupleUnpackTaintFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        import jax
+
+        rank, n = jax.process_index(), 4
+        if n == 0:
+            self.clean = 1  # sibling binding: NOT rank-dependent
+        if rank == 0:
+            self.tainted = 1  # MARK-unpack
+        self.rank = rank
+        self.next(self.joiner)
+
+
+def test_tuple_unpack_taints_elementwise():
+    """Satellite regression: `rank, n = jax.process_index(), 4` must
+    taint `rank` but NOT `n` (blanket taint flagged every sibling)."""
+    found = _findings(TupleUnpackTaintFlow, code="gang-divergent-write")
+    assert [f.artifact for f in found] == ["tainted"], found
+    assert found[0].lineno == _line_of(TupleUnpackTaintFlow, "MARK-unpack")
+
+
+class AugAssignTaintFlow(RankGuardedPsumFlow):
+    @step
+    def train(self):
+        import jax
+
+        r = 0
+        r += jax.process_index()
+        if r == 0:
+            self.leader_note = 1  # MARK-aug
+        self.rank = r
+        self.next(self.joiner)
+
+
+def test_augassign_accumulates_taint():
+    found = _findings(AugAssignTaintFlow, code="gang-divergent-write")
+    assert [f.artifact for f in found] == ["leader_note"], found
+    assert found[0].lineno == _line_of(AugAssignTaintFlow, "MARK-aug")
+
+
+def test_divergence_pass_ignores_non_gang_steps():
+    """psum in a NON-gang step is a plain library call, not a finding."""
+
+    class SoloPsumFlow(FlowSpec):
+        @step
+        def start(self):
+            import jax
+
+            if len("x") == 1:
+                jax.lax.psum(1, "data")
+            self.next(self.end)
+
+        @step
+        def end(self):
+            pass
+
+    assert _findings(SoloPsumFlow, code="gang-divergent-collective") == []
+
+
+# ---------------------------------------------------------------------------
+# determinism pass: seeded violations (analysis/determinism.py)
+# ---------------------------------------------------------------------------
+
+
+class WallClockArtifactFlow(FlowSpec):
+    @step
+    def start(self):
+        import time
+
+        self.stamp = time.time()  # MARK-stamp
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.stamp)
+
+
+def test_wall_clock_artifact_is_warning():
+    found = _findings(WallClockArtifactFlow,
+                      code="nondeterministic-artifact")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "warning" and f.artifact == "stamp"
+    assert f.lineno == _line_of(WallClockArtifactFlow, "MARK-stamp")
+    assert "time.time" in f.message
+
+
+class WallClockCheckpointFlow(FlowSpec):
+    @step
+    def start(self):
+        import time
+
+        stamp = time.time()
+        ckpt = None
+        ckpt.save({"t": stamp}, step=1)  # MARK-ckptsink
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_wall_clock_into_checkpoint_payload_is_error():
+    found = _findings(WallClockCheckpointFlow,
+                      code="nondeterministic-checkpoint")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error"
+    assert f.lineno == _line_of(WallClockCheckpointFlow, "MARK-ckptsink")
+
+
+class WallClockSeedFlow(FlowSpec):
+    @step
+    def start(self):
+        import time
+
+        from metaflow_tpu.data import StreamingTokenBatches
+
+        # the COMMON form: the sink call sits on an assignment RHS
+        loader = StreamingTokenBatches(None, "corpus", 8, 128,  # MARK-seed
+                                       seed=int(time.time()))
+        self.loader_ok = loader is not None
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_wall_clock_seed_is_data_order_error():
+    found = _findings(WallClockSeedFlow,
+                      code="nondeterministic-data-order")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error"
+    assert f.lineno == _line_of(WallClockSeedFlow, "MARK-seed")
+    assert "seed" in f.message
+
+
+def test_error_path_is_anchored_on_the_package():
+    """A USER flow under some directory named data/ must not have its
+    warnings force-escalated by its checkout path."""
+    from metaflow_tpu.analysis.determinism import _error_path
+
+    assert not _error_path("/home/me/data/train_flow.py")
+    assert _error_path("/x/metaflow_tpu/data/loader.py")
+    assert _error_path("/x/metaflow_tpu/training/checkpoint.py")
+    assert not _error_path("/home/me/training/checkpoint.py")
+
+
+class StateKeyStampFlow(FlowSpec):
+    @step
+    def start(self):
+        import time
+
+        stamp = {}
+        stamp["data_state"] = time.time()  # MARK-statekey
+        self.stamp = stamp.get("other", 0)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        pass
+
+
+def test_wall_clock_into_state_key_is_error():
+    found = _findings(StateKeyStampFlow,
+                      code="nondeterministic-data-order")
+    assert len(found) == 1, found
+    assert found[0].severity == "error"
+    assert found[0].lineno == _line_of(StateKeyStampFlow, "MARK-statekey")
+
+
+class ListingOrderFlow(FlowSpec):
+    @step
+    def start(self):
+        import os as _os
+
+        files = _os.listdir(".")
+        self.first = files[0]  # MARK-listing
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.first)
+
+
+def test_unsorted_listing_order_is_flagged():
+    found = _findings(ListingOrderFlow, code="nondeterministic-artifact")
+    assert [f.artifact for f in found] == ["first"], found
+    assert "listdir" in found[0].message
+
+
+class SortedListingFlow(FlowSpec):
+    @step
+    def start(self):
+        import os as _os
+
+        files = sorted(_os.listdir("."))
+        self.first = files[0] if files else None
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.first)
+
+
+def test_sorted_launders_listing_order():
+    assert _findings(SortedListingFlow,
+                     code="nondeterministic-artifact") == []
+
+
+class UuidArtifactFlow(FlowSpec):
+    @step
+    def start(self):
+        import uuid
+
+        self.tag = uuid.uuid4().hex  # MARK-uuid
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.tag)
+
+
+def test_uuid_artifact_is_flagged():
+    found = _findings(UuidArtifactFlow, code="nondeterministic-artifact")
+    assert [f.artifact for f in found] == ["tag"], found
+    assert "uuid" in found[0].message
+
+
+class SetOrderFlow(FlowSpec):
+    @step
+    def start(self):
+        seen = {"a", "b", "c"}
+        self.order = list(seen)  # MARK-set
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.order)
+
+
+def test_set_iteration_order_is_flagged():
+    found = _findings(SetOrderFlow, code="nondeterministic-artifact")
+    assert [f.artifact for f in found] == ["order"], found
+    assert "set iteration" in found[0].message
+
+
+def test_seeded_rng_and_fixed_seed_are_clean():
+    class SeededFlow(FlowSpec):
+        @step
+        def start(self):
+            import numpy as _np
+
+            rng = _np.random.default_rng(7)
+            self.draw = float(rng.random())
+            self.next(self.end)
+
+        @step
+        def end(self):
+            print(self.draw)
+
+    assert _findings(SeededFlow, code="nondeterministic-artifact") == []
+
+
+def test_library_data_paths_scan_clean():
+    """The analyzer's own self-check: the modules that ARE the
+    exact-resume contract (data/, training/checkpoint.py) must scan
+    clean at error severity."""
+    from metaflow_tpu.analysis import scan_paths
+
+    paths = sorted(
+        glob.glob(os.path.join(REPO, "metaflow_tpu", "data", "*.py"))
+    ) + [os.path.join(REPO, "metaflow_tpu", "training", "checkpoint.py")]
+    assert len(paths) >= 6
+    errors = [f for f in scan_paths(paths) if f.severity == "error"]
+    assert errors == [], [f.render() for f in errors]
+
+
 # ---------------------------------------------------------------------------
 # report plumbing: schema, CLI exit codes, strict gate
 # ---------------------------------------------------------------------------
@@ -709,7 +1321,8 @@ def test_check_deep_json_cli(run_flow, flows_dir):
     assert report["ok"] is True
     assert report["flow"] == "BranchFlow"
     assert set(report["analyses"]) == {"lint", "artifact-dataflow",
-                                       "spmd-config"}
+                                       "spmd-config", "gang-divergence",
+                                       "determinism"}
     assert "join" in report["steps_analyzed"]
     assert report["checks_run"] > 20
 
@@ -745,6 +1358,53 @@ def test_check_deep_exits_nonzero_on_error(run_flow, tmp_path):
     assert out.returncode == 0
 
 
+_GANG_BAD_FLOW_SRC = '''
+from metaflow_tpu import FlowSpec, current, step
+
+class SeededGangBadFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        import jax
+        if current.parallel.node_index == 0:
+            jax.lax.psum(1, "data")
+        self.rank = current.parallel.node_index
+        self.next(self.join_gang)
+
+    @step
+    def join_gang(self, inputs):
+        self.ranks = [i.rank for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.ranks)
+
+if __name__ == "__main__":
+    SeededGangBadFlow()
+'''
+
+
+def test_check_deep_fails_on_gang_divergence(run_flow, tmp_path):
+    """The divergence pass rides `check --deep`: a rank-guarded
+    collective makes the CLI exit non-zero with the finding in the
+    pinned JSON report."""
+    bad = tmp_path / "seeded_gang_bad_flow.py"
+    bad.write_text(_GANG_BAD_FLOW_SRC)
+    out = run_flow(str(bad), "check", "--deep", "--json", expect_fail=True)
+    assert out.returncode != 0
+    report = json.loads(out.stdout)
+    validate_check_report(report)
+    assert report["ok"] is False
+    codes = [f["code"] for f in report["findings"]
+             if f["severity"] == "error"]
+    assert codes == ["gang-divergent-collective"], codes
+    assert "gang-divergence" in report["analyses"]
+
+
 def test_strict_gate_blocks_run(run_flow, tmp_path):
     bad = tmp_path / "seeded_bad_flow.py"
     bad.write_text(_BAD_FLOW_SRC)
@@ -764,6 +1424,44 @@ def test_lenient_gate_warns(run_flow, tmp_path):
         "print(getattr(self, 'never_written', None))"))
     out = run_flow(str(flow), "run")
     assert out.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# scripts/analyze_all.sh: the CI analyzer-regression gate
+# ---------------------------------------------------------------------------
+
+
+def _run_analyze_all(*files, env_extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHON", sys.executable)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "analyze_all.sh")]
+        + [str(f) for f in files],
+        env=env, capture_output=True, text=True, timeout=240)
+
+
+def test_analyze_all_script_passes_on_clean_flows():
+    """The sweep script wiring: clean shipped flows exit 0. A subset
+    keeps this tier-1-fast; the full sweep is the script's default
+    invocation (CI) and the in-process parametrized sweep below."""
+    out = _run_analyze_all(
+        os.path.join(REPO, "tests", "flows", "branch_flow.py"),
+        os.path.join(REPO, "tests", "flows", "train_gang_flow.py"),
+        os.path.join(REPO, "tests", "flows", "sanitize_gang_flow.py"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "3 flow(s) checked" in out.stdout
+
+
+def test_analyze_all_script_fails_on_seeded_divergence(tmp_path):
+    bad = tmp_path / "seeded_gang_bad_flow.py"
+    bad.write_text(_GANG_BAD_FLOW_SRC)
+    out = _run_analyze_all(bad)
+    assert out.returncode != 0, out.stdout + out.stderr
+    assert "gang-divergent-collective" in out.stderr
+    assert "fail=1" in out.stdout
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,330 @@
+"""Runtime collective sanitizer (metaflow_tpu/spmd/sanitizer.py).
+
+The acceptance scenario: a test gang with an injected rank-divergent
+collective — one rank skips a psum — must produce a `_telemetry/` desync
+report naming the diverging op and rank within the barrier timeout. The
+same shape is seeded statically in test_analysis.py::RankGuardedPsumFlow:
+a confirmed runtime divergence and its static signature stay paired.
+"""
+
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+from metaflow_tpu.spmd.sanitizer import (
+    GangDesyncError,
+    GangSanitizer,
+    make_signature,
+    render_report,
+    shape_hash,
+)
+from metaflow_tpu.spmd import sanitizer
+
+from schema_validate import (
+    validate_sanitize_report,
+    validate_sanitize_stream,
+    validate_telemetry_record,
+)
+
+
+@pytest.fixture
+def fds(tmp_path):
+    return FlowDataStore("SanitizerFlow", LocalStorage,
+                         ds_root=str(tmp_path))
+
+
+def _gang(fds, world, **kw):
+    kw.setdefault("timeout_s", 5.0)
+    kw.setdefault("poll_s", 0.01)
+    return [GangSanitizer(fds, "run1", rank=r, world=world, **kw)
+            for r in range(world)]
+
+
+def _find_reports(tmp_path):
+    out = []
+    for dirpath, _dirs, files in os.walk(str(tmp_path)):
+        for name in files:
+            if name.startswith("desync."):
+                with open(os.path.join(dirpath, name)) as f:
+                    out.append(json.load(f))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# signatures
+# ---------------------------------------------------------------------------
+
+
+def test_shape_hash_is_structural_and_stable():
+    a = {"tokens": np.zeros((4, 129), np.int32)}
+    b = {"tokens": np.ones((4, 129), np.int32)}  # values differ, shape same
+    c = {"tokens": np.zeros((8, 129), np.int32)}
+    assert shape_hash(a) == shape_hash(b)
+    assert shape_hash(a) != shape_hash(c)
+
+
+def test_make_signature_fields():
+    sig = make_signature("collective", "psum", axes=("data", "fsdp"))
+    assert sig == "collective|psum|data,fsdp"
+    assert "checkpoint.save" in make_signature(
+        "write", "checkpoint.save", key=7)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: one rank skips a psum
+# ---------------------------------------------------------------------------
+
+
+def test_injected_rank_divergent_psum_produces_desync_report(
+        fds, tmp_path):
+    ranks = _gang(fds, 2)
+    batch = {"tokens": np.zeros((4, 129), np.int32)}
+    for r, s in enumerate(ranks):
+        s.journal("collective", "shard_batch", axes=("data",), shape=batch)
+        if r == 0:
+            s.journal("collective", "psum", axes=("data",))  # rank 1 skips
+        s.journal("step", "train_step")
+
+    # concurrent publish from the non-checker rank, barrier on rank 0 —
+    # the checker must see the peer stream within the timeout
+    t = threading.Thread(target=ranks[1].publish, args=(0,))
+    t.start()
+    with pytest.raises(GangDesyncError) as exc:
+        ranks[0].barrier(0)
+    t.join()
+
+    report = exc.value.report
+    validate_sanitize_report(report)
+    assert report["status"] == "desync"
+    assert report["diverged_ranks"] == [1]
+    div = report["first_divergence"]
+    assert div["seq"] == 1
+    assert "psum" in div["ops"]["0"]
+    assert div["ops"]["1"] != div["ops"]["0"]
+    # the rendered diagnosis names the op and the rank on one screen
+    rendered = render_report(report)
+    assert "psum" in rendered and "rank 1" in rendered
+
+    # the report is durable under the run's _telemetry/ prefix
+    reports = _find_reports(tmp_path)
+    assert len(reports) == 1
+    validate_sanitize_report(reports[0])
+    assert reports[0]["first_divergence"]["seq"] == 1
+
+
+def test_missing_rank_times_out_with_named_rank(fds, tmp_path):
+    s0 = GangSanitizer(fds, "run1", rank=0, world=2, timeout_s=0.3,
+                       poll_s=0.02)
+    s0.journal("collective", "psum", axes=("data",))
+    with pytest.raises(GangDesyncError) as exc:
+        s0.barrier(0)
+    report = exc.value.report
+    validate_sanitize_report(report)
+    assert report["status"] == "timeout"
+    assert report["missing_ranks"] == [1]
+    assert report["diverged_ranks"] == [1]
+    assert "never published" in render_report(report)
+    assert _find_reports(tmp_path)
+
+
+def test_lockstep_gang_passes_barrier(fds, tmp_path):
+    ranks = _gang(fds, 2)
+    batch = {"tokens": np.zeros((4, 129), np.int32)}
+    for s in ranks:
+        for i in range(5):
+            s.journal("collective", "shard_batch", axes=("data",),
+                      shape=batch)
+            s.journal("step", "train_step", key=i)
+            s.journal("write", "checkpoint.save", key=i)
+    ranks[1].publish(0)
+    report = ranks[0].barrier(0)
+    validate_sanitize_report(report)
+    assert report["status"] == "ok"
+    assert report["first_divergence"] is None
+    assert _find_reports(tmp_path) == []  # no report file on a clean pass
+
+
+def test_divergent_checkpoint_key_is_named(fds):
+    """Same count, different WRITE KEY: the race class at runtime."""
+    ranks = _gang(fds, 2)
+    for r, s in enumerate(ranks):
+        s.journal("step", "train_step")
+        s.journal("write", "checkpoint.save", key=100 + r)
+    ranks[1].publish(0)
+    with pytest.raises(GangDesyncError) as exc:
+        ranks[0].barrier(0)
+    div = exc.value.report["first_divergence"]
+    assert div["seq"] == 1
+    assert "checkpoint.save|100" in div["ops"]["0"]
+    assert "checkpoint.save|101" in div["ops"]["1"]
+
+
+def test_published_stream_schema(fds):
+    s = GangSanitizer(fds, "run1", rank=0, world=1)
+    s.journal("collective", "psum", axes=("data",))
+    payload = s.publish(3)
+    validate_sanitize_stream(payload)
+    assert payload["count"] == 1 and payload["barrier"] == 3
+
+
+def test_rolling_window_keeps_tail(fds):
+    s = GangSanitizer(fds, "run1", rank=0, world=1, window=16)
+    for i in range(100):
+        s.journal("step", "train_step", key=i)
+    payload = s.publish(0)
+    assert payload["count"] == 100
+    assert payload["window_start"] == 84
+    assert len(payload["sigs"]) == 16
+
+
+def test_wrap_step_journals_and_runs_barrier_cadence(fds):
+    s = GangSanitizer(fds, "run1", rank=0, world=1, barrier_every=2)
+    calls = []
+
+    def step(state, batch):
+        calls.append(1)
+        return state, {"loss": 0.0}
+
+    wrapped = s.wrap_step(step)
+    batch = {"tokens": np.zeros((2, 9), np.int32)}
+    for _ in range(4):
+        wrapped({"w": 0}, batch)
+    assert len(calls) == 4
+    # 4 step signatures journaled; 2 barriers published (world=1: no check)
+    assert s._seq == 4
+    assert s._barriers == 2
+    # a KEYWORD batch must produce the SAME signature as a positional
+    # one (and never hash the state tree in its place)
+    positional = s._sigs[-1][1]
+    wrapped({"w": 0}, batch=batch)
+    assert s._sigs[-1][1] == positional
+
+
+# ---------------------------------------------------------------------------
+# library hooks: module-level current sanitizer
+# ---------------------------------------------------------------------------
+
+
+def test_module_hooks_are_noops_when_uninstalled():
+    sanitizer.uninstall()
+    sanitizer.journal("collective", "psum")  # must not raise
+
+    def step():
+        return 1
+
+    assert sanitizer.wrap_step(step) is step
+
+
+def test_install_requires_env_gate(fds, monkeypatch):
+    monkeypatch.delenv("TPUFLOW_SANITIZE", raising=False)
+    assert sanitizer.install(fds, "run1") is None
+    monkeypatch.setenv("TPUFLOW_SANITIZE", "1")
+    try:
+        active = sanitizer.install(fds, "run1", rank=0, world=1)
+        assert active is not None and sanitizer.current() is active
+    finally:
+        sanitizer.uninstall()
+
+
+def test_shard_batch_and_trainer_hooks_journal(fds, monkeypatch):
+    """The library hooks feed the journal: shard_batch and make_trainer's
+    wrapped step + compile signature, and checkpoint.save's write key."""
+    jax = pytest.importorskip("jax")
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import shard_batch
+    from metaflow_tpu.training.checkpoint import AsyncCheckpointManager
+
+    monkeypatch.setenv("TPUFLOW_SANITIZE", "1")
+    s = sanitizer.install(fds, "run1", rank=0, world=1, barrier_every=0)
+    try:
+        mesh = create_mesh(MeshSpec.dp(), n_devices=1)
+        shard_batch({"tokens": np.zeros((2, 9), np.int32)}, mesh)
+        ckpt = AsyncCheckpointManager(fds, name="san")
+        ckpt.save({"w": np.zeros(3)}, step=7)
+        ckpt.wait()
+        sigs = [sig for _seq, sig in s._sigs]
+        assert any(sig.startswith("collective|shard_batch|") for sig in sigs)
+        assert "write|checkpoint.save|7" in sigs
+    finally:
+        sanitizer.uninstall()
+
+
+def test_make_trainer_wraps_outside_instrumentation(fds, monkeypatch):
+    """Regression: the sanitizer must wrap OUTSIDE instrument_train_step.
+    Wrapping first hid the jitted step behind a plain function (breaking
+    the instrumentation's jit-cache probe and cost-analysis lower()) and
+    dropped the `.telemetry` handle from the returned step."""
+    jax = pytest.importorskip("jax")
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import make_trainer, shard_batch
+
+    monkeypatch.setenv("TPUFLOW_SANITIZE", "1")
+    s = sanitizer.install(fds, "run1", rank=0, world=1, barrier_every=0)
+    try:
+        cfg = llama.LlamaConfig.tiny()
+        mesh = create_mesh(MeshSpec.dp(), n_devices=1)
+        state, step, _ = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama, telemetry=True)
+        # sanitizer-wrapped AND instrumented: both handles reachable
+        assert hasattr(step, "sanitizer")
+        assert hasattr(step, "telemetry")
+        batch = shard_batch(
+            {"tokens": np.zeros((2, 9), np.int32)}, mesh)
+        with mesh:
+            state, metrics = step(state, batch)
+        assert "loss" in metrics
+        sigs = [sig for _seq, sig in s._sigs]
+        assert any(sig.startswith("compile|make_trainer|")
+                   for sig in sigs)
+        assert any(sig.startswith("step|train_step|") for sig in sigs)
+        step.telemetry.close()
+    finally:
+        sanitizer.uninstall()
+
+
+def test_gang_flow_e2e_desync_report(run_flow, flows_dir, tpuflow_root):
+    """The acceptance run: a real 2-rank gang (separate task processes
+    sharing the run datastore) with rank 1 skipping a psum signature.
+    The flow itself asserts the checker rank caught the desync; here we
+    assert the durable report landed under _telemetry/ and names the op
+    and rank."""
+    run_flow(os.path.join(flows_dir, "sanitize_gang_flow.py"), "run",
+             env_extra={"TPUFLOW_SANITIZE": "1",
+                        "TPUFLOW_SANITIZE_TIMEOUT": "60"})
+    reports = _find_reports(tpuflow_root)
+    assert len(reports) == 1, reports
+    report = reports[0]
+    validate_sanitize_report(report)
+    assert report["status"] == "desync"
+    assert report["diverged_ranks"] == [1]
+    ops = report["first_divergence"]["ops"]
+    assert "psum" in ops["0"]
+
+
+def test_desync_event_rides_flight_recorder(fds, monkeypatch):
+    """The checker emits a sanitize.desync event through the run's
+    flight recorder, so `tpuflow metrics` surfaces the diagnosis."""
+    from metaflow_tpu import telemetry
+
+    telemetry.init_recorder(fds, "run1", "train", "t1")
+    try:
+        ranks = _gang(fds, 2)
+        ranks[0].journal("collective", "psum", axes=("data",))
+        ranks[1].journal("step", "train_step")
+        ranks[1].publish(0)
+        with pytest.raises(GangDesyncError):
+            ranks[0].barrier(0)
+    finally:
+        telemetry.close_recorder()
+    records = telemetry.read_run_records(fds, "run1")
+    desync = [r for r in records if r["name"] == "sanitize.desync"]
+    assert len(desync) == 1
+    validate_telemetry_record(desync[0])
+    assert desync[0]["data"]["status"] == "desync"
+    assert desync[0]["data"]["diverged_ranks"] == [1]
