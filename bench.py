@@ -998,6 +998,99 @@ def bench_ckpt_overlap():
         }
 
 
+def bench_elastic_goodput():
+    """Goodput (useful train steps / wall-clock) under a kill schedule
+    and a scripted capacity hole: the elastic supervisor's
+    resize-and-continue vs the fixed-size retry baseline, which can only
+    park until the hole closes (admission control applies to both — a
+    gang cannot relaunch onto capacity that is not there).
+
+    Scenario (time-keyed ScriptedCapacityOracle): the fleet starts full,
+    drops to HALF capacity around the chaos kill, and recovers
+    BENCH_ELASTIC_HOLE_S seconds later. Both runs complete the same
+    number of useful train steps on the exact same token order (the
+    flow's `end` step asserts it); only the wall-clock differs. Grow-back
+    is disabled for the measurement so each run's step count is the
+    clean numerator."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    flow = os.path.join(here, "tests", "flows", "elastic_train_flow.py")
+    ranks = int(os.environ.get("BENCH_ELASTIC_RANKS", "4"))
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", "30"))
+    sleep = os.environ.get("BENCH_ELASTIC_SLEEP", "0.05")
+    hole_s = float(os.environ.get("BENCH_ELASTIC_HOLE_S", "10"))
+    half = max(1, ranks // 2)
+    kill_step = 3
+
+    def run_once(resize):
+        with tempfile.TemporaryDirectory() as root:
+            env = dict(os.environ)
+            env.update({
+                "TPUFLOW_DATASTORE_SYSROOT_LOCAL": root,
+                "TPUFLOW_CLIENT_CACHE": os.path.join(root, "cache"),
+                "PYTHONPATH": here,
+                "JAX_PLATFORMS": "cpu",
+                "TPUFLOW_CHAOS": "%d:1" % kill_step,
+                "TPUFLOW_CHAOS_DIR": os.path.join(root, "chaos"),
+                # "+" anchors the timeline at the FIRST consult = the
+                # post-kill retry decision: a capacity hole of exactly
+                # hole_s seconds starting at the failure, regardless of
+                # how long imports/steps ran before the kill
+                "TPUFLOW_CAPACITY_ORACLE": "scripted:+0:%d,%g:%d"
+                                           % (half, hole_s, ranks),
+                "TPUFLOW_ELASTIC_RESIZE": "1" if resize else "0",
+                # no grow-back mid-measurement: both runs finish at one
+                # size so goodput = steps / wall is directly comparable
+                "TPUFLOW_ELASTIC_GROW_EVERY_S": "3600",
+                "TPUFLOW_RETRY_BACKOFF_BASE_S": "0.1",
+                "TPUFLOW_RETRY_BACKOFF_SEED": "0",
+                "ELASTIC_FLOW_RANKS": str(ranks),
+                "ELASTIC_FLOW_STEPS": str(steps),
+                "ELASTIC_FLOW_SLEEP": str(sleep),
+            })
+            t0 = time.perf_counter()
+            proc = subprocess.run([sys.executable, flow, "run"], env=env,
+                                  capture_output=True, text=True)
+            wall = time.perf_counter() - t0
+            out = proc.stdout + proc.stderr
+            if proc.returncode != 0 or "elastic run ok" not in out:
+                raise SystemExit(
+                    "elastic bench flow failed (resize=%s):\n%s"
+                    % (resize, out[-2000:]))
+            return steps / wall, wall
+
+    elastic_goodput, elastic_wall = run_once(True)
+    fixed_goodput, fixed_wall = run_once(False)
+    ratio = elastic_goodput / fixed_goodput
+    return {
+        "metric": "elastic_goodput_ratio",
+        "value": round(ratio, 2),
+        "unit": "x (elastic vs fixed-size retry, same kill + capacity "
+                "hole)",
+        "vs_baseline": _vs_baseline(ratio),
+        "extra": {
+            "ranks": ranks,
+            "shrink_to": half,
+            "useful_steps": steps,
+            "kill_step": kill_step,
+            "capacity_hole_s": hole_s,
+            "elastic_wall_s": round(elastic_wall, 2),
+            "fixed_wall_s": round(fixed_wall, 2),
+        },
+        "submetrics": [
+            {"metric": "elastic_goodput_steps_per_s",
+             "value": round(elastic_goodput, 3),
+             "unit": "useful train steps/s (resize-and-continue)"},
+            {"metric": "fixed_goodput_steps_per_s",
+             "value": round(fixed_goodput, 3),
+             "unit": "useful train steps/s (park until capacity "
+                     "returns)"},
+        ],
+    }
+
+
 def bench_telemetry_overhead():
     """Instrumented-vs-disabled train-step overhead of the flight
     recorder (training.metrics.instrument_train_step emitting per-step
@@ -1320,6 +1413,10 @@ if __name__ == "__main__":
         result = bench_data_stream()
     elif mode == "gsop":
         result = bench_data_path()
+    elif mode == "elastic":
+        # scheduler-policy metric: subprocess flows on a CPU mesh by
+        # design — no chip involved, never a degraded fallback
+        result = bench_elastic_goodput()
     elif mode == "persist":
         # artifact persist pipeline + async checkpoint overlap: pure
         # host/IO metrics, no chip needed

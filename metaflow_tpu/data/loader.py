@@ -142,14 +142,30 @@ class StreamingTokenBatches(object):
             "drop_last": int(self._drop_last),
         }
 
-    def restore(self, state):
+    def restore(self, state, reslice=False):
         """Position the stream just after the batch that carried `state`
-        — iteration continues with the batch that would have come next."""
+        — iteration continues with the batch that would have come next.
+
+        reslice=True accepts a stamp recorded under a DIFFERENT gang
+        geometry (host_index/n_hosts — an elastic resize): per-host
+        slices are disjoint stride slices of the epoch shard order, so a
+        mid-epoch position under the old slicing has no exact equivalent
+        under the new one. The stamp must therefore sit at an epoch
+        boundary (start of an epoch, or the old slice fully drained);
+        the new layout then re-slices that epoch deterministically and
+        the GLOBAL token order stays exact. A mid-epoch stamp with a
+        changed geometry is a hard error either way — align resizes to
+        checkpoint-at-epoch-boundary (or use a global, non-sharded
+        stream, which is resize-invariant)."""
         if state.get("seed") != self._seed:
             raise ValueError(
                 "checkpointed stream seed %r != this stream's %r — "
                 "restoring would produce a different shuffle order"
                 % (state.get("seed"), self._seed))
+        old_hosts = (int(state.get("host_index", self._host_index)),
+                     int(state.get("n_hosts", self._n_hosts)))
+        if reslice and old_hosts != (self._host_index, self._n_hosts):
+            return self._restore_resliced(state, old_hosts)
         for key, mine in (("batch_size", self._batch_size),
                           ("window", self._window),
                           ("n_shards", self._n_shards),
@@ -189,6 +205,57 @@ class StreamingTokenBatches(object):
         self._epoch = epoch
         self._shard_cursor = shard_cursor
         self._window_cursor = window_cursor
+        return self
+
+    def _restore_resliced(self, state, old_hosts):
+        """Epoch-boundary restore across a gang-geometry change."""
+        old_index, old_n = old_hosts
+        for key, mine in (("batch_size", self._batch_size),
+                          ("window", self._window),
+                          ("n_shards", self._n_shards),
+                          ("total_tokens", self._manifest["total_tokens"]),
+                          ("shard_tokens", self._manifest["shard_tokens"]),
+                          ("drop_last", int(self._drop_last))):
+            theirs = int(state[key])
+            if theirs != int(mine):
+                raise ValueError(
+                    "checkpointed stream %s=%d != this stream's %d — a "
+                    "resize can re-slice the SAME corpus, not a "
+                    "different one" % (key, theirs, int(mine)))
+        if not 0 <= old_index < old_n:
+            raise ValueError(
+                "checkpointed stream host_index=%d out of range for "
+                "n_hosts=%d — corrupted resume stamp" % (old_index, old_n))
+        epoch = int(state["epoch"])
+        shard_cursor = int(state["shard_cursor"])
+        window_cursor = int(state["window_cursor"])
+        if epoch < 0 or (self._epochs is not None and epoch > self._epochs):
+            raise ValueError(
+                "checkpointed stream epoch=%d out of range [0, %s] — "
+                "corrupted resume stamp" % (epoch, self._epochs))
+        old_order = host_slice(
+            epoch_shard_order(self._seed, epoch, self._n_order),
+            old_index, old_n)
+        if shard_cursor == 0 and window_cursor == 0:
+            pass  # start of `epoch` — globally aligned under any slicing
+        elif shard_cursor == len(old_order) and window_cursor == 0:
+            epoch += 1  # old slice fully drained: next epoch's start
+        else:
+            raise ValueError(
+                "cannot re-slice a mid-epoch stamp (epoch=%d, "
+                "shard_cursor=%d/%d, window_cursor=%d) from %d host(s) "
+                "onto %d: per-host slices are disjoint, so the position "
+                "has no exact equivalent. Align elastic resizes to an "
+                "epoch boundary, or stream a global (non-sharded) "
+                "source." % (epoch, shard_cursor, len(old_order),
+                             window_cursor, old_n, self._n_hosts))
+        if self._epochs is not None and epoch > self._epochs:
+            raise ValueError(
+                "checkpointed stream epoch=%d out of range [0, %s] — "
+                "corrupted resume stamp" % (epoch, self._epochs))
+        self._epoch = epoch
+        self._shard_cursor = 0
+        self._window_cursor = 0
         return self
 
     # ---------- iteration ----------

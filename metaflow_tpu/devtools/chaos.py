@@ -1,0 +1,197 @@
+"""Deterministic fault injection: seeded kill schedules for gang steps.
+
+Preemption testing must not depend on prod incidents: this harness turns
+"rank 3 gets reclaimed at step 7, capacity shrinks to 4 hosts, comes
+back 10 seconds later" into a reproducible unit test.
+
+Kill delivery rides the EXACT production path — `notify_preemption`
+drops the timestamped spot-notice marker and SIGTERMs the process, so
+the PreemptionHandler, the gang teardown, the scheduler's failure
+classification and the elastic supervisor's resize policy are all
+exercised end to end, not mocked.
+
+Environment contract (read by `from_env`, ticked by
+`training/metrics.instrument_train_step` or an explicit
+`chaos.maybe_chaos_step(step)` in the training loop):
+
+    TPUFLOW_CHAOS=<seed>        seeded schedule: kills drawn from
+                                default_rng(seed) over the horizon
+    TPUFLOW_CHAOS=3:1,7:0       explicit schedule: kill rank 1 at step 3,
+                                rank 0 at step 7
+    TPUFLOW_CHAOS_STEPS=N       seeded horizon (default 10)
+    TPUFLOW_CHAOS_NKILLS=K      kills drawn from the seed (default 1)
+    TPUFLOW_CHAOS_DIR=path      once-only ledger dir (defaults to a
+                                per-run dir under the system tempdir)
+
+Each scheduled kill fires AT MOST ONCE per run: delivery claims a
+ledger file with O_EXCL, so the retried (resumed) gang replaying the
+same step numbers does not re-kill itself forever. The capacity side of
+a scenario is scripted on the scheduler via
+TPUFLOW_CAPACITY_ORACLE=scripted:... (elastic/oracle.py) — together
+they make shrink/grow/repeated-kill scenarios deterministic.
+"""
+
+import os
+import tempfile
+
+from .. import telemetry
+
+CHAOS_ENV = "TPUFLOW_CHAOS"
+STEPS_ENV = "TPUFLOW_CHAOS_STEPS"
+NKILLS_ENV = "TPUFLOW_CHAOS_NKILLS"
+DIR_ENV = "TPUFLOW_CHAOS_DIR"
+
+
+class KillSchedule(object):
+    """An immutable set of (step, rank) kill events."""
+
+    def __init__(self, kills):
+        self.kills = tuple(sorted({(int(s), int(r)) for s, r in kills}))
+
+    @classmethod
+    def parse(cls, spec):
+        """"3:1,7:0" -> kill rank 1 at step 3, rank 0 at step 7."""
+        kills = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            step, rank = part.split(":")
+            kills.append((int(step), int(rank)))
+        return cls(kills)
+
+    @classmethod
+    def seeded(cls, seed, n_steps, world, n_kills=1):
+        """A pure function of (seed, n_steps, world, n_kills): every rank
+        of the gang — and every retry attempt — computes the identical
+        schedule with no coordination. Kills land in [1, n_steps-1]
+        (never step 0: a gang killed before its first checkpoint has
+        nothing to prove about resume)."""
+        import numpy as np
+
+        rng = np.random.default_rng([int(seed), int(n_steps), int(world)])
+        hi = max(2, int(n_steps))
+        steps = rng.choice(
+            np.arange(1, hi), size=min(int(n_kills), hi - 1), replace=False)
+        ranks = rng.integers(0, max(1, int(world)), size=len(steps))
+        return cls(zip(steps.tolist(), ranks.tolist()))
+
+    def kills_for_rank(self, rank):
+        return [s for s, r in self.kills if r == int(rank)]
+
+    def __iter__(self):
+        return iter(self.kills)
+
+    def __len__(self):
+        return len(self.kills)
+
+
+class ChaosInjector(object):
+    """Per-process kill dispatcher: tick `on_step(step)` at each train
+    step boundary; scheduled (step, my_rank) events deliver a real
+    preemption notice to this process, once per run."""
+
+    def __init__(self, schedule, rank, world, ledger_dir, notify=None):
+        if notify is None:
+            from ..plugins.tpu.preemption import notify_preemption
+
+            notify = notify_preemption
+        self.schedule = schedule
+        self.rank = int(rank)
+        self.world = int(world)
+        self.ledger_dir = ledger_dir
+        self._notify = notify
+        self._my_steps = set(schedule.kills_for_rank(self.rank))
+
+    def _claim(self, step):
+        """True iff THIS call is the first delivery of (step, rank) in
+        the run — O_EXCL on a ledger file arbitrates across attempts
+        (and across racing processes on the same host)."""
+        os.makedirs(self.ledger_dir, exist_ok=True)
+        path = os.path.join(
+            self.ledger_dir, "kill-%d-%d" % (int(step), self.rank))
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def on_step(self, step):
+        """Deliver any scheduled kill for (step, this rank). Returns True
+        when a notice was just delivered (the SIGTERM raise is typically
+        already unwinding the stack by then)."""
+        if int(step) not in self._my_steps:
+            return False
+        if not self._claim(step):
+            return False
+        telemetry.event(
+            "chaos.kill",
+            data={"step": int(step), "rank": self.rank,
+                  "world": self.world})
+        self._notify(os.getpid())
+        return True
+
+
+def _default_ledger_dir():
+    """Per-run ledger so once-only semantics span attempts but never leak
+    across runs. Falls back to a pid-keyed dir outside a task context."""
+    run_id = None
+    try:
+        from ..current import current
+
+        run_id = current.run_id
+        flow = current.flow_name
+    except Exception:
+        flow = None
+    if run_id:
+        name = "tpuflow-chaos-%s-%s" % (flow or "flow", run_id)
+    else:
+        name = "tpuflow-chaos-%d" % os.getppid()
+    return os.path.join(tempfile.gettempdir(), name)
+
+
+def schedule_from_env(world, env=None):
+    """The configured KillSchedule, or None when chaos is off."""
+    env = env if env is not None else os.environ
+    spec = (env.get(CHAOS_ENV) or "").strip()
+    if not spec:
+        return None
+    if ":" in spec:
+        return KillSchedule.parse(spec)
+    n_steps = int(env.get(STEPS_ENV, "10"))
+    n_kills = int(env.get(NKILLS_ENV, "1"))
+    return KillSchedule.seeded(int(spec), n_steps, world, n_kills)
+
+
+def from_env(rank=None, world=None, env=None):
+    """Build the process's ChaosInjector from the environment, or None
+    when TPUFLOW_CHAOS is unset. rank/world default to the gang env."""
+    env = env if env is not None else os.environ
+    if rank is None:
+        rank = int(env.get("MF_PARALLEL_NODE_INDEX", "0"))
+    if world is None:
+        world = int(env.get("MF_PARALLEL_NUM_NODES", "1"))
+    schedule = schedule_from_env(world, env=env)
+    if schedule is None:
+        return None
+    ledger = env.get(DIR_ENV) or _default_ledger_dir()
+    return ChaosInjector(schedule, rank, world, ledger)
+
+
+_injector_cache = {}
+
+
+def maybe_chaos_step(step):
+    """Module-level tick for instrumented training loops: no-op unless
+    TPUFLOW_CHAOS is set. The injector is cached per (pid, rank) — gang
+    worker processes each build their own."""
+    if not os.environ.get(CHAOS_ENV):
+        return False
+    key = (os.getpid(), os.environ.get("MF_PARALLEL_NODE_INDEX", "0"))
+    if key not in _injector_cache:
+        _injector_cache[key] = from_env()
+    injector = _injector_cache[key]
+    if injector is None:
+        return False
+    return injector.on_step(step)

@@ -1,0 +1,44 @@
+"""Elastic gang supervision: preemption-priced training that resizes
+instead of dying.
+
+Preemptible capacity is the default economics of TPU fleets ("Exploring
+the limits of Concurrency in ML Training on Google TPUs", PAPERS.md):
+a reclaimed rank should cost one checkpoint interval, not the run. This
+package is the policy layer that composes the ingredients the rest of
+the repo already ships:
+
+  - `policy.py`     — failure classification (preemption / grow / user /
+                      infra) + shared jittered-exponential backoff; also
+                      used by the scheduler's plain task-retry path.
+  - `oracle.py`     — pluggable capacity oracles: how many gang hosts are
+                      admissible right now (GCE probe, static, scripted
+                      for the chaos harness, adaptive when unknown).
+  - `supervisor.py` — the elastic gang supervisor wired into
+                      NativeRuntime: on a preemption-classified gang
+                      failure it consults the oracle, picks the largest
+                      admissible topology (validated through
+                      analysis/spmd_check BEFORE relaunch), re-forks the
+                      gang at the new size, and grows it back at the next
+                      checkpoint boundary when capacity returns.
+
+The chaos harness that proves all of this under hostile schedules lives
+in `metaflow_tpu/devtools/chaos.py` (TPUFLOW_CHAOS). See
+docs/elasticity.md for the state machine and env vars.
+"""
+
+from .policy import (  # noqa: F401
+    BackoffPolicy,
+    CLASS_GROW,
+    CLASS_INFRA,
+    CLASS_PREEMPTION,
+    CLASS_USER,
+    classify_failure,
+)
+from .oracle import (  # noqa: F401
+    CapacityOracle,
+    GceCapacityOracle,
+    ScriptedCapacityOracle,
+    StaticCapacityOracle,
+    oracle_from_env,
+)
+from .supervisor import Decision, ElasticGangSupervisor  # noqa: F401

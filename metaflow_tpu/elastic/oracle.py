@@ -1,0 +1,170 @@
+"""Capacity oracles: how many gang hosts are admissible right now.
+
+The supervisor never assumes it can see capacity perfectly — on real
+fleets the only authoritative probe is a launch attempt. Oracles
+therefore answer with an *estimate*:
+
+    available_hosts() -> int   capacity known (static config, scripted
+                               chaos timeline, a cached probe)
+                       -> None capacity unknown: the supervisor falls
+                               back to its adaptive policy (step down a
+                               size after repeated preemptions, probe
+                               growth after a quiet period)
+
+`oracle_from_env()` builds the configured oracle:
+
+    TPUFLOW_CAPACITY_ORACLE=static:4          fixed capacity
+    TPUFLOW_CAPACITY_ORACLE=scripted:4,8      consult-indexed script
+    TPUFLOW_CAPACITY_ORACLE=scripted:0:8,5:4  time-keyed script (t:cap)
+    TPUFLOW_CAPACITY_ORACLE=gce               GCE probe (best effort)
+    unset / none                              unknown (adaptive)
+
+Scripted oracles are the injectable fake the chaos harness uses: a
+shrink/grow scenario becomes a deterministic unit test instead of a
+prod incident.
+"""
+
+import os
+import time
+
+
+class CapacityOracle(object):
+    def available_hosts(self):
+        """Estimated hosts admissible now, or None when unknown."""
+        return None
+
+    def describe(self):
+        return type(self).__name__
+
+
+class StaticCapacityOracle(CapacityOracle):
+    def __init__(self, hosts):
+        self.hosts = int(hosts)
+
+    def available_hosts(self):
+        return self.hosts
+
+    def describe(self):
+        return "static:%d" % self.hosts
+
+
+class ScriptedCapacityOracle(CapacityOracle):
+    """Deterministic capacity timeline for tests and the chaos harness.
+
+    Three spec forms:
+      "4,8"        consult-indexed: the i-th call returns the i-th entry,
+                   the last entry sticks. Deterministic regardless of
+                   wall-clock — the form unit tests want.
+      "0:8,5:4"    time-keyed: `t:cap` pairs; capacity is the entry with
+                   the largest t <= elapsed seconds since construction.
+      "+0:4,8:8"   time-keyed, anchored at the FIRST consult instead of
+                   construction. The first consult is the supervisor's
+                   post-failure retry decision, so "+0:H,W:F" means
+                   "a capacity hole of exactly W seconds starting at the
+                   failure" — the form a goodput bench wants, immune to
+                   how long imports/steps took before the kill.
+    """
+
+    def __init__(self, spec, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self._consults = 0
+        spec = spec.strip() if isinstance(spec, str) else spec
+        self._anchored = isinstance(spec, str) and spec.startswith("+")
+        if self._anchored:
+            spec = spec[1:]
+            self._t0 = None  # anchored lazily at the first consult
+        if isinstance(spec, str) and ":" in spec:
+            self.timeline = []
+            for part in spec.split(","):
+                t, cap = part.split(":")
+                self.timeline.append((float(t), int(cap)))
+            self.timeline.sort()
+            self.sequence = None
+        else:
+            if isinstance(spec, str):
+                spec = [int(x) for x in spec.split(",") if x.strip()]
+            self.sequence = [int(x) for x in spec]
+            if not self.sequence:
+                raise ValueError("empty capacity script")
+            self.timeline = None
+
+    def available_hosts(self):
+        if self.sequence is not None:
+            i = min(self._consults, len(self.sequence) - 1)
+            self._consults += 1
+            return self.sequence[i]
+        if self._t0 is None:
+            self._t0 = self._clock()
+        elapsed = self._clock() - self._t0
+        cap = self.timeline[0][1]
+        for t, c in self.timeline:
+            if elapsed >= t:
+                cap = c
+        return cap
+
+    def describe(self):
+        if self.sequence is not None:
+            return "scripted:%s" % ",".join(map(str, self.sequence))
+        return "scripted:%s%s" % ("+" if self._anchored else "", ",".join(
+            "%g:%d" % (t, c) for t, c in self.timeline))
+
+
+class GceCapacityOracle(CapacityOracle):
+    """Best-effort GCE probe.
+
+    There is no public "how many TPU hosts could I get right now" API —
+    on a real fleet the launch attempt IS the probe. What the metadata
+    server does tell us cheaply is whether THIS VM is being reclaimed,
+    and operators can export a capacity hint (e.g. from a reservation
+    dashboard or the queued-resources API) via TPUFLOW_CAPACITY_HINT.
+    Anything else returns None, which selects the supervisor's adaptive
+    step-down/probe-up policy."""
+
+    METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/preempted")
+
+    def __init__(self, hint_env="TPUFLOW_CAPACITY_HINT", timeout=2.0):
+        self.hint_env = hint_env
+        self.timeout = timeout
+
+    def available_hosts(self):
+        hint = os.environ.get(self.hint_env)
+        if hint:
+            try:
+                return int(hint)
+            except ValueError:
+                pass
+        return None
+
+    def this_host_reclaimed(self):
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.METADATA_URL, headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read().decode("utf-8", "replace")
+                return body.strip().upper() == "TRUE"
+        except Exception:
+            return False
+
+    def describe(self):
+        return "gce"
+
+
+def oracle_from_env(env=None):
+    """Build the configured oracle; None = capacity unknown (adaptive)."""
+    env = env if env is not None else os.environ
+    spec = (env.get("TPUFLOW_CAPACITY_ORACLE") or "none").strip()
+    if spec in ("", "none", "0"):
+        return None
+    if spec.startswith("static:"):
+        return StaticCapacityOracle(int(spec.split(":", 1)[1]))
+    if spec.startswith("scripted:"):
+        return ScriptedCapacityOracle(spec.split(":", 1)[1])
+    if spec == "gce":
+        return GceCapacityOracle()
+    raise ValueError(
+        "unknown TPUFLOW_CAPACITY_ORACLE=%r (expected none, static:N, "
+        "scripted:..., or gce)" % spec)

@@ -1,0 +1,117 @@
+"""Retry classification + shared jittered-exponential backoff.
+
+One policy, two consumers: the scheduler's plain task-retry path
+(runtime.py used to re-fork failed attempts immediately — a crash loop
+against a broken dependency hammers the datastore and the metadata
+service at full speed) and the elastic gang supervisor (which must not
+relaunch a gang into the same capacity hole it just fell out of).
+
+Failure classes drive what a retry MEANS:
+
+  preemption  capacity was reclaimed (spot notice marker present on a
+              rank): resize-and-retry — the work is checkpointed, the
+              only question is at what size to continue.
+  grow        the supervisor itself asked the gang to exit at a
+              checkpoint boundary so it can relaunch larger: retry
+              immediately at the new size.
+  user        the step raised (attempt_ok metadata was recorded): honor
+              the @retry budget, short backoff — retrying faster never
+              fixes user code, retrying slower never hurts it.
+  infra       the process died without even recording its attempt
+              verdict (OOM kill, wedged runtime, torn node): exponential
+              backoff — this is the class where hammering makes it worse.
+"""
+
+import os
+
+CLASS_PREEMPTION = "preemption"
+CLASS_GROW = "grow"
+CLASS_USER = "user"
+CLASS_INFRA = "infra"
+
+
+def classify_failure(spot_notice=False, grow_notice=False,
+                     attempt_recorded=True):
+    """Map one failed attempt's observable outcome to a failure class.
+
+    spot_notice / grow_notice: a fresh notice marker was recorded (by the
+    preemption monitor, the chaos harness, or the supervisor's own grow
+    request) on the task or any of its gang ranks.
+    attempt_recorded: the task got far enough to register its attempt_ok
+    metadata — i.e. user code ran and raised, vs the process being torn
+    from under it. (The exit code deliberately plays no part: a -TERM
+    can be a reclaim, a teardown, or an operator kill — only the marker
+    metadata distinguishes them.)
+    """
+    if grow_notice:
+        return CLASS_GROW
+    if spot_notice:
+        return CLASS_PREEMPTION
+    if attempt_recorded:
+        return CLASS_USER
+    return CLASS_INFRA
+
+
+class BackoffPolicy(object):
+    """Deterministic jittered exponential backoff.
+
+    delay(attempt) = min(cap, base * 2**attempt), multiplied by a jitter
+    factor drawn uniformly from [1-jitter, 1+jitter]. The jitter is a
+    pure function of (seed, key, attempt) so a seeded chaos run replays
+    the exact same schedule; with seed=None it is seeded from os.urandom
+    once per policy instance (still jittered, no longer reproducible).
+    """
+
+    def __init__(self, base_s=0.5, cap_s=60.0, jitter=0.5, seed=None):
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        self.seed = int(seed)
+
+    def delay(self, attempt, key=""):
+        if self.base_s <= 0:
+            return 0.0
+        raw = min(self.cap_s, self.base_s * (2.0 ** max(0, int(attempt))))
+        if self.jitter <= 0:
+            return raw
+        # splitmix-style integer hash over (seed, key, attempt): cheap,
+        # process-stable (str.__hash__ is randomized per interpreter —
+        # a seeded schedule must replay across scheduler restarts), and
+        # numpy-free (this runs in the scheduler poll loop)
+        import zlib
+
+        khash = zlib.crc32(str(key).encode("utf-8", "replace"))
+        h = (self.seed * 0x9E3779B97F4A7C15 + khash * 0xBF58476D1CE4E5B9
+             + int(attempt) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 31
+        h = (h * 0xD6E8FEB86659FD93) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 29
+        u = (h & 0xFFFFFFFF) / float(0x100000000)  # uniform [0, 1)
+        return raw * (1.0 - self.jitter + 2.0 * self.jitter * u)
+
+    @classmethod
+    def from_env(cls, env=None):
+        env = env if env is not None else os.environ
+
+        # a malformed knob degrades to its default — this runs inside
+        # NativeRuntime construction, where a typo'd env var must not
+        # kill every run of every flow before any task starts
+        def _f(name, default):
+            try:
+                return float(env.get(name, default))
+            except (TypeError, ValueError):
+                return default
+
+        seed = env.get("TPUFLOW_RETRY_BACKOFF_SEED")
+        try:
+            seed = int(seed) if seed is not None else None
+        except ValueError:
+            seed = None
+        return cls(
+            base_s=_f("TPUFLOW_RETRY_BACKOFF_BASE_S", 0.2),
+            cap_s=_f("TPUFLOW_RETRY_BACKOFF_CAP_S", 60.0),
+            jitter=_f("TPUFLOW_RETRY_BACKOFF_JITTER", 0.5),
+            seed=seed,
+        )

@@ -1,0 +1,563 @@
+"""ElasticGangSupervisor: the scheduler-side policy that turns a
+preempted gang into a resized gang instead of a dead run.
+
+Wired into NativeRuntime (runtime.py): every failed attempt is routed
+through `plan_retry`, which
+
+  1. classifies the failure (policy.classify_failure) by reading the
+     notice markers the task — or any of its gang ranks — recorded in
+     task metadata (preemption.py writes them; the chaos harness injects
+     them);
+  2. consults the capacity oracle and picks the LARGEST currently
+     admissible gang size: same-family TPU topologies from
+     topologies.py for @tpu steps, divisors of the requested size for
+     local gangs — each candidate validated through analysis/spmd_check
+     (mesh-axis divisibility, topology host counts) BEFORE relaunch;
+  3. prices the relaunch with the shared jittered-exponential backoff
+     (policy.BackoffPolicy) — preemption retries do NOT consume the
+     user @retry budget (capacity events are not user errors);
+  4. while a gang runs below its requested size, watches the oracle and
+     delivers a grow notice (preemption.notify_resize) so the gang exits
+     at its next checkpoint boundary and relaunches larger.
+
+The data layer's deterministic host-count re-slicing plus
+AsyncCheckpointManager.restore(like=...)/reshard_like make the resized
+attempt continue the SAME training run: token-exact data order, model
+state resharded onto the new mesh. tests/test_elastic.py proves the
+8→4→8 scenario end to end under the chaos harness.
+"""
+
+import collections
+import json
+import os
+import time
+
+from ..plugins.tpu.topologies import TPU_TOPOLOGY_SELECTORS
+from ..unbounded_foreach import UBF_CONTROL
+from ..util import env_float, env_int
+from .oracle import oracle_from_env
+from .policy import (
+    BackoffPolicy,
+    CLASS_GROW,
+    CLASS_PREEMPTION,
+    classify_failure,
+)
+
+Decision = collections.namedtuple(
+    "Decision",
+    ["action",         # "retry" | "fail"
+     "delay_s",        # backoff before relaunch
+     "new_size",       # gang size for the next attempt (None = unchanged)
+     "failure_class",  # policy.CLASS_*
+     "reason",         # human-readable one-liner for the echo line
+     "waiting"],       # True: parked on capacity — recheck at launch time
+)
+Decision.__new__.__defaults__ = (False,)
+
+
+class _GangState(object):
+    """Per-(step, task_id) bookkeeping across attempts."""
+
+    __slots__ = ("first_launch_ts", "running_s", "launched_ts", "resizes",
+                 "consecutive_preemptions", "current_size", "pending_grow",
+                 "last_grow_poll", "grow_notified_ts", "had_elastic_event")
+
+    def __init__(self):
+        self.first_launch_ts = None
+        self.running_s = 0.0
+        self.launched_ts = None
+        self.resizes = 0
+        self.consecutive_preemptions = 0
+        self.current_size = None
+        self.pending_grow = None
+        self.last_grow_poll = 0.0
+        self.grow_notified_ts = None
+        self.had_elastic_event = False
+
+
+class ElasticGangSupervisor(object):
+    def __init__(self, flow, graph, metadata, echo=None, recorder=None,
+                 oracle=None, backoff=None, resize_enabled=None):
+        self._flow = flow
+        self._graph = graph
+        self._metadata = metadata
+        self._echo = echo or (lambda line: print(line, flush=True))
+        self._recorder = recorder
+        if oracle is None:
+            try:
+                oracle = oracle_from_env()
+            except ValueError as ex:
+                # a malformed oracle spec must not kill every run at
+                # scheduler construction — degrade to capacity-unknown
+                # (adaptive policy) and say so loudly
+                self._echo(
+                    "WARNING: ignoring invalid TPUFLOW_CAPACITY_ORACLE "
+                    "(%s); elastic supervisor falls back to the adaptive "
+                    "capacity-unknown policy." % ex)
+                oracle = None
+        self._oracle = oracle
+        self._backoff = backoff or BackoffPolicy.from_env()
+        if resize_enabled is None:
+            resize_enabled = os.environ.get("TPUFLOW_ELASTIC_RESIZE",
+                                            "1") == "1"
+        self._resize_enabled = resize_enabled
+        # extra attempts granted to capacity-classified failures, beyond
+        # the user @retry budget (MAX_ATTEMPTS still caps everything)
+        self._elastic_retries = env_int("TPUFLOW_ELASTIC_RETRIES", 8)
+        # adaptive (oracle-less) policy knobs
+        self._shrink_after = env_int("TPUFLOW_ELASTIC_SHRINK_AFTER", 2)
+        self._grow_every_s = env_float("TPUFLOW_ELASTIC_GROW_EVERY_S", 5.0)
+        self.run_id = None  # set by the runtime once the run id exists
+        self._state = {}
+        self._facts = None  # lazy analysis facts for mesh validation
+
+    # ------------------------------------------------------------------
+    # bookkeeping hooks (called by the runtime)
+    # ------------------------------------------------------------------
+
+    def _key(self, task):
+        return (task.step, task.task_id)
+
+    def _gang(self, task):
+        return self._state.setdefault(self._key(task), _GangState())
+
+    def note_launch(self, task):
+        g = self._gang(task)
+        now = time.time()
+        if g.first_launch_ts is None:
+            g.first_launch_ts = now
+        g.launched_ts = now
+        g.current_size = task.elastic_size or task.num_parallel or None
+        # the grow clock starts at relaunch: a shrunk gang gets a full
+        # TPUFLOW_ELASTIC_GROW_EVERY_S head start to resume and make
+        # progress before the first grow probe can interrupt it
+        g.last_grow_poll = now
+        g.grow_notified_ts = None
+
+    def note_finished(self, task, ok):
+        """Called for every reaped attempt; on final success emits the
+        goodput gauge for tasks that went through an elastic event."""
+        g = self._state.get(self._key(task))
+        if g is None:
+            return
+        if g.launched_ts is not None:
+            g.running_s += time.time() - g.launched_ts
+            g.launched_ts = None
+        if ok and g.had_elastic_event and self._recorder is not None:
+            total = max(time.time() - g.first_launch_ts, 1e-9)
+            self._recorder.gauge(
+                "elastic.goodput", round(g.running_s / total, 4),
+                data={"pathspec": self._pathspec(task),
+                      "running_s": round(g.running_s, 3),
+                      "total_s": round(total, 3),
+                      "attempts": task.attempt + 1,
+                      "resizes": g.resizes})
+
+    def _pathspec(self, task):
+        return "/".join((str(self.run_id), task.step, task.task_id))
+
+    # ------------------------------------------------------------------
+    # failure classification
+    # ------------------------------------------------------------------
+
+    def _task_metadata(self, step, task_id):
+        try:
+            return self._metadata.get_task_metadata(
+                self._flow.name, self.run_id, step, task_id) or []
+        except Exception:
+            return []
+
+    @staticmethod
+    def _notice_fields(records, attempt):
+        """(spot, grow) notice flags recorded at `attempt` in one task's
+        metadata record list."""
+        tag = "attempt_id:%d" % attempt
+        spot = grow = False
+        for m in records:
+            if tag not in (m.get("tags") or []):
+                continue
+            if m.get("field_name") == "preempted":
+                spot = True
+            elif m.get("field_name") == "resize":
+                grow = True
+        return spot, grow
+
+    @staticmethod
+    def _gang_members(control_task_id, control_records):
+        """All task ids of the gang (control first), from the membership
+        metadata the control task registers BEFORE the step body runs —
+        readable even when the attempt failed and persisted nothing."""
+        members = [control_task_id]
+        for m in control_records:
+            if m.get("field_name") == "control-mapper-tasks":
+                try:
+                    members = [p.split("/")[-1]
+                               for p in json.loads(m.get("value") or "[]")]
+                except (ValueError, TypeError):
+                    pass
+        if control_task_id not in members:
+            members.insert(0, control_task_id)
+        return members
+
+    def classify(self, task):
+        """Failure class of the just-failed attempt, from the notice
+        markers and attempt verdicts recorded in task metadata. Each
+        task's metadata is fetched exactly once (locally a JSON read,
+        remotely a service round-trip per task)."""
+        control_records = self._task_metadata(task.step, task.task_id)
+        if task.ubf_context == UBF_CONTROL:
+            members = self._gang_members(task.task_id, control_records)
+        else:
+            members = [task.task_id]
+        spot = grow = attempt_recorded = False
+        tag = "attempt_id:%d" % task.attempt
+        for member in members:
+            records = (control_records if member == task.task_id
+                       else self._task_metadata(task.step, member))
+            s, g = self._notice_fields(records, task.attempt)
+            spot = spot or s
+            grow = grow or g
+        for m in control_records:
+            if (m.get("field_name") == "attempt_ok"
+                    and tag in (m.get("tags") or [])):
+                attempt_recorded = True
+        return classify_failure(spot_notice=spot, grow_notice=grow,
+                                attempt_recorded=attempt_recorded)
+
+    # ------------------------------------------------------------------
+    # size selection + pre-relaunch validation
+    # ------------------------------------------------------------------
+
+    def _tpu_topology(self, step_name):
+        node = self._graph[step_name]
+        for deco in node.decorators or []:
+            if getattr(deco, "name", None) == "tpu":
+                topo = (getattr(deco, "attributes", None) or {}).get(
+                    "topology")
+                if topo:
+                    return str(topo)
+        return None
+
+    def admissible_sizes(self, step_name, requested):
+        """Candidate gang sizes, largest first.
+
+        @tpu steps: host counts of same-family, same-chips topologies
+        (a v5p-64 gang can shrink to v5p-32/-16/-8 — never to a v5e
+        shape). Local gangs: divisors of the requested size, so a
+        data-parallel global batch still divides evenly."""
+        requested = int(requested)
+        topo = self._tpu_topology(step_name)
+        if topo is not None and topo in TPU_TOPOLOGY_SELECTORS:
+            family = topo.rsplit("-", 1)[0]
+            _, _, _, chips = TPU_TOPOLOGY_SELECTORS[topo]
+            sizes = sorted(
+                {hosts for name, (_, _, hosts, c)
+                 in TPU_TOPOLOGY_SELECTORS.items()
+                 if name.rsplit("-", 1)[0] == family and c == chips
+                 and hosts <= requested},
+                reverse=True)
+            return sizes or [requested]
+        return [d for d in range(requested, 0, -1) if requested % d == 0]
+
+    def topology_for_size(self, step_name, size):
+        """The same-family topology whose host count is `size` (for the
+        relaunch env override), or None for non-@tpu gangs."""
+        topo = self._tpu_topology(step_name)
+        if topo is None or topo not in TPU_TOPOLOGY_SELECTORS:
+            return None
+        family = topo.rsplit("-", 1)[0]
+        _, _, _, chips = TPU_TOPOLOGY_SELECTORS[topo]
+        for name, (_, _, hosts, c) in sorted(
+                TPU_TOPOLOGY_SELECTORS.items()):
+            if (name.rsplit("-", 1)[0] == family and c == chips
+                    and hosts == size):
+                return name
+        return None
+
+    def _flow_facts(self):
+        if self._facts is None:
+            try:
+                from ..analysis.extractor import extract_flow_facts
+
+                self._facts = extract_flow_facts(
+                    self._flow.__class__, self._graph)
+            except Exception:
+                self._facts = {}
+        return self._facts
+
+    def validate_size(self, step_name, size):
+        """SPMD pre-flight for a candidate size: the same checks the
+        static analyzer runs at submit time, re-run against the RESIZED
+        world before any rank is forked. Returns (ok, problems)."""
+        problems = []
+        size = int(size)
+        if size < 1:
+            return False, ["gang size must be >= 1"]
+        topo = self._tpu_topology(step_name)
+        n_devices = None
+        if topo is not None:
+            new_topo = self.topology_for_size(step_name, size)
+            if new_topo is None:
+                return False, [
+                    "no %s topology with %d host(s) in the topology table"
+                    % (topo.rsplit("-", 1)[0], size)]
+            _, _, hosts, chips = TPU_TOPOLOGY_SELECTORS[new_topo]
+            n_devices = hosts * chips
+        facts = self._flow_facts()
+        f = facts.get(step_name)
+        if f is not None and n_devices is not None:
+            from ..analysis.spmd_check import (
+                _resolve_mesh_axes,
+                check_mesh_devices,
+            )
+
+            for ml in getattr(f, "mesh_literals", []) or []:
+                if getattr(ml, "in_hybrid", False):
+                    continue
+                axes = _resolve_mesh_axes(ml)
+                if axes is None:
+                    continue
+                problems.extend(check_mesh_devices(axes, n_devices))
+        return not problems, problems
+
+    def pick_size(self, task, capacity):
+        """Largest admissible, validated size <= capacity (None when even
+        size 1 is inadmissible or capacity is 0)."""
+        requested = int(task.num_parallel)
+        for size in self.admissible_sizes(task.step, requested):
+            if capacity is not None and size > capacity:
+                continue
+            ok, _problems = self.validate_size(task.step, size)
+            if ok:
+                return size
+        return None
+
+    # ------------------------------------------------------------------
+    # the retry decision
+    # ------------------------------------------------------------------
+
+    def plan_retry(self, task, returncode, max_attempts):
+        """Decide what happens after a failed attempt. `max_attempts` is
+        the datastore's hard attempt ceiling (MAX_ATTEMPTS)."""
+        fclass = self.classify(task)
+        g = self._gang(task)
+        user_budget = task.user_retries + task.error_retries
+        key = self._pathspec(task)
+        is_gang = task.ubf_context == UBF_CONTROL and task.num_parallel > 0
+
+        pending_grow = g.pending_grow
+        g.pending_grow = None  # one relaunch per delivered grow notice
+        if is_gang and pending_grow and fclass != CLASS_GROW:
+            # a grow notice was in flight and the gang then failed in some
+            # other shape — the SIGTERM landed before the handler was
+            # installed (INFRA: raw -TERM death), or the TaskPreempted
+            # raise got mangled by the frame it interrupted (e.g. an
+            # in-flight import re-raises it as ImportError → USER). The
+            # exit is still OURS: relaunch at the validated grow size. A
+            # real coinciding user error will reproduce and fail-fast on
+            # the next attempt.
+            fclass = CLASS_GROW
+
+        if fclass in (CLASS_PREEMPTION, CLASS_GROW):
+            g.consecutive_preemptions += (1 if fclass == CLASS_PREEMPTION
+                                          else 0)
+            budget = max(user_budget, self._elastic_retries)
+        else:
+            g.consecutive_preemptions = 0
+            budget = user_budget
+
+        if task.attempt >= min(budget, max_attempts - 1):
+            return Decision("fail", 0.0, None, fclass,
+                            "retry budget exhausted (%d attempts)"
+                            % (task.attempt + 1))
+
+        new_size = None
+        reason = fclass
+        if is_gang and pending_grow and fclass == CLASS_GROW:
+            # the gang exited at its checkpoint boundary because WE asked:
+            # relaunch at the size the grow poll validated
+            new_size = pending_grow
+            g.resizes += 1
+            g.had_elastic_event = True
+            reason = "grow to %d rank(s)" % new_size
+            self._emit_resize(task, g.current_size, new_size, "grow")
+        elif is_gang and fclass == CLASS_PREEMPTION:
+            g.had_elastic_event = True
+            current = int(task.elastic_size or task.num_parallel)
+            capacity = self._consult_oracle()
+            if capacity is not None:
+                # admission control applies whether or not resize is on:
+                # a gang cannot relaunch onto capacity that is not there.
+                # With resize on we pick the largest admissible size; with
+                # it off the ONLY admissible size is the current one.
+                if self._resize_enabled:
+                    picked = self.pick_size(task, capacity)
+                else:
+                    picked = current if capacity >= current else None
+                if picked is None:
+                    # nothing admissible right now: hold the attempt and
+                    # recheck at launch time (capacity-wait, not failure)
+                    delay = self._backoff.delay(task.attempt, key=key)
+                    self._emit_backoff(task, fclass, delay, waiting=True)
+                    return Decision("retry", delay, current, fclass,
+                                    "no admissible capacity (oracle=%s); "
+                                    "waiting" % self._describe_oracle(),
+                                    waiting=True)
+                if picked != current:
+                    new_size = picked
+                    g.resizes += 1
+                    reason = ("preempted; resizing %d -> %d rank(s)"
+                              % (current, picked))
+                    self._emit_resize(task, current, picked, "shrink"
+                                      if picked < current else "grow")
+            elif (self._resize_enabled
+                  and g.consecutive_preemptions >= self._shrink_after):
+                # capacity unknown: adaptive step-down one admissible size
+                sizes = self.admissible_sizes(task.step, task.num_parallel)
+                smaller = [s for s in sizes if s < current]
+                for s in smaller:
+                    ok, _ = self.validate_size(task.step, s)
+                    if ok:
+                        new_size = s
+                        g.resizes += 1
+                        g.consecutive_preemptions = 0
+                        reason = ("preempted %dx; stepping down %d -> %d "
+                                  "rank(s)" % (self._shrink_after, current,
+                                               s))
+                        self._emit_resize(task, current, s, "shrink")
+                        break
+
+        delay = (0.0 if fclass == CLASS_GROW
+                 else self._backoff.delay(task.attempt, key=key))
+        if fclass != CLASS_GROW:
+            self._emit_backoff(task, fclass, delay)
+        return Decision("retry", delay,
+                        new_size if new_size is not None
+                        else task.elastic_size,
+                        fclass, reason)
+
+    def recheck_capacity(self, task):
+        """Launch-time recheck for a capacity-waiting task: returns
+        (launch_now, delay_s). Keeps the attempt parked (no budget
+        consumed) until the oracle admits SOME size (fixed-size mode:
+        until it admits the CURRENT size)."""
+        capacity = self._consult_oracle()
+        if capacity is None:
+            return True, 0.0
+        current = int(task.elastic_size or task.num_parallel)
+        if self._resize_enabled:
+            picked = self.pick_size(task, capacity)
+        else:
+            picked = current if capacity >= current else None
+        if picked is None:
+            return False, self._backoff.delay(task.attempt,
+                                              key=self._pathspec(task))
+        if picked != current:
+            g = self._gang(task)
+            g.resizes += 1
+            g.had_elastic_event = True
+            self._emit_resize(task, current, picked,
+                              "shrink" if picked < current else "grow")
+            task.elastic_size = picked
+        return True, 0.0
+
+    # ------------------------------------------------------------------
+    # grow-back watch
+    # ------------------------------------------------------------------
+
+    def poll_grow(self, active_workers):
+        """Called from the scheduler poll loop: for every RUNNING gang
+        below its requested size, ask the oracle whether a larger
+        validated size is admissible; if so, deliver a grow notice so the
+        gang exits at its next checkpoint boundary and relaunches
+        larger."""
+        now = time.time()
+        for worker in list(active_workers.values()):
+            task = worker.task
+            if task.ubf_context != UBF_CONTROL or not task.num_parallel:
+                continue
+            current = int(task.elastic_size or task.num_parallel)
+            if current >= int(task.num_parallel):
+                continue
+            g = self._gang(task)
+            if g.pending_grow is not None:
+                # notice delivered — but an async raise can land in an
+                # unraisable frame (a GC callback) and be silently
+                # swallowed: while the gang is STILL running undersized,
+                # re-deliver periodically (idempotent: a dying process
+                # ignores it, a reaped pid raises ProcessLookupError)
+                renag = max(2.0 * self._grow_every_s, 1.0)
+                if (g.grow_notified_ts is not None
+                        and now - g.grow_notified_ts >= renag):
+                    self._deliver_grow(task, g, worker, current,
+                                       g.pending_grow, renotify=True)
+                continue
+            if now - g.last_grow_poll < self._grow_every_s:
+                continue
+            g.last_grow_poll = now
+            capacity = self._consult_oracle()
+            if capacity is None or capacity <= current:
+                continue
+            picked = self.pick_size(task, capacity)
+            if picked is None or picked <= current:
+                continue
+            g.pending_grow = picked
+            g.had_elastic_event = True
+            self._deliver_grow(task, g, worker, current, picked)
+
+    def _deliver_grow(self, task, g, worker, current, picked,
+                      renotify=False):
+        from ..plugins.tpu.preemption import notify_resize
+
+        try:
+            notify_resize(worker.proc.pid)
+        except ProcessLookupError:
+            if not renotify:
+                g.pending_grow = None
+            return
+        g.grow_notified_ts = time.time()
+        if not renotify:
+            self._echo(
+                "Capacity returned (oracle=%s): asked gang %s to grow "
+                "%d -> %d rank(s) at its next checkpoint boundary."
+                % (self._describe_oracle(), self._pathspec(task),
+                   current, picked))
+
+    # ------------------------------------------------------------------
+    # telemetry + misc
+    # ------------------------------------------------------------------
+
+    def _consult_oracle(self):
+        if self._oracle is None:
+            return None
+        try:
+            return self._oracle.available_hosts()
+        except Exception:
+            return None
+
+    def _describe_oracle(self):
+        return self._oracle.describe() if self._oracle else "none"
+
+    def _emit_resize(self, task, from_size, to_size, direction):
+        self._echo(
+            "Elastic resize (%s): gang %s %s -> %s rank(s)."
+            % (direction, self._pathspec(task), from_size, to_size))
+        if self._recorder is not None:
+            self._recorder.event(
+                "elastic.resize",
+                data={"pathspec": self._pathspec(task),
+                      "from_size": int(from_size or 0),
+                      "to_size": int(to_size),
+                      "direction": direction,
+                      "attempt": task.attempt,
+                      "oracle": self._describe_oracle()})
+
+    def _emit_backoff(self, task, fclass, delay, waiting=False):
+        if self._recorder is not None:
+            self._recorder.event(
+                "elastic.backoff",
+                data={"pathspec": self._pathspec(task),
+                      "failure_class": fclass,
+                      "attempt": task.attempt,
+                      "delay_s": round(float(delay), 3),
+                      "waiting_for_capacity": bool(waiting)})

@@ -25,6 +25,21 @@ from ..metadata.metadata import MetaDatum
 from ..unbounded_foreach import UBF_CONTROL, UBF_TASK
 
 
+def _elastic_gang_size(num_parallel):
+    """Clamp the gang fan-out to the elastic supervisor's per-attempt
+    size override (TPUFLOW_ELASTIC_SIZE, set by the scheduler when a
+    preempted gang is relaunched at a different size). The override can
+    only SHRINK below the flow-requested size — a stale env var from an
+    earlier, larger attempt must never over-fork the gang."""
+    override = os.environ.get("TPUFLOW_ELASTIC_SIZE")
+    if not override:
+        return num_parallel
+    try:
+        return max(1, min(int(num_parallel), int(override)))
+    except ValueError:
+        return num_parallel
+
+
 class ParallelDecorator(StepDecorator):
     name = "parallel"
     defaults = {}
@@ -111,7 +126,8 @@ class ParallelDecorator(StepDecorator):
         """Record _control_mapper_tasks for an externally-launched gang:
         worker task ids follow the same `{control}-node-{i}` naming the
         local fork path and every launcher use."""
-        num_nodes = int(os.environ.get("MF_PARALLEL_NUM_NODES", "1"))
+        num_nodes = _elastic_gang_size(
+            int(os.environ.get("MF_PARALLEL_NUM_NODES", "1")))
         control_task_id = str(self._task_id)
         mapper_task_ids = [control_task_id] + [
             "%s-node-%d" % (control_task_id, i)
@@ -146,6 +162,7 @@ class ParallelDecorator(StepDecorator):
         from ..cli import STEP_ARGV_ENV
 
         num_parallel = int(flow._foreach_num_splits or 1)
+        num_parallel = _elastic_gang_size(num_parallel)
         run_id = current.run_id
         step_name = current.step_name
         control_task_id = current.task_id

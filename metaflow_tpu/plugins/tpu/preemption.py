@@ -28,6 +28,7 @@ attempt share one root).
 """
 
 import contextlib
+import json
 import os
 import signal
 import sys
@@ -43,30 +44,80 @@ DEFAULT_METADATA_URL = (
 )
 POLL_SECS = 5.0
 
+# a notice marker older than this is STALE: the process it was meant for
+# died before handling SIGTERM and the PID was recycled — a later task
+# reusing the PID must read a routine teardown, not a spot notice
+MARKER_TTL_S = 900.0
+
 
 def _notice_marker(pid):
     return os.path.join(tempfile.gettempdir(), "tpuflow-preempted-%d" % pid)
 
 
-def notify_preemption(pid):
+def _read_marker(path):
+    """(ts, kind) of a notice marker; (None, None) when unreadable.
+    Accepts both the JSON form written today and the legacy bare-float
+    form, so an in-flight upgrade never misclassifies a live notice."""
+    try:
+        with open(path) as f:
+            body = f.read().strip()
+    except OSError:
+        return None, None
+    try:
+        obj = json.loads(body)
+        if isinstance(obj, dict):
+            return float(obj.get("ts", 0)), str(obj.get("kind", "spot"))
+        return float(obj), "spot"
+    except (ValueError, TypeError):
+        return None, None
+
+
+def notify_preemption(pid, kind="spot"):
     """Deliver a preemption notice to a task process: drop the marker file
     (distinguishes a real spot reclaim from a routine teardown SIGTERM, e.g.
     the gang control terminating workers after a rank-0 failure), then
-    SIGTERM it."""
-    with open(_notice_marker(pid), "w") as f:
-        f.write(str(time.time()))
-    os.kill(pid, signal.SIGTERM)
+    SIGTERM it. The marker is timestamped so a stale leftover (task died
+    before handling SIGTERM, PID recycled) is ignored by the next reader;
+    a notice raced against process exit is cleaned up here immediately —
+    a FRESH marker for a pid that is already gone would otherwise hand a
+    recycled pid a notice meant for its predecessor."""
+    marker = _notice_marker(pid)
+    with open(marker, "w") as f:
+        f.write(json.dumps({"ts": time.time(), "kind": kind}))
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        raise
+
+
+def notify_resize(pid):
+    """Deliver a GROW notice: the elastic supervisor asks the gang to exit
+    at its next checkpoint boundary so it can be relaunched at a larger
+    size. Same marker+SIGTERM mechanism as a spot notice — the handler
+    raises the same retryable TaskPreempted — but the marker kind lets the
+    task (and the supervisor's retry classification) tell the two apart."""
+    notify_preemption(pid, kind="grow")
 
 
 class PreemptionHandler(object):
     """In-task SIGTERM → TaskPreempted bridge. Exposed as
     `current.preemption`."""
 
-    def __init__(self):
+    def __init__(self, marker_ttl_s=None):
         self.requested = threading.Event()
-        # True when the SIGTERM was a real spot notice (monitor marker
-        # present) rather than a teardown kill
+        # True when the SIGTERM was a real spot notice (fresh monitor
+        # marker) rather than a teardown kill
         self.spot_notice = False
+        # True when the SIGTERM was the elastic supervisor's grow request
+        self.grow_notice = False
+        self._marker_ttl_s = (
+            marker_ttl_s if marker_ttl_s is not None
+            else float(os.environ.get("TPUFLOW_SPOT_MARKER_TTL_S",
+                                      str(MARKER_TTL_S))))
         self._shield_depth = 0
         self._pending_exc = None
         self._prev_handler = None
@@ -83,16 +134,43 @@ class PreemptionHandler(object):
         if self._installed:
             signal.signal(signal.SIGTERM, self._prev_handler or signal.SIG_DFL)
             self._installed = False
+        # never leave a marker behind for a recycled PID to misread: a
+        # notice that arrives between here and process exit is a routine
+        # teardown as far as the NEXT process with this PID is concerned
+        try:
+            os.unlink(_notice_marker(os.getpid()))
+        except OSError:
+            pass
+
+    def _consume_marker(self):
+        """Read-and-clear this PID's notice marker; returns its kind or
+        None when absent or STALE (written for an earlier process that
+        died before handling SIGTERM — PID reuse must not turn a routine
+        teardown into a spot notice)."""
+        marker = _notice_marker(os.getpid())
+        ts, kind = _read_marker(marker)
+        if ts is None:
+            return None
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        if time.time() - ts > self._marker_ttl_s:
+            return None  # stale leftover: cleaned up, not a notice
+        return kind
 
     def _on_sigterm(self, signum, frame):
         self.requested.set()
-        marker = _notice_marker(os.getpid())
-        if os.path.exists(marker):
+        kind = self._consume_marker()
+        if kind == "grow":
+            self.grow_notice = True
+            self.deliver(TaskPreempted(
+                "Elastic grow notice received: exiting at the checkpoint "
+                "boundary so the gang can relaunch at a larger size."
+            ))
+            return
+        if kind == "spot":
             self.spot_notice = True
-            try:
-                os.unlink(marker)
-            except OSError:
-                pass
         self.deliver(TaskPreempted(
             "Preemption notice received (SIGTERM): failing the attempt so "
             "retry can resume from the last checkpoint."

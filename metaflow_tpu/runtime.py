@@ -57,6 +57,9 @@ class _Task(object):
         "is_cloned",
         "origin_pathspec",
         "queued_ts",
+        "not_before",       # earliest launch time (retry backoff)
+        "elastic_size",     # gang size override for the next attempt
+        "awaiting_capacity",  # parked: recheck the capacity oracle at launch
     )
 
     def __init__(self, step, task_id, input_paths, split_index=None, ctx=(),
@@ -76,6 +79,9 @@ class _Task(object):
         self.is_cloned = False
         self.origin_pathspec = None
         self.queued_ts = None
+        self.not_before = 0.0
+        self.elastic_size = None
+        self.awaiting_capacity = False
 
 
 class CLIArgs(object):
@@ -285,6 +291,20 @@ class NativeRuntime(object):
                 attempt=0,
             )
 
+        # elastic gang supervision: classified retries (preemption /
+        # user / infra) with shared jittered backoff, capacity-oracle
+        # driven gang resize, and grow-back when capacity returns.
+        # TPUFLOW_ELASTIC=0 restores the legacy immediate-re-fork path.
+        self._elastic = None
+        if os.environ.get("TPUFLOW_ELASTIC", "1") == "1":
+            from .elastic import ElasticGangSupervisor
+
+            self._elastic = ElasticGangSupervisor(
+                flow, graph, metadata, echo=self._echo,
+                recorder=self._recorder,
+            )
+            self._elastic.run_id = self.run_id
+
         # resume support: index the origin run's finished tasks
         self._origin_index = {}
         self._cloned_pathspecs = set()
@@ -321,27 +341,53 @@ class NativeRuntime(object):
         hooks_ran = False
         try:
             while self._run_queue or self._active:
-                # launch as many queued tasks as the worker pool allows
-                while self._run_queue and len(self._active) < self._max_workers:
-                    task = self._run_queue.popleft()
+                # launch as many DUE queued tasks as the worker pool
+                # allows (retry backoff parks a task via not_before)
+                while len(self._active) < self._max_workers:
+                    task = self._pop_due_task()
+                    if task is None:
+                        break
                     if self._maybe_clone(task):
                         continue
+                    if task.awaiting_capacity and self._elastic is not None:
+                        launch_now, delay = (
+                            self._elastic.recheck_capacity(task))
+                        if not launch_now:
+                            # still no admissible capacity: stay parked
+                            # (no attempt consumed), recheck after delay
+                            task.not_before = time.time() + max(delay, 0.05)
+                            self._run_queue.append(task)
+                            continue
+                        task.awaiting_capacity = False
                     self._launch_worker(task, sel)
 
-                if not self._active:
-                    continue
+                # grow-back watch: gangs running below their requested
+                # size relaunch larger once the oracle admits it
+                if self._elastic is not None and self._active:
+                    self._elastic.poll_grow(self._active)
 
-                # poll worker pipes
-                for key, _mask in sel.select(timeout=0.2):
-                    worker, stream_name = key.data
-                    worker.read_stream(stream_name, key.fileobj)
-
+                # external-observer surfaces stay live whether tasks are
+                # running or the scheduler is waiting out a backoff /
+                # capacity window (a park can last a whole capacity hole,
+                # and the buffered backoff/park events are exactly what
+                # an operator would be looking for during one)
                 if time.time() - last_beat > 10:
                     self._metadata.heartbeat()
                     last_beat = time.time()
                     if self._recorder is not None:
                         self._recorder.flush()
                 self._persist_runstate()
+
+                if not self._active:
+                    # nothing running: sleep toward the earliest due task
+                    # instead of spinning
+                    self._sleep_until_due()
+                    continue
+
+                # poll worker pipes
+                for key, _mask in sel.select(timeout=0.2):
+                    worker, stream_name = key.data
+                    worker.read_stream(stream_name, key.fileobj)
 
                 # reap finished workers
                 for pid in list(self._active):
@@ -452,6 +498,24 @@ class NativeRuntime(object):
     def _pathspec(self, task):
         return "/".join((self.run_id, task.step, task.task_id))
 
+    def _pop_due_task(self):
+        """Next queued task whose backoff window has passed (FIFO among
+        due tasks); None when nothing is due."""
+        now = time.time()
+        for _ in range(len(self._run_queue)):
+            task = self._run_queue.popleft()
+            if (task.not_before or 0.0) <= now:
+                return task
+            self._run_queue.append(task)
+        return None
+
+    def _sleep_until_due(self):
+        if not self._run_queue:
+            return
+        now = time.time()
+        earliest = min((t.not_before or now) for t in self._run_queue)
+        time.sleep(min(max(earliest - now, 0.01), 0.2))
+
     def _persist_runstate(self, force=False, min_interval=2.0):
         """Atomically snapshot live scheduler state to
         <flow>/<run>/_runstate.json so an external observer can reconstruct
@@ -529,31 +593,61 @@ class NativeRuntime(object):
         except Exception:
             pass
 
+        if self._elastic is not None:
+            self._elastic.note_finished(task, ok=(returncode == 0))
+
         if returncode != 0:
-            max_retries = task.user_retries + task.error_retries
-            if task.attempt < min(max_retries, MAX_ATTEMPTS - 1):
+            if self._elastic is not None:
+                decision = self._elastic.plan_retry(
+                    task, returncode, MAX_ATTEMPTS)
+                retry = decision.action == "retry"
+            else:
+                # legacy path (TPUFLOW_ELASTIC=0): unclassified retries
+                # within the user budget, immediate re-fork
+                max_retries = task.user_retries + task.error_retries
+                retry = task.attempt < min(max_retries, MAX_ATTEMPTS - 1)
+                decision = None
+            if retry:
                 task.attempt += 1
-                self._echo(
-                    "Task %s failed (attempt %d); retrying."
-                    % (self._pathspec(task), task.attempt - 1)
-                )
+                if decision is not None:
+                    task.not_before = time.time() + decision.delay_s
+                    task.awaiting_capacity = decision.waiting
+                    if decision.new_size is not None:
+                        task.elastic_size = int(decision.new_size)
+                    self._echo(
+                        "Task %s failed (attempt %d, %s); retrying%s."
+                        % (self._pathspec(task), task.attempt - 1,
+                           decision.reason,
+                           " in %.1fs" % decision.delay_s
+                           if decision.delay_s >= 0.1 else "")
+                    )
+                else:
+                    self._echo(
+                        "Task %s failed (attempt %d); retrying."
+                        % (self._pathspec(task), task.attempt - 1)
+                    )
                 if self._recorder is not None:
-                    self._recorder.event(
-                        "sched.task_retry",
-                        data={"pathspec": self._pathspec(task),
-                              "failed_attempt": task.attempt - 1,
-                              "next_attempt": task.attempt,
-                              "returncode": returncode})
+                    data = {"pathspec": self._pathspec(task),
+                            "failed_attempt": task.attempt - 1,
+                            "next_attempt": task.attempt,
+                            "returncode": returncode}
+                    if decision is not None:
+                        data["failure_class"] = decision.failure_class
+                        data["delay_s"] = round(decision.delay_s, 3)
+                        if decision.new_size is not None:
+                            data["gang_size"] = int(decision.new_size)
+                    self._recorder.event("sched.task_retry", data=data)
                 task.queued_ts = time.time()
                 self._run_queue.append(task)
                 return
             self._echo("Task %s failed." % self._pathspec(task))
             if self._recorder is not None:
-                self._recorder.event(
-                    "sched.task_failed",
-                    data={"pathspec": self._pathspec(task),
-                          "attempt": task.attempt,
-                          "returncode": returncode})
+                data = {"pathspec": self._pathspec(task),
+                        "attempt": task.attempt,
+                        "returncode": returncode}
+                if decision is not None:
+                    data["failure_class"] = decision.failure_class
+                self._recorder.event("sched.task_failed", data=data)
             self._failed = True
             # fail fast: drain the queue, let active workers finish
             self._run_queue.clear()
@@ -698,17 +792,30 @@ class NativeRuntime(object):
         )
         if self._recorder is not None:
             queue_s = (time.time() - task.queued_ts) if task.queued_ts else 0
-            self._recorder.event(
-                "sched.task_launched",
-                data={"pathspec": self._pathspec(task),
-                      "attempt": task.attempt,
-                      "queue_seconds": round(queue_s, 3)})
+            data = {"pathspec": self._pathspec(task),
+                    "attempt": task.attempt,
+                    "queue_seconds": round(queue_s, 3)}
+            if task.elastic_size is not None:
+                data["gang_size"] = int(task.elastic_size)
+            self._recorder.event("sched.task_launched", data=data)
+        if self._elastic is not None:
+            self._elastic.note_launch(task)
         if self._can_fork(task):
             proc = self._fork_worker(task)
         else:
             args = self._build_cli_args(task)
             env = dict(os.environ)
             env.update(args.env)
+            if task.elastic_size is not None:
+                # resized gang: the parallel decorator clamps its fork
+                # fan-out (and MF_PARALLEL_NUM_NODES) to this; the data
+                # layer re-slices per-host reads off the same env
+                env["TPUFLOW_ELASTIC_SIZE"] = str(int(task.elastic_size))
+                if self._elastic is not None:
+                    topo = self._elastic.topology_for_size(
+                        task.step, int(task.elastic_size))
+                    if topo:
+                        env["TPUFLOW_ELASTIC_TOPOLOGY"] = topo
             if task.queued_ts:
                 # tasks compute scheduler-queue time from this stamp
                 env["TPUFLOW_QUEUE_TS"] = repr(task.queued_ts)

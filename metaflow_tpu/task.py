@@ -413,6 +413,19 @@ class MetaflowTask(object):
                     [MetaDatum("preempted", "true", "preemption",
                                ["attempt_id:%d" % retry_count])],
                 )
+            elif isinstance(ex, TaskPreempted) and preemption.grow_notice:
+                # the elastic supervisor asked the gang to exit so it can
+                # relaunch larger: the scheduler's retry classification
+                # reads this marker to pick the grow size immediately
+                # (no backoff, no budget consumed)
+                telemetry.event("task.preempted",
+                                data={"spot_notice": False,
+                                      "grow_notice": True})
+                self.metadata.register_metadata(
+                    run_id, step_name, task_id,
+                    [MetaDatum("resize", "grow", "preemption",
+                               ["attempt_id:%d" % retry_count])],
+                )
             for deco in decorators:
                 if deco.task_exception(
                     ex, step_name, flow, graph, retry_count, max_user_code_retries

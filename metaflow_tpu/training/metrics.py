@@ -321,8 +321,17 @@ def instrument_train_step(step_fn, tokens_per_step=None, flops_per_step=None,
         cost_analysis=cost_analysis, prefix=prefix,
         memory_every=memory_every, profile=profile)
 
+    # chaos harness tick (TPUFLOW_CHAOS): any instrumented train loop
+    # gets deterministic fault injection for free — the scheduled kill
+    # lands at a step boundary, before the step's compute is issued
+    chaos_on = bool(os.environ.get("TPUFLOW_CHAOS"))
+
     @functools.wraps(step_fn, assigned=("__name__", "__doc__"), updated=())
     def wrapped(*args, **kwargs):
+        if chaos_on:
+            from ..devtools.chaos import maybe_chaos_step
+
+            maybe_chaos_step(tel.step_num)
         started = tel.before_step()
         pre_cache = _cache_size(step_fn)
         out = step_fn(*args, **kwargs)

@@ -23,6 +23,23 @@ except Exception:  # non-Linux / restricted: hardening becomes a no-op
 _PR_SET_PDEATHSIG = 1
 
 
+def env_int(name, default):
+    """int(os.environ[name]) with the default on missing OR malformed
+    values — config knobs must degrade to their default, never crash the
+    scheduler/loader that reads them."""
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return int(default)
+
+
+def env_float(name, default):
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return float(default)
+
+
 def preexec_die_with_parent(expected_ppid=None, sig=9, setsid=False):
     """A Popen preexec_fn arming PR_SET_PDEATHSIG: the kernel signals the
     child the instant its parent dies — no matter how the parent died

@@ -61,4 +61,19 @@ done
 
 echo "analyze_all: ${checked} flow(s) checked, ${skipped} skipped, fail=${fail}"
 [ "$checked" -gt 0 ] || { echo "analyze_all: nothing checked" >&2; fail=1; }
+
+# coverage guard: the sweep's value is that EVERY shipped flow family
+# stays analyzer-clean — a glob/loader regression that silently drops a
+# family must fail here, not rot. These flows exercise the analyses with
+# the most ways to false-positive (gang divergence, elastic resize
+# patterns, determinism of the exact-resume contract).
+if [ "$#" -eq 0 ]; then
+    for required in preempt_gang_flow.py elastic_train_flow.py \
+                    sanitize_gang_flow.py data_resume_flow.py; do
+        if [ ! -f "$ROOT/tests/flows/$required" ]; then
+            echo "analyze_all: required flow missing from sweep: $required" >&2
+            fail=1
+        fi
+    done
+fi
 exit $fail

@@ -597,6 +597,78 @@ def validate_sanitize_report(report):
 
 
 # ---------------------------------------------------------------------------
+# Elastic gang supervision (metaflow_tpu/elastic/) + chaos harness
+# (metaflow_tpu/devtools/chaos.py): the pinned event surface for resize /
+# backoff decisions and the goodput gauge the scheduler emits when an
+# elastic run completes. Dashboards pricing preemptible capacity key on
+# these fields — they must not drift silently.
+# ---------------------------------------------------------------------------
+
+ELASTIC_EVENT_DATA_SCHEMAS = {
+    "elastic.resize": _obj(
+        {"pathspec": _STR, "from_size": _INT, "to_size": _INT,
+         "direction": {"enum": ["shrink", "grow"]},
+         "attempt": _INT, "oracle": _STR},
+        required=("pathspec", "from_size", "to_size", "direction",
+                  "attempt"),
+    ),
+    "elastic.backoff": _obj(
+        {"pathspec": _STR,
+         "failure_class": {"enum": ["preemption", "grow", "user",
+                                    "infra"]},
+         "attempt": _INT, "delay_s": _NUM,
+         "waiting_for_capacity": _BOOL},
+        required=("pathspec", "failure_class", "attempt", "delay_s"),
+    ),
+    "chaos.kill": _obj(
+        {"step": _INT, "rank": _INT, "world": _INT},
+        required=("step", "rank", "world"),
+    ),
+}
+
+# the goodput gauge: value = running seconds / total wall seconds of the
+# gang step across all attempts, backoff and relaunch overhead included
+ELASTIC_METRIC_NAMES = {
+    "elastic.goodput": "gauge",
+}
+
+ELASTIC_GOODPUT_DATA_SCHEMA = _obj(
+    {"pathspec": _STR, "running_s": _NUM, "total_s": _NUM,
+     "attempts": _INT, "resizes": _INT},
+    required=("pathspec", "running_s", "total_s", "attempts", "resizes"),
+)
+
+
+def validate_elastic_record(record):
+    """Validate one elastic.*/chaos.* flight-recorder record: base v1
+    record shape, a pinned name, and the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name in ELASTIC_EVENT_DATA_SCHEMAS:
+        if record.get("type") != "event":
+            raise jsonschema.ValidationError(
+                "%s must be an event record, got %r"
+                % (name, record.get("type")))
+        jsonschema.validate(record.get("data", {}),
+                            ELASTIC_EVENT_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+    elif name in ELASTIC_METRIC_NAMES:
+        if record.get("type") != ELASTIC_METRIC_NAMES[name]:
+            raise jsonschema.ValidationError(
+                "%s must be a %s record, got %r"
+                % (name, ELASTIC_METRIC_NAMES[name], record.get("type")))
+        if name == "elastic.goodput":
+            jsonschema.validate(record.get("data", {}),
+                                ELASTIC_GOODPUT_DATA_SCHEMA,
+                                cls=jsonschema.Draft202012Validator)
+    else:
+        raise jsonschema.ValidationError(
+            "unknown elastic record name %r (pinned: %s)"
+            % (name, sorted(ELASTIC_EVENT_DATA_SCHEMAS)
+               + sorted(ELASTIC_METRIC_NAMES)))
+
+
+# ---------------------------------------------------------------------------
 # `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
 # v1 surface for the static analyzer. additionalProperties: false — a field
 # the analyzer invents fails validation, protecting editor/CI consumers of

@@ -68,6 +68,80 @@ class TestPreemptionHandler:
         finally:
             handler.uninstall()
 
+    def test_nested_shield_exit_does_not_raise_early(self):
+        # the INNER shield exiting must not release the deferred raise:
+        # only the outermost exit may (e.g. a checkpoint save nested in a
+        # larger critical section)
+        handler = PreemptionHandler().install()
+        inner_done = outer_done = False
+        try:
+            with pytest.raises(TaskPreempted):
+                with handler.shield():
+                    with handler.shield():
+                        os.kill(os.getpid(), signal.SIGTERM)
+                        time.sleep(0.05)
+                    inner_done = True  # survived the inner __exit__
+                    outer_done = True
+            assert inner_done and outer_done
+        finally:
+            handler.uninstall()
+
+    def test_exception_during_shield_wins_over_pending_preemption(self):
+        # the body is already unwinding with a REAL error when the shield
+        # exits: the pending preemption must not mask it (the real error
+        # is what the operator needs to see); `requested` stays set for
+        # callers that want to know a notice also arrived
+        handler = PreemptionHandler().install()
+        try:
+            with pytest.raises(ValueError, match="real failure"):
+                with handler.shield():
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    time.sleep(0.05)
+                    raise ValueError("real failure")
+            assert handler.requested.is_set()
+        finally:
+            handler.uninstall()
+
+    def test_notice_between_uninstall_and_exit(self):
+        # a notice landing after uninstall() must behave like a plain
+        # SIGTERM for THIS process (previous disposition restored) and
+        # must not leave a marker behind for a recycled PID: the
+        # subprocess dies by SIGTERM without raising TaskPreempted, and
+        # its marker file is gone (uninstall cleans up what it can; the
+        # freshness TTL covers the rest)
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        script = r"""
+import os, signal, sys, time
+from metaflow_tpu.plugins.tpu.preemption import (
+    PreemptionHandler, notify_preemption, _notice_marker)
+handler = PreemptionHandler().install()
+handler.uninstall()
+# simulate the monitor racing process exit: notice arrives AFTER
+# uninstall — SIGTERM takes the default disposition (process death)
+print("MARKER=%s" % _notice_marker(os.getpid()), flush=True)
+notify_preemption(os.getpid())
+time.sleep(5)
+print("SURVIVED", flush=True)  # must never be reached
+"""
+        proc = subprocess.run(
+            [_sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=30,
+            env=dict(os.environ, PYTHONPATH=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        assert proc.returncode == -signal.SIGTERM, (proc.returncode,
+                                                    proc.stdout)
+        assert "SURVIVED" not in proc.stdout
+        marker = proc.stdout.strip().split("MARKER=")[-1].splitlines()[0]
+        # the marker the late notice dropped is still on disk (the dead
+        # process could not clean it) — but it is timestamped, so a
+        # recycled PID reads it as stale after the TTL; remove it here
+        # to keep the shared tempdir clean for other tests
+        if os.path.exists(marker):
+            os.unlink(marker)
+
 
 class _FakeMetadata(http.server.BaseHTTPRequestHandler):
     preempted = "FALSE"
