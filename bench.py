@@ -1091,6 +1091,176 @@ def bench_elastic_goodput():
     }
 
 
+def _fleet_replica_env(here):
+    """CPU-pinned env for fleet replica subprocesses: like every other
+    subprocess bench, replicas must never touch the axon TPU tunnel."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [here] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p and "axon_site" not in p])
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TPUFLOW_TELEMETRY", "0")
+    return env
+
+
+def bench_fleet_goodput():
+    """Fleet-router metrics, CPU by design (subprocess replicas on a
+    device-emulation step delay — sleep in the replica's step loop models
+    a device-bound decode the way the elastic bench models train steps;
+    processes don't contend for the one host core while sleeping, so
+    replica scaling is honest even on a 1-core box).
+
+    Two gates off the SAME synthetic-weight replica binary:
+      * scaling: 1 -> 2 replica useful tok/s ratio (floor: >= 1.8x) on
+        a saturating closed-loop trace — the router's dispatch overhead
+        and least-loaded policy must not eat the second replica.
+      * goodput under chaos (the headline): a seeded mid-trace replica
+        kill (FleetChaosInjector through the REAL process-death path),
+        failover+restart ON vs OFF (floor: >= 1.5x). With failover the
+        victim's in-flight requests re-dispatch to the survivor
+        token-identically and the supervisor restarts the corpse; with
+        both disabled the same kill strands those requests (502) and
+        halves capacity for the rest of the trace."""
+    import contextlib
+    import http.client
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from metaflow_tpu.devtools import chaos
+    from metaflow_tpu.elastic.policy import BackoffPolicy
+    from metaflow_tpu.serving import (FleetConfig, ServingFleet,
+                                      SubprocessReplicaSpawner)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    synth = {"vocab_size": 256, "dim": 64, "n_layers": 1, "n_heads": 4,
+             "n_kv_heads": 2, "ffn_dim": 128, "max_seq_len": 128,
+             "rope_llama3_scaling": False, "dtype": "float32"}
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", "4"))
+    step_delay_ms = float(os.environ.get("BENCH_FLEET_STEP_DELAY_MS", "30"))
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "128"))
+    max_new = 24
+    kill_dispatch = max(2, n_requests // 5)  # ~20% into the trace
+    env = _fleet_replica_env(here)
+    # shared persistent jit cache across every boot in this bench: the
+    # first fleet pays the compiles once, so a mid-trace RESTART costs
+    # ~2s instead of ~5 — the goodput comparison then measures the
+    # supervisor's recovery policy, not XLA compile time
+    cache_root = tempfile.mkdtemp(prefix="bench-fleet-jit-")
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_root
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    replica_args = [
+        "--synthetic-config", json.dumps(synth), "--synthetic-seed", "7",
+        "--slots", str(slots), "--max-seq-len", "96",
+        "--prefill-chunk", "16", "--max-queue", str(2 * n_requests),
+        "--step-delay-ms", str(step_delay_ms),
+    ]
+
+    def ask(port, i):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        try:
+            conn.request(
+                "POST", "/v1/generate",
+                json.dumps({"tokens": [1 + (i % 40), 2, 3, 4, 5, 6, 7, 8],
+                            "max_new_tokens": max_new, "seed": i}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                return 0
+            return len(json.loads(body)["new_tokens"])
+        except (OSError, ValueError):
+            return 0
+        finally:
+            conn.close()
+
+    def run_trace(n_replicas, failover, restart, kill=False):
+        """Boot a fresh fleet, push the closed-loop trace through it
+        with a saturating client pool (2x every replica's slots, so
+        each replica always has a backlog), return (tok/s, completed,
+        wall)."""
+        with contextlib.ExitStack() as stack:
+            tmp = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="bench-fleet-"))
+            injector = None
+            if kill:
+                injector = chaos.FleetChaosInjector(
+                    chaos.KillSchedule.parse("%d:0" % kill_dispatch),
+                    os.path.join(tmp, "ledger"))
+            config = FleetConfig(
+                failover=failover, restart=restart,
+                spawn_timeout_s=600.0, wait_s=60.0,
+                backoff=BackoffPolicy(base_s=0.2, cap_s=0.5, jitter=0.0,
+                                      seed=0))
+            fleet = ServingFleet(
+                SubprocessReplicaSpawner(replica_args, workdir=tmp,
+                                         env=env, spawn_timeout_s=600.0),
+                n_replicas, config=config, chaos=injector)
+            fleet.start()
+            stack.callback(fleet.close)
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(
+                    max_workers=2 * n_replicas * slots) as pool:
+                tokens = sum(pool.map(
+                    lambda i: ask(fleet.port, i), range(n_requests)))
+            wall = time.perf_counter() - t0
+            return tokens / wall, tokens, wall
+
+    one_tps, one_tok, _ = run_trace(1, failover=True, restart=True)
+    assert one_tok == n_requests * max_new, (one_tok, "1-replica drop")
+    two_tps, two_tok, _ = run_trace(2, failover=True, restart=True)
+    assert two_tok == n_requests * max_new, (two_tok, "2-replica drop")
+    scaling = two_tps / one_tps
+
+    ft_tps, ft_tok, ft_wall = run_trace(
+        2, failover=True, restart=True, kill=True)
+    assert ft_tok == n_requests * max_new, (
+        ft_tok, "failover must complete every request across the kill")
+    nf_tps, nf_tok, nf_wall = run_trace(
+        2, failover=False, restart=False, kill=True)
+    assert nf_tok < n_requests * max_new, (
+        nf_tok, "the kill must strand work when failover is off")
+    goodput_ratio = ft_tps / nf_tps
+
+    return {
+        "metric": "fleet_goodput_ratio",
+        "value": round(goodput_ratio, 2),
+        "unit": "x (failover+restart vs disabled, same seeded replica "
+                "kill)",
+        "vs_baseline": _vs_baseline(goodput_ratio),
+        "extra": {
+            "replicas": 2,
+            "slots_per_replica": slots,
+            "requests": n_requests,
+            "max_new_tokens": max_new,
+            "useful_tokens": n_requests * max_new,
+            "step_delay_ms": step_delay_ms,
+            "kill_dispatch": kill_dispatch,
+            "scaling_1_to_2_replicas": round(scaling, 2),
+            "one_replica_tokens_per_s": round(one_tps, 1),
+            "two_replica_tokens_per_s": round(two_tps, 1),
+            "failover_tokens_per_s": round(ft_tps, 1),
+            "failover_completed_tokens": ft_tok,
+            "no_failover_tokens_per_s": round(nf_tps, 1),
+            "no_failover_completed_tokens": nf_tok,
+            "failover_wall_s": round(ft_wall, 2),
+            "no_failover_wall_s": round(nf_wall, 2),
+            "gate_scaling": 1.8,
+            "gate_goodput": 1.5,
+        },
+        "submetrics": [
+            {"metric": "fleet_scaling_1_to_2", "value": round(scaling, 2),
+             "unit": "x useful tok/s, 2 replicas vs 1 (same trace)"},
+            {"metric": "fleet_failover_tokens_per_s",
+             "value": round(ft_tps, 1),
+             "unit": "useful tok/s under seeded kill (failover on)"},
+            {"metric": "fleet_no_failover_tokens_per_s",
+             "value": round(nf_tps, 1),
+             "unit": "useful tok/s under seeded kill (failover off)"},
+        ],
+    }
+
+
 def bench_telemetry_overhead():
     """Instrumented-vs-disabled train-step overhead of the flight
     recorder (training.metrics.instrument_train_step emitting per-step
@@ -1417,6 +1587,15 @@ if __name__ == "__main__":
         # scheduler-policy metric: subprocess flows on a CPU mesh by
         # design — no chip involved, never a degraded fallback
         result = bench_elastic_goodput()
+    elif mode == "fleet":
+        # router-policy metric: subprocess replicas on the CPU
+        # device-emulation delay by design — pin this process too so
+        # importing the serving package never touches the axon tunnel
+        if (os.environ.get("JAX_PLATFORMS") != "cpu"
+                or any("axon_site" in p for p in
+                       os.environ.get("PYTHONPATH", "").split(os.pathsep))):
+            _rerun_on_cpu(degraded=False)
+        result = bench_fleet_goodput()
     elif mode == "persist":
         # artifact persist pipeline + async checkpoint overlap: pure
         # host/IO metrics, no chip needed

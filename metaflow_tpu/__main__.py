@@ -432,6 +432,13 @@ def metrics(flow_run, run_id, datastore, datastore_root, as_json,
               help="Model family of the checkpoint.")
 @click.option("--host", default="127.0.0.1")
 @click.option("--port", default=8000, type=int)
+@click.option("--replicas", default=1, type=int,
+              help="Engine replica processes behind the failover "
+                   "router (1 = single-process serving). The fleet "
+                   "health-checks replicas, re-dispatches a dead "
+                   "replica's in-flight requests token-identically, "
+                   "and restarts it with backoff "
+                   "(docs/serving.md#fleet).")
 @click.option("--slots", default=8, type=int,
               help="Concurrent sequences (KV-cache pool size).")
 @click.option("--max-seq-len", default=None, type=int,
@@ -446,8 +453,8 @@ def metrics(flow_run, run_id, datastore, datastore_root, as_json,
 @click.option("--attn-impl", default="auto",
               type=click.Choice(["auto", "dense", "chunked"]))
 def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
-          model, host, port, slots, max_seq_len, prefill_chunk, max_queue,
-          mesh_spec, attn_impl):
+          model, host, port, replicas, slots, max_seq_len, prefill_chunk,
+          max_queue, mesh_spec, attn_impl):
     from .cmd.serve import serve as serve_impl
     from .exception import TpuFlowException
 
@@ -455,7 +462,8 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
         serve_impl(flow_run, run_id=run_id, step_name=step_name,
                    ckpt_step=ckpt_step, params_key=params_key,
                    config_json=config_json, model=model, host=host,
-                   port=port, slots=slots, max_seq_len=max_seq_len,
+                   port=port, replicas=replicas, slots=slots,
+                   max_seq_len=max_seq_len,
                    prefill_chunk=prefill_chunk, max_queue=max_queue,
                    mesh_spec=mesh_spec, attn_impl=attn_impl,
                    echo=click.echo)

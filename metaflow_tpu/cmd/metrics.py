@@ -36,6 +36,13 @@ def aggregate(records, profiles=None):
     ranks = set()
     hosts = set()
     traces = set()
+    # fleet.* serving events (serving/fleet.py router telemetry)
+    fleet_dispatch = {}
+    fleet_shed = {}
+    fleet_restarts = []
+    fleet_failovers = 0
+    fleet_deaths = 0
+    fleet_chaos_kills = 0
 
     for rec in records:
         name = rec.get("name", "")
@@ -103,6 +110,29 @@ def aggregate(records, profiles=None):
                         rec.get("value"))
         elif rtype == "event":
             events[name] = events.get(name, 0) + 1
+            if name.startswith(("fleet.", "chaos.replica_kill")):
+                data = rec.get("data") or {}
+                if name == "fleet.request.dispatch":
+                    r = data.get("replica")
+                    if r is not None:
+                        fleet_dispatch[int(r)] = \
+                            fleet_dispatch.get(int(r), 0) + 1
+                elif name == "fleet.request.failover":
+                    fleet_failovers += 1
+                elif name == "fleet.request.shed":
+                    reason = str(data.get("reason", "unknown"))
+                    fleet_shed[reason] = fleet_shed.get(reason, 0) + 1
+                elif name == "fleet.replica.restart":
+                    fleet_restarts.append({
+                        "ts": rec.get("ts"),
+                        "replica": data.get("replica"),
+                        "attempt": data.get("attempt"),
+                        "delay_s": data.get("delay_s"),
+                    })
+                elif name == "fleet.replica.dead":
+                    fleet_deaths += 1
+                elif name == "chaos.replica_kill":
+                    fleet_chaos_kills += 1
 
     # finalize timer stats
     for t in timers.values():
@@ -176,6 +206,22 @@ def aggregate(records, profiles=None):
             elif key_name == "compiles":
                 train["compiles_total"] = int(sum(vals))
 
+    fleet = {}
+    if (fleet_dispatch or fleet_failovers or fleet_shed
+            or fleet_restarts or fleet_deaths or fleet_chaos_kills):
+        fleet_restarts.sort(key=lambda r: (r["ts"] is None, r["ts"]))
+        fleet = {
+            "requests_per_replica": {
+                str(k): fleet_dispatch[k] for k in sorted(fleet_dispatch)},
+            "dispatched": sum(fleet_dispatch.values()),
+            "failovers": fleet_failovers,
+            "shed": dict(sorted(fleet_shed.items())),
+            "shed_total": sum(fleet_shed.values()),
+            "replica_deaths": fleet_deaths,
+            "chaos_kills": fleet_chaos_kills,
+            "restarts": fleet_restarts,
+        }
+
     task_rows = sorted(
         tasks.values(),
         key=lambda t: (t["step"], str(t["task_id"])))
@@ -189,6 +235,7 @@ def aggregate(records, profiles=None):
         "counters": dict(sorted(counters.items())),
         "events": dict(sorted(events.items())),
         "train": train,
+        "fleet": fleet,
         "timeline": timeline,
         "profiles": list(profiles or []),
     }
@@ -281,6 +328,30 @@ def render_summary(run_id, agg, echo=print):
                           % (train["device_memory_peak_bytes_max"] / 2**20))
         if extras:
             echo("  " + ", ".join(extras))
+    fleet = agg.get("fleet") or {}
+    if fleet:
+        echo("")
+        echo("fleet (serving router):")
+        per = fleet.get("requests_per_replica") or {}
+        dist = ", ".join("replica%s=%d" % (r, per[r]) for r in sorted(
+            per, key=int)) or "-"
+        echo("  %d request(s) dispatched  [%s]"
+             % (fleet.get("dispatched", 0), dist))
+        line = ("  failovers %d, shed %d, replica deaths %d"
+                % (fleet.get("failovers", 0), fleet.get("shed_total", 0),
+                   fleet.get("replica_deaths", 0)))
+        if fleet.get("chaos_kills"):
+            line += ", chaos kills %d" % fleet["chaos_kills"]
+        echo(line)
+        if fleet.get("shed"):
+            echo("  shed by reason: " + ", ".join(
+                "%s=%d" % (k, v) for k, v in fleet["shed"].items()))
+        if fleet.get("restarts"):
+            echo("  restart backoff timeline:")
+            for r in fleet["restarts"]:
+                echo("    replica %s attempt %s: wait %ss"
+                     % (r.get("replica"), r.get("attempt"),
+                        r.get("delay_s")))
     if agg["counters"]:
         echo("")
         echo("counters:")

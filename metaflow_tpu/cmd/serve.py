@@ -103,9 +103,11 @@ def build_engine(params, cfg, slots=8, max_seq_len=None, prefill_chunk=64,
                       mesh=mesh, attn_impl=attn_impl)
 
 
-def _init_serve_telemetry(flow_name, run_id):
+def _init_serve_telemetry(flow_name, run_id, task_prefix="server"):
     """Record serving telemetry into the served run's datastore under a
-    synthetic `_serve` step, riding the existing FlightRecorder."""
+    synthetic `_serve` step, riding the existing FlightRecorder. The
+    fleet router records as task `fleet-<pid>` next to the replicas'
+    `replica<i>-<pid>` tasks."""
     from .. import telemetry
     from .. import metaflow_config as cfg
     from ..datastore import STORAGE_BACKENDS, FlowDataStore
@@ -115,41 +117,117 @@ def _init_serve_telemetry(flow_name, run_id):
     try:
         storage = STORAGE_BACKENDS[cfg.default_datastore()]
         fds = FlowDataStore(flow_name, storage)
-        return telemetry.init_recorder(fds, run_id, "_serve",
-                                       "server-%d" % os.getpid())
+        return telemetry.init_recorder(
+            fds, run_id, "_serve",
+            "%s-%d" % (task_prefix, os.getpid()))
     except Exception:
         return None  # serving must come up even if telemetry cannot
 
 
-def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
-          params_key="params", config_json=None, model="llama",
-          host="127.0.0.1", port=8000, slots=8, max_seq_len=None,
-          prefill_chunk=64, max_queue=64, mesh_spec=None,
-          attn_impl="auto", echo=print, block=True):
-    """Load FLOW/RUN's checkpoint and serve it. Returns the running
-    ServingServer when block=False (tests); otherwise serves until
-    SIGTERM/SIGINT, draining in-flight requests before exit."""
-    from .. import telemetry
-    from ..inference import load_run_checkpoint
-    from ..serving import Scheduler, ServingServer
-
+def _resolve_flow_run(flow_run, run_id):
+    """FLOW/RUN (or FLOW + --run-id) -> (flow_name, run_id), falling
+    back to the latest successful run so telemetry lands under the real
+    run id."""
     if run_id is None:
         flow_name, _, run_id = flow_run.rpartition("/")
         if not flow_name:
             flow_name, run_id = flow_run, None
     else:
         flow_name = flow_run
-
     if run_id is None:
-        # resolve the run HERE (not only inside load_run_checkpoint) so
-        # telemetry lands under the real run id, next to its training
-        # records — never under a synthetic label
         from ..inference.loading import _latest_successful_run_id
 
         run_id = _latest_successful_run_id(flow_name, None)
         if run_id is None:
             raise TpuFlowException(
                 "No successful run of %s to serve." % flow_name)
+    return flow_name, run_id
+
+
+def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
+                params_key="params", config_json=None, model="llama",
+                host="127.0.0.1", port=8000, replicas=2, slots=8,
+                max_seq_len=None, prefill_chunk=64, max_queue=64,
+                mesh_spec=None, attn_impl="auto", echo=print,
+                block=True):
+    """`tpuflow serve FLOW/RUN --replicas N`: fork N replica workers
+    (each loading the run's checkpoint through load_run_checkpoint) and
+    front them with the health-checked failover router
+    (serving/fleet.py). Returns the running ServingFleet when
+    block=False (tests); otherwise serves until SIGTERM/SIGINT, draining
+    the whole fleet before exit."""
+    from .. import telemetry
+    from ..devtools import chaos as chaos_mod
+    from ..serving import FleetConfig, ServingFleet, \
+        SubprocessReplicaSpawner
+
+    flow_name, run_id = _resolve_flow_run(flow_run, run_id)
+    replica_args = [
+        "--flow", flow_name, "--run-id", str(run_id),
+        "--params-key", params_key, "--model", model,
+        "--slots", str(slots), "--prefill-chunk", str(prefill_chunk),
+        "--max-queue", str(max_queue), "--attn-impl", attn_impl,
+    ]
+    if step_name:
+        replica_args += ["--step-name", step_name]
+    if ckpt_step is not None:
+        replica_args += ["--ckpt-step", str(ckpt_step)]
+    if config_json:
+        replica_args += ["--config-json", config_json]
+    if max_seq_len is not None:
+        replica_args += ["--max-seq-len", str(max_seq_len)]
+    if mesh_spec:
+        replica_args += ["--mesh", mesh_spec]
+    config = FleetConfig.from_env()
+    spawner = SubprocessReplicaSpawner(
+        replica_args, spawn_timeout_s=config.spawn_timeout_s)
+    _init_serve_telemetry(flow_name, run_id, task_prefix="fleet")
+    fleet = ServingFleet(
+        spawner, replicas, config=config, host=host, port=port,
+        chaos=chaos_mod.fleet_from_env(replicas), echo=echo)
+    fleet.start()
+    echo("fleet: serving %s/%s on http://%s:%d (%d replicas x %d "
+         "slots)" % (flow_name, run_id, fleet.host, fleet.port,
+                     replicas, slots))
+    echo("  POST /v1/generate  {\"tokens\": [...], \"max_new_tokens\":"
+         " N, \"stream\": true, \"session\": \"...\"}")
+    if not block:
+        return fleet
+    try:
+        fleet.serve_forever()
+    finally:
+        telemetry.close_recorder()
+    echo("fleet drained — all replicas stopped")
+
+
+def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
+          params_key="params", config_json=None, model="llama",
+          host="127.0.0.1", port=8000, replicas=1, slots=8,
+          max_seq_len=None, prefill_chunk=64, max_queue=64,
+          mesh_spec=None, attn_impl="auto", echo=print, block=True):
+    """Load FLOW/RUN's checkpoint and serve it. Returns the running
+    ServingServer when block=False (tests); otherwise serves until
+    SIGTERM/SIGINT, draining in-flight requests before exit. With
+    --replicas N > 1 the work moves to the fleet tier (serve_fleet):
+    N forked replica workers behind the failover router."""
+    from .. import telemetry
+    from ..inference import load_run_checkpoint
+    from ..serving import Scheduler, ServingServer
+
+    if int(replicas) > 1:
+        return serve_fleet(
+            flow_run, run_id=run_id, step_name=step_name,
+            ckpt_step=ckpt_step, params_key=params_key,
+            config_json=config_json, model=model, host=host, port=port,
+            replicas=int(replicas), slots=slots,
+            max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+            max_queue=max_queue, mesh_spec=mesh_spec,
+            attn_impl=attn_impl, echo=echo, block=block)
+
+    # resolve the run HERE (not only inside load_run_checkpoint) so
+    # telemetry lands under the real run id, next to its training
+    # records — never under a synthetic label
+    flow_name, run_id = _resolve_flow_run(flow_run, run_id)
     restored = load_run_checkpoint(flow_name, run_id=run_id,
                                    step_name=step_name,
                                    ckpt_step=ckpt_step)

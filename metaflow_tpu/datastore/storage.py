@@ -8,7 +8,51 @@ in GCP); S3-style paths are not ported (SURVEY.md §7 stage 2).
 
 import os
 import shutil
+import sys
+import time
 from tempfile import NamedTemporaryFile
+
+
+def _storage_retry(fn, what, policy=None, attempts=None):
+    """Run an idempotent storage network op with bounded, jittered
+    retries over TRANSIENT failures (gsop's GSTransientError — i.e. its
+    own in-client retry budget already ran dry — plus raw connection
+    resets/timeouts). One flaky 503 must not fail a whole task when the
+    checkpoint it carries took an hour to compute.
+
+    Backoff rides the shared elastic.policy.BackoffPolicy
+    (TPUFLOW_RETRY_BACKOFF_*), so a seeded policy replays the exact
+    retry timeline under test. TPUFLOW_STORAGE_RETRIES bounds the extra
+    attempts (default 3); the final failure re-raises LOUDLY after a
+    stderr warning — never swallowed. GSNotFound is semantics, not
+    weather, and passes straight through."""
+    from ..elastic.policy import BackoffPolicy
+    from ..gsop import GSTransientError
+
+    if attempts is None:
+        try:
+            attempts = int(os.environ.get("TPUFLOW_STORAGE_RETRIES", "3"))
+        except ValueError:
+            attempts = 3
+    attempts = max(0, int(attempts))
+    if policy is None:
+        policy = BackoffPolicy.from_env()
+    for attempt in range(attempts + 1):
+        try:
+            return fn()
+        except (GSTransientError, ConnectionError, TimeoutError) as ex:
+            if attempt >= attempts:
+                sys.stderr.write(
+                    "storage: %s failed after %d retries: %s\n"
+                    % (what, attempts, ex))
+                sys.stderr.flush()
+                raise
+            delay = policy.delay(attempt, key=what)
+            sys.stderr.write(
+                "storage: transient failure in %s (%s); retry %d/%d "
+                "in %.2fs\n" % (what, ex, attempt + 1, attempts, delay))
+            sys.stderr.flush()
+            time.sleep(delay)
 
 
 class CloseAfterUse(object):
@@ -278,6 +322,9 @@ class GCSStorage(DataStoreStorage):
         # workers): honor whichever signal is bigger
         effective_batch = max(len(items), len_hint)
         allow_compose = effective_batch < self.COMPOSE_OFF_BATCH
+        from ..elastic.policy import BackoffPolicy
+
+        retry_policy = BackoffPolicy.from_env()
 
         def upload(item):
             path, payload = item
@@ -286,7 +333,9 @@ class GCSStorage(DataStoreStorage):
             else:
                 byte_obj = payload
             key = self._key(path)
-            if not overwrite and self.client.exists(self._bucket_name, key):
+            if not overwrite and _storage_retry(
+                    lambda: self.client.exists(self._bucket_name, key),
+                    "exists(%s)" % path, policy=retry_policy):
                 if hasattr(byte_obj, "close"):
                     byte_obj.close()
                 return
@@ -297,7 +346,12 @@ class GCSStorage(DataStoreStorage):
                     # materializing multi-GB blobs
                     name = getattr(byte_obj, "name", None)
                     if isinstance(name, str) and os.path.isfile(name):
-                        self.client.put_file(self._bucket_name, key, name)
+                        # pread-based upload is idempotent: safe to
+                        # retry the whole PUT on a transient failure
+                        _storage_retry(
+                            lambda: self.client.put_file(
+                                self._bucket_name, key, name),
+                            "put_file(%s)" % path, policy=retry_policy)
                         return
                     # unnamed reader (e.g. the CAS's tagged file stream):
                     # spool through a temp file at bounded memory, then
@@ -317,8 +371,12 @@ class GCSStorage(DataStoreStorage):
                         with tmp:
                             shutil.copyfileobj(byte_obj, tmp,
                                                length=1 << 20)
-                        self.client.put_file(self._bucket_name, key,
-                                             tmp.name)
+                        # the spool is single-shot but the PUT from it
+                        # is idempotent — retry only the network op
+                        _storage_retry(
+                            lambda: self.client.put_file(
+                                self._bucket_name, key, tmp.name),
+                            "put_file(%s)" % path, policy=retry_policy)
                     finally:
                         os.unlink(tmp.name)
                     return
@@ -329,8 +387,11 @@ class GCSStorage(DataStoreStorage):
                 len(byte_obj)
                 > self.client.ranged_threshold * self.COMPOSE_BIG_MULT
             )
-            self.client.put_bytes(self._bucket_name, key, byte_obj,
-                                  allow_compose=compose_ok)
+            _storage_retry(
+                lambda: self.client.put_bytes(self._bucket_name, key,
+                                              byte_obj,
+                                              allow_compose=compose_ok),
+                "put_bytes(%s)" % path, policy=retry_policy)
 
         with ThreadPoolExecutor(max_workers=min(32, len(items))) as ex:
             list(ex.map(upload, items))
@@ -339,9 +400,11 @@ class GCSStorage(DataStoreStorage):
         import tempfile
         from concurrent.futures import ThreadPoolExecutor
 
+        from ..elastic.policy import BackoffPolicy
         from ..gsop import GSNotFound
 
         tmpdir = tempfile.mkdtemp(prefix="tpuflow_gs_")
+        retry_policy = BackoffPolicy.from_env()
 
         def download(idx_path):
             idx, path = idx_path
@@ -349,9 +412,13 @@ class GCSStorage(DataStoreStorage):
             # collide in the shared tmpdir ('a/b_c' vs 'a_b/c')
             local = os.path.join(tmpdir, str(idx))
             try:
-                # ranged parallel fetch kicks in automatically for big blobs
-                self.client.get_file(self._bucket_name, self._key(path),
-                                     local)
+                # ranged parallel fetch kicks in automatically for big
+                # blobs; GSNotFound passes through the transient-retry
+                # wrapper untouched (absence is an answer, not a flake)
+                _storage_retry(
+                    lambda: self.client.get_file(
+                        self._bucket_name, self._key(path), local),
+                    "get_file(%s)" % path, policy=retry_policy)
                 return path, local, None
             except GSNotFound:
                 return path, None, None

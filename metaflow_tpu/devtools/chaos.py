@@ -41,6 +41,13 @@ STEPS_ENV = "TPUFLOW_CHAOS_STEPS"
 NKILLS_ENV = "TPUFLOW_CHAOS_NKILLS"
 DIR_ENV = "TPUFLOW_CHAOS_DIR"
 
+# serving-fleet variant: kills are indexed by DISPATCH COUNT (the
+# router's monotonically increasing request-dispatch counter), not train
+# step, and the victim coordinate is a replica index, not a gang rank
+FLEET_ENV = "TPUFLOW_CHAOS_FLEET"
+FLEET_DISPATCHES_ENV = "TPUFLOW_CHAOS_FLEET_DISPATCHES"
+FLEET_NKILLS_ENV = "TPUFLOW_CHAOS_FLEET_NKILLS"
+
 
 class KillSchedule(object):
     """An immutable set of (step, rank) kill events."""
@@ -177,6 +184,89 @@ def from_env(rank=None, world=None, env=None):
         return None
     ledger = env.get(DIR_ENV) or _default_ledger_dir()
     return ChaosInjector(schedule, rank, world, ledger)
+
+
+class FleetChaosInjector(object):
+    """Replica-kill dispatcher for the serving fleet: the router ticks
+    `on_dispatch(n, n_replicas)` every time it forwards a request; a
+    scheduled (dispatch, replica) event names the victim ONCE (O_EXCL
+    ledger, same arbitration as the gang injector). Delivery is the
+    caller's job — serving/fleet.py SIGKILLs the replica process, so the
+    failure rides the real process-death path (monitor reap, relay-
+    thread failover, BackoffPolicy restart), nothing mocked.
+
+    The schedule reuses KillSchedule: "step" is the dispatch ordinal,
+    "rank" is the replica index.
+
+        TPUFLOW_CHAOS_FLEET=<seed>          seeded schedule
+        TPUFLOW_CHAOS_FLEET=5:1             kill replica 1 on the 5th
+                                            dispatch
+        TPUFLOW_CHAOS_FLEET_DISPATCHES=N    seeded horizon (default 8)
+        TPUFLOW_CHAOS_FLEET_NKILLS=K        kills drawn (default 1)
+    """
+
+    def __init__(self, schedule, ledger_dir):
+        self.schedule = schedule
+        self.ledger_dir = ledger_dir
+        self._by_dispatch = {}
+        for dispatch, replica in schedule:
+            self._by_dispatch.setdefault(int(dispatch), []).append(
+                int(replica))
+
+    def _claim(self, dispatch, replica):
+        os.makedirs(self.ledger_dir, exist_ok=True)
+        path = os.path.join(
+            self.ledger_dir,
+            "fleetkill-%d-%d" % (int(dispatch), int(replica)))
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def on_dispatch(self, dispatch, n_replicas):
+        """The replica index to kill at this dispatch ordinal, or None.
+        Out-of-range victims (schedule written for a bigger fleet) wrap
+        into the live replica set."""
+        victims = self._by_dispatch.get(int(dispatch))
+        if not victims:
+            return None
+        for replica in victims:
+            replica = replica % max(1, int(n_replicas))
+            if not self._claim(dispatch, replica):
+                continue
+            telemetry.event(
+                "chaos.replica_kill",
+                data={"dispatch": int(dispatch), "replica": replica,
+                      "replicas": int(n_replicas)})
+            return replica
+        return None
+
+
+def fleet_schedule_from_env(n_replicas, env=None):
+    """The configured fleet KillSchedule, or None when fleet chaos is
+    off."""
+    env = env if env is not None else os.environ
+    spec = (env.get(FLEET_ENV) or "").strip()
+    if not spec:
+        return None
+    if ":" in spec:
+        return KillSchedule.parse(spec)
+    horizon = int(env.get(FLEET_DISPATCHES_ENV, "8"))
+    n_kills = int(env.get(FLEET_NKILLS_ENV, "1"))
+    return KillSchedule.seeded(int(spec), horizon, n_replicas, n_kills)
+
+
+def fleet_from_env(n_replicas, env=None):
+    """Build the router's FleetChaosInjector from the environment, or
+    None when TPUFLOW_CHAOS_FLEET is unset."""
+    env = env if env is not None else os.environ
+    schedule = fleet_schedule_from_env(n_replicas, env=env)
+    if schedule is None:
+        return None
+    ledger = env.get(DIR_ENV) or _default_ledger_dir()
+    return FleetChaosInjector(schedule, ledger)
 
 
 _injector_cache = {}

@@ -1,7 +1,14 @@
 """Continuous-batching serving engine: slot-based KV cache, request
-scheduler, HTTP API. See docs/serving.md."""
+scheduler, HTTP API, and the fault-tolerant replica fleet. See
+docs/serving.md."""
 
 from .engine import SlotEngine, request_step_keys, sample_slots
+from .fleet import (
+    FleetConfig,
+    ReplicaHandle,
+    ServingFleet,
+    SubprocessReplicaSpawner,
+)
 from .scheduler import (
     DrainingError,
     QueueFullError,
@@ -19,4 +26,8 @@ __all__ = [
     "QueueFullError",
     "DrainingError",
     "ServingServer",
+    "ServingFleet",
+    "FleetConfig",
+    "ReplicaHandle",
+    "SubprocessReplicaSpawner",
 ]

@@ -1,0 +1,896 @@
+"""Fault-tolerant serving fleet: replica supervisor + health-checked
+router with failover re-dispatch and load shedding.
+
+PR 4's single SlotEngine process is one SIGKILL away from an outage.
+This tier gives serving the contract the elastic trainer already has
+(elastic/supervisor.py): a replica kill costs a retry, not the endpoint.
+
+Topology — one router process fronting N replica workers:
+
+    client ──► FleetRouter (HTTP, this process)
+                  │ least-loaded + session-affine dispatch
+                  ├──► replica 0  (serving/replica.py subprocess)
+                  ├──► replica 1
+                  │      ▲ health: /healthz poll + proc liveness
+                  └── FleetSupervisor: restart dead replicas with
+                      elastic.policy.BackoffPolicy delays
+
+Failover correctness rides the engine's determinism: a request's token
+stream is a pure function of (prompt, sampling knobs, seed) via
+`request_step_keys`, so when a replica dies mid-request the router
+re-issues the SAME request to a survivor and gets the SAME tokens —
+already-streamed prefixes are skipped, the client sees one seamless
+stream. Requests the dead replica had finished streaming are NOT
+re-issued (at-most-once for completed work; re-dispatch until complete
+for in-flight work — docs/serving.md#fleet spells out the guarantee).
+
+Load shedding keeps the fleet stable under overload: a bounded fleet
+in-flight budget (429 before any replica sees the request), expired
+deadlines are rejected before prefill (429), and a draining fleet 503s
+new work while in-flight requests finish (SIGTERM drains the router,
+then SIGTERMs each replica, which drain their own schedulers).
+
+Env knobs (all optional, read by FleetConfig.from_env):
+
+    TPUFLOW_FLEET_MAX_INFLIGHT      fleet-wide in-flight bound
+                                    (default 4x total slots)
+    TPUFLOW_FLEET_FAILOVER=0        disable re-dispatch (bench baseline)
+    TPUFLOW_FLEET_RESTART=0         disable replica restart
+    TPUFLOW_FLEET_MAX_RESTARTS      per-replica restart budget (def 16)
+    TPUFLOW_FLEET_HEALTH_INTERVAL_S health poll period (default 1.0)
+    TPUFLOW_FLEET_HEALTH_FAILS      consecutive probe failures that
+                                    declare a replica dead (default 3)
+    TPUFLOW_FLEET_SPAWN_TIMEOUT_S   replica boot budget (default 180)
+    TPUFLOW_FLEET_REDISPATCH_MAX    failovers per request (default 3)
+    TPUFLOW_FLEET_WAIT_S            max wait for a ready replica before
+                                    503 (default 15)
+
+Restart delays come from the shared elastic.policy.BackoffPolicy
+(TPUFLOW_RETRY_BACKOFF_*), so a seeded chaos run replays the exact
+restart timeline. Telemetry: the fleet.* event set is pinned in
+tests/schema_validate.py::FLEET_EVENT_DATA_SCHEMAS.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import telemetry
+from ..elastic.policy import BackoffPolicy
+
+
+def _env_num(env, name, default, cast=float):
+    try:
+        return cast(env.get(name, default))
+    except (TypeError, ValueError):
+        return cast(default)
+
+
+class FleetConfig(object):
+    """Router/supervisor knobs; see the module docstring for the env
+    contract."""
+
+    def __init__(self, max_inflight=None, failover=True, restart=True,
+                 max_restarts=16, health_interval_s=1.0, health_fails=3,
+                 spawn_timeout_s=180.0, redispatch_max=3, wait_s=15.0,
+                 backoff=None):
+        self.max_inflight = max_inflight  # None: 4x total slots at start
+        self.failover = bool(failover)
+        self.restart = bool(restart)
+        self.max_restarts = int(max_restarts)
+        self.health_interval_s = float(health_interval_s)
+        self.health_fails = int(health_fails)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.redispatch_max = int(redispatch_max)
+        self.wait_s = float(wait_s)
+        self.backoff = backoff or BackoffPolicy.from_env()
+
+    @classmethod
+    def from_env(cls, env=None):
+        env = env if env is not None else os.environ
+        max_inflight = env.get("TPUFLOW_FLEET_MAX_INFLIGHT")
+        try:
+            max_inflight = int(max_inflight) if max_inflight else None
+        except ValueError:
+            max_inflight = None
+        return cls(
+            max_inflight=max_inflight,
+            failover=env.get("TPUFLOW_FLEET_FAILOVER", "1") != "0",
+            restart=env.get("TPUFLOW_FLEET_RESTART", "1") != "0",
+            max_restarts=_env_num(env, "TPUFLOW_FLEET_MAX_RESTARTS",
+                                  16, int),
+            health_interval_s=_env_num(
+                env, "TPUFLOW_FLEET_HEALTH_INTERVAL_S", 1.0),
+            health_fails=_env_num(env, "TPUFLOW_FLEET_HEALTH_FAILS",
+                                  3, int),
+            spawn_timeout_s=_env_num(env, "TPUFLOW_FLEET_SPAWN_TIMEOUT_S",
+                                     180.0),
+            redispatch_max=_env_num(env, "TPUFLOW_FLEET_REDISPATCH_MAX",
+                                    3, int),
+            wait_s=_env_num(env, "TPUFLOW_FLEET_WAIT_S", 15.0),
+        )
+
+
+class ReplicaHandle(object):
+    """Router-side view of one replica worker."""
+
+    def __init__(self, index):
+        self.index = index
+        self.proc = None        # Popen-like: poll/terminate/kill/wait
+        self.host = None
+        self.port = None
+        self.state = "starting"  # starting|ready|backoff|dead|stopped
+        self.generation = 0      # bumps on every (re)spawn
+        self.restarts = 0        # restart attempts consumed
+        self.inflight = 0        # router-dispatched, not yet returned
+        self.dispatched = 0
+        self.health_fails = 0
+        self.last_stats = {}
+        self.restart_at = None   # backoff deadline (monotonic)
+        self.t_spawn = None
+
+    @property
+    def pid(self):
+        return getattr(self.proc, "pid", None)
+
+    def describe(self):
+        return {
+            "index": self.index, "state": self.state, "pid": self.pid,
+            "port": self.port, "inflight": self.inflight,
+            "dispatched": self.dispatched, "restarts": self.restarts,
+            "generation": self.generation,
+            "queue_depth": self.last_stats.get("queue_depth"),
+            "occupancy": self.last_stats.get("occupancy"),
+        }
+
+
+class SubprocessReplicaSpawner(object):
+    """Default spawner: fork `python -m metaflow_tpu.serving.replica`
+    and wait for its port-file (the ready protocol)."""
+
+    def __init__(self, replica_args, workdir=None, env=None,
+                 spawn_timeout_s=180.0):
+        self.replica_args = list(replica_args)  # sans --port-file/--index
+        self.workdir = workdir or tempfile.mkdtemp(prefix="tpuflow-fleet-")
+        self.env = env
+        self.spawn_timeout_s = float(spawn_timeout_s)
+
+    def __call__(self, index, generation):
+        port_file = os.path.join(
+            self.workdir, "replica-%d-gen%d.port" % (index, generation))
+        log_path = os.path.join(
+            self.workdir, "replica-%d-gen%d.log" % (index, generation))
+        argv = [sys.executable, "-m", "metaflow_tpu.serving.replica",
+                "--port-file", port_file,
+                "--replica-index", str(index)] + self.replica_args
+        log = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, stdout=log, stderr=subprocess.STDOUT,
+                env=self.env, start_new_session=True)
+        finally:
+            log.close()
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            if os.path.exists(port_file):
+                try:
+                    with open(port_file) as f:
+                        info = json.load(f)
+                    return proc, info["host"], int(info["port"])
+                except (ValueError, KeyError, OSError):
+                    pass  # partially visible write; retry
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "replica %d exited rc=%s during boot (log: %s)"
+                    % (index, proc.returncode, log_path))
+            time.sleep(0.05)
+        proc.kill()
+        raise RuntimeError("replica %d did not come up in %.0fs (log: %s)"
+                           % (index, self.spawn_timeout_s, log_path))
+
+
+class _ReplicaBackendError(Exception):
+    """The replica connection died or answered garbage mid-request —
+    the trigger for failover re-dispatch. Carries the streaming progress
+    the relay had made so the re-issue can skip what the client already
+    has."""
+
+    def __init__(self, delivered=0, started=False):
+        super(_ReplicaBackendError, self).__init__("replica backend lost")
+        self.delivered = delivered
+        self.started = started
+
+
+class _ReplicaBusyError(Exception):
+    """The replica shed the request (429/503) — try a sibling."""
+
+    def __init__(self, code, body):
+        super(_ReplicaBusyError, self).__init__("replica returned %d"
+                                                % code)
+        self.code = code
+        self.body = body
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpuflow-fleet/1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def fleet(self):
+        return self.server.fleet
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode("utf-8")
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # client gave up (health probes with short timeouts do this
+            # routinely while replicas boot) — nothing to answer
+            self.close_connection = True
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._json(200, self.fleet.healthz())
+            return
+        if self.path == "/v1/stats":
+            self._json(200, self.fleet.stats())
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            self._json(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, TypeError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        self.fleet.handle_generate(self, payload)
+
+    def _chunk(self, data):
+        self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+
+class ServingFleet(object):
+    """N replicas + the router + the supervisor, one object.
+
+    `spawner(index, generation) -> (proc, host, port)` must block until
+    the replica's HTTP listener is up; the supervisor then health-checks
+    /healthz before marking it ready. The default production spawner is
+    SubprocessReplicaSpawner; tests inject in-process fakes.
+    """
+
+    def __init__(self, spawner, n_replicas, config=None, host="127.0.0.1",
+                 port=0, chaos=None, echo=None):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.spawner = spawner
+        self.config = config or FleetConfig.from_env()
+        self.chaos = chaos
+        self.echo = echo or (lambda *_a, **_k: None)
+        self.handles = [ReplicaHandle(i) for i in range(n_replicas)]
+        self._lock = threading.Lock()
+        self._sessions = {}      # session id -> ReplicaHandle
+        self._draining = False
+        self._stopped = False
+        self._done = threading.Event()
+        # fleet counters (under _lock)
+        self.dispatch_count = 0
+        self.failover_count = 0
+        self.shed_count = 0
+        self.restart_count = 0
+        self.completed = 0
+        self._httpd = ThreadingHTTPServer((host, port), _FleetHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.fleet = self
+        self._threads = []
+
+    # ---------- lifecycle ----------
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def start(self):
+        """Spawn every replica (concurrently — boot cost is import +
+        warmup), then start the monitor/health/HTTP threads."""
+        boot_errors = []
+
+        def _boot(h):
+            try:
+                self._spawn(h)
+            except Exception as ex:
+                boot_errors.append((h.index, ex))
+                h.state = "dead"
+
+        boots = [threading.Thread(target=_boot, args=(h,), daemon=True)
+                 for h in self.handles]
+        for t in boots:
+            t.start()
+        for t in boots:
+            t.join()
+        if not any(h.state == "ready" for h in self.handles):
+            raise RuntimeError("no replica came up: %s"
+                               % "; ".join("replica %d: %s" % (i, e)
+                                           for i, e in boot_errors))
+        for i, ex in boot_errors:
+            self.echo("fleet: replica %d failed to boot (%s); the "
+                      "supervisor will retry" % (i, ex))
+            self._schedule_restart(self.handles[i])
+        if self.config.max_inflight is None:
+            slots = sum(h.last_stats.get("slots") or 8
+                        for h in self.handles if h.state == "ready")
+            self.config.max_inflight = max(8, 4 * slots)
+        for name, target in (("fleet-monitor", self._monitor_loop),
+                             ("fleet-health", self._health_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="fleet-http", daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _spawn(self, h):
+        h.generation += 1
+        h.state = "starting"
+        h.t_spawn = time.monotonic()
+        telemetry.event("fleet.replica.spawn", data={
+            "replica": h.index, "generation": h.generation,
+            "restarts": h.restarts})
+        proc, host, port = self.spawner(h.index, h.generation)
+        h.proc, h.host, h.port = proc, host, port
+        # the listener is up; confirm the scheduler answers before
+        # taking traffic
+        deadline = time.monotonic() + self.config.spawn_timeout_s
+        while time.monotonic() < deadline:
+            stats = self._probe(h)
+            if stats is not None and stats.get("ok"):
+                h.last_stats = stats
+                h.health_fails = 0
+                h.state = "ready"
+                telemetry.event("fleet.replica.ready", data={
+                    "replica": h.index, "pid": h.pid or 0,
+                    "port": h.port,
+                    "spawn_ms": round(
+                        (time.monotonic() - h.t_spawn) * 1000, 3)})
+                self._gauge_ready()
+                self.echo("fleet: replica %d ready on %s:%d (pid %s)"
+                          % (h.index, h.host, h.port, h.pid))
+                return
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        raise RuntimeError("replica %d never answered /healthz" % h.index)
+
+    def _probe(self, h):
+        try:
+            conn = http.client.HTTPConnection(h.host, h.port, timeout=5)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return None
+                return json.loads(resp.read().decode("utf-8"))
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return None
+
+    def _gauge_ready(self):
+        telemetry.gauge("fleet.replicas_ready",
+                        sum(1 for h in self.handles
+                            if h.state == "ready"))
+
+    # ---------- supervision ----------
+
+    def _monitor_loop(self):
+        while not self._stopped:
+            now = time.monotonic()
+            for h in self.handles:
+                if self._stopped:
+                    return
+                if h.state == "ready" and h.proc is not None \
+                        and h.proc.poll() is not None:
+                    self._on_death(h)
+                elif h.state == "backoff" and h.restart_at is not None \
+                        and now >= h.restart_at:
+                    h.restart_at = None
+                    try:
+                        self._spawn(h)
+                    except Exception as ex:
+                        self.echo("fleet: replica %d restart failed: %s"
+                                  % (h.index, ex))
+                        self._schedule_restart(h)
+            time.sleep(0.05)
+
+    def _health_loop(self):
+        while not self._stopped:
+            time.sleep(self.config.health_interval_s)
+            for h in self.handles:
+                if self._stopped or self._draining:
+                    return
+                if h.state != "ready":
+                    continue
+                stats = self._probe(h)
+                if stats is not None and stats.get("ok"):
+                    h.last_stats = stats
+                    h.health_fails = 0
+                elif h.state == "ready":
+                    h.health_fails += 1
+                    if h.health_fails >= self.config.health_fails:
+                        # unresponsive but the process lives: a wedged
+                        # replica is dead to the router — take it out
+                        # through the same death path
+                        self.echo("fleet: replica %d failed %d health "
+                                  "probes; killing it"
+                                  % (h.index, h.health_fails))
+                        try:
+                            h.proc.kill()
+                        except OSError:
+                            pass
+                        self._on_death(h)
+
+    def _on_death(self, h):
+        with self._lock:
+            if h.state in ("dead", "backoff", "stopped"):
+                return
+            h.state = "dead"
+            inflight = h.inflight
+            # sticky sessions to a dead replica re-pin on next dispatch
+            for sid in [s for s, hh in self._sessions.items() if hh is h]:
+                del self._sessions[sid]
+        telemetry.event("fleet.replica.dead", data={
+            "replica": h.index, "pid": h.pid or 0, "inflight": inflight})
+        self._gauge_ready()
+        self.echo("fleet: replica %d died (pid %s, %d in flight)"
+                  % (h.index, h.pid, inflight))
+        if not self._draining:
+            self._schedule_restart(h)
+
+    def _schedule_restart(self, h):
+        if not self.config.restart:
+            return
+        if h.restarts >= self.config.max_restarts:
+            self.echo("fleet: replica %d out of restart budget (%d)"
+                      % (h.index, h.restarts))
+            return
+        delay = self.config.backoff.delay(h.restarts,
+                                          key="replica-%d" % h.index)
+        h.restarts += 1
+        h.state = "backoff"
+        h.restart_at = time.monotonic() + delay
+        with self._lock:
+            self.restart_count += 1
+        telemetry.event("fleet.replica.restart", data={
+            "replica": h.index, "attempt": h.restarts,
+            "delay_s": round(delay, 4)})
+        self.echo("fleet: replica %d restarting in %.2fs (attempt %d)"
+                  % (h.index, delay, h.restarts))
+
+    def kill_replica(self, index, sig=signal.SIGKILL):
+        """Chaos hook: deliver a REAL process kill to replica `index`.
+        The monitor observes the death exactly as it would a prod
+        reclaim; relay threads fail over organically."""
+        h = self.handles[index]
+        proc = h.proc
+        if proc is None:
+            return False
+        if hasattr(proc, "send_signal"):
+            try:
+                proc.send_signal(sig)
+                return True
+            except OSError:
+                return False
+        proc.kill()
+        return True
+
+    # ---------- dispatch ----------
+
+    def _pick(self, session, exclude):
+        with self._lock:
+            ready = [h for h in self.handles
+                     if h.state == "ready" and h not in exclude]
+            if not ready:
+                return None
+            if session is not None:
+                pinned = self._sessions.get(session)
+                if pinned is not None and pinned in ready:
+                    pinned.inflight += 1
+                    return pinned
+            h = min(ready, key=lambda r: (
+                r.inflight, r.last_stats.get("queue_depth") or 0,
+                r.index))
+            if session is not None:
+                self._sessions[session] = h
+            h.inflight += 1
+            return h
+
+    def _wait_for_ready(self, deadline_s, exclude):
+        """Block (bounded) for a ready replica: a fleet mid-restart
+        should queue briefly, not 503 the world."""
+        end = time.monotonic() + deadline_s
+        while time.monotonic() < end and not self._draining \
+                and not self._stopped:
+            with self._lock:
+                if any(h.state == "ready" and h not in exclude
+                       for h in self.handles):
+                    return True
+                if not any(h.state in ("starting", "backoff")
+                           for h in self.handles):
+                    return False  # nothing will ever become ready
+            time.sleep(0.05)
+        return False
+
+    def _shed(self, handler, request_id, reason, code, message):
+        with self._lock:
+            self.shed_count += 1
+        telemetry.event("fleet.request.shed", data={
+            "request_id": str(request_id), "reason": reason})
+        handler._json(code, {"error": message, "reason": reason})
+
+    def handle_generate(self, handler, payload):
+        request_id = payload.get("request_id") or \
+            "fleet-%d" % (id(payload) & 0xFFFFFF)
+        session = payload.get("session")
+        stream = bool(payload.get("stream", False))
+        deadline = None
+        if payload.get("deadline_ms") is not None:
+            try:
+                deadline = time.monotonic() \
+                    + float(payload["deadline_ms"]) / 1000.0
+            except (TypeError, ValueError):
+                handler._json(400, {"error": "bad deadline_ms"})
+                return
+        # ---- admission: shed before any replica spends prefill ----
+        if self._draining or self._stopped:
+            self._shed(handler, request_id, "draining", 503,
+                       "fleet is draining")
+            return
+        if deadline is not None and deadline <= time.monotonic():
+            self._shed(handler, request_id, "deadline", 429,
+                       "deadline already expired")
+            return
+        with self._lock:
+            total_inflight = sum(h.inflight for h in self.handles)
+            if self.config.max_inflight is not None \
+                    and total_inflight >= self.config.max_inflight:
+                full = True
+            else:
+                full = False
+        if full:
+            self._shed(handler, request_id, "queue_full", 429,
+                       "fleet in-flight budget exhausted")
+            return
+
+        delivered = 0      # tokens already streamed to the client
+        started = False    # status line sent (streaming path)
+        attempts = 0
+        tried_busy = set()
+        exclude = set()
+        while True:
+            if deadline is not None and deadline <= time.monotonic() \
+                    and delivered == 0:
+                self._shed(handler, request_id, "deadline", 429,
+                           "deadline expired before dispatch")
+                return
+            h = self._pick(session, exclude | tried_busy)
+            if h is None:
+                wait = self.config.wait_s
+                if deadline is not None:
+                    wait = min(wait, max(0.0,
+                                         deadline - time.monotonic()))
+                if self._wait_for_ready(wait, exclude | tried_busy):
+                    continue
+                if started:
+                    handler.close_connection = True
+                    return
+                self._shed(handler, request_id, "no_replica", 503,
+                           "no ready replica")
+                return
+            with self._lock:
+                self.dispatch_count += 1
+                n_dispatch = self.dispatch_count
+                h.dispatched += 1
+            telemetry.event("fleet.request.dispatch", data={
+                "request_id": str(request_id), "replica": h.index,
+                "dispatch": n_dispatch})
+            if self.chaos is not None:
+                victim = self.chaos.on_dispatch(n_dispatch,
+                                                len(self.handles))
+                if victim is not None:
+                    self.kill_replica(victim)
+            try:
+                done, delivered, started = self._relay(
+                    handler, h, payload, request_id, stream, delivered)
+                with self._lock:
+                    h.inflight = max(0, h.inflight - 1)
+                    if done:
+                        self.completed += 1
+                return
+            except _ReplicaBusyError as ex:
+                with self._lock:
+                    h.inflight = max(0, h.inflight - 1)
+                tried_busy.add(h)
+                if len(tried_busy) >= len(self.handles):
+                    self._shed(handler, request_id, "queue_full",
+                               ex.code, "every replica shed the request")
+                    return
+                continue
+            except _ReplicaBackendError as ex:
+                with self._lock:
+                    h.inflight = max(0, h.inflight - 1)
+                delivered, started = ex.delivered, ex.started
+                exclude = {h}
+                if not self.config.failover:
+                    if started:
+                        handler.close_connection = True
+                    else:
+                        self._shed(handler, request_id, "replica_lost",
+                                   502, "replica died (failover "
+                                   "disabled)")
+                    return
+                attempts += 1
+                if attempts > self.config.redispatch_max:
+                    if started:
+                        handler.close_connection = True
+                    else:
+                        self._shed(handler, request_id,
+                                   "failover_exhausted", 502,
+                                   "re-dispatch budget exhausted")
+                    return
+                with self._lock:
+                    self.failover_count += 1
+                telemetry.event("fleet.request.failover", data={
+                    "request_id": str(request_id),
+                    "from_replica": h.index, "attempt": attempts,
+                    "delivered": delivered})
+                continue
+            except (BrokenPipeError, ConnectionResetError):
+                # the CLIENT went away: nothing to re-dispatch
+                with self._lock:
+                    h.inflight = max(0, h.inflight - 1)
+                handler.close_connection = True
+                return
+
+    def _relay(self, handler, h, payload, request_id, stream, delivered):
+        """Forward one dispatch attempt; returns (done, delivered,
+        started). Raises _ReplicaBackendError (carrying progress) on
+        replica death."""
+        # always ask the replica to stream: the router must observe
+        # token-by-token progress to resume a partially-streamed request
+        # on a survivor without duplicating output
+        fwd = dict(payload)
+        fwd["stream"] = True
+        fwd["request_id"] = str(request_id)
+        fwd.pop("session", None)
+        body = json.dumps(fwd).encode("utf-8")
+        started = delivered > 0
+
+        def backend(fn):
+            # replica-side I/O only: a socket reset HERE is a replica
+            # loss (failover), never a client disconnect — client-side
+            # wfile errors propagate to handle_generate unwrapped
+            try:
+                return fn()
+            except (http.client.HTTPException, OSError, ValueError):
+                raise _ReplicaBackendError(delivered, started)
+
+        conn = http.client.HTTPConnection(h.host, h.port, timeout=300)
+        try:
+            backend(lambda: conn.request(
+                "POST", "/v1/generate", body=body,
+                headers={"Content-Type": "application/json"}))
+            resp = backend(conn.getresponse)
+            if resp.status in (429, 503):
+                raise _ReplicaBusyError(
+                    resp.status,
+                    backend(resp.read).decode("utf-8", "replace"))
+            if resp.status != 200:
+                # non-retryable replica verdict (400 oversized etc):
+                # relay it verbatim
+                data = backend(resp.read)
+                handler.send_response(resp.status)
+                handler.send_header("Content-Type", "application/json")
+                handler.send_header("Content-Length", str(len(data)))
+                handler.end_headers()
+                handler.wfile.write(data)
+                return (False, delivered, started)
+            tokens = []
+            terminal = None
+            index = delivered
+            skip = delivered
+            while True:
+                line = backend(resp.readline)
+                if not line:
+                    raise _ReplicaBackendError(delivered, started)
+                line = line.strip()
+                if not line:
+                    continue
+                item = backend(
+                    lambda: json.loads(line.decode("utf-8")))
+                if item.get("done"):
+                    if item.get("reason") == "shutdown":
+                        # the replica hard-stopped mid-request: its
+                        # scheduler flushed in-flight work as 'shutdown'
+                        # before the process died — incomplete output,
+                        # a replica loss, not a result
+                        raise _ReplicaBackendError(delivered, started)
+                    terminal = item
+                    break
+                if skip > 0:
+                    # token-identical re-issue: the survivor
+                    # regenerates the prefix the client already has
+                    skip -= 1
+                    continue
+                tokens.append(item["token"])
+                if stream:
+                    if not started:
+                        handler.send_response(200)
+                        handler.send_header("Content-Type",
+                                            "application/jsonl")
+                        handler.send_header("Transfer-Encoding",
+                                            "chunked")
+                        handler.end_headers()
+                        started = True
+                    handler._chunk(json.dumps(
+                        {"token": item["token"],
+                         "index": index}).encode() + b"\n")
+                    handler.wfile.flush()
+                    index += 1
+                    delivered += 1
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        # terminal reached: close out the client response
+        new_tokens = terminal.get("new_tokens", tokens)
+        if stream:
+            if not started:
+                handler.send_response(200)
+                handler.send_header("Content-Type", "application/jsonl")
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                started = True
+            handler._chunk(json.dumps(
+                {"done": True, "reason": terminal.get("reason"),
+                 "new_tokens": new_tokens}).encode() + b"\n")
+            handler._chunk(b"")
+            handler.wfile.flush()
+        else:
+            prompt = payload.get("tokens") or []
+            handler._json(200, {
+                "id": str(request_id),
+                "tokens": list(prompt) + list(new_tokens),
+                "new_tokens": new_tokens,
+                "reason": terminal.get("reason"),
+                "usage": {"prompt_tokens": len(prompt),
+                          "new_tokens": len(new_tokens)},
+                "replica": h.index,
+            })
+        return (True, delivered, started)
+
+    # ---------- introspection ----------
+
+    def healthz(self):
+        ready = sum(1 for h in self.handles if h.state == "ready")
+        with self._lock:
+            inflight = sum(h.inflight for h in self.handles)
+        return {
+            "ok": ready > 0 and not self._draining,
+            "draining": self._draining,
+            "replicas": [h.describe() for h in self.handles],
+            "ready": ready,
+            "inflight": inflight,
+        }
+
+    def stats(self):
+        with self._lock:
+            return {
+                "replicas": [h.describe() for h in self.handles],
+                "dispatched": self.dispatch_count,
+                "completed": self.completed,
+                "failovers": self.failover_count,
+                "shed": self.shed_count,
+                "restarts": self.restart_count,
+                "inflight": sum(h.inflight for h in self.handles),
+                "max_inflight": self.config.max_inflight,
+                "draining": self._draining,
+            }
+
+    # ---------- shutdown ----------
+
+    def install_signal_handlers(self):
+        def _on_signal(_sig, _frame):
+            threading.Thread(target=self.shutdown, daemon=True).start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _on_signal)
+            except ValueError:
+                pass  # not the main thread (tests)
+
+    def serve_forever(self):
+        self.install_signal_handlers()
+        try:
+            self._done.wait()
+        except KeyboardInterrupt:
+            self.shutdown()
+
+    def shutdown(self, timeout=60.0):
+        """Graceful fleet drain: 503 new work, let in-flight relays
+        finish, then SIGTERM each replica (they drain their own
+        schedulers) and reap the processes."""
+        self._draining = True
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            with self._lock:
+                if all(h.inflight == 0 for h in self.handles):
+                    break
+            time.sleep(0.05)
+        for h in self.handles:
+            h.state = "stopped"
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                except OSError:
+                    pass
+        for h in self.handles:
+            if h.proc is not None:
+                try:
+                    h.proc.wait(timeout=max(1.0,
+                                            end - time.monotonic()))
+                except Exception:
+                    try:
+                        h.proc.kill()
+                    except OSError:
+                        pass
+        self._stopped = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._done.set()
+        return True
+
+    def close(self):
+        """Hard stop (tests): kill everything now."""
+        self._draining = True
+        self._stopped = True
+        for h in self.handles:
+            h.state = "stopped"
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.kill()
+                except OSError:
+                    pass
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._done.set()

@@ -177,6 +177,11 @@ class Scheduler(object):
     # ---------- lifecycle helpers ----------
 
     def _finish(self, req, reason):
+        if req.state in ("finished", "cancelled"):
+            # terminal already: finishing twice would release a slot
+            # that may hold the NEXT occupant, and put a second None
+            # sentinel into the stream
+            return
         if req.slot is not None:
             self.engine.release(req.slot)
             del self._slots[req.slot]
@@ -240,10 +245,23 @@ class Scheduler(object):
         free = self.engine.free_slots()
         admitted = 0
         for slot in free:
-            with self._cond:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
+            req = None
+            while req is None:
+                with self._cond:
+                    if not self._queue:
+                        return admitted
+                    req = self._queue.popleft()
+                # the reap->admit race: a request cancelled (or expired)
+                # after _reap scanned the queue but before this pop must
+                # finish HERE, without ever taking the slot — admitting
+                # it would spend a prefill chunk on a corpse and free
+                # the slot a second time one iteration later
+                now = time.time()
+                expired = (req.deadline is not None and now > req.deadline)
+                if req.cancelled or expired:
+                    self._finish(req, "cancelled" if req.cancelled
+                                 else "deadline")
+                    req = None
             try:
                 self.engine.admit(
                     slot, req.tokens, req.max_new_tokens,

@@ -16,7 +16,9 @@ compiled programs.
       -> 400 malformed body / oversized request
       -> 429 queue full (backpressure)
       -> 503 draining (graceful shutdown in progress)
-    GET /healthz        {"ok": true, "draining": false}
+    GET /healthz        {"ok", "draining", "queue_depth", "in_flight",
+                         "slots", "occupancy"} — one probe carries the
+                         admission signals (fleet router / external LB)
     GET /v1/stats       scheduler + engine counters
 
 Graceful shutdown: SIGTERM (install_signal_handlers) flips /healthz to
@@ -81,8 +83,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/healthz":
-            self._json(200, {"ok": True,
-                             "draining": self.server.draining})
+            # one probe carries everything an admission decision needs
+            # (the fleet router and external LBs both read this):
+            # readiness, drain state, queue pressure, slot occupancy.
+            # Schema pinned in tests/schema_validate.py::HEALTHZ_SCHEMA.
+            stats = self.scheduler.stats()
+            self._json(200, {
+                "ok": True,
+                "draining": self.server.draining or stats["draining"],
+                "queue_depth": stats["queue_depth"],
+                "in_flight": stats["in_flight"],
+                "slots": stats["slots"],
+                "occupancy": stats["occupancy"],
+            })
             return
         if self.path == "/v1/stats":
             self._json(200, self.scheduler.stats())

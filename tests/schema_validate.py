@@ -669,6 +669,143 @@ def validate_elastic_record(record):
 
 
 # ---------------------------------------------------------------------------
+# Serving fleet (metaflow_tpu/serving/fleet.py + devtools/chaos.py fleet
+# injector): the pinned event surface for replica lifecycle, request
+# dispatch/failover/shedding, and chaos replica kills, plus the /healthz
+# payloads of both tiers. `tpuflow metrics` keys its fleet aggregation on
+# these fields and the chaos e2e test asserts failover off the real event
+# stream — they must not drift silently.
+# ---------------------------------------------------------------------------
+
+FLEET_SHED_REASONS = ["queue_full", "deadline", "draining", "no_replica",
+                      "replica_lost", "failover_exhausted"]
+
+FLEET_EVENT_DATA_SCHEMAS = {
+    "fleet.replica.spawn": _obj(
+        {"replica": _INT, "generation": _INT, "restarts": _INT},
+        required=("replica", "generation", "restarts"),
+    ),
+    "fleet.replica.ready": _obj(
+        {"replica": _INT, "pid": _INT, "port": _INT, "spawn_ms": _NUM},
+        required=("replica", "pid", "port", "spawn_ms"),
+    ),
+    "fleet.replica.dead": _obj(
+        {"replica": _INT, "pid": _INT, "inflight": _INT},
+        required=("replica", "pid", "inflight"),
+    ),
+    "fleet.replica.restart": _obj(
+        {"replica": _INT, "attempt": _INT, "delay_s": _NUM},
+        required=("replica", "attempt", "delay_s"),
+    ),
+    "fleet.request.dispatch": _obj(
+        {"request_id": _STR, "replica": _INT, "dispatch": _INT},
+        required=("request_id", "replica", "dispatch"),
+    ),
+    "fleet.request.failover": _obj(
+        {"request_id": _STR, "from_replica": _INT, "attempt": _INT,
+         "delivered": _INT},
+        required=("request_id", "from_replica", "attempt", "delivered"),
+    ),
+    "fleet.request.shed": _obj(
+        {"request_id": _STR, "reason": {"enum": FLEET_SHED_REASONS}},
+        required=("request_id", "reason"),
+    ),
+    "chaos.replica_kill": _obj(
+        {"dispatch": _INT, "replica": _INT, "replicas": _INT},
+        required=("dispatch", "replica", "replicas"),
+    ),
+}
+
+FLEET_METRIC_NAMES = {
+    "fleet.replicas_ready": "gauge",
+}
+
+
+def validate_fleet_record(record):
+    """Validate one fleet.*/chaos.replica_kill flight-recorder record:
+    base v1 record shape, a pinned name, and the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name in FLEET_EVENT_DATA_SCHEMAS:
+        if record.get("type") != "event":
+            raise jsonschema.ValidationError(
+                "%s must be an event record, got %r"
+                % (name, record.get("type")))
+        jsonschema.validate(record.get("data", {}),
+                            FLEET_EVENT_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+    elif name in FLEET_METRIC_NAMES:
+        if record.get("type") != FLEET_METRIC_NAMES[name]:
+            raise jsonschema.ValidationError(
+                "%s must be a %s record, got %r"
+                % (name, FLEET_METRIC_NAMES[name], record.get("type")))
+    else:
+        raise jsonschema.ValidationError(
+            "unknown fleet record name %r (pinned: %s)"
+            % (name, sorted(FLEET_EVENT_DATA_SCHEMAS)
+               + sorted(FLEET_METRIC_NAMES)))
+
+
+# single-server /healthz (serving/server.py): a load balancer's health
+# probe AND the fleet router's per-replica probe both key on this shape.
+HEALTHZ_SCHEMA = _obj(
+    {
+        "ok": _BOOL,
+        "draining": _BOOL,
+        "queue_depth": _INT,
+        "in_flight": _INT,
+        "slots": _INT,
+        "occupancy": _NUM,
+    },
+    required=("ok", "draining", "queue_depth", "in_flight", "slots",
+              "occupancy"),
+)
+
+_REPLICA_DESCRIBE = _obj(
+    {
+        "index": _INT,
+        "state": {"enum": ["starting", "ready", "backoff", "dead",
+                           "stopped"]},
+        "pid": {"type": ["integer", "null"]},
+        "port": {"type": ["integer", "null"]},
+        "inflight": _INT,
+        "dispatched": _INT,
+        "restarts": _INT,
+        "generation": _INT,
+        "queue_depth": {"type": ["integer", "null"]},
+        "occupancy": {"type": ["number", "null"]},
+    },
+    required=("index", "state", "pid", "inflight", "dispatched",
+              "restarts", "generation"),
+)
+
+# fleet-router /healthz (serving/fleet.py): the supervisor's aggregate
+# view — per-replica state plus fleet readiness.
+FLEET_HEALTHZ_SCHEMA = _obj(
+    {
+        "ok": _BOOL,
+        "draining": _BOOL,
+        "replicas": _arr(_REPLICA_DESCRIBE),
+        "ready": _INT,
+        "inflight": _INT,
+    },
+    required=("ok", "draining", "replicas", "ready", "inflight"),
+)
+
+
+def validate_healthz(payload):
+    """Validate a single-server /healthz response body."""
+    jsonschema.validate(payload, HEALTHZ_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+def validate_fleet_healthz(payload):
+    """Validate a fleet-router /healthz response body."""
+    jsonschema.validate(payload, FLEET_HEALTHZ_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+# ---------------------------------------------------------------------------
 # `check --deep --json` report (metaflow_tpu/analysis/report.py): the pinned
 # v1 surface for the static analyzer. additionalProperties: false — a field
 # the analyzer invents fails validation, protecting editor/CI consumers of

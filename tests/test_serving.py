@@ -232,6 +232,95 @@ class TestCancellationDeadlines:
         assert last.generated == []
         assert all(r.reason == "length" for r in reqs[:-1])
 
+    def test_cancel_mid_prefill_frees_slot_once(self, engine):
+        """Cancel while the prompt is still prefilling (no token out
+        yet): the slot comes back exactly once and no masked lane
+        leaks into later decode batches."""
+        sched = Scheduler(engine)
+        victim = sched.submit(Request(list(range(1, 61)),
+                                      max_new_tokens=50, rng=0))
+        sched.step()  # admit + first prefill chunks (budget < prompt)
+        assert victim.state == "prefill"
+        sched.cancel(victim.id)
+        sched.run_until_idle(10_000)
+        assert victim.reason == "cancelled"
+        assert victim.generated == []
+        assert engine.free_slots() == list(range(engine.max_slots))
+        assert engine.occupancy() == 0.0
+        # the stream got exactly one terminal sentinel
+        assert list(victim.stream(timeout=1)) == []
+        assert victim.out.qsize() == 0
+
+    def test_deadline_expires_mid_prefill(self, engine):
+        sched = Scheduler(engine)
+        req = sched.submit(Request(list(range(1, 61)),
+                                   max_new_tokens=50,
+                                   deadline=time.time() + 3600))
+        sched.step()
+        assert req.state == "prefill"
+        req.deadline = time.time() - 0.001
+        sched.run_until_idle(10_000)
+        assert req.reason == "deadline"
+        assert req.generated == []
+        assert engine.free_slots() == list(range(engine.max_slots))
+        assert engine.occupancy() == 0.0
+
+    def test_cancel_between_reap_and_admit(self, engine):
+        """The reap->admit race: a request cancelled (or expired) after
+        _reap scanned the queue but before _admit pops it must finish
+        WITHOUT taking a slot. Calling _admit directly (no prior reap)
+        models the race window deterministically."""
+        sched = Scheduler(engine)
+        victim = sched.submit(Request(list(range(1, 10)),
+                                      max_new_tokens=5))
+        expired = sched.submit(Request(list(range(1, 10)),
+                                       max_new_tokens=5,
+                                       deadline=time.time() - 1))
+        survivor = sched.submit(Request(list(range(1, 10)),
+                                        max_new_tokens=2, rng=1))
+        victim.cancel()  # flag set; _reap has NOT seen it
+        admitted = sched._admit()
+        assert admitted == 1, "only the survivor may take a slot"
+        assert victim.reason == "cancelled" and victim.slot is None
+        assert expired.reason == "deadline" and expired.slot is None
+        sched.run_until_idle(10_000)
+        assert survivor.reason == "length"
+        assert engine.free_slots() == list(range(engine.max_slots))
+        # each corpse's stream carries exactly one terminal sentinel
+        for corpse in (victim, expired):
+            assert list(corpse.stream(timeout=1)) == []
+            assert corpse.out.qsize() == 0
+
+    def test_finish_idempotent_single_release(self, engine):
+        """Finishing the same request twice (cancel racing a deadline)
+        must release its slot exactly once — a second release would
+        free the slot's NEXT occupant mid-generation."""
+        sched = Scheduler(engine)
+        a = sched.submit(Request(list(range(1, 20)),
+                                 max_new_tokens=100, rng=0))
+        for _ in range(4):
+            sched.step()
+        assert a.state in ("prefill", "decode")
+        sched._finish(a, "cancelled")
+        # the freed slot is immediately re-admitted to b ...
+        b = sched.submit(Request(list(range(1, 10)),
+                                 max_new_tokens=30, rng=1))
+        sched.step()
+        assert b.slot is not None
+        # ... so the racing second finish must be a no-op
+        sched._finish(a, "deadline")
+        assert a.reason == "cancelled"  # first terminal reason wins
+        sched.run_until_idle(10_000)
+        assert b.reason == "length"
+        assert engine.free_slots() == list(range(engine.max_slots))
+        # a's stream: tokens delivered before the cancel, then EXACTLY
+        # one terminal sentinel (a second would confuse a reader
+        # blocked on the stream of a reused Request object)
+        drained = []
+        while not a.out.empty():
+            drained.append(a.out.get())
+        assert drained.count(None) == 1 and drained[-1] is None
+
     def test_backpressure(self, engine):
         sched = Scheduler(engine, max_queue=2)
         sched.submit(Request([1, 2, 3], max_new_tokens=2))
@@ -287,11 +376,18 @@ class TestHTTPServer:
         conn.close()
 
     def test_healthz_stats_and_errors(self, server):
+        from schema_validate import validate_healthz
+
         conn = http.client.HTTPConnection("127.0.0.1", server.port,
                                           timeout=30)
         conn.request("GET", "/healthz")
-        assert json.loads(conn.getresponse().read()) == {
-            "ok": True, "draining": False}
+        body = json.loads(conn.getresponse().read())
+        # /healthz is the probe surface both a load balancer and the
+        # fleet router key on: shape pinned in schema_validate.py
+        validate_healthz(body)
+        assert body["ok"] is True and body["draining"] is False
+        assert body["slots"] == 4
+        assert body["queue_depth"] == 0 and body["in_flight"] == 0
         conn.request("GET", "/v1/stats")
         stats = json.loads(conn.getresponse().read())
         assert stats["slots"] == 4
