@@ -563,6 +563,57 @@ def bench_serve():
     p99 = gaps[min(len(gaps) - 1, int(len(gaps) * 0.99))] if gaps else 0.0
     occupancy = sched.stats()["mean_batch_occupancy"]
 
+    # ---- request-tracing overhead: same trace through the SAME engine,
+    # a live flight recorder on BOTH sides so the delta isolates what
+    # TPUFLOW_TRACE_REQUESTS=0 turns off (traceparent derivation + per-
+    # event trace/span stamping), not telemetry I/O itself. Interleaved
+    # pairs so host drift cancels; min-of-3 each side. ----
+    import tempfile
+
+    from metaflow_tpu import telemetry, tracing
+    from metaflow_tpu.cmd.trace import (
+        build_request_traces,
+        ttft_decomposition,
+    )
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+    def timed_pass(traced):
+        sched = Scheduler(engine, max_queue=n_requests + 1)
+        reqs = [Request(p.tolist(), max_new_tokens=n, rng=i)
+                for i, (p, n) in enumerate(trace)]
+        if traced:
+            for r in reqs:
+                r.traceparent = tracing.request_traceparent(r.id)
+        t0 = time.perf_counter()
+        for r in reqs:
+            sched.submit(r)
+        sched.run_until_idle(max_iterations=100_000)
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as troot:
+        fds = FlowDataStore("ServeBench", LocalStorage, ds_root=troot)
+        telemetry.init_recorder(fds, "bench", "_serve", "bench")
+        try:
+            plain_dts, traced_dts = [], []
+            for _ in range(3):
+                plain_dts.append(timed_pass(False))
+                traced_dts.append(timed_pass(True))
+        finally:
+            telemetry.close_recorder()
+        records = telemetry.read_run_records(fds, "bench")
+    plain_dt, traced_dt = min(plain_dts), min(traced_dts)
+    tracing_overhead_pct = max(
+        0.0, (traced_dt - plain_dt) / plain_dt * 100) if plain_dt else 0.0
+
+    # TTFT decomposition consistency off the traced passes' own records:
+    # the components are independent measurements, so median |err| is a
+    # real check that the trace tree reconstructs the request path
+    errs = sorted(abs(d["err_pct"]) for d in
+                  (ttft_decomposition(t)
+                   for t in build_request_traces(records))
+                  if d is not None and d["measured_ttft_ms"] > 0)
+    decomp_err_pct = errs[len(errs) // 2] if errs else 0.0
+
     return {
         "metric": "serve_tokens_per_s",
         "value": round(serve_tps, 1),
@@ -588,6 +639,14 @@ def bench_serve():
             {"metric": "serve_batch_occupancy",
              "value": round(occupancy, 4),
              "unit": "mean fraction of slots active per decode step"},
+            {"metric": "serve_tracing_overhead_pct",
+             "value": round(tracing_overhead_pct, 2),
+             "unit": "% tok/s cost of request tracing vs "
+                     "TPUFLOW_TRACE_REQUESTS=0 (gate: <= 2.0)"},
+            {"metric": "serve_ttft_decomp_err_pct",
+             "value": round(decomp_err_pct, 2),
+             "unit": "median |TTFT decomposition sum - measured| % "
+                     "(gate: <= 5.0)"},
         ],
     }
 

@@ -391,9 +391,24 @@ def stubs(out_dir):
               help="Per-train-step wall/tokens-per-sec/MFU series.")
 @click.option("--spans", default=0, type=int,
               help="Show the N slowest timer spans of the run.")
+@click.option("--step", "step_filter", default=None,
+              help="Only records from this flow step.")
+@click.option("--rank", "rank_filter", default=None, type=int,
+              help="Only records from this gang rank.")
 def metrics(flow_run, run_id, datastore, datastore_root, as_json,
-            timeline, spans):
+            timeline, spans, step_filter, rank_filter):
     from .cmd.metrics import show_metrics
+
+    fds, run_id = _resolve_run(flow_run, run_id, datastore,
+                               datastore_root)
+    show_metrics(fds, run_id, as_json=as_json, timeline=timeline,
+                 spans=spans, step=step_filter, rank=rank_filter,
+                 echo=click.echo)
+
+
+def _resolve_run(flow_run, run_id, datastore, datastore_root):
+    """FLOW/RUN_ID (or FLOW RUN_ID) + backend flags -> (fds, run_id);
+    shared by the read-side commands (metrics / trace / watch)."""
     from .datastore import STORAGE_BACKENDS, FlowDataStore
     from . import metaflow_config as cfg
 
@@ -401,13 +416,77 @@ def metrics(flow_run, run_id, datastore, datastore_root, as_json,
         flow_name, _, run_id = flow_run.rpartition("/")
         if not flow_name:
             raise click.ClickException(
-                "specify a run as FLOW/RUN_ID (or: metrics FLOW RUN_ID)")
+                "specify a run as FLOW/RUN_ID (or: FLOW RUN_ID)")
     else:
         flow_name = flow_run
     storage_impl = STORAGE_BACKENDS[datastore or cfg.default_datastore()]
     fds = FlowDataStore(flow_name, storage_impl, ds_root=datastore_root)
-    show_metrics(fds, run_id, as_json=as_json, timeline=timeline,
-                 spans=spans, echo=click.echo)
+    return fds, run_id
+
+
+@main.command(
+    help="Reassemble per-request distributed traces from a run's "
+         "telemetry: `trace FLOW/RUN_ID`. Shows each serving request "
+         "as one tree (queued -> dispatch -> prefill -> first_token -> "
+         "finished/failover, across replicas) with a TTFT critical-path "
+         "decomposition; --perfetto exports Chrome/Perfetto trace-event "
+         "JSON (train runs export their timer spans instead).")
+@click.argument("flow_run")
+@click.argument("run_id", required=False)
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]),
+              help="Storage backend (default: configured default).")
+@click.option("--datastore-root", default=None,
+              help="Datastore root override.")
+@click.option("--request", "request_id", default=None,
+              help="Only this request id.")
+@click.option("--perfetto", default=None, metavar="OUT.json",
+              help="Write Chrome/Perfetto trace-event JSON here.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit assembled trees as JSON.")
+def trace(flow_run, run_id, datastore, datastore_root, request_id,
+          perfetto, as_json):
+    from .cmd.trace import show_trace
+
+    fds, run_id = _resolve_run(flow_run, run_id, datastore,
+                               datastore_root)
+    show_trace(fds, run_id, request=request_id, perfetto=perfetto,
+               as_json=as_json, echo=click.echo)
+
+
+@main.command(
+    help="Live watchtower over a (possibly in-progress) run: "
+         "`watch FLOW/RUN_ID`. Tails _telemetry/ part files "
+         "incrementally and renders tok/s, MFU, input-stall fraction, "
+         "queue depth, slot occupancy, rolling TTFT/ITL percentiles, "
+         "replica flaps and straggler skew. --check evaluates the "
+         "configured SLO rules (--slo / TPUFLOW_SLO_*) and exits "
+         "non-zero on breach.")
+@click.argument("flow_run")
+@click.argument("run_id", required=False)
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]),
+              help="Storage backend (default: configured default).")
+@click.option("--datastore-root", default=None,
+              help="Datastore root override.")
+@click.option("--once", is_flag=True,
+              help="Render a single frame and exit.")
+@click.option("--check", is_flag=True,
+              help="Exit non-zero when an SLO rule is breached.")
+@click.option("--interval", default=2.0, type=float,
+              help="Refresh interval in seconds.")
+@click.option("--slo", "slo_path", default=None,
+              help="JSON SLO rule file (default: TPUFLOW_SLO_* env).")
+def watch(flow_run, run_id, datastore, datastore_root, once, check,
+          interval, slo_path):
+    from .cmd.watch import watch as watch_run
+
+    fds, run_id = _resolve_run(flow_run, run_id, datastore,
+                               datastore_root)
+    rc = watch_run(fds, run_id, once=once, check=check,
+                   interval=interval, slo_path=slo_path, echo=click.echo)
+    if rc:
+        raise SystemExit(rc)
 
 
 @main.command(

@@ -814,15 +814,21 @@ def make_cli(flow, state):
                   help="Per-train-step wall/tokens-per-sec/MFU series.")
     @click.option("--spans", default=0, type=int,
                   help="Show the N slowest timer spans of the run.")
+    @click.option("--step", "step_filter", default=None,
+                  help="Only records from this flow step.")
+    @click.option("--rank", "rank_filter", default=None, type=int,
+                  help="Only records from this gang rank.")
     @click.pass_obj
-    def metrics(state, run_id, as_json, timeline, spans):
+    def metrics(state, run_id, as_json, timeline, spans, step_filter,
+                rank_filter):
         from .cmd.metrics import show_metrics
 
         run_id = run_id or read_latest_run_id(flow.name)
         if run_id is None:
             raise TpuFlowException("No run found for %s." % flow.name)
         show_metrics(state.flow_datastore, run_id, as_json=as_json,
-                     timeline=timeline, spans=spans, echo=print)
+                     timeline=timeline, spans=spans, step=step_filter,
+                     rank=rank_filter, echo=print)
 
     @start.command(help="Garbage-collect old runs (keep the newest N) and "
                         "unreferenced CAS blobs.")

@@ -412,18 +412,35 @@ def render_spans(records, limit, echo=print):
                 "" if s["ok"] else "FAIL"))
 
 
+def filter_records(records, step=None, rank=None):
+    """Narrow records to one flow step and/or gang rank — multi-gang
+    runs interleave everything, and a straggler hunt wants ONE rank's
+    timeline. Matches the record's own step/rank fields (records from a
+    different step/rank simply vanish from summary, timeline, spans)."""
+    if step is not None:
+        records = [r for r in records if r.get("step") == step]
+    if rank is not None:
+        records = [r for r in records if r.get("rank") == int(rank)]
+    return records
+
+
 def show_metrics(flow_datastore, run_id, as_json=False, timeline=False,
-                 spans=0, echo=print):
+                 spans=0, step=None, rank=None, echo=print):
     """The shared CLI driver. Returns the aggregation dict."""
     records, profiles = load_run(flow_datastore, run_id)
+    records = filter_records(records, step=step, rank=rank)
     agg = aggregate(records, profiles)
     if as_json:
         agg["slowest_spans"] = slowest_spans(records, spans or 10)
         echo(json.dumps(agg, indent=2, sort_keys=True, default=list))
         return agg
     if not records:
-        echo("no telemetry records found for run %s (was the run "
-             "executed with TPUFLOW_TELEMETRY=0?)" % run_id)
+        if step is not None or rank is not None:
+            echo("no telemetry records match the --step/--rank filter "
+                 "for run %s" % run_id)
+        else:
+            echo("no telemetry records found for run %s (was the run "
+                 "executed with TPUFLOW_TELEMETRY=0?)" % run_id)
         return agg
     if timeline:
         render_timeline(agg, echo=echo)

@@ -1,0 +1,238 @@
+"""`tpuflow watch` — live watchtower over a run's telemetry stream.
+
+Tails the run's `_telemetry/` part files incrementally
+(telemetry.TelemetryTail: a path-cursor delta over list_content — each
+refresh loads only part files that appeared since the last one, instead
+of the full re-read `read_run_records` does) and renders a rolling view:
+
+  train  tok/s, MFU, input-stall fraction, worst-rank straggler skew
+  serve  queue depth, slot occupancy, rolling p50/p99 TTFT and
+         inter-token latency, delivered tok/s
+  fleet  replicas ready, flaps (deaths), restart rate
+
+`--once` renders a single frame and exits (tests / cron). `--check`
+additionally evaluates the configured SLO rules (slo.load_rules: JSON
+file or TPUFLOW_SLO_* env) against the live metrics and exits non-zero
+on any breach — or on a pinned `slo.breach` event already persisted by
+the fleet supervisor — so CI can gate on a run's health.
+"""
+
+import time
+from collections import deque
+
+from .. import slo as slo_rules_mod
+from .. import telemetry
+
+
+def _mean(vals):
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def _pctl(vals, q):
+    if not vals:
+        return 0.0
+    ordered = sorted(vals)
+    idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+    return float(ordered[idx])
+
+
+class WatchState(object):
+    """Rolling aggregation of a telemetry record stream. Bounded
+    windows: a watch session over a week-long run must not grow."""
+
+    def __init__(self, window=256):
+        self.records_total = 0
+        self.last_ts = 0.0
+        # train
+        self._step_ms = deque(maxlen=window)
+        self._stall_ms = deque(maxlen=window)
+        self._tok_s = deque(maxlen=window)
+        self._mfu = deque(maxlen=window)
+        self._rank_ms = {}            # rank -> deque of recent step ms
+        self.last_step_num = None
+        # serve
+        self.queue_depth = None
+        self.occupancy = None
+        self._ttft_ms = deque(maxlen=window)
+        self._itl_ms = deque(maxlen=window * 4)
+        self._served = deque(maxlen=window * 2)   # (ts, new_tokens)
+        # fleet
+        self.replicas_ready = None
+        self.replica_flaps = 0
+        self._restart_ts = deque(maxlen=64)
+        # incidents
+        self.desync_count = 0
+        self.flush_failures = 0
+        self.breach_events = []       # persisted slo.breach records
+
+    def ingest(self, records):
+        for rec in records:
+            self.records_total += 1
+            ts = rec.get("ts", 0.0)
+            if ts > self.last_ts:
+                self.last_ts = ts
+            name = rec.get("name", "")
+            rtype = rec.get("type")
+            data = rec.get("data") or {}
+            if rtype == "timer" and name.endswith(".step") \
+                    and rec.get("step_num") is not None:
+                ms = rec.get("ms")
+                if ms is not None:
+                    self._step_ms.append(ms)
+                    self._rank_ms.setdefault(
+                        rec.get("rank") or 0,
+                        deque(maxlen=32)).append(ms)
+                self.last_step_num = rec.get("step_num")
+                if data.get("input_stall_ms") is not None:
+                    self._stall_ms.append(data["input_stall_ms"])
+                if data.get("tokens_per_sec") is not None:
+                    self._tok_s.append(data["tokens_per_sec"])
+                if data.get("mfu") is not None:
+                    self._mfu.append(data["mfu"])
+            elif rtype == "gauge":
+                if name == "serve.queue_depth":
+                    self.queue_depth = rec.get("value")
+                elif name == "serve.batch_occupancy":
+                    self.occupancy = rec.get("value")
+                elif name == "fleet.replicas_ready":
+                    self.replicas_ready = rec.get("value")
+            elif rtype == "event":
+                if name == "serve.request.first_token":
+                    if data.get("ttft_ms") is not None:
+                        self._ttft_ms.append(data["ttft_ms"])
+                elif name == "serve.request.finished":
+                    new = data.get("new_tokens") or 0
+                    self._served.append((ts, new))
+                    ttft = data.get("ttft_ms")
+                    total = data.get("total_ms")
+                    if ttft is not None and total is not None and new > 1:
+                        self._itl_ms.append((total - ttft) / (new - 1))
+                elif name == "fleet.replica.dead":
+                    self.replica_flaps += 1
+                elif name == "fleet.replica.restart":
+                    self._restart_ts.append(ts)
+                elif name == "sanitize.desync":
+                    self.desync_count += 1
+                elif name == "slo.breach":
+                    self.breach_events.append(rec)
+            elif rtype == "counter" and name == "telemetry.flush_failed":
+                self.flush_failures += rec.get("inc") or 1
+
+    def metrics(self):
+        """The SLO rule vocabulary (slo.ENV_RULES) + render inputs.
+        Latency percentiles are present only once samples exist, so an
+        idle server is not 'in breach of 0ms'."""
+        m = {
+            "records": self.records_total,
+            "replica_flaps": self.replica_flaps,
+            "desync_count": float(self.desync_count),
+            "flush_failures": self.flush_failures,
+        }
+        # restart rate over the final observed minute (record-clock, so
+        # it works identically on live and finished runs)
+        if self.last_ts:
+            recent = [t for t in self._restart_ts
+                      if self.last_ts - t <= 60.0]
+            m["replica_restart_rate_per_min"] = float(len(recent))
+        if self._step_ms:
+            m["step_ms"] = round(_mean(self._step_ms), 3)
+            if self._stall_ms:
+                m["input_stall_frac"] = round(
+                    _mean(self._stall_ms) / max(1e-9,
+                                                _mean(self._step_ms)), 4)
+        if self._tok_s:
+            m["train_tokens_per_sec"] = round(_mean(self._tok_s), 1)
+        if self._mfu:
+            m["mfu"] = round(_mean(self._mfu), 4)
+        if len(self._rank_ms) > 1:
+            means = sorted(_mean(d) for d in self._rank_ms.values())
+            median = means[len(means) // 2]
+            if median > 0:
+                m["straggler_skew"] = round(means[-1] / median, 3)
+        if self._ttft_ms:
+            m["p50_ttft_ms"] = round(_pctl(self._ttft_ms, 0.50), 3)
+            m["p99_ttft_ms"] = round(_pctl(self._ttft_ms, 0.99), 3)
+        if self._itl_ms:
+            m["p50_itl_ms"] = round(_pctl(self._itl_ms, 0.50), 3)
+            m["p99_itl_ms"] = round(_pctl(self._itl_ms, 0.99), 3)
+        if len(self._served) > 1:
+            span = self._served[-1][0] - self._served[0][0]
+            if span > 0:
+                m["serve_tokens_per_sec"] = round(
+                    sum(n for _t, n in self._served) / span, 1)
+        return m
+
+
+def render_frame(state, run_id, breaches=(), echo=print):
+    m = state.metrics()
+    head = "watch %s  %d record(s)" % (run_id, state.records_total)
+    if state.last_step_num is not None:
+        head += "  step %s" % state.last_step_num
+    echo(head)
+    if "step_ms" in m:
+        line = "  train: %.1f ms/step" % m["step_ms"]
+        if "train_tokens_per_sec" in m:
+            line += "  %.0f tok/s" % m["train_tokens_per_sec"]
+        if "mfu" in m:
+            line += "  mfu %.1f%%" % (m["mfu"] * 100)
+        if "input_stall_frac" in m:
+            line += "  stall %.1f%%" % (m["input_stall_frac"] * 100)
+        if "straggler_skew" in m:
+            line += "  skew x%.2f" % m["straggler_skew"]
+        echo(line)
+    if state.queue_depth is not None or "p50_ttft_ms" in m:
+        line = "  serve: queue %s  occupancy %s" % (
+            state.queue_depth if state.queue_depth is not None else "-",
+            ("%.2f" % state.occupancy)
+            if state.occupancy is not None else "-")
+        if "p50_ttft_ms" in m:
+            line += "  ttft p50/p99 %.1f/%.1f ms" % (
+                m["p50_ttft_ms"], m["p99_ttft_ms"])
+        if "p50_itl_ms" in m:
+            line += "  itl p50/p99 %.1f/%.1f ms" % (
+                m["p50_itl_ms"], m["p99_itl_ms"])
+        if "serve_tokens_per_sec" in m:
+            line += "  %.0f tok/s" % m["serve_tokens_per_sec"]
+        echo(line)
+    if state.replicas_ready is not None or state.replica_flaps:
+        echo("  fleet: ready %s  flaps %d  restarts/min %s" % (
+            state.replicas_ready
+            if state.replicas_ready is not None else "-",
+            state.replica_flaps,
+            m.get("replica_restart_rate_per_min", 0.0)))
+    if state.desync_count or state.flush_failures:
+        echo("  incidents: desync %d  flush_failed %d"
+             % (state.desync_count, state.flush_failures))
+    for b in breaches:
+        echo("  SLO BREACH: %s %s=%s > %s" % (
+            b["rule"], b["metric"], b["value"], b["threshold"]))
+    for rec in state.breach_events:
+        d = rec.get("data") or {}
+        echo("  slo.breach event: %s %s=%s > %s (%s)" % (
+            d.get("rule"), d.get("metric"), d.get("value"),
+            d.get("threshold"), d.get("source", "?")))
+
+
+def watch(flow_datastore, run_id, once=False, check=False, interval=2.0,
+          slo_path=None, echo=print, max_frames=None):
+    """Tail a run. Returns the process exit code: 0, or 1 when --check
+    and an SLO breach was observed (live-evaluated or persisted)."""
+    tail = telemetry.TelemetryTail(flow_datastore, run_id)
+    rules = slo_rules_mod.load_rules(slo_path)
+    state = WatchState()
+    frames = 0
+    breaches = []
+    while True:
+        state.ingest(tail.poll())
+        breaches = slo_rules_mod.evaluate(rules, state.metrics())
+        render_frame(state, run_id, breaches, echo)
+        frames += 1
+        if once or (max_frames is not None and frames >= max_frames):
+            break
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            break
+    if check and (breaches or state.breach_events):
+        return 1
+    return 0

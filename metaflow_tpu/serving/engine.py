@@ -148,6 +148,7 @@ class SlotEngine(object):
         self._top_p = np.ones(B, np.float32)
         self._keys = np.zeros((B, 2), np.uint32)  # current step key
         self._step_keys = [None] * B              # [max_new, 2] per slot
+        self._slot_ctx = [None] * B               # request trace context
         self._key_cursor = np.zeros(B, np.int32)
         self._prompt = [None] * B                 # remaining host prompt
         self._prefill_cursor = np.zeros(B, np.int32)
@@ -264,10 +265,24 @@ class SlotEngine(object):
         self._key_cursor[slot] = 0
         self._dirty = True
 
+    def bind_slot_context(self, slot, ctx):
+        """Attach the occupant's identity/trace context ({"request_id",
+        "trace", "span"} from the scheduler) to a slot. The engine is
+        the system of record for slot->request binding, so engine-level
+        instrumentation (the serve.prefill_chunk device timer, future
+        per-slot profiling hooks) attributes device work to the request
+        that bought it."""
+        self._slot_ctx[slot] = dict(ctx) if ctx else None
+
+    def slot_context(self, slot):
+        """The context bound at admit time, or None for a free slot."""
+        return self._slot_ctx[slot]
+
     def release(self, slot):
         """Reclaim a slot immediately; the stale cache contents stay and
         are overwritten by the next occupant's prefill."""
         self.active[slot] = False
+        self._slot_ctx[slot] = None
         self.decoding[slot] = False
         self.pos[slot] = 0  # park the masked-lane write cursor
         self._prompt[slot] = None

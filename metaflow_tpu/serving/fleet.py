@@ -63,7 +63,9 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import slo as slo_rules_mod
 from .. import telemetry
+from .. import tracing
 from ..elastic.policy import BackoffPolicy
 
 
@@ -299,6 +301,12 @@ class ServingFleet(object):
         self.shed_count = 0
         self.restart_count = 0
         self.completed = 0
+        # SLO monitoring: rules come from TPUFLOW_SLO_* / TPUFLOW_SLO_FILE
+        # and are re-evaluated by the health loop against replica-reported
+        # tail latency + the supervisor's own restart history
+        self.slo_rules = slo_rules_mod.load_rules()
+        self._slo_breaches = {}       # rule name -> latest breach dict
+        self._restart_times = []      # monotonic stamps (under _lock)
         self._httpd = ThreadingHTTPServer((host, port), _FleetHandler)
         self._httpd.daemon_threads = True
         self._httpd.fleet = self
@@ -432,9 +440,47 @@ class ServingFleet(object):
                         self._schedule_restart(h)
             time.sleep(0.05)
 
+    def slo_metrics(self):
+        """Live values for the SLO rule vocabulary (slo.ENV_RULES). The
+        fleet tail is the WORST ready replica's rolling percentile — an
+        SLO holds only if every replica holds it. A percentile of 0.0
+        means the replica's window is empty (no samples yet): such
+        replicas do not contribute, and with no samples anywhere the
+        metric is absent so its rules are not evaluated."""
+        now = time.monotonic()
+        with self._lock:
+            restarts = [t for t in self._restart_times if now - t <= 60.0]
+        metrics = {"replica_restart_rate_per_min": float(len(restarts))}
+        for key in ("p99_ttft_ms", "p99_itl_ms", "p50_ttft_ms",
+                    "p50_itl_ms"):
+            vals = [h.last_stats.get(key) for h in self.handles]
+            vals = [float(v) for v in vals
+                    if isinstance(v, (int, float)) and v > 0]
+            if vals:
+                metrics[key] = max(vals)
+        return metrics
+
+    def _check_slo(self):
+        if not self.slo_rules:
+            return
+        breaches = slo_rules_mod.evaluate(self.slo_rules,
+                                          self.slo_metrics())
+        current = {b["rule"]: b for b in breaches}
+        for name, breach in current.items():
+            if name not in self._slo_breaches:
+                # rising edge only: a sustained breach is ONE event, not
+                # one per probe interval
+                telemetry.event("slo.breach",
+                                data=dict(breach, source="fleet"))
+                self.echo("fleet: SLO breach: %s %s=%s > %s"
+                          % (breach["rule"], breach["metric"],
+                             breach["value"], breach["threshold"]))
+        self._slo_breaches = current
+
     def _health_loop(self):
         while not self._stopped:
             time.sleep(self.config.health_interval_s)
+            self._check_slo()
             for h in self.handles:
                 if self._stopped or self._draining:
                     return
@@ -490,6 +536,8 @@ class ServingFleet(object):
         h.restart_at = time.monotonic() + delay
         with self._lock:
             self.restart_count += 1
+            self._restart_times.append(time.monotonic())
+            del self._restart_times[:-256]
         telemetry.event("fleet.replica.restart", data={
             "replica": h.index, "attempt": h.restarts,
             "delay_s": round(delay, 4)})
@@ -562,6 +610,15 @@ class ServingFleet(object):
             "fleet-%d" % (id(payload) & 0xFFFFFF)
         session = payload.get("session")
         stream = bool(payload.get("stream", False))
+        # the router is where a request's trace begins: mint the root
+        # traceparent here (or adopt the client's) so every dispatch
+        # attempt — including failover re-dispatch — carries a child
+        # span of the same trace to its replica
+        root_tp = handler.headers.get("Traceparent") or None
+        if root_tp is None and tracing.trace_requests_enabled():
+            root_tp = tracing.request_traceparent(str(request_id))
+        trace_id, root_span = tracing.traceparent_ids(root_tp)
+        attempt_span = ""
         deadline = None
         if payload.get("deadline_ms") is not None:
             try:
@@ -620,9 +677,18 @@ class ServingFleet(object):
                 self.dispatch_count += 1
                 n_dispatch = self.dispatch_count
                 h.dispatched += 1
-            telemetry.event("fleet.request.dispatch", data={
+            attempt_tp = None
+            dispatch_data = {
                 "request_id": str(request_id), "replica": h.index,
-                "dispatch": n_dispatch})
+                "dispatch": n_dispatch}
+            if trace_id:
+                attempt_tp = tracing.child_traceparent(
+                    root_tp, "dispatch-%d" % n_dispatch)
+                attempt_span = tracing.traceparent_ids(attempt_tp)[1]
+                dispatch_data["trace"] = trace_id
+                dispatch_data["span"] = attempt_span
+                dispatch_data["parent_span"] = root_span
+            telemetry.event("fleet.request.dispatch", data=dispatch_data)
             if self.chaos is not None:
                 victim = self.chaos.on_dispatch(n_dispatch,
                                                 len(self.handles))
@@ -630,7 +696,8 @@ class ServingFleet(object):
                     self.kill_replica(victim)
             try:
                 done, delivered, started = self._relay(
-                    handler, h, payload, request_id, stream, delivered)
+                    handler, h, payload, request_id, stream, delivered,
+                    traceparent=attempt_tp)
                 with self._lock:
                     h.inflight = max(0, h.inflight - 1)
                     if done:
@@ -669,10 +736,18 @@ class ServingFleet(object):
                     return
                 with self._lock:
                     self.failover_count += 1
-                telemetry.event("fleet.request.failover", data={
+                failover_data = {
                     "request_id": str(request_id),
                     "from_replica": h.index, "attempt": attempts,
-                    "delivered": delivered})
+                    "delivered": delivered}
+                if trace_id:
+                    # span = the attempt that died, so the assembler can
+                    # close the victim's delivered-prefix span and parent
+                    # the successor under the same request
+                    failover_data["trace"] = trace_id
+                    failover_data["span"] = attempt_span
+                telemetry.event("fleet.request.failover",
+                                data=failover_data)
                 continue
             except (BrokenPipeError, ConnectionResetError):
                 # the CLIENT went away: nothing to re-dispatch
@@ -681,7 +756,8 @@ class ServingFleet(object):
                 handler.close_connection = True
                 return
 
-    def _relay(self, handler, h, payload, request_id, stream, delivered):
+    def _relay(self, handler, h, payload, request_id, stream, delivered,
+               traceparent=None):
         """Forward one dispatch attempt; returns (done, delivered,
         started). Raises _ReplicaBackendError (carrying progress) on
         replica death."""
@@ -704,11 +780,15 @@ class ServingFleet(object):
             except (http.client.HTTPException, OSError, ValueError):
                 raise _ReplicaBackendError(delivered, started)
 
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            # per-attempt trace context: the replica stamps this span
+            # into its serve.request.* records
+            headers["Traceparent"] = traceparent
         conn = http.client.HTTPConnection(h.host, h.port, timeout=300)
         try:
             backend(lambda: conn.request(
-                "POST", "/v1/generate", body=body,
-                headers={"Content-Type": "application/json"}))
+                "POST", "/v1/generate", body=body, headers=headers))
             resp = backend(conn.getresponse)
             if resp.status in (429, 503):
                 raise _ReplicaBusyError(
@@ -805,12 +885,20 @@ class ServingFleet(object):
         ready = sum(1 for h in self.handles if h.state == "ready")
         with self._lock:
             inflight = sum(h.inflight for h in self.handles)
+        metrics = self.slo_metrics()
+        breaches = list(self._slo_breaches.values())
         return {
             "ok": ready > 0 and not self._draining,
             "draining": self._draining,
             "replicas": [h.describe() for h in self.handles],
             "ready": ready,
             "inflight": inflight,
+            # fleet tail latency (worst ready replica; null = no samples)
+            "p99_ttft_ms": metrics.get("p99_ttft_ms"),
+            "p99_itl_ms": metrics.get("p99_itl_ms"),
+            # SLO breach state: what `tpuflow watch --check` and external
+            # monitors gate on without reading telemetry
+            "slo": {"breached": bool(breaches), "breaches": breaches},
         }
 
     def stats(self):

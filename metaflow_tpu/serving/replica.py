@@ -179,6 +179,14 @@ def main(argv=None):
 
     if args.synthetic_config:
         engine = _build_synthetic(args)
+        # hermetic fleets have no run of their own, but a harness can
+        # still point every replica at a shared run's datastore so the
+        # chaos / trace e2e can reassemble request trees from replica-
+        # side records (TPUFLOW_DATASTORE_SYSROOT_LOCAL scopes the root)
+        t_flow = os.environ.get("TPUFLOW_REPLICA_TELEMETRY_FLOW")
+        t_run = os.environ.get("TPUFLOW_REPLICA_TELEMETRY_RUN")
+        if t_flow and t_run:
+            _init_replica_telemetry(t_flow, t_run, args.replica_index)
     else:
         engine = _build_from_checkpoint(args)
         _init_replica_telemetry(args.flow, args.run_id,

@@ -28,13 +28,24 @@ serve.decode_step / serve.prefill_chunk timers.
 """
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
 
 from .. import telemetry
+from .. import tracing
 
 _request_ids = itertools.count(1)
+
+
+def _pctl(values, q):
+    """Nearest-rank percentile of an unsorted sequence; 0.0 when empty."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+    return round(float(ordered[idx]), 3)
 
 
 class QueueFullError(Exception):
@@ -51,9 +62,13 @@ class Request(object):
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0, top_k=None,
                  top_p=None, eos_id=None, rng=0, deadline=None,
-                 request_id=None):
+                 request_id=None, traceparent=None):
         self.id = str(request_id) if request_id is not None \
             else "req-%d" % next(_request_ids)
+        # W3C trace context for this request (minted by the fleet router
+        # or the HTTP server; None = untraced). Stamped into every
+        # serve.request.* telemetry record.
+        self.traceparent = traceparent
         self.tokens = [int(t) for t in tokens]
         if not self.tokens:
             raise ValueError("empty prompt")
@@ -136,6 +151,12 @@ class Scheduler(object):
         self.cancelled_count = 0
         self.decode_steps = 0
         self._occupancy_sum = 0.0
+        # rolling latency windows for /v1/stats and /healthz percentiles:
+        # bounded so a long-lived server reports RECENT tail latency, not
+        # an all-time blend that a morning incident pollutes forever
+        window = int(os.environ.get("TPUFLOW_SERVE_LATENCY_WINDOW", "1024"))
+        self._ttft_window = deque(maxlen=max(1, window))
+        self._itl_window = deque(maxlen=max(1, window * 4))
 
     # ---------- intake ----------
 
@@ -156,10 +177,10 @@ class Scheduler(object):
             self._queue.append(request)
             depth = len(self._queue)
             self._cond.notify_all()
-        telemetry.event("serve.request.queued", data={
+        telemetry.event("serve.request.queued", data=self._tdata(request, {
             "request_id": request.id, "queue_depth": depth,
             "prompt_tokens": len(request.tokens),
-            "max_new_tokens": request.max_new_tokens})
+            "max_new_tokens": request.max_new_tokens}))
         telemetry.gauge("serve.queue_depth", depth)
         return request
 
@@ -175,6 +196,19 @@ class Scheduler(object):
         return False
 
     # ---------- lifecycle helpers ----------
+
+    @staticmethod
+    def _tdata(req, data):
+        """Stamp the request's trace context into an event payload so the
+        trace assembler (cmd/trace.py) can join records across replicas.
+        `span` is the dispatch-attempt span the router forwarded — two
+        attempts of one request share `trace` but differ in `span`."""
+        trace_id, span_id = tracing.traceparent_ids(
+            getattr(req, "traceparent", None))
+        if trace_id:
+            data["trace"] = trace_id
+            data["span"] = span_id
+        return data
 
     def _finish(self, req, reason):
         if req.state in ("finished", "cancelled"):
@@ -200,7 +234,7 @@ class Scheduler(object):
             data["ttft_ms"] = round((req.t_first - req.t_submit) * 1000, 3)
         if req.t_submit is not None:
             data["total_ms"] = round((req.t_done - req.t_submit) * 1000, 3)
-        telemetry.event(name, data=data)
+        telemetry.event(name, data=self._tdata(req, data))
         if ok:
             self.served += 1
         else:
@@ -209,13 +243,19 @@ class Scheduler(object):
 
     def _deliver(self, req, token):
         now = time.time()
+        prev = req.token_times[-1] if req.token_times else None
         req.generated.append(token)
         req.token_times.append(now)
         if req.t_first is None:
             req.t_first = now
-            telemetry.event("serve.request.first_token", data={
-                "request_id": req.id, "slot": req.slot,
-                "ttft_ms": round((now - req.t_submit) * 1000, 3)})
+            self._ttft_window.append((now - req.t_submit) * 1000)
+            telemetry.event("serve.request.first_token",
+                            data=self._tdata(req, {
+                                "request_id": req.id, "slot": req.slot,
+                                "ttft_ms": round(
+                                    (now - req.t_submit) * 1000, 3)}))
+        elif prev is not None:
+            self._itl_window.append((now - prev) * 1000)
         req.out.put(token)
         if req.eos_id is not None and token == req.eos_id:
             self._finish(req, "eos")
@@ -272,20 +312,25 @@ class Scheduler(object):
                 req.reason = "rejected"
                 req.state = "cancelled"
                 req.error = str(ex)
-                telemetry.event("serve.request.cancelled", data={
-                    "request_id": req.id, "reason": "rejected"})
+                telemetry.event("serve.request.cancelled",
+                                data=self._tdata(req, {
+                                    "request_id": req.id,
+                                    "reason": "rejected"}))
                 self.cancelled_count += 1
                 req.out.put(None)
                 continue
+            bind = getattr(self.engine, "bind_slot_context", None)
+            if bind is not None:
+                bind(slot, self._tdata(req, {"request_id": req.id}))
             req.slot = slot
             req.state = "prefill"
             req.t_admit = time.time()
             req.admit_iteration = self.iteration
             self._slots[slot] = req
             admitted += 1
-            telemetry.event("serve.request.prefill", data={
+            telemetry.event("serve.request.prefill", data=self._tdata(req, {
                 "request_id": req.id, "slot": slot,
-                "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)})
+                "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)}))
         return admitted
 
     def _prefill(self):
@@ -302,10 +347,18 @@ class Scheduler(object):
             req = self._slots[slot]
             t0 = time.perf_counter()
             consumed, first = self.engine.prefill_step(slot)
+            # the chunk's attribution comes from the ENGINE's slot
+            # binding (bind_slot_context at admit): device work is
+            # stamped by the layer that performed it
+            ctx = (self.engine.slot_context(slot)
+                   if hasattr(self.engine, "slot_context") else None)
+            chunk_data = dict(ctx) if ctx \
+                else self._tdata(req, {"request_id": req.id})
+            chunk_data.update({"slot": slot, "tokens": consumed})
             telemetry.emit(
                 "timer", "serve.prefill_chunk",
                 ms=(time.perf_counter() - t0) * 1000, ok=True,
-                data={"slot": slot, "tokens": consumed})
+                data=chunk_data)
             budget -= consumed
             worked = True
             if first is not None:
@@ -430,4 +483,9 @@ class Scheduler(object):
             "decode_steps": self.decode_steps,
             "iterations": self.iteration,
             "draining": self._draining,
+            # rolling-window tail latency (the SLO monitor's poll surface)
+            "p50_ttft_ms": _pctl(list(self._ttft_window), 0.50),
+            "p99_ttft_ms": _pctl(list(self._ttft_window), 0.99),
+            "p50_itl_ms": _pctl(list(self._itl_window), 0.50),
+            "p99_itl_ms": _pctl(list(self._itl_window), 0.99),
         }

@@ -31,6 +31,7 @@ import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import tracing
 from .scheduler import DrainingError, QueueFullError, Request
 
 STREAM_TIMEOUT_S = 300.0
@@ -95,6 +96,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "in_flight": stats["in_flight"],
                 "slots": stats["slots"],
                 "occupancy": stats["occupancy"],
+                # rolling tail latency: the SLO monitor polls this
+                "p50_ttft_ms": stats["p50_ttft_ms"],
+                "p99_ttft_ms": stats["p99_ttft_ms"],
+                "p50_itl_ms": stats["p50_itl_ms"],
+                "p99_itl_ms": stats["p99_itl_ms"],
             })
             return
         if self.path == "/v1/stats":
@@ -113,6 +119,13 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError) as ex:
             self._json(400, {"error": str(ex)})
             return
+        # trace context: the fleet router forwards a per-attempt
+        # Traceparent header; a direct (router-less) request gets a root
+        # traceparent minted here so its records still form a tree
+        tp = self.headers.get("Traceparent")
+        if not tp and tracing.trace_requests_enabled():
+            tp = tracing.request_traceparent(req.id)
+        req.traceparent = tp or None
         stream = bool(payload.get("stream", False))
         try:
             self.scheduler.submit(req)

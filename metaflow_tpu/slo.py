@@ -1,0 +1,109 @@
+"""Declarative SLO rules over live telemetry-derived metrics.
+
+A rule is an upper bound on one metric: ``p99_ttft_ms <= 500``. Rules come
+from a JSON file (``--slo PATH`` / ``TPUFLOW_SLO_FILE``) or from
+``TPUFLOW_SLO_*`` environment shorthands, and are evaluated in two places
+against the same metric names: the fleet supervisor's health loop (which
+emits the pinned ``slo.breach`` telemetry event and surfaces breach state
+in ``/healthz``) and the ``tpuflow watch`` watchtower (whose ``--check``
+mode exits non-zero on a breach so CI can gate on it).
+
+JSON rule file format::
+
+    {"rules": [
+        {"name": "ttft", "metric": "p99_ttft_ms", "max": 500},
+        {"name": "stall", "metric": "input_stall_frac", "max": 0.2}
+    ]}
+
+Environment shorthands (value = threshold)::
+
+    TPUFLOW_SLO_P99_TTFT_MS            -> p99_ttft_ms
+    TPUFLOW_SLO_P99_ITL_MS             -> p99_itl_ms
+    TPUFLOW_SLO_INPUT_STALL_FRAC       -> input_stall_frac
+    TPUFLOW_SLO_RESTART_RATE_PER_MIN   -> replica_restart_rate_per_min
+    TPUFLOW_SLO_DESYNC                 -> desync_count
+
+A rule whose metric is absent from the metrics dict (or None) is not
+evaluated — an idle fleet with no latency samples yet is not in breach.
+"""
+
+import json
+import os
+
+# env shorthand -> metric name; the metric vocabulary is shared with
+# ServingFleet.slo_metrics() and cmd/watch.WatchState.metrics()
+ENV_RULES = (
+    ("TPUFLOW_SLO_P99_TTFT_MS", "p99_ttft_ms"),
+    ("TPUFLOW_SLO_P99_ITL_MS", "p99_itl_ms"),
+    ("TPUFLOW_SLO_INPUT_STALL_FRAC", "input_stall_frac"),
+    ("TPUFLOW_SLO_RESTART_RATE_PER_MIN", "replica_restart_rate_per_min"),
+    ("TPUFLOW_SLO_DESYNC", "desync_count"),
+)
+
+SLO_FILE_VAR = "TPUFLOW_SLO_FILE"
+
+
+class SLORule(object):
+    """One upper-bound rule: breach when metrics[metric] > max."""
+
+    __slots__ = ("name", "metric", "max")
+
+    def __init__(self, name, metric, max):
+        self.name = str(name)
+        self.metric = str(metric)
+        self.max = float(max)
+
+    def __repr__(self):
+        return "SLORule(%s: %s <= %g)" % (self.name, self.metric, self.max)
+
+
+def load_rules(path=None, env=None):
+    """Rules from a JSON file and/or TPUFLOW_SLO_* env vars (file first,
+    env appended). Returns [] when neither is configured. A malformed
+    file raises ValueError — a silently dropped SLO is worse than a
+    failed startup."""
+    env = os.environ if env is None else env
+    rules = []
+    path = path or env.get(SLO_FILE_VAR)
+    if path:
+        with open(path) as f:
+            doc = json.load(f)
+        entries = doc.get("rules") if isinstance(doc, dict) else None
+        if not isinstance(entries, list):
+            raise ValueError(
+                "SLO file %s must be {\"rules\": [...]}" % path)
+        for e in entries:
+            try:
+                rules.append(SLORule(
+                    e.get("name", e["metric"]), e["metric"], e["max"]))
+            except (KeyError, TypeError, ValueError):
+                raise ValueError("bad SLO rule in %s: %r" % (path, e))
+    for var, metric in ENV_RULES:
+        raw = env.get(var)
+        if raw in (None, ""):
+            continue
+        try:
+            rules.append(SLORule(metric, metric, float(raw)))
+        except ValueError:
+            raise ValueError("%s=%r is not a number" % (var, raw))
+    return rules
+
+
+def evaluate(rules, metrics):
+    """Breach dicts for every rule whose metric exceeds its bound. The
+    dict shape is pinned as SLO_BREACH_SCHEMA — it is also the data
+    payload of the slo.breach telemetry event."""
+    breaches = []
+    for rule in rules:
+        value = metrics.get(rule.metric)
+        if value is None:
+            continue
+        value = float(value)
+        if value > rule.max:
+            breaches.append({
+                "rule": rule.name,
+                "metric": rule.metric,
+                "value": round(value, 4),
+                "threshold": rule.max,
+            })
+    return breaches

@@ -98,6 +98,13 @@ class FlightRecorder(object):
         # blocking failed upload (nor grow the buffer without bound)
         self._flush_fail_until = 0.0
         self._max_buffered = max(self._flush_every * 8, 4096)
+        # flush-failure visibility: failed attempts / shed records are
+        # counted here and reported as telemetry.flush_failed +
+        # telemetry.dropped_records on the first flush that lands again
+        self._flush_failures = 0
+        self._fail_buffered = 0
+        self._dropped = 0
+        self._dropped_reported = 0
 
     # ---------- emit ----------
 
@@ -135,7 +142,9 @@ class FlightRecorder(object):
             if len(self._buf) > self._max_buffered:
                 # storage has been down long enough to hit the cap: shed
                 # the oldest half rather than grow without bound
-                del self._buf[: len(self._buf) // 2]
+                shed = len(self._buf) // 2
+                del self._buf[:shed]
+                self._dropped += shed
             want_flush = len(self._buf) >= self._flush_every
         if want_flush:
             self.flush()
@@ -209,7 +218,27 @@ class FlightRecorder(object):
                 # (readers take every part), a clobber is not
                 self._buf[:0] = records
                 self._flush_fail_until = time.monotonic() + 30.0
+                self._flush_failures += 1
+                self._fail_buffered = len(self._buf)
             return 0
+        with self._lock:
+            failures, self._flush_failures = self._flush_failures, 0
+            buffered, self._fail_buffered = self._fail_buffered, 0
+            dropped_new = self._dropped - self._dropped_reported
+            self._dropped_reported = self._dropped
+        if failures:
+            # first flush to land after an outage: make the outage (and
+            # anything shed during it) visible in the record stream
+            self.counter("telemetry.flush_failed", inc=failures,
+                         data={"buffered": buffered})
+        if dropped_new:
+            self.gauge("telemetry.dropped_records", self._dropped,
+                       data={"dropped_since_last_flush": dropped_new})
+        if failures or dropped_new:
+            # persist the visibility records now — the recursion is
+            # bounded: the counters were just zeroed, so the inner call
+            # cannot emit again (and a close() must not strand them)
+            self.flush(force=force)
         return len(records)
 
     def close(self):
@@ -340,6 +369,55 @@ def read_run_records(flow_datastore, run_id):
                             continue
     records.sort(key=lambda r: r.get("ts", 0))
     return records
+
+
+class TelemetryTail(object):
+    """Incremental reader over a run's _telemetry/ part files.
+
+    Part files are write-once (the recorder never rewrites a landed
+    part), so a path-cursor delta over list_content is exact: each poll()
+    lists the prefix, loads only paths not yet seen, and returns their
+    records sorted by timestamp. This is what lets `tpuflow watch` tail a
+    run that is still producing records without the full re-read
+    read_run_records does on every refresh."""
+
+    def __init__(self, flow_datastore, run_id):
+        self._fds = flow_datastore
+        self.run_id = str(run_id)
+        self._seen = set()
+
+    def poll(self):
+        """Records from part files that appeared since the last poll()
+        (all of them on the first call). [] when nothing new — including
+        when the run has not written any telemetry yet."""
+        storage = self._fds.storage
+        prefix = storage.path_join(
+            self._fds.flow_name, self.run_id, TELEMETRY_PREFIX)
+        try:
+            paths = [p for p, is_file in storage.list_content([prefix])
+                     if is_file and p.endswith(".jsonl")]
+        except Exception:
+            # an in-progress run may not have created _telemetry/ yet
+            return []
+        new = sorted(p for p in paths if p not in self._seen)
+        if not new:
+            return []
+        self._seen.update(new)
+        records = []
+        with storage.load_bytes(new) as loaded:
+            for _path, local, _meta in loaded:
+                if local is None:
+                    continue
+                with open(local, "rb") as f:
+                    for line in f.read().decode("utf-8").splitlines():
+                        if not line.strip():
+                            continue
+                        try:
+                            records.append(json.loads(line))
+                        except ValueError:
+                            continue
+        records.sort(key=lambda r: r.get("ts", 0))
+        return records
 
 
 def list_run_profiles(flow_datastore, run_id):

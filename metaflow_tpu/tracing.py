@@ -154,3 +154,67 @@ def get_trace_id():
         return format(ctx.trace_id, "032x") if ctx.is_valid else ""
     except ImportError:
         return ""
+
+
+# ---------------------------------------------------------------------------
+# Per-request trace context (serving path)
+#
+# The fleet router mints one traceparent per request and forwards it as an
+# HTTP header on every dispatch (including failover re-dispatch), deriving a
+# fresh child span id per attempt. Replicas stamp the received trace/span
+# into every serve.request.* telemetry record, so `tpuflow trace` can
+# reassemble queued -> dispatch -> prefill -> first_token -> failover ->
+# finished as ONE tree from the records alone. All ids are deterministic
+# sha256 derivations: a re-run with the same request ids produces the same
+# tree, and no coordination between router and replicas is needed.
+# ---------------------------------------------------------------------------
+
+_TRACE_REQUESTS_VAR = "TPUFLOW_TRACE_REQUESTS"
+
+
+def trace_requests_enabled(env=None):
+    """Per-request tracing is on unless TPUFLOW_TRACE_REQUESTS=0."""
+    return (env if env is not None else os.environ).get(
+        _TRACE_REQUESTS_VAR, "1") != "0"
+
+
+def _hexdigest(seed, n):
+    import hashlib
+
+    return hashlib.sha256(seed.encode()).hexdigest()[:n]
+
+
+def request_traceparent(request_id):
+    """Mint the root traceparent for one serving request.
+
+    The trace id joins the ambient run trace (TRACEPARENT set by
+    ensure_traceparent / the launching driver) when one exists, so request
+    subtrees nest under the run; otherwise it is derived from the request
+    id alone. The span id is always derived from the request id — it is
+    the root of the request's subtree."""
+    ambient = os.environ.get(_TRACEPARENT_VAR, "")
+    parts = ambient.split("-")
+    if len(parts) >= 3 and len(parts[1]) == 32:
+        trace_id = parts[1]
+    else:
+        trace_id = _hexdigest("tpuflow-request-trace:%s" % request_id, 32)
+    span_id = _hexdigest("tpuflow-request:%s" % request_id, 16)
+    return "00-%s-%s-01" % (trace_id, span_id)
+
+
+def child_traceparent(traceparent, key):
+    """Derive a child traceparent: same trace id, span id keyed off the
+    parent span + `key` (e.g. "dispatch-2" for the second dispatch
+    attempt). Deterministic so the assembler can re-derive parentage."""
+    trace_id, span_id = traceparent_ids(traceparent)
+    child = _hexdigest("tpuflow-span:%s:%s" % (span_id, key), 16)
+    return "00-%s-%s-01" % (trace_id, child)
+
+
+def traceparent_ids(traceparent):
+    """Split a W3C traceparent into (trace_id, span_id); ("", "") when
+    malformed or absent."""
+    parts = (traceparent or "").split("-")
+    if len(parts) >= 3 and len(parts[1]) == 32 and len(parts[2]) == 16:
+        return parts[1], parts[2]
+    return "", ""

@@ -1,0 +1,91 @@
+"""Watchtower + SLO monitors end to end: the start step serves a small
+traced request stream in-process (its serve.request.* lifecycle events
+land in the run's own telemetry through the task flight recorder), then
+the watch step tails the SAME run while it is still in progress —
+a single `tpuflow watch --once` frame must render, `--check` must exit
+non-zero under a deliberately tight SLO and zero without rules — and
+reassembles the per-request trace trees from telemetry alone."""
+
+from metaflow_tpu import FlowSpec, current, step
+
+
+class WatchSloFlow(FlowSpec):
+    @step
+    def start(self):
+        import jax
+
+        from metaflow_tpu import telemetry, tracing
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.serving import Request, Scheduler, SlotEngine
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(jax.random.PRNGKey(3), cfg)
+        engine = SlotEngine(params, cfg, max_slots=2, max_seq_len=64,
+                            prefill_chunk=16)
+        sched = Scheduler(engine)
+        for i in range(4):
+            req = Request(list(range(1, 6 + i)), max_new_tokens=3,
+                          rng=i, request_id="watch-%d" % i)
+            req.traceparent = tracing.request_traceparent(req.id)
+            sched.submit(req)
+        sched.run_until_idle(100_000)
+        # land the serve.request.* records now so the NEXT step can tail
+        # them while this run is still in progress
+        telemetry.flush()
+        self.n_requests = 4
+        self.next(self.watchtower)
+
+    @step
+    def watchtower(self):
+        import json
+        import os
+        import tempfile
+
+        from metaflow_tpu import metaflow_config as mf_cfg
+        from metaflow_tpu import telemetry
+        from metaflow_tpu.cmd.trace import (
+            build_request_traces,
+            ttft_decomposition,
+        )
+        from metaflow_tpu.cmd.watch import watch as watch_run
+        from metaflow_tpu.datastore import STORAGE_BACKENDS, FlowDataStore
+
+        storage = STORAGE_BACKENDS[mf_cfg.default_datastore()]
+        fds = FlowDataStore(current.flow_name, storage)
+        run_id = str(current.run_id)
+        # one frame against the in-progress run; no rules -> exit 0
+        rc = watch_run(fds, run_id, once=True, check=True)
+        assert rc == 0, "no SLO rules configured but --check failed"
+        # a deliberately tight SLO must trip --check
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".json", delete=False) as f:
+            json.dump({"rules": [{"name": "tight-ttft",
+                                  "metric": "p99_ttft_ms",
+                                  "max": 0.001}]}, f)
+            slo_path = f.name
+        try:
+            rc = watch_run(fds, run_id, once=True, check=True,
+                           slo_path=slo_path)
+        finally:
+            os.unlink(slo_path)
+        assert rc == 1, "tight SLO did not trip watch --check"
+        # the request trace trees reassemble from telemetry alone
+        records = telemetry.read_run_records(fds, run_id)
+        trees = [t for t in build_request_traces(records)
+                 if str(t["request_id"]).startswith("watch-")]
+        assert len(trees) == self.n_requests, \
+            "expected %d trace trees, got %d" % (self.n_requests,
+                                                 len(trees))
+        assert all(t["trace"] for t in trees)
+        self.decomps = [ttft_decomposition(t) for t in trees]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert all(d is not None for d in self.decomps)
+        print("watchtower traced %d request(s); ttft decompositions ok"
+              % len(self.decomps))
+
+
+if __name__ == "__main__":
+    WatchSloFlow()

@@ -334,32 +334,42 @@ TELEMETRY_RECORD_SCHEMA = _obj(
 # one) fails validation, protecting dashboards keyed on TTFT/queue-wait.
 # ---------------------------------------------------------------------------
 
+# per-request W3C trace context stamped by the serving stack
+# (scheduler._tdata / fleet.handle_generate): optional on every request
+# lifecycle event, present whenever TPUFLOW_TRACE_REQUESTS != 0
+_TRACE_HEX = {"type": "string", "pattern": "^[0-9a-f]{32}$"}
+_SPAN_HEX = {"type": "string", "pattern": "^[0-9a-f]{16}$"}
+
 SERVING_EVENT_DATA_SCHEMAS = {
     "serve.request.queued": _obj(
         {"request_id": _STR, "queue_depth": _INT, "prompt_tokens": _INT,
-         "max_new_tokens": _INT},
+         "max_new_tokens": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "queue_depth", "prompt_tokens",
                   "max_new_tokens"),
     ),
     "serve.request.prefill": _obj(
-        {"request_id": _STR, "slot": _INT, "queue_ms": _NUM},
+        {"request_id": _STR, "slot": _INT, "queue_ms": _NUM,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "slot", "queue_ms"),
     ),
     "serve.request.first_token": _obj(
-        {"request_id": _STR, "slot": _INT, "ttft_ms": _NUM},
+        {"request_id": _STR, "slot": _INT, "ttft_ms": _NUM,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "slot", "ttft_ms"),
     ),
     "serve.request.finished": _obj(
         {"request_id": _STR, "slot": _INT,
          "reason": {"enum": ["eos", "length"]},
-         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM},
+         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason", "new_tokens"),
     ),
     "serve.request.cancelled": _obj(
         {"request_id": _STR, "slot": _INT,
          "reason": {"enum": ["cancelled", "deadline", "shutdown",
                              "rejected"]},
-         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM},
+         "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason"),
     ),
 }
@@ -698,12 +708,14 @@ FLEET_EVENT_DATA_SCHEMAS = {
         required=("replica", "attempt", "delay_s"),
     ),
     "fleet.request.dispatch": _obj(
-        {"request_id": _STR, "replica": _INT, "dispatch": _INT},
+        {"request_id": _STR, "replica": _INT, "dispatch": _INT,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX,
+         "parent_span": _SPAN_HEX},
         required=("request_id", "replica", "dispatch"),
     ),
     "fleet.request.failover": _obj(
         {"request_id": _STR, "from_replica": _INT, "attempt": _INT,
-         "delivered": _INT},
+         "delivered": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "from_replica", "attempt", "delivered"),
     ),
     "fleet.request.shed": _obj(
@@ -756,9 +768,16 @@ HEALTHZ_SCHEMA = _obj(
         "in_flight": _INT,
         "slots": _INT,
         "occupancy": _NUM,
+        # rolling-window tail latency (scheduler.stats): what the fleet
+        # SLO monitor polls; 0.0 until the window has samples
+        "p50_ttft_ms": _NUM,
+        "p99_ttft_ms": _NUM,
+        "p50_itl_ms": _NUM,
+        "p99_itl_ms": _NUM,
     },
     required=("ok", "draining", "queue_depth", "in_flight", "slots",
-              "occupancy"),
+              "occupancy", "p50_ttft_ms", "p99_ttft_ms", "p50_itl_ms",
+              "p99_itl_ms"),
 )
 
 _REPLICA_DESCRIBE = _obj(
@@ -779,8 +798,22 @@ _REPLICA_DESCRIBE = _obj(
               "restarts", "generation"),
 )
 
+# slo.breach event data payload (slo.evaluate + the "source" the
+# emitter adds): also embedded in fleet /healthz breach state
+SLO_BREACH_SCHEMA = _obj(
+    {
+        "rule": _STR,
+        "metric": _STR,
+        "value": _NUM,
+        "threshold": _NUM,
+        "source": _STR,
+    },
+    required=("rule", "metric", "value", "threshold"),
+)
+
 # fleet-router /healthz (serving/fleet.py): the supervisor's aggregate
-# view — per-replica state plus fleet readiness.
+# view — per-replica state plus fleet readiness, tail latency (worst
+# ready replica; null until samples exist) and SLO breach state.
 FLEET_HEALTHZ_SCHEMA = _obj(
     {
         "ok": _BOOL,
@@ -788,8 +821,15 @@ FLEET_HEALTHZ_SCHEMA = _obj(
         "replicas": _arr(_REPLICA_DESCRIBE),
         "ready": _INT,
         "inflight": _INT,
+        "p99_ttft_ms": {"type": ["number", "null"]},
+        "p99_itl_ms": {"type": ["number", "null"]},
+        "slo": _obj(
+            {"breached": _BOOL, "breaches": _arr(SLO_BREACH_SCHEMA)},
+            required=("breached", "breaches"),
+        ),
     },
-    required=("ok", "draining", "replicas", "ready", "inflight"),
+    required=("ok", "draining", "replicas", "ready", "inflight",
+              "p99_ttft_ms", "p99_itl_ms", "slo"),
 )
 
 
@@ -803,6 +843,67 @@ def validate_fleet_healthz(payload):
     """Validate a fleet-router /healthz response body."""
     jsonschema.validate(payload, FLEET_HEALTHZ_SCHEMA,
                         cls=jsonschema.Draft202012Validator)
+
+
+def validate_slo_breach_record(record):
+    """Validate a pinned slo.breach flight-recorder event record."""
+    validate_telemetry_record(record)
+    if record.get("type") != "event" or record.get("name") != "slo.breach":
+        raise jsonschema.ValidationError(
+            "expected an slo.breach event record, got type=%r name=%r"
+            % (record.get("type"), record.get("name")))
+    jsonschema.validate(record.get("data", {}), SLO_BREACH_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+# ---------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export (cmd/trace.py): the pinned shape of
+# one entry in traceEvents. Only the phases the exporter emits are legal —
+# "X" (complete slice, ts+dur in microseconds), "M" (process/thread name
+# metadata), "i" (instant). additionalProperties: false so an invented
+# field breaks here before it breaks in the Perfetto UI.
+# ---------------------------------------------------------------------------
+
+TRACE_RECORD_SCHEMA = _obj(
+    {
+        "name": _STR,
+        "ph": {"enum": ["X", "M", "i"]},
+        "ts": _NUM,
+        "dur": _NUM,
+        "pid": _INT,
+        "tid": _INT,
+        "s": {"enum": ["t", "p", "g"]},
+        "args": {"type": "object"},
+    },
+    required=("name", "ph", "ts", "pid", "tid"),
+)
+
+PERFETTO_TRACE_SCHEMA = _obj(
+    {
+        "traceEvents": _arr(TRACE_RECORD_SCHEMA),
+        "displayTimeUnit": {"enum": ["ms", "ns"]},
+    },
+    required=("traceEvents", "displayTimeUnit"),
+)
+
+
+def validate_trace_event(entry):
+    """Validate one Perfetto trace-event entry."""
+    jsonschema.validate(entry, TRACE_RECORD_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+    if entry["ph"] == "X" and "dur" not in entry:
+        raise jsonschema.ValidationError(
+            "complete slice (ph=X) %r missing dur" % entry["name"])
+
+
+def validate_perfetto_trace(doc):
+    """Validate a full Perfetto trace-event JSON document."""
+    jsonschema.validate(doc, PERFETTO_TRACE_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+    for entry in doc["traceEvents"]:
+        if entry["ph"] == "X" and "dur" not in entry:
+            raise jsonschema.ValidationError(
+                "complete slice (ph=X) %r missing dur" % entry["name"])
 
 
 # ---------------------------------------------------------------------------

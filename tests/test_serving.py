@@ -603,8 +603,16 @@ class TestServeBench:
         assert result["value"] > 0
         subs = {s["metric"]: s["value"] for s in result["submetrics"]}
         assert set(subs) == {"serve_p50_ms", "serve_p99_ms",
-                             "serve_batch_occupancy"}
+                             "serve_batch_occupancy",
+                             "serve_tracing_overhead_pct",
+                             "serve_ttft_decomp_err_pct"}
         assert subs["serve_p99_ms"] >= subs["serve_p50_ms"] > 0
         assert 0 < subs["serve_batch_occupancy"] <= 1
+        # request tracing must be ~free (min-of-3 interleaved passes) and
+        # the TTFT decomposition must reconstruct the measured TTFT
+        assert 0 <= subs["serve_tracing_overhead_pct"] <= 2.0, \
+            "request tracing overhead above 2%%: %s" % result
+        assert 0 <= subs["serve_ttft_decomp_err_pct"] <= 5.0, \
+            "TTFT decomposition inconsistent with measured TTFT: %s" % result
         assert result["extra"]["speedup_vs_lockstep"] >= 1.5, \
             "continuous batching must beat lockstep by 1.5x: %s" % result

@@ -1,0 +1,381 @@
+"""Watchtower + SLO monitors: incremental telemetry tailing
+(TelemetryTail), WatchState aggregation, `tpuflow watch --once/--check`
+exit semantics, declarative SLO rules (file + env), the fleet
+supervisor's rising-edge slo.breach emission and /healthz breach state,
+flush-failure visibility, and the `tpuflow metrics --step/--rank`
+filters."""
+
+import json
+import time
+
+import pytest
+
+from metaflow_tpu import slo, telemetry
+from metaflow_tpu.cmd.watch import WatchState, render_frame, watch
+from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+
+def _base(name, rtype, ts, **kw):
+    rec = {"v": 1, "type": rtype, "name": name, "ts": ts, "run_id": "1",
+           "step": "train", "task_id": "t", "attempt": 0, "rank": 0,
+           "host": "h", "pid": 1}
+    rec.update(kw)
+    return rec
+
+
+def _fds(tmp_path, flow="WatchTest"):
+    return FlowDataStore(flow, LocalStorage, ds_root=str(tmp_path))
+
+
+class TestTelemetryTail:
+    def test_incremental_poll_returns_only_new_parts(self, tmp_path):
+        fds = _fds(tmp_path)
+        rec = telemetry.init_recorder(fds, "1", "train", "t0")
+        tail = telemetry.TelemetryTail(fds, "1")
+        assert tail.poll() == []  # nothing persisted yet
+        try:
+            rec.event("a.one", data={"k": 1})
+            rec.flush(force=True)
+            first = tail.poll()
+            assert [r["name"] for r in first] == ["a.one"]
+            assert tail.poll() == []  # no re-read of seen parts
+            rec.event("a.two")
+            rec.event("a.three")
+            rec.flush(force=True)
+            second = tail.poll()
+            assert [r["name"] for r in second] == ["a.two", "a.three"]
+            assert tail.poll() == []
+        finally:
+            telemetry.close_recorder()
+
+    def test_poll_on_missing_run_is_empty(self, tmp_path):
+        tail = telemetry.TelemetryTail(_fds(tmp_path), "no-such-run")
+        assert tail.poll() == []
+
+
+class TestWatchState:
+    def _feed(self, state):
+        t0 = 1000.0
+        recs = []
+        # train: 3 ranks, rank 2 is a straggler
+        for step_num in range(4):
+            for rank, ms in ((0, 100.0), (1, 100.0), (2, 150.0)):
+                recs.append(_base(
+                    "train.step", "timer", t0 + step_num, rank=rank,
+                    ms=ms, step_num=step_num,
+                    data={"input_stall_ms": 10.0, "tokens_per_sec": 500.0,
+                          "mfu": 0.31}))
+        recs.append(_base("serve.queue_depth", "gauge", t0 + 5, value=3))
+        recs.append(_base("serve.batch_occupancy", "gauge", t0 + 5,
+                          value=0.75))
+        recs.append(_base("fleet.replicas_ready", "gauge", t0 + 5,
+                          value=2))
+        for i in range(4):
+            recs.append(_base(
+                "serve.request.first_token", "event", t0 + 6 + i,
+                data={"request_id": "r%d" % i, "slot": 0,
+                      "ttft_ms": 40.0 + i}))
+            recs.append(_base(
+                "serve.request.finished", "event", t0 + 7 + i,
+                data={"request_id": "r%d" % i, "reason": "length",
+                      "new_tokens": 5, "ttft_ms": 40.0 + i,
+                      "total_ms": 140.0 + i}))
+        recs.append(_base("fleet.replica.dead", "event", t0 + 12,
+                          data={"replica": 1, "pid": 9, "inflight": 1}))
+        recs.append(_base("fleet.replica.restart", "event", t0 + 13,
+                          data={"replica": 1, "attempt": 1,
+                                "delay_s": 0.1}))
+        recs.append(_base("sanitize.desync", "event", t0 + 14,
+                          data={"barrier": 1}))
+        recs.append(_base("telemetry.flush_failed", "counter", t0 + 15,
+                          inc=3, data={"buffered": 12}))
+        state.ingest(recs)
+        return state
+
+    def test_metrics_vocabulary(self):
+        m = self._feed(WatchState()).metrics()
+        assert m["step_ms"] == round(350.0 / 3, 3)
+        assert m["input_stall_frac"] == round(10.0 / (350.0 / 3), 4)
+        assert m["train_tokens_per_sec"] == 500.0
+        assert m["mfu"] == 0.31
+        assert m["straggler_skew"] == 1.5  # rank 2 mean / median
+        assert m["p50_ttft_ms"] == 42.0
+        assert m["p99_ttft_ms"] == 43.0
+        # ITL = (total - ttft) / (new_tokens - 1) = 100/4
+        assert m["p50_itl_ms"] == 25.0
+        assert m["replica_flaps"] == 1
+        assert m["replica_restart_rate_per_min"] == 1.0
+        assert m["desync_count"] == 1.0
+        assert m["flush_failures"] == 3
+        assert m["serve_tokens_per_sec"] > 0
+
+    def test_idle_state_has_no_latency_metrics(self):
+        m = WatchState().metrics()
+        for key in ("p50_ttft_ms", "p99_ttft_ms", "p50_itl_ms",
+                    "p99_itl_ms", "step_ms", "input_stall_frac"):
+            assert key not in m, "idle must not report 0ms %s" % key
+
+    def test_render_frame_covers_sections(self):
+        state = self._feed(WatchState())
+        state.breach_events.append(_base(
+            "slo.breach", "event", 2000.0,
+            data={"rule": "ttft", "metric": "p99_ttft_ms", "value": 43.0,
+                  "threshold": 5.0, "source": "fleet"}))
+        lines = []
+        render_frame(state, "1", breaches=[
+            {"rule": "live", "metric": "desync_count", "value": 1.0,
+             "threshold": 0.0}], echo=lines.append)
+        text = "\n".join(lines)
+        assert "train:" in text and "serve:" in text
+        assert "fleet:" in text and "incidents:" in text
+        assert "SLO BREACH: live" in text
+        assert "slo.breach event: ttft" in text
+
+
+class TestSLORules:
+    def test_env_rules(self):
+        rules = slo.load_rules(env={"TPUFLOW_SLO_P99_TTFT_MS": "500",
+                                    "TPUFLOW_SLO_DESYNC": "0"})
+        assert {(r.metric, r.max) for r in rules} == \
+            {("p99_ttft_ms", 500.0), ("desync_count", 0.0)}
+        assert slo.load_rules(env={}) == []
+
+    def test_file_rules_and_env_append(self, tmp_path):
+        path = tmp_path / "slo.json"
+        path.write_text(json.dumps({"rules": [
+            {"name": "ttft", "metric": "p99_ttft_ms", "max": 500},
+            {"metric": "input_stall_frac", "max": 0.2}]}))
+        rules = slo.load_rules(str(path),
+                               env={"TPUFLOW_SLO_DESYNC": "0"})
+        assert [r.name for r in rules] == \
+            ["ttft", "input_stall_frac", "desync_count"]
+        # TPUFLOW_SLO_FILE is the env-var spelling of --slo
+        rules = slo.load_rules(env={"TPUFLOW_SLO_FILE": str(path)})
+        assert len(rules) == 2
+
+    def test_malformed_file_raises(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"rules": [{"name": "x"}]}))
+        with pytest.raises(ValueError):
+            slo.load_rules(str(bad))
+        bad.write_text(json.dumps(["not", "a", "dict"]))
+        with pytest.raises(ValueError):
+            slo.load_rules(str(bad))
+        with pytest.raises(ValueError):
+            slo.load_rules(env={"TPUFLOW_SLO_DESYNC": "lots"})
+
+    def test_evaluate_skips_absent_metrics(self):
+        rules = slo.load_rules(env={"TPUFLOW_SLO_P99_TTFT_MS": "5",
+                                    "TPUFLOW_SLO_DESYNC": "0"})
+        breaches = slo.evaluate(rules, {"desync_count": 2.0})
+        assert breaches == [{"rule": "desync_count",
+                             "metric": "desync_count", "value": 2.0,
+                             "threshold": 0.0}]
+        assert slo.evaluate(rules, {}) == []
+        assert slo.evaluate(rules, {"p99_ttft_ms": 4.9,
+                                    "desync_count": 0.0}) == []
+
+
+def _serve_run(tmp_path, breach_event=False):
+    """Persist a small serve-shaped record stream; returns its fds."""
+    fds = _fds(tmp_path, flow="WatchRun")
+    rec = telemetry.init_recorder(fds, "1", "_serve", "t0")
+    try:
+        now = time.time()
+        rec.gauge("serve.queue_depth", 2)
+        for i in range(3):
+            rec.event("serve.request.first_token",
+                      data={"request_id": "r%d" % i, "slot": 0,
+                            "ttft_ms": 80.0})
+            rec.event("serve.request.finished",
+                      data={"request_id": "r%d" % i, "reason": "length",
+                            "new_tokens": 4, "ttft_ms": 80.0,
+                            "total_ms": 120.0})
+        if breach_event:
+            rec.event("slo.breach",
+                      data={"rule": "ttft", "metric": "p99_ttft_ms",
+                            "value": 80.0, "threshold": 5.0,
+                            "source": "fleet"})
+        assert now  # records carry real timestamps
+    finally:
+        telemetry.close_recorder()
+    return fds
+
+
+class TestWatchCommand:
+    def test_once_renders_in_progress_run(self, tmp_path, monkeypatch):
+        for var, _m in slo.ENV_RULES:
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.delenv(slo.SLO_FILE_VAR, raising=False)
+        fds = _serve_run(tmp_path)
+        lines = []
+        rc = watch(fds, "1", once=True, check=True, echo=lines.append)
+        assert rc == 0
+        text = "\n".join(lines)
+        assert "watch 1" in text and "serve:" in text
+        assert "ttft p50/p99 80.0/80.0" in text
+
+    def test_check_exits_nonzero_on_env_rule_breach(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("TPUFLOW_SLO_P99_TTFT_MS", "5")
+        fds = _serve_run(tmp_path)
+        lines = []
+        rc = watch(fds, "1", once=True, check=True, echo=lines.append)
+        assert rc == 1
+        assert any("SLO BREACH" in l for l in lines)
+        # without --check the same breach renders but does not fail
+        assert watch(fds, "1", once=True, echo=lines.append) == 0
+
+    def test_check_exits_nonzero_on_persisted_breach_event(
+            self, tmp_path, monkeypatch):
+        for var, _m in slo.ENV_RULES:
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.delenv(slo.SLO_FILE_VAR, raising=False)
+        fds = _serve_run(tmp_path, breach_event=True)
+        lines = []
+        rc = watch(fds, "1", once=True, check=True, echo=lines.append)
+        assert rc == 1
+        assert any("slo.breach event" in l for l in lines)
+
+    def test_slo_file_argument(self, tmp_path, monkeypatch):
+        for var, _m in slo.ENV_RULES:
+            monkeypatch.delenv(var, raising=False)
+        path = tmp_path / "rules.json"
+        path.write_text(json.dumps({"rules": [
+            {"name": "tight", "metric": "p99_ttft_ms", "max": 1}]}))
+        fds = _serve_run(tmp_path / "ds")
+        rc = watch(fds, "1", once=True, check=True, slo_path=str(path),
+                   echo=lambda *_a: None)
+        assert rc == 1
+
+
+class TestFleetSLO:
+    def test_rising_edge_breach_event_and_healthz(self, tmp_path):
+        from schema_validate import (
+            validate_fleet_healthz,
+            validate_slo_breach_record,
+        )
+
+        from metaflow_tpu.serving.fleet import ServingFleet
+
+        fds = _fds(tmp_path, flow="FleetSLO")
+        telemetry.init_recorder(fds, "1", "_serve", "slo-test")
+        try:
+            fleet = ServingFleet(lambda i, g: (_ for _ in ()).throw(
+                RuntimeError("never spawned")), 1)
+            fleet.slo_rules = slo.load_rules(
+                env={"TPUFLOW_SLO_P99_TTFT_MS": "5"})
+            fleet.handles[0].state = "ready"
+            fleet.handles[0].last_stats = {"p99_ttft_ms": 50.0,
+                                           "p99_itl_ms": 7.0}
+            assert fleet.slo_metrics()["p99_ttft_ms"] == 50.0
+            fleet._check_slo()
+            fleet._check_slo()  # sustained breach: still ONE event
+            body = fleet.healthz()
+            validate_fleet_healthz(body)
+            assert body["slo"]["breached"] is True
+            assert body["slo"]["breaches"][0]["metric"] == "p99_ttft_ms"
+            assert body["p99_ttft_ms"] == 50.0
+            # breach clears -> healthz clears; a NEW breach re-emits
+            fleet.handles[0].last_stats = {"p99_ttft_ms": 1.0}
+            fleet._check_slo()
+            assert fleet.healthz()["slo"]["breached"] is False
+            fleet.handles[0].last_stats = {"p99_ttft_ms": 60.0}
+            fleet._check_slo()
+        finally:
+            telemetry.close_recorder()
+        records = telemetry.read_run_records(fds, "1")
+        breaches = [r for r in records if r["name"] == "slo.breach"]
+        assert len(breaches) == 2, "rising-edge only: clear then re-breach"
+        for rec in breaches:
+            validate_slo_breach_record(rec)
+            assert rec["data"]["source"] == "fleet"
+
+    def test_empty_window_is_not_a_breach(self):
+        from metaflow_tpu.serving.fleet import ServingFleet
+
+        fleet = ServingFleet(lambda i, g: None, 1)
+        fleet.slo_rules = slo.load_rules(
+            env={"TPUFLOW_SLO_P99_TTFT_MS": "5"})
+        # no samples anywhere: metric absent, rule not evaluated
+        assert "p99_ttft_ms" not in fleet.slo_metrics()
+        fleet._check_slo()
+        assert fleet.healthz()["slo"]["breached"] is False
+
+
+class TestFlushFailureVisibility:
+    def test_flush_failed_counter_and_dropped_gauge(self, tmp_path):
+        fds = _fds(tmp_path, flow="FlushFail")
+        rec = telemetry.FlightRecorder(fds, "1", "train", "t0",
+                                       flush_every=10_000)
+        real_save = fds.storage.save_bytes
+
+        def broken(*_a, **_k):
+            raise OSError("datastore down")
+
+        fds.storage.save_bytes = broken
+        rec._max_buffered = 4  # hit the shed path without 4096 emits
+        for i in range(6):
+            rec.event("work.item", data={"i": i})
+            rec.flush(force=True)  # every attempt fails, buffer retained
+        assert rec._flush_failures >= 1
+        assert rec._dropped > 0  # cap hit: oldest half shed
+        fds.storage.save_bytes = real_save
+        rec.close()  # first flush to land + the visibility records
+        records = telemetry.read_run_records(fds, "1")
+        failed = [r for r in records
+                  if r["name"] == "telemetry.flush_failed"]
+        assert len(failed) == 1
+        assert failed[0]["type"] == "counter"
+        assert failed[0]["inc"] >= 1
+        assert failed[0]["data"]["buffered"] > 0
+        dropped = [r for r in records
+                   if r["name"] == "telemetry.dropped_records"]
+        assert len(dropped) == 1
+        assert dropped[0]["value"] == rec._dropped
+        assert dropped[0]["data"]["dropped_since_last_flush"] > 0
+        # the work that survived the outage landed too
+        assert any(r["name"] == "work.item" for r in records)
+
+    def test_flush_failure_never_raises(self, tmp_path):
+        fds = _fds(tmp_path, flow="FlushFail2")
+        rec = telemetry.FlightRecorder(fds, "1", "train", "t0",
+                                       flush_every=10_000)
+        fds.storage.save_bytes = lambda *_a, **_k: (_ for _ in ()).throw(
+            OSError("down"))
+        rec.event("x")
+        assert rec.flush(force=True) == 0  # swallowed, not raised
+
+
+class TestMetricsFilters:
+    def test_filter_records_by_step_and_rank(self):
+        from metaflow_tpu.cmd.metrics import filter_records
+
+        recs = [_base("train.step", "timer", 1.0, ms=5.0, step_num=0),
+                _base("train.step", "timer", 2.0, ms=6.0, step_num=1,
+                      rank=1),
+                _base("eval.step", "timer", 3.0, ms=7.0, step_num=0)]
+        recs[2]["step"] = "eval"
+        assert len(filter_records(recs, step="train")) == 2
+        assert len(filter_records(recs, step="eval")) == 1
+        assert len(filter_records(recs, rank=1)) == 1
+        assert len(filter_records(recs, step="train", rank="0")) == 1
+        assert filter_records(recs) == recs
+
+    def test_show_metrics_applies_filters(self, tmp_path):
+        from metaflow_tpu.cmd.metrics import show_metrics
+
+        fds = _fds(tmp_path, flow="MetricsFilter")
+        rec = telemetry.init_recorder(fds, "1", "train", "t0")
+        try:
+            with rec.timer("train.step", step_num=0):
+                pass
+        finally:
+            telemetry.close_recorder()
+        lines = []
+        agg = show_metrics(fds, "1", step="train", echo=lines.append)
+        assert agg["records"] == 1
+        lines = []
+        agg = show_metrics(fds, "1", step="nope", echo=lines.append)
+        assert agg["records"] == 0
+        assert any("--step/--rank" in l for l in lines)
