@@ -87,7 +87,7 @@ def aggregate(records, profiles=None):
                 s = train_steps.setdefault(
                     (rec.get("step", ""), base, rec["step_num"]), {
                         "ms": [], "tokens_per_sec": [], "mfu": [],
-                        "input_stall_ms": [],
+                        "input_stall_ms": [], "optimizer_update_ms": [],
                         "ranks": set(), "compile": False})
                 s["ms"].append(ms)
                 s["ranks"].add(rec.get("rank", 0))
@@ -99,6 +99,9 @@ def aggregate(records, profiles=None):
                     s["mfu"].append(data["mfu"])
                 if "input_stall_ms" in data:
                     s["input_stall_ms"].append(data["input_stall_ms"])
+                if "optimizer_update_ms" in data:
+                    s["optimizer_update_ms"].append(
+                        data["optimizer_update_ms"])
         elif rtype == "counter":
             counters[name] = counters.get(name, 0) + rec.get("inc", 1)
         elif rtype == "gauge":
@@ -108,6 +111,12 @@ def aggregate(records, profiles=None):
                 train_summary.setdefault(
                     name[len("train.summary."):], []).append(
                         rec.get("value"))
+            if name.startswith("train.memory."):
+                # per-step memory-split gauges normalize onto the same
+                # keys the summary gauges use (memory_params_bytes, ...)
+                suffix = name[len("train.memory."):]
+                train_summary.setdefault(
+                    "memory_%s" % suffix, []).append(rec.get("value"))
         elif rtype == "event":
             events[name] = events.get(name, 0) + 1
             if name.startswith(("fleet.", "chaos.replica_kill")):
@@ -165,6 +174,9 @@ def aggregate(records, profiles=None):
         if s["input_stall_ms"]:
             # worst rank: a gang step waits for its SLOWEST host's input
             row["input_stall_ms"] = round(max(s["input_stall_ms"]), 3)
+        if s["optimizer_update_ms"]:
+            row["optimizer_update_ms"] = round(
+                statistics.mean(s["optimizer_update_ms"]), 3)
         timeline.append(row)
 
     train = {}
@@ -197,14 +209,30 @@ def aggregate(records, profiles=None):
                 # spent waiting on data instead of dispatching
                 train["input_stall_frac"] = round(
                     train["input_stall_ms"] / mean_ms, 4)
+        updates = [r["optimizer_update_ms"] for r in pick
+                   if "optimizer_update_ms" in r]
+        if updates:
+            train["optimizer_update_ms"] = round(
+                statistics.mean(updates), 3)
+            if train["mean_step_ms"]:
+                # how much of each step the weight update costs — the
+                # number the ZeRO sharded-update path shrinks
+                train["optimizer_update_frac"] = round(
+                    train["optimizer_update_ms"] / train["mean_step_ms"], 4)
         for key_name, values in train_summary.items():
             vals = [v for v in values if isinstance(v, (int, float))]
             if not vals:
                 continue
             if key_name in ("compile_ms", "device_memory_peak_bytes"):
                 train["%s_max" % key_name] = max(vals)
+            elif key_name.startswith("memory_"):
+                train["%s_max" % key_name] = max(vals)
             elif key_name == "compiles":
                 train["compiles_total"] = int(sum(vals))
+            elif (key_name == "optimizer_update_ms"
+                  and "optimizer_update_ms" not in train):
+                train["optimizer_update_ms"] = round(
+                    statistics.mean(vals), 3)
 
     fleet = {}
     if (fleet_dispatch or fleet_failovers or fleet_shed
@@ -317,6 +345,11 @@ def render_summary(run_id, agg, echo=print):
             if train.get("input_stall_frac", 0) >= 0.1:
                 line += " (INPUT-BOUND %.0f%%)" % (
                     train["input_stall_frac"] * 100)
+        if "optimizer_update_ms" in train:
+            line += ", opt update %s/step" % _fmt_ms(
+                train["optimizer_update_ms"])
+            if train.get("optimizer_update_frac"):
+                line += " (%.0f%%)" % (train["optimizer_update_frac"] * 100)
         echo(line)
         extras = []
         if "compiles_total" in train:
@@ -326,6 +359,14 @@ def render_summary(run_id, agg, echo=print):
         if "device_memory_peak_bytes_max" in train:
             extras.append("device mem peak %.1f MB"
                           % (train["device_memory_peak_bytes_max"] / 2**20))
+        mem_split = [(label, train.get("memory_%s_bytes_max" % key))
+                     for label, key in (("params", "params"),
+                                        ("opt state", "opt_state"),
+                                        ("activations", "activations"))]
+        if any(v is not None for _l, v in mem_split):
+            extras.append("per-device mem " + " + ".join(
+                "%s %.1f MB" % (label, v / 2**20)
+                for label, v in mem_split if v is not None))
         if extras:
             echo("  " + ", ".join(extras))
     fleet = agg.get("fleet") or {}
@@ -384,17 +425,19 @@ def render_timeline(agg, echo=print):
         echo("no per-step training records in this run")
         return
     grouped = any("group" in row for row in agg["timeline"])
-    header = "%8s %10s %14s %8s %10s %6s %s" % (
-        "step", "wall", "tokens/s", "MFU", "stall", "ranks", "")
+    header = "%8s %10s %14s %8s %10s %10s %6s %s" % (
+        "step", "wall", "tokens/s", "MFU", "stall", "opt", "ranks", "")
     echo(("%-24s " % "group") + header if grouped else header)
     for row in agg["timeline"]:
-        line = "%8d %10s %14s %8s %10s %6d %s" % (
+        line = "%8d %10s %14s %8s %10s %10s %6d %s" % (
             row["step_num"], _fmt_ms(row["ms"]),
             ("%.0f" % row["tokens_per_sec"]
              if "tokens_per_sec" in row else "-"),
             ("%.1f%%" % (row["mfu"] * 100) if "mfu" in row else "-"),
             (_fmt_ms(row["input_stall_ms"])
              if "input_stall_ms" in row else "-"),
+            (_fmt_ms(row["optimizer_update_ms"])
+             if "optimizer_update_ms" in row else "-"),
             row["ranks"], "compile" if row.get("compile") else "")
         echo(("%-24s " % row.get("group", "")) + line if grouped
              else line)

@@ -133,6 +133,40 @@ def make_signature(kind, name, axes=(), shape=None, key=None):
     return "|".join(parts)
 
 
+# Pinned signature vocabulary. Every first-party journal site uses one of
+# these kinds, and every "collective" signature one of these names — the
+# stream schema in tests/schema_validate.py pins the same sets, so a new
+# collective is a deliberate two-file change, not drift. The zero.* names
+# are the ZeRO sharded-update schedule (spmd/sharding.py): the grad
+# reduce-scatter into the 1/N update and the param all-gather out of it,
+# journaled once per trace like `constrain`.
+SIG_KINDS = ("collective", "step", "compile", "write", "data")
+
+COLLECTIVE_NAMES = (
+    "shard_tree",
+    "constrain",
+    "shard_batch",
+    "zero.reduce_scatter",
+    "zero.shard",
+    "zero.all_gather",
+)
+
+
+def journal_collective(name, axes=(), shape=None, key=None):
+    """Journal a collective signature, enforcing the pinned name registry.
+
+    Gang-desync detection only works if every rank journals the same
+    vocabulary — a typo'd or ad-hoc collective name would read as a
+    divergence on some ranks and silence on others. First-party collective
+    sites go through here; third parties can still call journal() raw."""
+    if name not in COLLECTIVE_NAMES:
+        raise ValueError(
+            "unknown collective %r: pinned names are %s (add new collectives "
+            "to sanitizer.COLLECTIVE_NAMES AND the stream schema in "
+            "tests/schema_validate.py)" % (name, list(COLLECTIVE_NAMES)))
+    journal("collective", name, axes=axes, shape=shape, key=key)
+
+
 class GangSanitizer(object):
     """Per-rank signature journal + cross-rank barrier checker.
 

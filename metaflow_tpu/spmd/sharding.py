@@ -114,8 +114,8 @@ def tree_shardings(logical_tree, mesh, rules=None):
 
 def shard_tree(tree, logical_tree, mesh, rules=None):
     """Device-put a pytree according to its logical axes."""
-    sanitizer.journal("collective", "shard_tree", axes=mesh.axis_names,
-                      shape=tree)
+    sanitizer.journal_collective("shard_tree", axes=mesh.axis_names,
+                                 shape=tree)
     shardings = tree_shardings(logical_tree, mesh, rules)
     return jax.device_put(tree, shardings)
 
@@ -126,9 +126,135 @@ def constrain(x, logical_axes, mesh, rules=None):
     The sanitizer journal entry lands at TRACE time (once per compile,
     not per step) — which is exactly the signal wanted: ranks tracing
     different programs produce different constraint streams."""
-    sanitizer.journal("collective", "constrain", axes=logical_axes,
-                      shape=x)
+    sanitizer.journal_collective("constrain", axes=logical_axes, shape=x)
     rules = rules or rules_for_mesh(mesh)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, spec_for(logical_axes, rules))
+    )
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style cross-replica weight-update sharding (ROADMAP item 2; the
+# recipe from "Automatic Cross-Replica Sharding of Weight Update in
+# Data-Parallel Training", PAPERS.md).
+#
+# Data parallelism replicates the weight update N times: every replica
+# all-reduces the full gradient, then runs the identical optimizer math on
+# the identical full state. The transform below re-spec's grads, params and
+# optimizer state over the pure-DP mesh axis *inside the update only*, so
+# GSPMD lowers the schedule to
+#
+#     grad reduce-scatter -> 1/N-sharded optimizer update -> param all-gather
+#
+# Everything is expressed as PartitionSpec extensions consumed by
+# with_sharding_constraint — no launcher or gang-runtime change, and
+# correctness is automatic (constraints change layout, never semantics).
+# The 'fsdp' axis needs none of this: its rule table already shards
+# params/state at rest (ZeRO-3). Only the 'data' axis replicates the
+# update, so that is the only axis zero_update_axis ever returns.
+
+ZERO_ENV = "TPUFLOW_ZERO"
+
+
+def zero_update_axis(mesh):
+    """The mesh axis the weight update shards over, or None.
+
+    Returns 'data' iff the mesh has a data axis of size > 1. Meshes whose
+    parallelism is all fsdp/tensor/expert get None — their updates are
+    already sharded (or there is no replication to remove)."""
+    return "data" if mesh.shape.get("data", 1) > 1 else None
+
+
+def zero_enabled(mesh, zero=None):
+    """Resolve the sharded-update switch: explicit arg wins, else the
+    TPUFLOW_ZERO env knob ('1' = on); always off when the mesh has no DP
+    axis to shard over (the transform would be a no-op)."""
+    if zero is None:
+        import os
+
+        zero = os.environ.get(ZERO_ENV, "0") == "1"
+    return bool(zero) and zero_update_axis(mesh) is not None
+
+
+def zero_spec(spec, shape, mesh, axis=None):
+    """Extend one leaf's PartitionSpec for the sharded update.
+
+    Deterministic rule: the largest dim that is still unsharded in `spec`
+    and divisible by the DP-axis size gets the DP axis (ties -> lowest
+    index). Leaves with no such dim — scalars, odd-sized biases — keep
+    their spec: their update stays replicated, which is correct, merely
+    not sharded. Leaves already touching the DP axis are left alone.
+
+    Determinism matters twice over: every rank in a gang must pick the
+    same dim (compile-identical programs, the sanitizer barrier checks
+    this), and a checkpoint restored into a fresh process must land on
+    the same layout it was saved from."""
+    axis = axis or zero_update_axis(mesh)
+    if axis is None:
+        return spec
+    size = mesh.shape[axis]
+    ndim = len(shape)
+    parts = list(spec) + [None] * (ndim - len(spec))
+    used = set()
+    for p in parts:
+        for a in p if isinstance(p, tuple) else (p,):
+            if a is not None:
+                used.add(a)
+    if axis in used:
+        return spec
+    best = None
+    for i in range(ndim):
+        if parts[i] is None and shape[i] > 0 and shape[i] % size == 0:
+            if best is None or shape[i] > shape[best]:
+                best = i
+    if best is None:
+        return spec
+    parts[best] = axis
+    return PartitionSpec(*parts)
+
+
+def _leaf_spec(leaf):
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return PartitionSpec()
+
+
+def zero_tree_specs(tree, mesh, axis=None, base_specs=None):
+    """Per-leaf zero specs for a pytree of arrays / ShapeDtypeStructs.
+
+    base_specs: optional matching pytree of base PartitionSpecs (e.g. the
+    rule-table specs for a param tree). Defaults to each leaf's LIVE
+    sharding spec, so optimizer state that GSPMD propagated to mirror
+    model-parallel params keeps that sharding and only gains the DP axis."""
+    axis = axis or zero_update_axis(mesh)
+    if base_specs is None:
+        base_specs = jax.tree.map(_leaf_spec, tree)
+    return jax.tree.map(
+        lambda leaf, sp: zero_spec(sp, leaf.shape, mesh, axis=axis),
+        tree, base_specs,
+    )
+
+
+def zero_tree_shardings(tree, mesh, axis=None, base_specs=None):
+    """NamedShardings for zero_tree_specs — device_put target for opt state."""
+    return jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        zero_tree_specs(tree, mesh, axis=axis, base_specs=base_specs),
+    )
+
+
+def zero_constrain(tree, mesh, specs, phase):
+    """with_sharding_constraint a pytree onto precomputed specs (use inside
+    jitted fns). `phase` names the collective the constraint lowers to
+    (reduce_scatter / all_gather / shard / unshard) and is journaled at
+    TRACE time like `constrain` — one rank running the ZeRO schedule while
+    another runs the replicated update diverges at the first barrier."""
+    sanitizer.journal_collective("zero.%s" % phase,
+                                 axes=(zero_update_axis(mesh),), shape=tree)
+    return jax.tree.map(
+        lambda x, sp: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, sp)
+        ),
+        tree, specs,
     )

@@ -8,6 +8,7 @@ from .metrics import (
     peak_tflops,
 )
 from .train_step import (
+    check_opt_state,
     default_optimizer,
     memory_efficient_optimizer,
     make_train_state,
@@ -21,6 +22,7 @@ from .train_step import (
 __all__ = [
     "AsyncCheckpointManager",
     "Checkpoint",
+    "check_opt_state",
     "default_optimizer",
     "memory_efficient_optimizer",
     "make_train_state",

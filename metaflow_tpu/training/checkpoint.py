@@ -215,10 +215,36 @@ class AsyncCheckpointManager(object):
             if like is not None:
                 from .train_step import reshard_like
 
+                self._check_like(state, like, step)
                 state = reshard_like(state, like)
         ck = Checkpoint(state, manifest["step"], manifest.get("extra"))
         self.last_restored = ck
         return ck
+
+    @staticmethod
+    def _check_like(state, like, step):
+        """Fail restore-onto-live-shardings loudly when the trees disagree.
+
+        reshard_like would die inside jax.tree.map with an opaque
+        structure error; the overwhelmingly common cause is a checkpoint
+        saved under a DIFFERENT optimizer than the trainer now uses
+        (opt_state trees differ), so name that. Shape mismatches (a
+        changed model config) surface from device_put with the leaf
+        named, which is already actionable."""
+        import jax
+
+        saved = jax.tree.structure(state)
+        live = jax.tree.structure(like)
+        if saved != live:
+            raise ValueError(
+                "checkpoint step %s does not match the live state tree —\n"
+                "  saved: %s\n  live:  %s\n"
+                "most likely the checkpoint was saved under a different "
+                "optimizer (or model) than this trainer was built with; "
+                "rebuild the trainer with the original optimizer, or "
+                "start a fresh run for the new one. (DP-size and ZeRO "
+                "on/off changes are fine — those reshard, they don't "
+                "change the tree.)" % (step, saved, live))
 
     def _load_manifest(self, step):
         with self._storage.load_bytes([self._manifest_path(step)]) as loaded:

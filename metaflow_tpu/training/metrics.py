@@ -106,6 +106,36 @@ def _device_memory_bytes():
     return in_use, peak if peak is not None else in_use
 
 
+def _tree_device_bytes(tree):
+    """Per-device resident bytes for a pytree of sharded arrays.
+
+    Metadata-only (shape/dtype/sharding.shard_shape) so it is safe on
+    DONATED buffers — the train step consumed its input state, but the
+    layout survives deletion. Replicated leaves count full size (each
+    device holds a copy); a ZeRO/fsdp-sharded leaf counts 1/N — this is
+    the gauge the sharded-update memory win shows up in. SPMD placement
+    is uniform across devices, so one device's sum is every device's."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        try:
+            shard_shape = sharding.shard_shape(tuple(shape))
+        except Exception:
+            shard_shape = tuple(shape)
+        n = 1
+        for d in shard_shape:
+            n *= int(d)
+        total += n * np.dtype(dtype).itemsize
+    return total
+
+
 class TrainStepTelemetry(object):
     """Per-step metric emitter driven by instrument_train_step."""
 
@@ -126,6 +156,9 @@ class TrainStepTelemetry(object):
         self._stalls = []
         self._intervals = []
         self._mem_peak = 0
+        self._mem_split = {}
+        self._update_ms = []
+        self._pending_update_ms = None
         self._per_chip = None  # (n_devices, peak_tflops) lazy
         self._profile = None
         self._want_profile = profile
@@ -194,8 +227,38 @@ class TrainStepTelemetry(object):
                     "%s.device_memory_bytes" % self.prefix, in_use,
                     step_num=self.step_num,
                     data={"peak": peak} if peak else None)
+            self._emit_memory_split(args, peak or in_use)
+        # diagnostic split-step mode (make_train_step timed_update=True)
+        # exposes the update's wall time as an attribute; ride it into the
+        # NEXT emitted record — _emit_step(N) fires before after_step(N+1)
+        update_ms = getattr(step_fn, "last_update_ms", None)
+        if update_ms is not None:
+            self._pending_update_ms = float(update_ms)
         self.step_num += 1
         self._prev_return = time.perf_counter()
+
+    def _emit_memory_split(self, args, peak):
+        """Split the high-water gauge: params vs optimizer state are
+        metadata-exact per device (see _tree_device_bytes); activations is
+        the remainder of the allocator peak — on backends with no
+        allocator stats (CPU) the remainder is live-footprint-derived and
+        only a rough upper bound, but the params/opt split stays exact."""
+        state = args[0] if args else None
+        if not (isinstance(state, dict) and "params" in state
+                and "opt_state" in state):
+            return
+        try:
+            params_b = _tree_device_bytes(state["params"])
+            opt_b = _tree_device_bytes(state["opt_state"])
+        except Exception:
+            return
+        split = {"params": params_b, "opt_state": opt_b}
+        if peak:
+            split["activations"] = max(0, int(peak) - params_b - opt_b)
+        self._mem_split = split
+        for key, value in split.items():
+            telemetry.gauge("%s.memory.%s_bytes" % (self.prefix, key),
+                            value, step_num=self.step_num)
 
     def _flops_from_cost_analysis(self, step_fn, args, kwargs):
         """XLA cost-model FLOPs for the exact step — pays ONE extra
@@ -231,6 +294,11 @@ class TrainStepTelemetry(object):
                 self._stalls.append(stall_s)
         if stall_s is not None:
             data["input_stall_ms"] = round(stall_s * 1000, 3)
+        if self._pending_update_ms is not None:
+            data["optimizer_update_ms"] = round(self._pending_update_ms, 3)
+            if "compile" not in data:
+                self._update_ms.append(self._pending_update_ms)
+            self._pending_update_ms = None
         if self.tokens_per_step:
             data["tokens_per_sec"] = round(
                 self.tokens_per_step / interval_s, 1)
@@ -260,7 +328,9 @@ class TrainStepTelemetry(object):
             self._profile.stop(self.step_num)
         summary = self.report()
         for key in ("steps", "mean_step_ms", "tokens_per_sec", "mfu",
-                    "input_stall_ms",
+                    "input_stall_ms", "optimizer_update_ms",
+                    "memory_params_bytes", "memory_opt_state_bytes",
+                    "memory_activations_bytes",
                     "compiles", "compile_ms", "device_memory_peak_bytes"):
             value = summary.get(key)
             if value is not None:
@@ -275,6 +345,11 @@ class TrainStepTelemetry(object):
                "compile_ms": round(self.compile_ms, 1)}
         if self._mem_peak:
             out["device_memory_peak_bytes"] = self._mem_peak
+        for key, value in self._mem_split.items():
+            out["memory_%s_bytes" % key] = value
+        if self._update_ms:
+            out["optimizer_update_ms"] = round(
+                sum(self._update_ms) / len(self._update_ms), 3)
         if not self._intervals:
             return out
         mean = sum(self._intervals) / len(self._intervals)

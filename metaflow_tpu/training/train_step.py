@@ -105,16 +105,63 @@ def reshard_like(tree, like):
     return jax.tree.map(_place, tree, like)
 
 
-def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):
+def check_opt_state(optimizer, state):
+    """Guard: would `optimizer.init(state['params'])` produce this opt state?
+
+    Using one optimizer to build the state and a different one in the step
+    is silently wrong when the trees happen to line up (e.g. two adamw
+    chains with different hyperparams) and a deep GSPMD crash when they do
+    not. The check compares the abstract tree `optimizer.init` would build
+    against the live/restored `state['opt_state']` — structure, shapes and
+    dtypes — and raises a ValueError that names the mismatch. Costs one
+    eval_shape (no compile, no device work)."""
+    expect = jax.eval_shape(optimizer.init, state["params"])
+    got = state["opt_state"]
+    want_def = jax.tree.structure(expect)
+    got_def = jax.tree.structure(got)
+    if want_def != got_def:
+        raise ValueError(
+            "optimizer/opt_state mismatch: optimizer.init(params) would "
+            "build tree\n  %s\nbut state['opt_state'] has tree\n  %s\n"
+            "make_train_state and make_train_step must share ONE optimizer "
+            "(use make_trainer, which enforces this); a restored checkpoint "
+            "must have been saved with the same optimizer the trainer now "
+            "uses." % (want_def, got_def))
+    for path_want, path_got in zip(
+            jax.tree_util.tree_leaves_with_path(expect),
+            jax.tree_util.tree_leaves_with_path(got)):
+        path, want = path_want
+        _, have = path_got
+        want_shape = tuple(want.shape)
+        have_shape = tuple(getattr(have, "shape", ()))
+        have_dtype = getattr(have, "dtype", None)
+        if want_shape != have_shape or (
+                have_dtype is not None and want.dtype != have_dtype):
+            raise ValueError(
+                "optimizer/opt_state mismatch at opt_state%s: optimizer."
+                "init(params) would build %s%s, state has %s%s — same "
+                "optimizer family but different hyperparameters (mu_dtype, "
+                "factoring, ...)?" % (
+                    jax.tree_util.keystr(path), want.dtype, want_shape,
+                    have_dtype, have_shape))
+
+
+def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None,
+                     zero=None):
     """Sharded init: params + optimizer state placed per the rule table.
 
     model: module exposing init_params(rng, cfg) and logical_axes(cfg).
+    zero: ZeRO-style sharded update (spmd/sharding.py) — when enabled, the
+    optimizer state is re-placed 1/N-sharded over the DP axis after init,
+    so each replica holds (and updates) only its shard. None resolves from
+    the TPUFLOW_ZERO env knob; a mesh without a data axis forces it off.
     Returns (state dict, shardings dict).
     """
     optimizer = optimizer or default_optimizer()
     rules = rules or shd.rules_for_mesh(mesh)
     log_axes = model.logical_axes(cfg)
     param_shardings = shd.tree_shardings(log_axes, mesh, rules)
+    use_zero = shd.zero_enabled(mesh, zero)
 
     def init():
         params = model.init_params(rng, cfg)
@@ -126,6 +173,14 @@ def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):
             optimizer.init,
             # optimizer state mirrors the param tree; let GSPMD propagate
         )(params)
+        if use_zero:
+            # re-spec each live leaf over the DP axis (base = the sharding
+            # GSPMD propagated, so model-parallel axes are kept) and
+            # re-place. device_put, not a second compile: the replicated
+            # copy is freed as each leaf lands, so peak memory never
+            # exceeds the non-zero path's.
+            opt_state = jax.device_put(
+                opt_state, shd.zero_tree_shardings(opt_state, mesh))
     state = {"params": params, "opt_state": opt_state,
              "step": jnp.zeros((), jnp.int32)}
     shardings = {
@@ -136,46 +191,167 @@ def make_train_state(rng, cfg, mesh, model, optimizer=None, rules=None):
     return state, shardings
 
 
-def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None):
+def make_train_step(cfg, mesh, model, optimizer=None, loss_fn=None,
+                    zero=None, rules=None, opt_specs=None,
+                    timed_update=False):
     """Build the jitted, donated train step: (state, batch) → (state, metrics).
 
-    `mesh` is accepted for signature symmetry with make_train_state; the
-    step itself is mesh-agnostic (shardings propagate from the state)."""
+    WARNING: `optimizer` must be the SAME GradientTransformation the state
+    was built with — a mismatch gives silently wrong updates when the state
+    trees happen to line up. Use make_trainer (which shares one optimizer
+    and runs check_opt_state) unless you have a reason not to.
+
+    zero: ZeRO-style weight-update sharding. The replicated-DP update is
+    rewritten as  grad reduce-scatter → 1/N-sharded optimizer update →
+    param all-gather, expressed purely as sharding constraints (GSPMD
+    inserts the collectives; semantics are unchanged). The all-gathered
+    params feed only the RETURNED state — nothing later in the step
+    consumes them — so XLA's latency-hiding scheduler can overlap the
+    gather with the loss/grad-norm tail and the next step's dispatch.
+    None resolves from TPUFLOW_ZERO; meshes without a data axis force off.
+
+    opt_specs: optional pytree of PartitionSpecs for the (zero-sharded)
+    optimizer state, matching make_train_state's placement. When omitted,
+    the specs are re-derived at trace time from shapes with a replicated
+    base — identical on pure-DP meshes; pass the live specs on mixed
+    meshes to avoid a per-step reshard of model-parallel state.
+
+    timed_update: split the step into two jits (grad, then donated update)
+    with dispatch fences so the wrapper can report `last_update_ms` — the
+    wall time of the optimizer update + collectives — per call. This is a
+    DIAGNOSTIC mode: the fences serialize work the fused step overlaps, so
+    never benchmark with it on. training/metrics.py picks the attribute up
+    into the per-step telemetry record as `optimizer_update_ms`.
+
+    `mesh` shapes the zero schedule's constraints; with zero off the step
+    itself is mesh-agnostic (shardings propagate from the state)."""
     optimizer = optimizer or default_optimizer()
     loss_fn = loss_fn or model.loss_fn
+    use_zero = shd.zero_enabled(mesh, zero)
 
     import inspect
 
     loss_takes_mesh = "mesh" in inspect.signature(loss_fn).parameters
 
-    def step(state, batch):
-        def compute_loss(params):
-            if loss_takes_mesh:
-                return loss_fn(params, batch, cfg, mesh=mesh)
-            return loss_fn(params, batch, cfg)
+    def compute_loss(params, batch):
+        if loss_takes_mesh:
+            return loss_fn(params, batch, cfg, mesh=mesh)
+        return loss_fn(params, batch, cfg)
 
-        loss, grads = jax.value_and_grad(compute_loss)(state["params"])
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        params = optax.apply_updates(state["params"], updates)
-        grad_norm = optax.global_norm(grads)
+    if use_zero:
+        zero_axis = shd.zero_update_axis(mesh)
+        base_specs = shd.tree_specs(
+            model.logical_axes(cfg), rules or shd.rules_for_mesh(mesh))
+
+    def apply_update(params, grads, opt_state):
+        """(full grads, state) -> (new params, new opt state, grad norm).
+
+        Zero path: constraining the summed grads onto DP-sharded specs
+        turns the grad all-reduce into a reduce-scatter; the optimizer
+        then runs on 1/N-sized shards (params sliced locally — no
+        collective, each replica already holds the full value); finally
+        constraining the updated params back to their base (replicated-
+        over-DP) specs emits the all-gather. grad_norm is computed from
+        the scattered shards — same value, 1/N the reduction input."""
+        if not use_zero:
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt,
+                    optax.global_norm(grads))
+        specs = jax.tree.map(
+            lambda g, sp: shd.zero_spec(sp, g.shape, mesh, axis=zero_axis),
+            grads, base_specs)
+        ospecs = opt_specs
+        if ospecs is None:
+            ospecs = jax.tree.map(
+                lambda o: shd.zero_spec(
+                    jax.sharding.PartitionSpec(), o.shape, mesh,
+                    axis=zero_axis),
+                opt_state)
+        grads = shd.zero_constrain(grads, mesh, specs, "reduce_scatter")
+        params_sh = shd.zero_constrain(params, mesh, specs, "shard")
+        opt_state = jax.tree.map(
+            lambda o, sp: jax.lax.with_sharding_constraint(
+                o, jax.sharding.NamedSharding(mesh, sp)),
+            opt_state, ospecs)
+        updates, new_opt = optimizer.update(grads, opt_state, params_sh)
+        updates = jax.tree.map(
+            lambda u, sp: jax.lax.with_sharding_constraint(
+                u, jax.sharding.NamedSharding(mesh, sp)),
+            updates, specs)
+        new_params = optax.apply_updates(params_sh, updates)
+        new_params = shd.zero_constrain(
+            new_params, mesh, base_specs, "all_gather")
+        new_opt = jax.tree.map(
+            lambda o, sp: jax.lax.with_sharding_constraint(
+                o, jax.sharding.NamedSharding(mesh, sp)),
+            new_opt, ospecs)
+        return new_params, new_opt, optax.global_norm(grads)
+
+    if not timed_update:
+        def step(state, batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: compute_loss(p, batch))(state["params"])
+            params, opt_state, grad_norm = apply_update(
+                state["params"], grads, state["opt_state"])
+            new_state = {
+                "params": params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+            }
+            return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    # diagnostic split: measure the update (optimizer math + zero
+    # collectives) separately from the fwd/bwd. Two compiles, two fences.
+    grad_fn = jax.jit(lambda params, batch: jax.value_and_grad(
+        lambda p: compute_loss(p, batch))(params))
+
+    def update(state, grads):
+        params, opt_state, grad_norm = apply_update(
+            state["params"], grads, state["opt_state"])
         new_state = {
             "params": params,
             "opt_state": opt_state,
             "step": state["step"] + 1,
         }
+        return new_state, grad_norm
+
+    update_fn = jax.jit(update, donate_argnums=(0, 1))
+
+    def step(state, batch):
+        import time
+
+        loss, grads = grad_fn(state["params"], batch)
+        jax.block_until_ready(grads)
+        t0 = time.perf_counter()
+        new_state, grad_norm = update_fn(state, grads)
+        jax.block_until_ready(new_state["params"])
+        step.last_update_ms = (time.perf_counter() - t0) * 1e3
         return new_state, {"loss": loss, "grad_norm": grad_norm}
 
-    return jax.jit(step, donate_argnums=(0,))
+    step.last_update_ms = None
+    return step
 
 
 def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
-                 loss_fn=None, checkpoint=None, telemetry=None):
+                 loss_fn=None, checkpoint=None, telemetry=None, zero=None,
+                 timed_update=False):
     """One-stop builder: returns (state, train_step_fn, shardings) with a
     SINGLE shared optimizer — prefer this over calling make_train_state and
-    make_train_step separately (mismatched optimizers give silently wrong or
-    crashing updates).
+    make_train_step separately: a mismatched optimizer between the two gives
+    SILENTLY WRONG updates whenever the opt-state trees happen to line up
+    (same optax family, different hyperparameters) and an opaque GSPMD
+    crash when they don't. make_trainer shares one optimizer and runs
+    check_opt_state after build/restore, so a stale checkpoint saved under
+    a different optimizer fails loudly with the mismatch named.
+
+    zero: ZeRO-style cross-replica weight-update sharding (see
+    make_train_step / docs/training.md). None resolves from the
+    TPUFLOW_ZERO env knob; forced off on meshes without a data axis.
+
+    timed_update: diagnostic split-step mode reporting per-call
+    `optimizer_update_ms` through telemetry (see make_train_step).
 
     telemetry: truthy wraps the returned step with
     training.metrics.instrument_train_step so every call emits per-step
@@ -194,20 +370,39 @@ def make_trainer(rng, cfg, mesh, model, optimizer=None, rules=None,
     are available afterwards as `checkpoint.last_restored` — without
     them a resumed run would silently restart its data stream."""
     optimizer = optimizer or default_optimizer()
+    use_zero = shd.zero_enabled(mesh, zero)
     # compile-shaping state: every rank must build the SAME mesh/program
     # (analysis/divergence.py's gang-divergent-compile class, verified at
-    # runtime by the sanitizer barrier)
+    # runtime by the sanitizer barrier); the zero switch shapes the
+    # program, so it is part of the compile key
     sanitizer.journal("compile", "make_trainer", axes=mesh.axis_names,
-                      key=str(dict(mesh.shape)))
+                      key=str(dict(mesh.shape))
+                      + (";zero" if use_zero else ""))
     state, shardings = make_train_state(
-        rng, cfg, mesh, model, optimizer=optimizer, rules=rules
+        rng, cfg, mesh, model, optimizer=optimizer, rules=rules,
+        zero=use_zero,
     )
+    # hand the step the LIVE opt-state placement so mixed (data+model
+    # parallel) meshes constrain onto exactly what make_train_state built
+    # instead of re-deriving from a replicated base
+    opt_specs = None
+    if use_zero:
+        from jax.sharding import NamedSharding
+
+        opt_specs = jax.tree.map(
+            lambda s: s.spec if isinstance(s, NamedSharding) else None,
+            shardings["opt_state"])
+        if any(sp is None for sp in jax.tree.leaves(
+                opt_specs, is_leaf=lambda x: x is None)):
+            opt_specs = None  # non-mesh placements: let trace-time derive
     step = make_train_step(cfg, mesh, model, optimizer=optimizer,
-                           loss_fn=loss_fn)
+                           loss_fn=loss_fn, zero=use_zero, rules=rules,
+                           opt_specs=opt_specs, timed_update=timed_update)
     if checkpoint is not None:
         restored = checkpoint.restore(like=state)
         if restored is not None:
             state = restored.state
+    check_opt_state(optimizer, state)
     if telemetry:
         from .metrics import instrument_train_step
 

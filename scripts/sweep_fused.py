@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Fused-config sweep: drive bench.py across the remat-policy, loss-chunk,
+optimizer, ZeRO-update and gmm-tile knobs and record the best config per
+device kind.
+
+The knobs interact (a remat policy changes what the ZeRO all-gather can
+overlap with; gmm tiles change the moe step the loss-chunk feeds), so the
+pick has to come from measuring the CROSS PRODUCT on the device kind at
+hand, not from tuning each knob alone. This harness is the recorded
+version of that: one subprocess bench per grid point, every result
+appended to a per-device-kind ledger (BENCH_SWEEP.jsonl), best config
+printed at the end.
+
+Usage:
+  scripts/sweep_fused.py                    # train-mode sweep, full grid
+  scripts/sweep_fused.py --mode moe         # gmm-tile sweep
+  scripts/sweep_fused.py --quick            # trimmed grid (CI/smoke)
+  scripts/sweep_fused.py --dry-run          # print the planned runs only
+
+Children always run with BENCH_HISTORY=0 — the sweep has its own ledger;
+BENCH_HISTORY.jsonl stays reserved for curated round entries.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# knob axes per bench mode: (env var, values, quick-values)
+GRIDS = {
+    "train": [
+        ("BENCH_REMAT_POLICY", ["", "dots"], [""]),
+        ("BENCH_LOSS_CHUNK", ["128", "256", "512"], ["256"]),
+        ("BENCH_OPT", ["factored", "adamw"], ["factored", "adamw"]),
+        ("TPUFLOW_ZERO", ["0", "1"], ["0", "1"]),
+    ],
+    "moe": [
+        ("TPUFLOW_GMM_BLOCK_S", ["64", "128", "256"], ["128"]),
+        ("TPUFLOW_GMM_BLOCK_F", ["128", "256"], ["128"]),
+        ("TPUFLOW_ZERO", ["0", "1"], ["0", "1"]),
+    ],
+    "zero": [
+        ("BENCH_ZERO_DEVICES", ["4", "8"], ["8"]),
+    ],
+}
+
+
+def plan(mode, quick):
+    axes = GRIDS[mode]
+    names = [a[0] for a in axes]
+    values = [a[2] if quick else a[1] for a in axes]
+    return [dict(zip(names, combo)) for combo in itertools.product(*values)]
+
+
+def run_one(bench, mode, knobs, timeout_s):
+    env = dict(os.environ)
+    env.update(knobs)
+    env["BENCH_MODE"] = mode
+    env["BENCH_HISTORY"] = "0"
+    env.setdefault("PYTHONPATH", REPO)
+    t0 = time.perf_counter()
+    proc = subprocess.run([sys.executable, bench], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout_s)
+    wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        return {"error": proc.stderr[-1000:], "wall_s": round(wall, 1)}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            result = json.loads(line)
+            break
+        except ValueError:
+            continue
+    else:
+        return {"error": "no JSON result in bench output",
+                "wall_s": round(wall, 1)}
+    result["wall_s"] = round(wall, 1)
+    return result
+
+
+def device_kind_of(result):
+    """Best-effort device-kind attribution for the ledger row."""
+    extra = result.get("extra") or {}
+    for key in ("device_kind", "hardware_model", "backend"):
+        if extra.get(key):
+            return str(extra[key])
+    return os.environ.get("BENCH_TARGET_CHIP", "unknown")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="train", choices=sorted(GRIDS))
+    ap.add_argument("--quick", action="store_true",
+                    help="trimmed grid for CI/smoke")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print planned runs, execute nothing")
+    ap.add_argument("--bench", default=os.path.join(REPO, "bench.py"),
+                    help="bench entrypoint (tests substitute a stub)")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_SWEEP.jsonl"))
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-run timeout, seconds")
+    args = ap.parse_args(argv)
+
+    grid = plan(args.mode, args.quick)
+    if args.dry_run:
+        for knobs in grid:
+            print(json.dumps({"mode": args.mode, "knobs": knobs}))
+        print("sweep: %d run(s) planned (dry run)" % len(grid))
+        return 0
+
+    rows = []
+    for i, knobs in enumerate(grid):
+        label = " ".join("%s=%s" % kv for kv in sorted(knobs.items()))
+        print("[%d/%d] %s" % (i + 1, len(grid), label), flush=True)
+        result = run_one(args.bench, args.mode, knobs, args.timeout)
+        row = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "mode": args.mode,
+            "device_kind": device_kind_of(result),
+            "knobs": knobs,
+            "metric": result.get("metric"),
+            "value": result.get("value"),
+            "wall_s": result.get("wall_s"),
+        }
+        if "error" in result:
+            row["error"] = result["error"]
+        rows.append(row)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row, sort_keys=True) + "\n")
+
+    ok = [r for r in rows if r.get("value") is not None]
+    if not ok:
+        print("sweep: no successful runs", file=sys.stderr)
+        return 1
+    # per device kind: higher metric value wins (every bench mode here
+    # reports a bigger-is-better number: tok/s, ratio, goodput)
+    by_kind = {}
+    for r in ok:
+        by_kind.setdefault(r["device_kind"], []).append(r)
+    for kind, group in sorted(by_kind.items()):
+        best = max(group, key=lambda r: r["value"])
+        print("best[%s] %s=%s  %s" % (
+            kind, best["metric"], best["value"],
+            " ".join("%s=%s" % kv for kv in sorted(best["knobs"].items()))))
+    print("sweep: %d/%d run(s) ok -> %s" % (len(ok), len(rows), args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -510,6 +510,9 @@ TRAIN_STEP_DATA_SCHEMA = _obj(
         "mfu": _NUM,
         "compile": _BOOL,
         "input_stall_ms": _NUM,
+        # wall time of the (possibly ZeRO-sharded) weight update — only
+        # present in the diagnostic timed_update split-step mode
+        "optimizer_update_ms": _NUM,
     },
 )
 
@@ -536,6 +539,26 @@ def validate_train_step_record(record):
 # drift silently.
 # ---------------------------------------------------------------------------
 
+# signature vocabulary, pinned to sanitizer.SIG_KINDS /
+# sanitizer.COLLECTIVE_NAMES (a test asserts they stay equal): every
+# first-party signature is "<kind>|<name>|..." with kind from the closed
+# set, and every collective name from the closed set — including the
+# zero.* ZeRO sharded-update schedule (reduce-scatter, local shard,
+# all-gather). A new collective is a deliberate two-file change.
+SANITIZE_SIG_KINDS = ("collective", "step", "compile", "write", "data")
+
+SANITIZE_COLLECTIVE_NAMES = (
+    "shard_tree",
+    "constrain",
+    "shard_batch",
+    "zero.reduce_scatter",
+    "zero.shard",
+    "zero.all_gather",
+)
+
+_SIG = {"type": "string",
+        "pattern": "^(%s)\\|" % "|".join(SANITIZE_SIG_KINDS)}
+
 SANITIZE_STREAM_SCHEMA = _obj(
     {
         "v": {"const": 1},
@@ -546,7 +569,7 @@ SANITIZE_STREAM_SCHEMA = _obj(
         # holds the tail: [window_start, count))
         "count": _INT,
         "window_start": _INT,
-        "sigs": _arr(_STR),
+        "sigs": _arr(_SIG),
         "ts": _NUM,
     },
     required=("v", "rank", "world", "barrier", "count", "window_start",

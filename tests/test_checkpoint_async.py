@@ -104,6 +104,35 @@ class TestSaveRestore:
         assert mgr.last_restored.step == 0
         assert mgr.last_restored.extra == {"cursor": 11}
 
+    def test_restore_like_zero_sharded_opt_state(self, flow_ds):
+        """Same resume recipe with the ZeRO sharded update on: the
+        DP-sharded optimizer state round-trips through restore(like=...)
+        bit-exact and lands back on its 1/N placement (deep cross-DP /
+        cross-switch coverage lives in test_zero_update.py)."""
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
+        from metaflow_tpu.training import make_trainer
+
+        cfg = llama.LlamaConfig.tiny()
+        mesh = create_mesh(MeshSpec.dp())
+        state, _fn, shardings = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama, zero=True)
+        mgr = AsyncCheckpointManager(flow_ds, name="zero")
+        mgr.save(state, 4)
+        mgr.wait()
+        state2, _fn2, sh2 = make_trainer(
+            jax.random.PRNGKey(1), cfg, mesh, llama, zero=True,
+            checkpoint=mgr)
+        assert mgr.last_restored.step == 4
+        for a, b in zip(jax.tree.leaves(state["opt_state"]),
+                        jax.tree.leaves(state2["opt_state"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # placement survived the round-trip: still 1/N over the DP axis
+        assert jax.tree.map(lambda s: s.spec, shardings["opt_state"]) \
+            == jax.tree.map(lambda x: x.sharding.spec, state2["opt_state"])
+
 
 class _GatedStorage(LocalStorage):
     """LocalStorage whose save_bytes blocks until released — makes the

@@ -171,6 +171,51 @@ class TestReshardOntoSmallerMesh:
         np.testing.assert_array_equal(np.asarray(restored), w)
         assert restored.sharding.mesh.devices.size == 4
 
+    def test_restore_zero_opt_state_half_mesh(self, tpuflow_root):
+        """The optimizer-state half of an elastic shrink with the ZeRO
+        sharded update on: opt state saved 1/8-sharded on 8 devices
+        restores 1/4-sharded onto a 4-device mesh via restore(like=...),
+        values intact (trajectory-level coverage in test_zero_update.py)."""
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
+        from metaflow_tpu.spmd import sharding as shd
+        from metaflow_tpu.training import AsyncCheckpointManager, \
+            make_trainer
+
+        fds = FlowDataStore("ElasticZeroCkpt", LocalStorage)
+        mesh8 = create_mesh(MeshSpec.dp())
+        cfg = llama.LlamaConfig.tiny()
+        state, _fn, _sh = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh8, llama, zero=True)
+        mgr = AsyncCheckpointManager(fds, name="zero-resize")
+        mgr.save(state, 5)
+        mgr.wait()
+
+        mesh4 = create_mesh(MeshSpec.dp(), devices=jax.devices()[:4])
+        state4, _fn4, _sh4 = make_trainer(
+            jax.random.PRNGKey(1), cfg, mesh4, llama, zero=True,
+            checkpoint=AsyncCheckpointManager(fds, name="zero-resize"))
+        for a, b in zip(jax.tree.leaves(state["opt_state"]),
+                        jax.tree.leaves(state4["opt_state"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the restored state is 1/4-sharded on the SMALLER mesh — the
+        # DP axis moved with the resize instead of replicating
+        def on_data_axis(spec):
+            return any(a == "data" for part in spec
+                       for a in (part if isinstance(part, tuple)
+                                 else (part,)))
+
+        leaves4 = [x for x in jax.tree.leaves(state4["opt_state"])
+                   if x.ndim and on_data_axis(x.sharding.spec)]
+        assert leaves4, "no opt-state leaf sharded over the 4-way mesh"
+        for x in leaves4:
+            assert x.sharding.mesh.devices.size == 4
+            assert shd.zero_spec(
+                jax.sharding.PartitionSpec(), x.shape, mesh4) \
+                == x.sharding.spec
+
 
 class TestElasticBenchGate:
     def test_goodput_vs_fixed_size_retry(self, tmp_path):
