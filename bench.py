@@ -617,6 +617,40 @@ def bench_serve():
                   if d is not None and d["measured_ttft_ms"] > 0)
     decomp_err_pct = errs[len(errs) // 2] if errs else 0.0
 
+    # ---- radix prefix cache: shared-system-prompt trace through the
+    # SAME engine with a fresh Scheduler + cache. One cold request seeds
+    # the system prefix; every later request shares it and differs only
+    # in a short user tail, so its prefill should start at the match
+    # boundary. The FLOPs proxy is hit_tokens/prompt_tokens over the
+    # POST-seed requests (counter deltas exclude the unavoidable cold
+    # miss). Acceptance floor: >= 0.9. ----
+    from metaflow_tpu.serving import RadixPrefixCache
+
+    sys_prefix = rng.integers(1, cfg.vocab_size, 72).tolist()
+    cache = RadixPrefixCache(64 << 20)
+    psched = Scheduler(engine, max_queue=n_requests + 1,
+                       prefix_cache=cache)
+    seed_req = Request(sys_prefix + [7, 8, 9, 10], max_new_tokens=4)
+    psched.submit(seed_req)
+    psched.run_until_idle(max_iterations=100_000)
+    hit0, prompt0 = psched.prefix_hit_tokens, psched.prefix_prompt_tokens
+    warm_reqs = [Request(sys_prefix
+                         + rng.integers(1, cfg.vocab_size, 4).tolist(),
+                         max_new_tokens=4, rng=i)
+                 for i in range(16)]
+    for r in warm_reqs:
+        psched.submit(r)
+    psched.run_until_idle(max_iterations=100_000)
+    prefix_skipped_frac = (
+        (psched.prefix_hit_tokens - hit0)
+        / max(1, psched.prefix_prompt_tokens - prompt0))
+
+    # ---- rolling upgrade under load: a 2-replica in-process fleet
+    # serves a trace WHILE rolling_reload surges/drains each replica;
+    # acceptance: zero requests shed (the rollout never sheds — it
+    # spawns the replacement before draining the old). ----
+    rollout_shed = _bench_rollout_shed(cfg, params)
+
     return {
         "metric": "serve_tokens_per_s",
         "value": round(serve_tps, 1),
@@ -650,8 +684,111 @@ def bench_serve():
              "value": round(decomp_err_pct, 2),
              "unit": "median |TTFT decomposition sum - measured| % "
                      "(gate: <= 5.0)"},
+            {"metric": "prefix_prefill_flops_skipped_frac",
+             "value": round(prefix_skipped_frac, 4),
+             "unit": "fraction of post-seed prompt tokens whose "
+                     "prefill the radix cache skipped (gate: >= 0.9)"},
+            {"metric": "rollout_shed_requests",
+             "value": rollout_shed,
+             "unit": "requests shed during a rolling upgrade under "
+                     "load (gate: == 0)"},
         ],
     }
+
+
+def _bench_rollout_shed(cfg, params):
+    """Zero-shed rolling upgrade: an in-process 2-replica fleet serves a
+    mixed trace concurrently with rolling_reload; returns the fleet's
+    shed counter delta (gate: 0)."""
+    import http.client
+    import json as json_mod
+    import threading
+
+    import numpy as np
+
+    from metaflow_tpu.elastic.policy import BackoffPolicy
+    from metaflow_tpu.serving import (
+        FleetConfig,
+        Scheduler,
+        ServingFleet,
+        ServingServer,
+        SlotEngine,
+    )
+
+    class _Proc(object):
+        def __init__(self, server):
+            self.server, self.pid, self._rc = server, os.getpid(), None
+
+        def poll(self):
+            return self._rc
+
+        def kill(self):
+            if self._rc is None:
+                self._rc = -9
+                self.server.close()
+
+        terminate = kill
+
+        def wait(self, timeout=None):
+            return self._rc
+
+    build_lock = threading.Lock()
+
+    def spawner(index, generation):
+        with build_lock:
+            eng = SlotEngine(params, cfg, max_slots=4, max_seq_len=128,
+                             prefill_chunk=32)
+            srv = ServingServer(Scheduler(eng), port=0).start()
+        return _Proc(srv), "127.0.0.1", srv.port
+
+    config = FleetConfig(
+        failover=True, restart=False, health_interval_s=0.2, wait_s=5.0,
+        redispatch_max=3, spawn_timeout_s=120.0,
+        backoff=BackoffPolicy(base_s=0.05, cap_s=0.1, jitter=0.0,
+                              seed=0))
+    fleet = ServingFleet(spawner, 2, config=config)
+    fleet.start()
+    try:
+        rng = np.random.default_rng(7)
+        trace = [rng.integers(1, cfg.vocab_size, 12).tolist()
+                 for _ in range(16)]
+        errors = []
+
+        def fire(tokens, i):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", fleet.port, timeout=120)
+                conn.request(
+                    "POST", "/v1/generate",
+                    json_mod.dumps({"tokens": tokens,
+                                    "max_new_tokens": 4,
+                                    "request_id": "ro-%d" % i}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                if resp.status != 200:
+                    errors.append((resp.status, body[:128]))
+            except Exception as ex:  # noqa: BLE001 — counted as shed
+                errors.append(repr(ex))
+
+        threads = [threading.Thread(target=fire, args=(t, i))
+                   for i, t in enumerate(trace)]
+        shed0 = fleet.shed_count
+        for t in threads[:8]:
+            t.start()
+        rollout = fleet.rolling_reload()
+        for t in threads[8:]:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rollout["replaced"] == 2, rollout
+        assert not errors, errors[:3]
+        # shed over the whole window (the rollout's own delta is a
+        # subset of it)
+        return int(fleet.shed_count - shed0)
+    finally:
+        fleet.close()
 
 
 def bench_step_launch():

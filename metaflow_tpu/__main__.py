@@ -531,9 +531,26 @@ def watch(flow_run, run_id, datastore, datastore_root, once, check,
               help="Shard params over a device mesh (training rules).")
 @click.option("--attn-impl", default="auto",
               type=click.Choice(["auto", "dense", "chunked"]))
+@click.option("--prefill-workers", default=0, type=int,
+              help="Dedicated prefill replicas (disaggregated "
+                   "prefill/decode): K workers run only chunked "
+                   "prefill and hand finished KV state to the decode "
+                   "pool. 0 = unified replicas "
+                   "(docs/serving.md#disagg).")
+@click.option("--prefix-cache-mb", default=None, type=int,
+              help="Radix prefix-cache budget per replica in MiB "
+                   "(0 disables; default: TPUFLOW_PREFIX_CACHE_MB). "
+                   "Cached prompt-prefix KV skips recompute on shared "
+                   "system prompts (docs/serving.md#prefix-cache).")
+@click.option("--reload", "reload_checkpoint", is_flag=True,
+              help="Don't start a server: roll the named checkpoint "
+                   "onto the RUNNING fleet at --host/--port via a "
+                   "zero-shed rolling upgrade "
+                   "(docs/serving.md#rollouts).")
 def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
           model, host, port, replicas, slots, max_seq_len, prefill_chunk,
-          max_queue, mesh_spec, attn_impl):
+          max_queue, mesh_spec, attn_impl, prefill_workers,
+          prefix_cache_mb, reload_checkpoint):
     from .cmd.serve import serve as serve_impl
     from .exception import TpuFlowException
 
@@ -545,6 +562,9 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
                    max_seq_len=max_seq_len,
                    prefill_chunk=prefill_chunk, max_queue=max_queue,
                    mesh_spec=mesh_spec, attn_impl=attn_impl,
+                   prefill_workers=prefill_workers,
+                   prefix_cache_mb=prefix_cache_mb,
+                   reload_checkpoint=reload_checkpoint,
                    echo=click.echo)
     except TpuFlowException as ex:
         raise click.ClickException(str(ex))

@@ -43,6 +43,12 @@ def aggregate(records, profiles=None):
     fleet_failovers = 0
     fleet_deaths = 0
     fleet_chaos_kills = 0
+    fleet_scale = {"out": 0, "in": 0}
+    fleet_rollouts = []
+    # serve.prefix.* radix-cache events (serving/prefix_cache.py)
+    prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
+              "prompt_tokens": 0, "evictions": 0, "evicted_tokens": 0,
+              "evicted_bytes": 0}
 
     for rec in records:
         name = rec.get("name", "")
@@ -119,6 +125,23 @@ def aggregate(records, profiles=None):
                     "memory_%s" % suffix, []).append(rec.get("value"))
         elif rtype == "event":
             events[name] = events.get(name, 0) + 1
+            if name.startswith("serve.prefix."):
+                data = rec.get("data") or {}
+                if name == "serve.prefix.hit":
+                    prefix["hits"] += 1
+                    prefix["hit_tokens"] += int(
+                        data.get("matched_tokens", 0))
+                    prefix["prompt_tokens"] += int(
+                        data.get("prompt_tokens", 0))
+                elif name == "serve.prefix.miss":
+                    prefix["misses"] += 1
+                    prefix["prompt_tokens"] += int(
+                        data.get("prompt_tokens", 0))
+                elif name == "serve.prefix.evict":
+                    prefix["evictions"] += int(data.get("nodes", 0))
+                    prefix["evicted_tokens"] += int(
+                        data.get("tokens", 0))
+                    prefix["evicted_bytes"] += int(data.get("bytes", 0))
             if name.startswith(("fleet.", "chaos.replica_kill")):
                 data = rec.get("data") or {}
                 if name == "fleet.request.dispatch":
@@ -142,6 +165,20 @@ def aggregate(records, profiles=None):
                     fleet_deaths += 1
                 elif name == "chaos.replica_kill":
                     fleet_chaos_kills += 1
+                elif name == "fleet.scale_out":
+                    fleet_scale["out"] += 1
+                elif name == "fleet.scale_in":
+                    fleet_scale["in"] += 1
+                elif name == "fleet.rollout":
+                    if data.get("phase") in ("done", "abort"):
+                        fleet_rollouts.append({
+                            "fleet_generation":
+                                data.get("fleet_generation"),
+                            "phase": data.get("phase"),
+                            "replaced": data.get("replaced"),
+                            "shed_requests": data.get("shed_requests"),
+                            "ms": data.get("ms"),
+                        })
 
     # finalize timer stats
     for t in timers.values():
@@ -236,7 +273,9 @@ def aggregate(records, profiles=None):
 
     fleet = {}
     if (fleet_dispatch or fleet_failovers or fleet_shed
-            or fleet_restarts or fleet_deaths or fleet_chaos_kills):
+            or fleet_restarts or fleet_deaths or fleet_chaos_kills
+            or fleet_scale["out"] or fleet_scale["in"]
+            or fleet_rollouts):
         fleet_restarts.sort(key=lambda r: (r["ts"] is None, r["ts"]))
         fleet = {
             "requests_per_replica": {
@@ -248,7 +287,21 @@ def aggregate(records, profiles=None):
             "replica_deaths": fleet_deaths,
             "chaos_kills": fleet_chaos_kills,
             "restarts": fleet_restarts,
+            "scale_outs": fleet_scale["out"],
+            "scale_ins": fleet_scale["in"],
+            "rollouts": fleet_rollouts,
         }
+
+    prefix_cache = {}
+    looked_up = prefix["hits"] + prefix["misses"]
+    if looked_up or prefix["evictions"]:
+        prefix_cache = dict(prefix)
+        prefix_cache["hit_rate"] = round(
+            prefix["hits"] / looked_up, 4) if looked_up else 0.0
+        # FLOPs proxy: fraction of admitted prompt tokens whose prefill
+        # was skipped because their KV came out of the radix cache
+        prefix_cache["prefill_tokens_skipped_frac"] = round(
+            prefix["hit_tokens"] / max(1, prefix["prompt_tokens"]), 4)
 
     task_rows = sorted(
         tasks.values(),
@@ -264,6 +317,7 @@ def aggregate(records, profiles=None):
         "events": dict(sorted(events.items())),
         "train": train,
         "fleet": fleet,
+        "prefix_cache": prefix_cache,
         "timeline": timeline,
         "profiles": list(profiles or []),
     }
@@ -384,6 +438,15 @@ def render_summary(run_id, agg, echo=print):
         if fleet.get("chaos_kills"):
             line += ", chaos kills %d" % fleet["chaos_kills"]
         echo(line)
+        if fleet.get("scale_outs") or fleet.get("scale_ins"):
+            echo("  autoscaler: %d scale-out(s), %d scale-in(s)"
+                 % (fleet.get("scale_outs", 0),
+                    fleet.get("scale_ins", 0)))
+        for ro in fleet.get("rollouts") or []:
+            echo("  rollout gen %s: %s (%s replaced, %s shed, %s)"
+                 % (ro.get("fleet_generation"), ro.get("phase"),
+                    ro.get("replaced"), ro.get("shed_requests"),
+                    _fmt_ms(ro.get("ms"))))
         if fleet.get("shed"):
             echo("  shed by reason: " + ", ".join(
                 "%s=%d" % (k, v) for k, v in fleet["shed"].items()))
@@ -393,6 +456,24 @@ def render_summary(run_id, agg, echo=print):
                 echo("    replica %s attempt %s: wait %ss"
                      % (r.get("replica"), r.get("attempt"),
                         r.get("delay_s")))
+    prefix_cache = agg.get("prefix_cache") or {}
+    if prefix_cache:
+        echo("")
+        echo("prefix cache (radix KV reuse):")
+        echo("  %d hit(s) / %d miss(es) (hit rate %.0f%%), %d of %d "
+             "prompt tokens served from cache (%.0f%% of prefill "
+             "skipped)"
+             % (prefix_cache["hits"], prefix_cache["misses"],
+                prefix_cache["hit_rate"] * 100,
+                prefix_cache["hit_tokens"],
+                prefix_cache["prompt_tokens"],
+                prefix_cache["prefill_tokens_skipped_frac"] * 100))
+        if prefix_cache.get("evictions"):
+            echo("  evicted %d node(s) / %d token(s) / %.1f MB under "
+                 "byte budget"
+                 % (prefix_cache["evictions"],
+                    prefix_cache["evicted_tokens"],
+                    prefix_cache["evicted_bytes"] / 2**20))
     if agg["counters"]:
         echo("")
         echo("counters:")

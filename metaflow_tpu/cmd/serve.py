@@ -148,14 +148,16 @@ def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
                 params_key="params", config_json=None, model="llama",
                 host="127.0.0.1", port=8000, replicas=2, slots=8,
                 max_seq_len=None, prefill_chunk=64, max_queue=64,
-                mesh_spec=None, attn_impl="auto", echo=print,
-                block=True):
+                mesh_spec=None, attn_impl="auto", prefill_workers=0,
+                prefix_cache_mb=None, echo=print, block=True):
     """`tpuflow serve FLOW/RUN --replicas N`: fork N replica workers
     (each loading the run's checkpoint through load_run_checkpoint) and
     front them with the health-checked failover router
-    (serving/fleet.py). Returns the running ServingFleet when
-    block=False (tests); otherwise serves until SIGTERM/SIGINT, draining
-    the whole fleet before exit."""
+    (serving/fleet.py). `--prefill-workers K` adds K dedicated prefill
+    replicas (disaggregated prefill/decode, docs/serving.md#disagg).
+    Returns the running ServingFleet when block=False (tests);
+    otherwise serves until SIGTERM/SIGINT, draining the whole fleet
+    before exit."""
     from .. import telemetry
     from ..devtools import chaos as chaos_mod
     from ..serving import FleetConfig, ServingFleet, \
@@ -178,17 +180,22 @@ def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
         replica_args += ["--max-seq-len", str(max_seq_len)]
     if mesh_spec:
         replica_args += ["--mesh", mesh_spec]
+    if prefix_cache_mb is not None:
+        replica_args += ["--prefix-cache-mb", str(prefix_cache_mb)]
     config = FleetConfig.from_env()
     spawner = SubprocessReplicaSpawner(
         replica_args, spawn_timeout_s=config.spawn_timeout_s)
     _init_serve_telemetry(flow_name, run_id, task_prefix="fleet")
     fleet = ServingFleet(
         spawner, replicas, config=config, host=host, port=port,
-        chaos=chaos_mod.fleet_from_env(replicas), echo=echo)
+        chaos=chaos_mod.fleet_from_env(replicas), echo=echo,
+        prefill_workers=int(prefill_workers))
     fleet.start()
     echo("fleet: serving %s/%s on http://%s:%d (%d replicas x %d "
-         "slots)" % (flow_name, run_id, fleet.host, fleet.port,
-                     replicas, slots))
+         "slots%s)" % (flow_name, run_id, fleet.host, fleet.port,
+                       replicas, slots,
+                       ", %d prefill workers" % prefill_workers
+                       if prefill_workers else ""))
     echo("  POST /v1/generate  {\"tokens\": [...], \"max_new_tokens\":"
          " N, \"stream\": true, \"session\": \"...\"}")
     if not block:
@@ -200,21 +207,87 @@ def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
     echo("fleet drained — all replicas stopped")
 
 
+def reload_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
+                 host="127.0.0.1", port=8000, echo=print,
+                 timeout_s=600.0):
+    """`tpuflow serve FLOW/RUN --reload`: roll a RUNNING fleet (at
+    --host/--port) onto a new checkpoint generation. POSTs
+    /v1/admin/reload with the replica-arg updates, then polls
+    /v1/admin/rollout until the surge rollout (spawn replacement ->
+    ready -> drain old -> retire, one replica at a time) finishes.
+    Returns the final rollout record; raises on abort/timeout."""
+    import time
+    from http.client import HTTPConnection
+
+    flow_name, run_id = _resolve_flow_run(flow_run, run_id)
+    args_update = {"--flow": flow_name, "--run-id": str(run_id)}
+    if step_name:
+        args_update["--step-name"] = step_name
+    if ckpt_step is not None:
+        args_update["--ckpt-step"] = str(ckpt_step)
+
+    def _call(method, path, body=None):
+        conn = HTTPConnection(host, port, timeout=30)
+        try:
+            headers = {"Content-Type": "application/json"} if body \
+                else {}
+            conn.request(method, path,
+                         body=json.dumps(body).encode() if body
+                         else None, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read().decode() or "{}")
+        finally:
+            conn.close()
+
+    status, ack = _call("POST", "/v1/admin/reload",
+                        {"args_update": args_update})
+    if status != 202:
+        raise TpuFlowException(
+            "fleet refused reload (%d): %s" % (status, ack))
+    target = int(ack.get("fleet_generation", 0))
+    echo("rollout: fleet -> generation %d (%s/%s)"
+         % (target, flow_name, run_id))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        _, ro = _call("GET", "/v1/admin/rollout")
+        last = ro.get("last") or {}
+        if (not ro.get("active")
+                and int(ro.get("fleet_generation", 0)) >= target):
+            echo("rollout: done — replaced %s replica(s), shed %s, "
+                 "%.0f ms" % (last.get("replaced"),
+                              last.get("shed_requests"),
+                              float(last.get("ms") or 0.0)))
+            return last
+        time.sleep(0.5)
+    raise TpuFlowException("rollout did not finish within %.0fs"
+                           % timeout_s)
+
+
 def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
           params_key="params", config_json=None, model="llama",
           host="127.0.0.1", port=8000, replicas=1, slots=8,
           max_seq_len=None, prefill_chunk=64, max_queue=64,
-          mesh_spec=None, attn_impl="auto", echo=print, block=True):
+          mesh_spec=None, attn_impl="auto", prefill_workers=0,
+          prefix_cache_mb=None, reload_checkpoint=False, echo=print,
+          block=True):
     """Load FLOW/RUN's checkpoint and serve it. Returns the running
     ServingServer when block=False (tests); otherwise serves until
     SIGTERM/SIGINT, draining in-flight requests before exit. With
-    --replicas N > 1 the work moves to the fleet tier (serve_fleet):
-    N forked replica workers behind the failover router."""
+    --replicas N > 1 (or --prefill-workers K > 0) the work moves to the
+    fleet tier (serve_fleet): forked replica workers behind the
+    failover router. With --reload, no server starts: the named
+    checkpoint is rolled onto the ALREADY-RUNNING fleet at
+    --host/--port via a zero-shed rolling upgrade."""
     from .. import telemetry
     from ..inference import load_run_checkpoint
-    from ..serving import Scheduler, ServingServer
+    from ..serving import RadixPrefixCache, Scheduler, ServingServer
 
-    if int(replicas) > 1:
+    if reload_checkpoint:
+        return reload_fleet(flow_run, run_id=run_id,
+                            step_name=step_name, ckpt_step=ckpt_step,
+                            host=host, port=port, echo=echo)
+
+    if int(replicas) > 1 or int(prefill_workers) > 0:
         return serve_fleet(
             flow_run, run_id=run_id, step_name=step_name,
             ckpt_step=ckpt_step, params_key=params_key,
@@ -222,7 +295,8 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
             replicas=int(replicas), slots=slots,
             max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
             max_queue=max_queue, mesh_spec=mesh_spec,
-            attn_impl=attn_impl, echo=echo, block=block)
+            attn_impl=attn_impl, prefill_workers=int(prefill_workers),
+            prefix_cache_mb=prefix_cache_mb, echo=echo, block=block)
 
     # resolve the run HERE (not only inside load_run_checkpoint) so
     # telemetry lands under the real run id, next to its training
@@ -238,7 +312,13 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
                           prefill_chunk=prefill_chunk,
                           mesh_spec=mesh_spec, attn_impl=attn_impl)
     _init_serve_telemetry(flow_name, run_id)
-    scheduler = Scheduler(engine, max_queue=max_queue)
+    if prefix_cache_mb is not None:
+        cache = (RadixPrefixCache(int(prefix_cache_mb) << 20)
+                 if int(prefix_cache_mb) > 0 else None)
+    else:
+        cache = RadixPrefixCache.from_env()
+    scheduler = Scheduler(engine, max_queue=max_queue,
+                          prefix_cache=cache)
     server = ServingServer(scheduler, host=host, port=port)
     echo("serving %s/%s on http://%s:%d  (%d slots x %d positions, "
          "attn=%s)" % (flow_name, run_id, server.host,

@@ -56,10 +56,19 @@ class WatchState(object):
         self._ttft_ms = deque(maxlen=window)
         self._itl_ms = deque(maxlen=window * 4)
         self._served = deque(maxlen=window * 2)   # (ts, new_tokens)
+        # prefix cache (radix KV reuse)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
+        self.prefix_evictions = 0
         # fleet
         self.replicas_ready = None
         self.replica_flaps = 0
         self._restart_ts = deque(maxlen=64)
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.last_rollout = None      # latest fleet.rollout event data
         # incidents
         self.desync_count = 0
         self.flush_failures = 0
@@ -107,10 +116,28 @@ class WatchState(object):
                     total = data.get("total_ms")
                     if ttft is not None and total is not None and new > 1:
                         self._itl_ms.append((total - ttft) / (new - 1))
+                elif name == "serve.prefix.hit":
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += \
+                        data.get("matched_tokens") or 0
+                    self.prefix_prompt_tokens += \
+                        data.get("prompt_tokens") or 0
+                elif name == "serve.prefix.miss":
+                    self.prefix_misses += 1
+                    self.prefix_prompt_tokens += \
+                        data.get("prompt_tokens") or 0
+                elif name == "serve.prefix.evict":
+                    self.prefix_evictions += data.get("nodes") or 0
                 elif name == "fleet.replica.dead":
                     self.replica_flaps += 1
                 elif name == "fleet.replica.restart":
                     self._restart_ts.append(ts)
+                elif name == "fleet.scale_out":
+                    self.scale_outs += 1
+                elif name == "fleet.scale_in":
+                    self.scale_ins += 1
+                elif name == "fleet.rollout":
+                    self.last_rollout = data
                 elif name == "sanitize.desync":
                     self.desync_count += 1
                 elif name == "slo.breach":
@@ -160,6 +187,12 @@ class WatchState(object):
             if span > 0:
                 m["serve_tokens_per_sec"] = round(
                     sum(n for _t, n in self._served) / span, 1)
+        looked_up = self.prefix_hits + self.prefix_misses
+        if looked_up:
+            m["prefix_hit_rate"] = round(self.prefix_hits / looked_up, 4)
+            m["prefix_tokens_skipped_frac"] = round(
+                self.prefix_hit_tokens
+                / max(1, self.prefix_prompt_tokens), 4)
         return m
 
 
@@ -194,12 +227,29 @@ def render_frame(state, run_id, breaches=(), echo=print):
         if "serve_tokens_per_sec" in m:
             line += "  %.0f tok/s" % m["serve_tokens_per_sec"]
         echo(line)
+    if "prefix_hit_rate" in m or state.prefix_evictions:
+        echo("  prefix: hit rate %.0f%%  prefill skipped %.0f%%  "
+             "evictions %d" % (
+                 m.get("prefix_hit_rate", 0.0) * 100,
+                 m.get("prefix_tokens_skipped_frac", 0.0) * 100,
+                 state.prefix_evictions))
     if state.replicas_ready is not None or state.replica_flaps:
-        echo("  fleet: ready %s  flaps %d  restarts/min %s" % (
+        line = "  fleet: ready %s  flaps %d  restarts/min %s" % (
             state.replicas_ready
             if state.replicas_ready is not None else "-",
             state.replica_flaps,
-            m.get("replica_restart_rate_per_min", 0.0)))
+            m.get("replica_restart_rate_per_min", 0.0))
+        if state.scale_outs or state.scale_ins:
+            line += "  scale +%d/-%d" % (state.scale_outs,
+                                         state.scale_ins)
+        echo(line)
+    if state.last_rollout is not None:
+        ro = state.last_rollout
+        echo("  rollout: gen %s %s%s" % (
+            ro.get("fleet_generation"), ro.get("phase"),
+            ("  (%s replaced, %s shed)"
+             % (ro.get("replaced"), ro.get("shed_requests")))
+            if ro.get("phase") == "done" else ""))
     if state.desync_count or state.flush_failures:
         echo("  incidents: desync %d  flush_failed %d"
              % (state.desync_count, state.flush_failures))

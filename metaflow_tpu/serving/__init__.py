@@ -1,7 +1,8 @@
 """Continuous-batching serving engine: slot-based KV cache, request
-scheduler, HTTP API, and the fault-tolerant replica fleet. See
-docs/serving.md."""
+scheduler, HTTP API, radix prefix cache, prefill/decode disaggregation,
+and the fault-tolerant autoscaling replica fleet. See docs/serving.md."""
 
+from .disagg import decode_handoff, encode_handoff
 from .engine import SlotEngine, request_step_keys, sample_slots
 from .fleet import (
     FleetConfig,
@@ -9,13 +10,14 @@ from .fleet import (
     ServingFleet,
     SubprocessReplicaSpawner,
 )
+from .prefix_cache import PrefixHandle, RadixPrefixCache
 from .scheduler import (
     DrainingError,
     QueueFullError,
     Request,
     Scheduler,
 )
-from .server import ServingServer
+from .server import ServingServer, retry_after_hint
 
 __all__ = [
     "SlotEngine",
@@ -30,4 +32,9 @@ __all__ = [
     "FleetConfig",
     "ReplicaHandle",
     "SubprocessReplicaSpawner",
+    "RadixPrefixCache",
+    "PrefixHandle",
+    "encode_handoff",
+    "decode_handoff",
+    "retry_after_hint",
 ]

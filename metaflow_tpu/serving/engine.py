@@ -209,6 +209,27 @@ class SlotEngine(object):
             return sample_slots(last, key[None], temp[None], top_k[None],
                                 top_p[None])[0]
 
+        def _seed(cache, k, v, slot):
+            # write a [layers, T, kv_heads, head_dim] KV range into one
+            # slot's cache view starting at position 0; slot is TRACED
+            # so compiles are bounded by the T bucket, not the pool size
+            cache_k = jax.lax.dynamic_update_slice(
+                cache["k"], k[:, None], (0, slot, 0, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache["v"], v[:, None], (0, slot, 0, 0, 0))
+            return {"k": cache_k, "v": cache_v}
+
+        def _extract(cache, slot, T):
+            # read the first T positions of one slot's view; T is STATIC
+            # (callers pass a power-of-two bucket and trim on host)
+            L = cache["k"].shape[0]
+            KV, HD = cache["k"].shape[3], cache["k"].shape[4]
+            k = jax.lax.dynamic_slice(
+                cache["k"], (0, slot, 0, 0, 0), (L, 1, T, KV, HD))
+            v = jax.lax.dynamic_slice(
+                cache["v"], (0, slot, 0, 0, 0), (L, 1, T, KV, HD))
+            return k[:, 0], v[:, 0]
+
         # the cache is donated: the pool's KV state is the single largest
         # buffer and every call replaces it wholesale
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(1,))
@@ -217,6 +238,9 @@ class SlotEngine(object):
         self._decode_greedy_fn = jax.jit(_decode_greedy,
                                          donate_argnums=(1,))
         self._first_fn = jax.jit(_first_token)
+        self._seed_fn = jax.jit(_seed, donate_argnums=(0,))
+        # no donation: the pool cache must survive an extraction
+        self._extract_fn = jax.jit(_extract, static_argnums=(2,))
 
     # ---------- pool state ----------
 
@@ -234,7 +258,16 @@ class SlotEngine(object):
             "decode_greedy": self._decode_greedy_fn._cache_size(),
             "decode_sampled": self._decode_sampled_fn._cache_size(),
             "first_token": self._first_fn._cache_size(),
+            "seed_prefix": self._seed_fn._cache_size(),
+            "extract_kv": self._extract_fn._cache_size(),
         }
+
+    def kv_token_bytes(self):
+        """Host bytes one cached token costs (k + v across layers) —
+        the unit the prefix-cache byte budget is denominated in."""
+        k = self._cache["k"]
+        layers, _, _, kv_heads, head_dim = k.shape
+        return 2 * layers * kv_heads * head_dim * k.dtype.itemsize
 
     # ---------- slot lifecycle ----------
 
@@ -277,6 +310,94 @@ class SlotEngine(object):
     def slot_context(self, slot):
         """The context bound at admit time, or None for a free slot."""
         return self._slot_ctx[slot]
+
+    def seed_prefix(self, slot, kv):
+        """Copy a cached KV range ({"k": [layers, T, kv_heads,
+        head_dim], "v": ...}, host arrays) into the slot's cache view at
+        positions [0, T) and move the prefill cursor to T, so chunked
+        prefill resumes at the match boundary. Must run after admit(),
+        before the first prefill_step; T must be < the slot's prompt
+        length (at least one token has to prefill so final-chunk logits
+        exist for first-token sampling).
+
+        The upload pads T to a power-of-two bucket (compiles stay
+        log2-bounded); pad positions hold garbage that is overwritten
+        before it becomes visible — by the resumed prefill chunks up to
+        the prompt end, and by the decode-step write at pos beyond it —
+        the same invariant masked lanes already rely on."""
+        if not self.active[slot] or self.decoding[slot]:
+            raise ValueError("slot %d is not prefilling" % slot)
+        if int(self._prefill_cursor[slot]) != 0:
+            raise ValueError("slot %d already started prefill" % slot)
+        k, v = np.asarray(kv["k"]), np.asarray(kv["v"])
+        T = k.shape[1]
+        prompt = self._prompt[slot]
+        if not (0 < T < prompt.size):
+            raise ValueError(
+                "seed length %d must be in [1, prompt %d)"
+                % (T, prompt.size))
+        bucket = bucket_length(T, minimum=self.min_bucket,
+                               maximum=self.max_seq_len)
+        if bucket > T:
+            pad = [(0, 0), (0, bucket - T), (0, 0), (0, 0)]
+            k = np.pad(k, pad)
+            v = np.pad(v, pad)
+        dtype = self._cache["k"].dtype
+        self._cache = self._seed_fn(
+            self._cache, jnp.asarray(k, dtype), jnp.asarray(v, dtype),
+            jnp.int32(slot))
+        self._prefill_cursor[slot] = T
+        self.pos[slot] = T
+        self._dirty = True
+
+    def extract_kv(self, slot, length):
+        """The first `length` cache positions of a slot as host arrays
+        ({"k": [layers, length, kv_heads, head_dim], "v": ...}) — the
+        prefix-cache insert / disaggregation handoff read path. The
+        device slice uses a power-of-two bucket (static shape, bounded
+        compiles) and trims on host."""
+        if length < 1 or length > self.max_seq_len:
+            raise ValueError("length %d out of range" % length)
+        bucket = bucket_length(length, minimum=self.min_bucket,
+                               maximum=self.max_seq_len)
+        k, v = self._extract_fn(self._cache, jnp.int32(slot), bucket)
+        return {"k": np.asarray(k)[:, :length],
+                "v": np.asarray(v)[:, :length]}
+
+    def admit_prefilled(self, slot, prompt_tokens, first_token, kv,
+                        max_new_tokens, temperature=0.0, top_k=None,
+                        top_p=None, rng=0):
+        """Bind a request whose prefill ALREADY happened elsewhere (a
+        dedicated prefill worker): seed the full prompt's KV, accept the
+        first sampled token, and enter the decode state directly. With
+        the same (prompt, knobs, rng), the continued decode emits
+        exactly the tokens a local prefill would — the key schedule
+        resumes at cursor 1, mirroring prefill_step's final chunk."""
+        self.admit(slot, prompt_tokens, max_new_tokens,
+                   temperature=temperature, top_k=top_k, top_p=top_p,
+                   rng=rng)
+        prompt = self._prompt[slot]
+        k = np.asarray(kv["k"])
+        if k.shape[1] != prompt.size:
+            self.release(slot)
+            raise ValueError("handoff kv length %d != prompt %d"
+                             % (k.shape[1], prompt.size))
+        bucket = bucket_length(prompt.size, minimum=self.min_bucket,
+                               maximum=self.max_seq_len)
+        v = np.asarray(kv["v"])
+        if bucket > prompt.size:
+            pad = [(0, 0), (0, bucket - prompt.size), (0, 0), (0, 0)]
+            k, v = np.pad(k, pad), np.pad(v, pad)
+        dtype = self._cache["k"].dtype
+        self._cache = self._seed_fn(
+            self._cache, jnp.asarray(k, dtype), jnp.asarray(v, dtype),
+            jnp.int32(slot))
+        self._prefill_cursor[slot] = prompt.size
+        self.decoding[slot] = True
+        self.pos[slot] = prompt.size
+        self._tok[slot] = int(first_token)
+        self._key_cursor[slot] = 1
+        self._dirty = True
 
     def release(self, slot):
         """Reclaim a slot immediately; the stale cache contents stay and

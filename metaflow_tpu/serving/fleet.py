@@ -67,6 +67,7 @@ from .. import slo as slo_rules_mod
 from .. import telemetry
 from .. import tracing
 from ..elastic.policy import BackoffPolicy
+from .server import retry_after_hint
 
 
 def _env_num(env, name, default, cast=float):
@@ -83,7 +84,9 @@ class FleetConfig(object):
     def __init__(self, max_inflight=None, failover=True, restart=True,
                  max_restarts=16, health_interval_s=1.0, health_fails=3,
                  spawn_timeout_s=180.0, redispatch_max=3, wait_s=15.0,
-                 backoff=None):
+                 backoff=None, autoscale=False, min_replicas=1,
+                 max_replicas=8, scale_out_queue=2.0,
+                 scale_in_occupancy=0.25, scale_sustain=3):
         self.max_inflight = max_inflight  # None: 4x total slots at start
         self.failover = bool(failover)
         self.restart = bool(restart)
@@ -94,6 +97,17 @@ class FleetConfig(object):
         self.redispatch_max = int(redispatch_max)
         self.wait_s = float(wait_s)
         self.backoff = backoff or BackoffPolicy.from_env()
+        # autoscaler: resize the decode pool from the queue-depth /
+        # occupancy gauges the health loop already aggregates. A signal
+        # must hold for `scale_sustain` consecutive health evaluations
+        # before acting, and direction flapping is damped by the shared
+        # BackoffPolicy cooldown.
+        self.autoscale = bool(autoscale)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_out_queue = float(scale_out_queue)
+        self.scale_in_occupancy = float(scale_in_occupancy)
+        self.scale_sustain = int(scale_sustain)
 
     @classmethod
     def from_env(cls, env=None):
@@ -118,18 +132,33 @@ class FleetConfig(object):
             redispatch_max=_env_num(env, "TPUFLOW_FLEET_REDISPATCH_MAX",
                                     3, int),
             wait_s=_env_num(env, "TPUFLOW_FLEET_WAIT_S", 15.0),
+            autoscale=env.get("TPUFLOW_FLEET_AUTOSCALE", "0") != "0",
+            min_replicas=_env_num(env, "TPUFLOW_FLEET_MIN_REPLICAS",
+                                  1, int),
+            max_replicas=_env_num(env, "TPUFLOW_FLEET_MAX_REPLICAS",
+                                  8, int),
+            scale_out_queue=_env_num(env, "TPUFLOW_FLEET_SCALE_OUT_QUEUE",
+                                     2.0),
+            scale_in_occupancy=_env_num(
+                env, "TPUFLOW_FLEET_SCALE_IN_OCC", 0.25),
+            scale_sustain=_env_num(env, "TPUFLOW_FLEET_SCALE_SUSTAIN",
+                                   3, int),
         )
 
 
 class ReplicaHandle(object):
     """Router-side view of one replica worker."""
 
-    def __init__(self, index):
+    def __init__(self, index, role="unified"):
         self.index = index
+        self.role = role        # unified|prefill|decode (pool membership)
         self.proc = None        # Popen-like: poll/terminate/kill/wait
         self.host = None
         self.port = None
-        self.state = "starting"  # starting|ready|backoff|dead|stopped
+        # starting|ready|draining|backoff|dead|stopped — `draining`
+        # means excluded from dispatch while in-flight work finishes
+        # (rolling upgrade / scale-in retirement)
+        self.state = "starting"
         self.generation = 0      # bumps on every (re)spawn
         self.restarts = 0        # restart attempts consumed
         self.inflight = 0        # router-dispatched, not yet returned
@@ -146,6 +175,7 @@ class ReplicaHandle(object):
     def describe(self):
         return {
             "index": self.index, "state": self.state, "pid": self.pid,
+            "role": self.role,
             "port": self.port, "inflight": self.inflight,
             "dispatched": self.dispatched, "restarts": self.restarts,
             "generation": self.generation,
@@ -158,21 +188,42 @@ class SubprocessReplicaSpawner(object):
     """Default spawner: fork `python -m metaflow_tpu.serving.replica`
     and wait for its port-file (the ready protocol)."""
 
+    supports_role = True
+
     def __init__(self, replica_args, workdir=None, env=None,
                  spawn_timeout_s=180.0):
         self.replica_args = list(replica_args)  # sans --port-file/--index
         self.workdir = workdir or tempfile.mkdtemp(prefix="tpuflow-fleet-")
         self.env = env
         self.spawn_timeout_s = float(spawn_timeout_s)
+        self._args_lock = threading.Lock()
 
-    def __call__(self, index, generation):
+    def update_args(self, mapping):
+        """Rewrite spawn-time flags ({"--ckpt-step": "400"}) — the
+        rolling-upgrade hook: replicas spawned AFTER this call boot with
+        the new values (e.g. a new checkpoint), already-running ones
+        keep serving the old generation until they are replaced."""
+        with self._args_lock:
+            args = list(self.replica_args)
+            for flag, value in mapping.items():
+                if flag in args:
+                    args[args.index(flag) + 1] = str(value)
+                else:
+                    args.extend([flag, str(value)])
+            self.replica_args = args
+
+    def __call__(self, index, generation, role="unified"):
         port_file = os.path.join(
             self.workdir, "replica-%d-gen%d.port" % (index, generation))
         log_path = os.path.join(
             self.workdir, "replica-%d-gen%d.log" % (index, generation))
+        with self._args_lock:
+            extra = list(self.replica_args)
+        if role != "unified":
+            extra += ["--role", role]
         argv = [sys.executable, "-m", "metaflow_tpu.serving.replica",
                 "--port-file", port_file,
-                "--replica-index", str(index)] + self.replica_args
+                "--replica-index", str(index)] + extra
         log = open(log_path, "ab")
         try:
             proc = subprocess.Popen(
@@ -232,12 +283,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def fleet(self):
         return self.server.fleet
 
-    def _json(self, code, obj):
+    def _json(self, code, obj, headers=None):
         body = json.dumps(obj).encode("utf-8")
         try:
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
@@ -252,15 +305,33 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/stats":
             self._json(200, self.fleet.stats())
             return
+        if self.path == "/v1/admin/rollout":
+            self._json(200, self.fleet.rollout_status())
+            return
         self._json(404, {"error": "not found"})
 
     def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+        except (ValueError, TypeError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        if self.path == "/v1/admin/reload":
+            try:
+                payload = json.loads(body or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, TypeError) as ex:
+                self._json(400, {"error": str(ex)})
+                return
+            self.fleet.handle_reload(self, payload)
+            return
         if self.path != "/v1/generate":
             self._json(404, {"error": "not found"})
             return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = json.loads(body or b"{}")
             if not isinstance(payload, dict):
                 raise ValueError("body must be a JSON object")
         except (ValueError, TypeError) as ex:
@@ -282,14 +353,25 @@ class ServingFleet(object):
     """
 
     def __init__(self, spawner, n_replicas, config=None, host="127.0.0.1",
-                 port=0, chaos=None, echo=None):
+                 port=0, chaos=None, echo=None, prefill_workers=0):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        if prefill_workers < 0:
+            raise ValueError("prefill_workers must be >= 0")
         self.spawner = spawner
         self.config = config or FleetConfig.from_env()
         self.chaos = chaos
         self.echo = echo or (lambda *_a, **_k: None)
-        self.handles = [ReplicaHandle(i) for i in range(n_replicas)]
+        # K=0: every replica is `unified` (prefill + decode, the
+        # pre-disaggregation topology). K>0: n_replicas decode replicas
+        # plus K dedicated prefill workers, tracked as two pools.
+        self.prefill_workers = int(prefill_workers)
+        role = "decode" if self.prefill_workers else "unified"
+        self.handles = [ReplicaHandle(i, role=role)
+                        for i in range(n_replicas)]
+        self.handles += [ReplicaHandle(n_replicas + i, role="prefill")
+                         for i in range(self.prefill_workers)]
+        self._next_index = len(self.handles)
         self._lock = threading.Lock()
         self._sessions = {}      # session id -> ReplicaHandle
         self._draining = False
@@ -301,6 +383,21 @@ class ServingFleet(object):
         self.shed_count = 0
         self.restart_count = 0
         self.completed = 0
+        self.prefill_handoffs = 0
+        self.disagg_fallbacks = 0
+        self.scale_out_count = 0
+        self.scale_in_count = 0
+        # autoscaler evaluation state (health-loop thread only)
+        self._scale_out_streak = 0
+        self._scale_in_streak = 0
+        self._scale_block_until = 0.0
+        self._scale_flaps = 0
+        self._last_scale_dir = None
+        # rolling-upgrade state
+        self.fleet_generation = 0
+        self._rollout_guard = threading.Lock()
+        self._rollout_active = False
+        self._last_rollout = None
         # SLO monitoring: rules come from TPUFLOW_SLO_* / TPUFLOW_SLO_FILE
         # and are re-evaluated by the health loop against replica-reported
         # tail latency + the supervisor's own restart history
@@ -373,8 +470,12 @@ class ServingFleet(object):
         h.t_spawn = time.monotonic()
         telemetry.event("fleet.replica.spawn", data={
             "replica": h.index, "generation": h.generation,
-            "restarts": h.restarts})
-        proc, host, port = self.spawner(h.index, h.generation)
+            "restarts": h.restarts, "role": h.role})
+        if getattr(self.spawner, "supports_role", False):
+            proc, host, port = self.spawner(h.index, h.generation,
+                                            role=h.role)
+        else:
+            proc, host, port = self.spawner(h.index, h.generation)
         h.proc, h.host, h.port = proc, host, port
         # the listener is up; confirm the scheduler answers before
         # taking traffic
@@ -423,12 +524,22 @@ class ServingFleet(object):
     def _monitor_loop(self):
         while not self._stopped:
             now = time.monotonic()
-            for h in self.handles:
+            for h in list(self.handles):
                 if self._stopped:
                     return
                 if h.state == "ready" and h.proc is not None \
                         and h.proc.poll() is not None:
                     self._on_death(h)
+                elif h.state == "draining" and h.proc is not None \
+                        and h.proc.poll() is not None:
+                    # a retiring replica (rollout / scale-in) that dies
+                    # early simply finishes retiring — its in-flight
+                    # relays fail over, but nothing restarts it
+                    with self._lock:
+                        h.state = "stopped"
+                        for sid in [s for s, hh in self._sessions.items()
+                                    if hh is h]:
+                            del self._sessions[sid]
                 elif h.state == "backoff" and h.restart_at is not None \
                         and now >= h.restart_at:
                     h.restart_at = None
@@ -481,7 +592,8 @@ class ServingFleet(object):
         while not self._stopped:
             time.sleep(self.config.health_interval_s)
             self._check_slo()
-            for h in self.handles:
+            self._autoscale_tick()
+            for h in list(self.handles):
                 if self._stopped or self._draining:
                     return
                 if h.state != "ready":
@@ -544,11 +656,299 @@ class ServingFleet(object):
         self.echo("fleet: replica %d restarting in %.2fs (attempt %d)"
                   % (h.index, delay, h.restarts))
 
+    # ---------- autoscaling ----------
+
+    def _decode_pool(self):
+        """Handles eligible for decode/unified dispatch (not prefill)."""
+        return [h for h in self.handles if h.role != "prefill"]
+
+    def _autoscale_tick(self, now=None):
+        """One autoscaler evaluation (normally called by the health loop
+        right after it refreshed last_stats). Scale-out when sustained
+        queue depth per ready replica crosses the threshold, scale-in
+        when the pool has drained (empty queues, low occupancy) — both
+        bounded by min/max_replicas, gated on `scale_sustain`
+        consecutive agreeing evaluations, and cooled down by the
+        BackoffPolicy so a flapping signal cannot thrash the pool."""
+        cfg = self.config
+        if (not cfg.autoscale or self._draining or self._stopped
+                or self._rollout_active):
+            return None
+        now = time.monotonic() if now is None else now
+        if now < self._scale_block_until:
+            return None
+        with self._lock:
+            pool = self._decode_pool()
+            ready = [h for h in pool if h.state == "ready"]
+            settling = [h for h in pool
+                        if h.state in ("starting", "backoff", "draining")]
+        if not ready or settling:
+            # a pool mid-transition gives garbage signals; wait it out
+            return None
+        queue_depth = sum((h.last_stats.get("queue_depth") or 0)
+                          for h in ready)
+        occ = [float(h.last_stats.get("occupancy") or 0.0)
+               for h in ready]
+        queue_per = queue_depth / float(len(ready))
+        if queue_per >= cfg.scale_out_queue \
+                and len(ready) < cfg.max_replicas:
+            self._scale_out_streak += 1
+            self._scale_in_streak = 0
+        elif (queue_depth == 0
+              and sum(occ) / len(occ) <= cfg.scale_in_occupancy
+              and len(ready) > cfg.min_replicas):
+            self._scale_in_streak += 1
+            self._scale_out_streak = 0
+        else:
+            self._scale_out_streak = 0
+            self._scale_in_streak = 0
+        if self._scale_out_streak >= cfg.scale_sustain:
+            self._scale_out_streak = 0
+            return self.scale_out(queue_per_replica=queue_per)
+        if self._scale_in_streak >= cfg.scale_sustain:
+            self._scale_in_streak = 0
+            return self.scale_in()
+        return None
+
+    def _scale_cooldown(self, direction):
+        # flapping (out→in→out…) earns geometrically longer cooldowns;
+        # repeated same-direction moves reset the damping
+        if self._last_scale_dir is not None \
+                and self._last_scale_dir != direction:
+            self._scale_flaps += 1
+        else:
+            self._scale_flaps = 0
+        self._last_scale_dir = direction
+        delay = self.config.backoff.delay(self._scale_flaps,
+                                          key="fleet-scale")
+        self._scale_block_until = time.monotonic() + delay
+        return delay
+
+    def scale_out(self, queue_per_replica=0.0, sync=False):
+        """Add one decode/unified replica. Async spawn by default (the
+        health loop must not block on a model boot); sync for tests."""
+        with self._lock:
+            pool = [h for h in self._decode_pool()
+                    if h.state not in ("stopped",)]
+            if len(pool) >= self.config.max_replicas:
+                return None
+            role = "decode" if self.prefill_workers else "unified"
+            h = ReplicaHandle(self._next_index, role=role)
+            self._next_index += 1
+            self.handles.append(h)
+            self.scale_out_count += 1
+            n_from = len(pool)
+        self._scale_cooldown("out")
+        telemetry.event("fleet.scale_out", data={
+            "replica": h.index, "from_replicas": n_from,
+            "to_replicas": n_from + 1,
+            "queue_per_replica": round(float(queue_per_replica), 3)})
+        self.echo("fleet: scaling OUT to %d replicas (queue/replica "
+                  "%.1f): spawning replica %d"
+                  % (n_from + 1, queue_per_replica, h.index))
+
+        def _boot():
+            try:
+                self._spawn(h)
+            except Exception as ex:
+                self.echo("fleet: scale-out replica %d failed to boot: "
+                          "%s" % (h.index, ex))
+                self._schedule_restart(h)
+
+        if sync:
+            _boot()
+        else:
+            threading.Thread(target=_boot, name="fleet-scale-out",
+                             daemon=True).start()
+        return h
+
+    def scale_in(self, sync=False):
+        """Retire the least-loaded decode replica: drain (no new
+        dispatches), wait for in-flight work, SIGTERM, drop."""
+        with self._lock:
+            ready = [h for h in self._decode_pool()
+                     if h.state == "ready"]
+            if len(ready) <= self.config.min_replicas:
+                return None
+            h = min(ready, key=lambda r: (
+                r.inflight, r.last_stats.get("queue_depth") or 0,
+                -r.index))
+            h.state = "draining"
+            for sid in [s for s, hh in self._sessions.items()
+                        if hh is h]:
+                del self._sessions[sid]
+            self.scale_in_count += 1
+            n_from = len(ready)
+        self._scale_cooldown("in")
+        telemetry.event("fleet.scale_in", data={
+            "replica": h.index, "from_replicas": n_from,
+            "to_replicas": n_from - 1})
+        self._gauge_ready()
+        self.echo("fleet: scaling IN to %d replicas: draining replica "
+                  "%d" % (n_from - 1, h.index))
+
+        def _retire():
+            self._retire(h)
+
+        if sync:
+            _retire()
+        else:
+            threading.Thread(target=_retire, name="fleet-scale-in",
+                             daemon=True).start()
+        return h
+
+    def _retire(self, h, timeout_s=120.0):
+        """Finish retiring a draining replica: wait out its in-flight
+        work, then the existing SIGTERM graceful drain, then drop it
+        from the fleet."""
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            with self._lock:
+                if h.inflight == 0:
+                    break
+            time.sleep(0.02)
+        if h.proc is not None and h.proc.poll() is None:
+            try:
+                h.proc.terminate()
+            except OSError:
+                pass
+            try:
+                h.proc.wait(timeout=timeout_s)
+            except Exception:
+                try:
+                    h.proc.kill()
+                except OSError:
+                    pass
+        with self._lock:
+            h.state = "stopped"
+            if h in self.handles:
+                self.handles.remove(h)
+        self._gauge_ready()
+
+    # ---------- rolling upgrades ----------
+
+    def rolling_reload(self, args_update=None, timeout_s=120.0):
+        """Generation-aware rollout: spawn a replacement for each
+        replica (surge), wait until it is ready, then drain and retire
+        the old one — one at a time, so capacity never drops below N
+        and a trace in flight during the rollout sheds NOTHING. With
+        `args_update` (e.g. {"--ckpt-step": "800"}) the replacements
+        boot from the new checkpoint: `tpuflow serve --reload` is this
+        method over HTTP."""
+        with self._rollout_guard:
+            if self._rollout_active:
+                raise RuntimeError("a rollout is already in progress")
+            self._rollout_active = True
+        t0 = time.monotonic()
+        with self._lock:
+            shed0 = self.shed_count
+        self.fleet_generation += 1
+        gen = self.fleet_generation
+        telemetry.event("fleet.rollout", data={
+            "phase": "start", "fleet_generation": gen,
+            "replicas": len(self.handles)})
+        self.echo("fleet: rolling upgrade to generation %d" % gen)
+        if args_update:
+            if not hasattr(self.spawner, "update_args"):
+                with self._rollout_guard:
+                    self._rollout_active = False
+                raise RuntimeError(
+                    "spawner cannot update args; reload unsupported")
+            self.spawner.update_args(args_update)
+        replaced = 0
+        try:
+            for h in list(self.handles):
+                if h.state != "ready" or self._draining or self._stopped:
+                    continue
+                nh = ReplicaHandle(self._next_index, role=h.role)
+                self._next_index += 1
+                with self._lock:
+                    self.handles.append(nh)
+                try:
+                    self._spawn(nh)
+                except Exception:
+                    with self._lock:
+                        if nh in self.handles:
+                            self.handles.remove(nh)
+                    telemetry.event("fleet.rollout", data={
+                        "phase": "abort", "fleet_generation": gen,
+                        "replaced": replaced})
+                    raise
+                # the surge replica is taking traffic; retire the old one
+                with self._lock:
+                    h.state = "draining"
+                    for sid in [s for s, hh in self._sessions.items()
+                                if hh is h]:
+                        del self._sessions[sid]
+                self._retire(h, timeout_s=timeout_s)
+                replaced += 1
+                telemetry.event("fleet.rollout", data={
+                    "phase": "replica", "fleet_generation": gen,
+                    "old_replica": h.index, "new_replica": nh.index})
+                self.echo("fleet: rollout replaced replica %d with %d"
+                          % (h.index, nh.index))
+        finally:
+            with self._rollout_guard:
+                self._rollout_active = False
+        with self._lock:
+            shed = self.shed_count - shed0
+        self._last_rollout = {
+            "fleet_generation": gen, "replaced": replaced,
+            "shed_requests": shed,
+            "ms": round((time.monotonic() - t0) * 1000, 3)}
+        telemetry.event("fleet.rollout", data=dict(
+            self._last_rollout, phase="done"))
+        self.echo("fleet: rollout to generation %d done (%d replaced, "
+                  "%d shed)" % (gen, replaced, shed))
+        return self._last_rollout
+
+    def rollout_status(self):
+        return {
+            "active": self._rollout_active,
+            "fleet_generation": self.fleet_generation,
+            "last": self._last_rollout,
+        }
+
+    def handle_reload(self, handler, payload):
+        """POST /v1/admin/reload: kick off a rollout in the background
+        and answer 202; poll GET /v1/admin/rollout for completion."""
+        if self._draining or self._stopped:
+            handler._json(503, {"error": "fleet is draining"})
+            return
+        with self._rollout_guard:
+            if self._rollout_active:
+                handler._json(409, {"error": "rollout already active"})
+                return
+        args_update = payload.get("args_update") or None
+        if args_update is not None and (
+                not isinstance(args_update, dict)
+                or not all(isinstance(k, str) for k in args_update)):
+            handler._json(400,
+                          {"error": "args_update must be a flag map"})
+            return
+
+        # capture the target before the thread starts: rolling_reload
+        # bumps fleet_generation and may win the race with the response
+        target_generation = self.fleet_generation + 1
+
+        def _run():
+            try:
+                self.rolling_reload(args_update=args_update)
+            except Exception as ex:
+                self.echo("fleet: rollout failed: %s" % ex)
+
+        threading.Thread(target=_run, name="fleet-rollout",
+                         daemon=True).start()
+        handler._json(202, {"status": "rollout started",
+                            "fleet_generation": target_generation})
+
     def kill_replica(self, index, sig=signal.SIGKILL):
         """Chaos hook: deliver a REAL process kill to replica `index`.
         The monitor observes the death exactly as it would a prod
         reclaim; relay threads fail over organically."""
-        h = self.handles[index]
+        h = next((hh for hh in self.handles if hh.index == index), None)
+        if h is None:
+            return False
         proc = h.proc
         if proc is None:
             return False
@@ -563,10 +963,18 @@ class ServingFleet(object):
 
     # ---------- dispatch ----------
 
-    def _pick(self, session, exclude):
+    def _eligible(self, h, role):
+        # decode dispatch may land on `unified` replicas (K=0 fleets and
+        # mixed fallback); prefill dispatch only on dedicated workers
+        if role == "prefill":
+            return h.role == "prefill"
+        return h.role in ("decode", "unified")
+
+    def _pick(self, session, exclude, role="decode"):
         with self._lock:
             ready = [h for h in self.handles
-                     if h.state == "ready" and h not in exclude]
+                     if h.state == "ready" and h not in exclude
+                     and self._eligible(h, role)]
             if not ready:
                 return None
             if session is not None:
@@ -582,7 +990,7 @@ class ServingFleet(object):
             h.inflight += 1
             return h
 
-    def _wait_for_ready(self, deadline_s, exclude):
+    def _wait_for_ready(self, deadline_s, exclude, role="decode"):
         """Block (bounded) for a ready replica: a fleet mid-restart
         should queue briefly, not 503 the world."""
         end = time.monotonic() + deadline_s
@@ -590,20 +998,34 @@ class ServingFleet(object):
                 and not self._stopped:
             with self._lock:
                 if any(h.state == "ready" and h not in exclude
+                       and self._eligible(h, role)
                        for h in self.handles):
                     return True
                 if not any(h.state in ("starting", "backoff")
+                           and self._eligible(h, role)
                            for h in self.handles):
                     return False  # nothing will ever become ready
             time.sleep(0.05)
         return False
+
+    def _retry_after(self):
+        """Retry-After seconds for shed responses, from fleet pressure:
+        in-flight work over ready decode-pool slot capacity (draining:
+        the time for in-flight work to finish is the same estimate)."""
+        with self._lock:
+            inflight = sum(h.inflight for h in self.handles)
+            slots = sum(h.last_stats.get("slots") or 0
+                        for h in self.handles
+                        if h.state == "ready" and h.role != "prefill")
+        return retry_after_hint(max(1, inflight), max(1, slots))
 
     def _shed(self, handler, request_id, reason, code, message):
         with self._lock:
             self.shed_count += 1
         telemetry.event("fleet.request.shed", data={
             "request_id": str(request_id), "reason": reason})
-        handler._json(code, {"error": message, "reason": reason})
+        handler._json(code, {"error": message, "reason": reason},
+                      headers={"Retry-After": str(self._retry_after())})
 
     def handle_generate(self, handler, payload):
         request_id = payload.get("request_id") or \
@@ -648,6 +1070,13 @@ class ServingFleet(object):
                        "fleet in-flight budget exhausted")
             return
 
+        # ---- disaggregation: prefill hop first when workers exist ----
+        # the returned frame (KV + first token + original payload) is
+        # re-POSTable as-is, so decode-side failover re-uses it instead
+        # of re-paying prefill
+        decode_body = None
+        if self.prefill_workers:
+            decode_body = self._prefill_hop(payload, request_id, root_tp)
         delivered = 0      # tokens already streamed to the client
         started = False    # status line sent (streaming path)
         attempts = 0
@@ -681,6 +1110,8 @@ class ServingFleet(object):
             dispatch_data = {
                 "request_id": str(request_id), "replica": h.index,
                 "dispatch": n_dispatch}
+            if decode_body is not None:
+                dispatch_data["phase"] = "decode"
             if trace_id:
                 attempt_tp = tracing.child_traceparent(
                     root_tp, "dispatch-%d" % n_dispatch)
@@ -697,7 +1128,10 @@ class ServingFleet(object):
             try:
                 done, delivered, started = self._relay(
                     handler, h, payload, request_id, stream, delivered,
-                    traceparent=attempt_tp)
+                    traceparent=attempt_tp,
+                    path=("/v1/decode" if decode_body is not None
+                          else "/v1/generate"),
+                    body=decode_body)
                 with self._lock:
                     h.inflight = max(0, h.inflight - 1)
                     if done:
@@ -707,7 +1141,9 @@ class ServingFleet(object):
                 with self._lock:
                     h.inflight = max(0, h.inflight - 1)
                 tried_busy.add(h)
-                if len(tried_busy) >= len(self.handles):
+                pool_n = len([hh for hh in self.handles
+                              if self._eligible(hh, "decode")])
+                if len(tried_busy) >= pool_n:
                     self._shed(handler, request_id, "queue_full",
                                ex.code, "every replica shed the request")
                     return
@@ -756,19 +1192,86 @@ class ServingFleet(object):
                 handler.close_connection = True
                 return
 
-    def _relay(self, handler, h, payload, request_id, stream, delivered,
-               traceparent=None):
-        """Forward one dispatch attempt; returns (done, delivered,
-        started). Raises _ReplicaBackendError (carrying progress) on
-        replica death."""
-        # always ask the replica to stream: the router must observe
-        # token-by-token progress to resume a partially-streamed request
-        # on a survivor without duplicating output
+    def _prefill_hop(self, payload, request_id, root_tp):
+        """Disaggregation phase 1: run chunked prefill on a dedicated
+        worker and return the KV-handoff frame (bytes) to POST to a
+        decode replica, or None to fall back to unified dispatch (no
+        worker ready / every worker shed or died — availability beats
+        the phase split)."""
         fwd = dict(payload)
+        # the decode replica streams to the ROUTER regardless of what
+        # the client asked for, and the frame embeds this payload
         fwd["stream"] = True
         fwd["request_id"] = str(request_id)
         fwd.pop("session", None)
         body = json.dumps(fwd).encode("utf-8")
+        trace_id, _ = tracing.traceparent_ids(root_tp)
+        tried = set()
+        while not self._draining and not self._stopped:
+            h = self._pick(None, tried, role="prefill")
+            if h is None:
+                break
+            with self._lock:
+                self.dispatch_count += 1
+                n_dispatch = self.dispatch_count
+                h.dispatched += 1
+            dispatch_data = {
+                "request_id": str(request_id), "replica": h.index,
+                "dispatch": n_dispatch, "phase": "prefill"}
+            attempt_tp = None
+            if trace_id:
+                attempt_tp = tracing.child_traceparent(
+                    root_tp, "prefill-%d" % n_dispatch)
+                dispatch_data["trace"] = trace_id
+                dispatch_data["span"] = tracing.traceparent_ids(
+                    attempt_tp)[1]
+            telemetry.event("fleet.request.dispatch", data=dispatch_data)
+            headers = {"Content-Type": "application/json"}
+            if attempt_tp:
+                headers["Traceparent"] = attempt_tp
+            status, data = None, None
+            try:
+                conn = http.client.HTTPConnection(h.host, h.port,
+                                                  timeout=300)
+                try:
+                    conn.request("POST", "/v1/prefill", body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    status, data = resp.status, resp.read()
+                finally:
+                    conn.close()
+            except (http.client.HTTPException, OSError, ValueError):
+                pass  # worker lost mid-prefill: try a sibling
+            with self._lock:
+                h.inflight = max(0, h.inflight - 1)
+            if status == 200:
+                with self._lock:
+                    self.prefill_handoffs += 1
+                return data
+            tried.add(h)
+        with self._lock:
+            self.disagg_fallbacks += 1
+        return None
+
+    def _relay(self, handler, h, payload, request_id, stream, delivered,
+               traceparent=None, path="/v1/generate", body=None):
+        """Forward one dispatch attempt; returns (done, delivered,
+        started). Raises _ReplicaBackendError (carrying progress) on
+        replica death. With `body` set (a KV-handoff frame), the POST
+        goes to `path` as octet-stream — the disaggregated decode hop;
+        the response protocol is identical to /v1/generate."""
+        content_type = "application/json"
+        if body is None:
+            # always ask the replica to stream: the router must observe
+            # token-by-token progress to resume a partially-streamed
+            # request on a survivor without duplicating output
+            fwd = dict(payload)
+            fwd["stream"] = True
+            fwd["request_id"] = str(request_id)
+            fwd.pop("session", None)
+            body = json.dumps(fwd).encode("utf-8")
+        else:
+            content_type = "application/octet-stream"
         started = delivered > 0
 
         def backend(fn):
@@ -780,7 +1283,7 @@ class ServingFleet(object):
             except (http.client.HTTPException, OSError, ValueError):
                 raise _ReplicaBackendError(delivered, started)
 
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": content_type}
         if traceparent:
             # per-attempt trace context: the replica stamps this span
             # into its serve.request.* records
@@ -788,7 +1291,7 @@ class ServingFleet(object):
         conn = http.client.HTTPConnection(h.host, h.port, timeout=300)
         try:
             backend(lambda: conn.request(
-                "POST", "/v1/generate", body=body, headers=headers))
+                "POST", path, body=body, headers=headers))
             resp = backend(conn.getresponse)
             if resp.status in (429, 503):
                 raise _ReplicaBusyError(
@@ -881,6 +1384,43 @@ class ServingFleet(object):
 
     # ---------- introspection ----------
 
+    def _pools(self):
+        """Per-pool occupancy for /healthz and /v1/stats: the decode
+        pool (decode + unified replicas) and the prefill pool, each with
+        replica counts, in-flight load, and mean reported occupancy."""
+        pools = {}
+        for name in ("decode", "prefill"):
+            members = [h for h in self.handles
+                       if self._eligible(h, name)]
+            ready = [h for h in members if h.state == "ready"]
+            occ = [float(h.last_stats.get("occupancy") or 0.0)
+                   for h in ready]
+            pools[name] = {
+                "replicas": len(members),
+                "ready": len(ready),
+                "inflight": sum(h.inflight for h in members),
+                "occupancy": round(sum(occ) / len(occ), 4) if occ
+                else 0.0,
+            }
+        return pools
+
+    def _prefix_rollup(self):
+        """Fleet-wide prefix-cache view, summed over the per-replica
+        healthz blocks the health loop last probed."""
+        blocks = [h.last_stats.get("prefix_cache") for h in self.handles
+                  if isinstance(h.last_stats.get("prefix_cache"), dict)]
+        enabled = [b for b in blocks if b.get("enabled")]
+        rates = [float(b.get("hit_rate") or 0.0) for b in enabled]
+        return {
+            "enabled": bool(enabled),
+            "hit_rate": round(sum(rates) / len(rates), 4) if rates
+            else 0.0,
+            "cached_bytes": sum(int(b.get("cached_bytes") or 0)
+                                for b in enabled),
+            "evictions": sum(int(b.get("evictions") or 0)
+                             for b in enabled),
+        }
+
     def healthz(self):
         ready = sum(1 for h in self.handles if h.state == "ready")
         with self._lock:
@@ -893,6 +1433,9 @@ class ServingFleet(object):
             "replicas": [h.describe() for h in self.handles],
             "ready": ready,
             "inflight": inflight,
+            "fleet_generation": self.fleet_generation,
+            "pools": self._pools(),
+            "prefix_cache": self._prefix_rollup(),
             # fleet tail latency (worst ready replica; null = no samples)
             "p99_ttft_ms": metrics.get("p99_ttft_ms"),
             "p99_itl_ms": metrics.get("p99_itl_ms"),
@@ -913,6 +1456,13 @@ class ServingFleet(object):
                 "inflight": sum(h.inflight for h in self.handles),
                 "max_inflight": self.config.max_inflight,
                 "draining": self._draining,
+                "fleet_generation": self.fleet_generation,
+                "prefill_handoffs": self.prefill_handoffs,
+                "disagg_fallbacks": self.disagg_fallbacks,
+                "scale_outs": self.scale_out_count,
+                "scale_ins": self.scale_in_count,
+                "rollout": {"active": self._rollout_active,
+                            "last": self._last_rollout},
             }
 
     # ---------- shutdown ----------

@@ -1,0 +1,275 @@
+"""Radix prefix cache: reusable KV ranges keyed by prompt token prefixes.
+
+Serving traffic is dominated by shared prefixes — a fleet-wide system
+prompt, few-shot templates, multi-turn histories that re-send the whole
+conversation. Cold prefill recomputes the KV projections for every one
+of those tokens on every request even though, for a causal model, the
+KV state of a prefix depends ONLY on the prefix tokens themselves.
+This module is the SGLang/vLLM-lineage fix: a compressed radix tree
+over token sequences whose nodes carry the host-side KV arrays for
+their edge tokens. On admit the scheduler looks up the longest cached
+prefix, seeds the slot's KV-cache view with it (SlotEngine.seed_prefix)
+and starts chunked prefill at the match boundary; after a finished
+prefill it inserts the slot's KV back (SlotEngine.extract_kv) so the
+next request sharing the prefix hits.
+
+Identity guarantee: the cached arrays are bitwise what cold prefill
+wrote for those positions, and resuming chunked prefill at a different
+boundary preserves numerics (the same property the chunked-prefill
+identity tests already pin), so a cache-hit request emits exactly the
+tokens a cold one would — greedy and sampled alike, since sampling only
+consumes logits and the request's own rng schedule.
+
+Concurrency/safety model: match() returns a PIN — every node on the
+matched path is ref-counted until release(), so LRU eviction (byte
+budget, leaf-first) can never free KV that an in-flight request still
+depends on. The scheduler releases the pin when the request finishes
+prefill or dies (cancel/deadline/shutdown); a leaked pin would show up
+as pinned_nodes() > 0 with an idle engine, which tests assert against.
+
+Node splits keep handles valid: the matched node OBJECT stays the
+deeper (suffix) node and handles capture numpy views of the KV at match
+time, so a later split neither moves a pin nor invalidates captured
+arrays.
+"""
+
+import os
+import threading
+
+import numpy as np
+
+from .. import telemetry
+
+
+def _as_tokens(tokens):
+    return np.asarray(tokens, np.int32).reshape(-1)
+
+
+def _common_prefix(a, b):
+    n = min(a.size, b.size)
+    if n == 0:
+        return 0
+    eq = a[:n] == b[:n]
+    if eq.all():
+        return n
+    return int(np.argmin(eq))
+
+
+class _Node(object):
+    __slots__ = ("tokens", "k", "v", "children", "parent", "refs",
+                 "last_use")
+
+    def __init__(self, tokens, k, v, parent):
+        self.tokens = tokens          # np.int32 [T] edge labels
+        self.k = k                    # np [layers, T, kv_heads, head_dim]
+        self.v = v
+        self.children = {}            # first token -> _Node
+        self.parent = parent
+        self.refs = 0
+        self.last_use = 0
+
+    def nbytes(self):
+        if self.k is None:
+            return 0
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+
+class PrefixHandle(object):
+    """A pinned match: `length` cached tokens and the KV that backs
+    them. Hold it until the request is past prefill (or dead), then
+    release() exactly once."""
+
+    __slots__ = ("_nodes", "_parts", "length", "_released")
+
+    def __init__(self, nodes, parts, length):
+        self._nodes = nodes           # pinned path, root-exclusive
+        self._parts = parts           # [(k_view, v_view), ...] in order
+        self.length = length
+        self._released = False
+
+    def kv(self):
+        """{"k": [layers, length, kv_heads, head_dim], "v": ...} — the
+        cached KV for the matched prefix, concatenated host-side."""
+        ks = [p[0] for p in self._parts]
+        vs = [p[1] for p in self._parts]
+        if len(ks) == 1:
+            return {"k": ks[0], "v": vs[0]}
+        return {"k": np.concatenate(ks, axis=1),
+                "v": np.concatenate(vs, axis=1)}
+
+
+class RadixPrefixCache(object):
+    """Compressed radix tree over prompt tokens with per-node KV ranges,
+    ref-count pinning and LRU leaf eviction under a byte budget."""
+
+    def __init__(self, max_bytes):
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes <= 0:
+            raise ValueError("max_bytes must be > 0")
+        self._root = _Node(np.zeros(0, np.int32), None, None, None)
+        self._lock = threading.Lock()
+        self._clock = 0
+        self._bytes = 0
+        self._nodes = 0
+        self._tokens = 0
+        self._evicted_nodes = 0
+        self._evicted_tokens = 0
+        self._evictions = 0           # evict() sweeps that freed memory
+
+    @classmethod
+    def from_env(cls, default_mb=0):
+        """Build from TPUFLOW_PREFIX_CACHE_MB, or None when the budget
+        is 0 (the cache is opt-in: no budget, no cache)."""
+        mb = float(os.environ.get("TPUFLOW_PREFIX_CACHE_MB", default_mb))
+        if mb <= 0:
+            return None
+        return cls(int(mb * 1024 * 1024))
+
+    # ---------- lookup ----------
+
+    def match(self, tokens):
+        """Longest cached prefix of `tokens`: a pinned PrefixHandle, or
+        None on a zero-length match. Callers cap reuse themselves (the
+        scheduler matches prompt[:-1] so at least one token prefills and
+        final-chunk logits exist for first-token sampling)."""
+        tokens = _as_tokens(tokens)
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            i = 0
+            nodes, parts = [], []
+            while i < tokens.size:
+                child = node.children.get(int(tokens[i]))
+                if child is None:
+                    break
+                common = _common_prefix(child.tokens, tokens[i:])
+                if common == 0:
+                    break
+                child.last_use = self._clock
+                nodes.append(child)
+                parts.append((child.k[:, :common], child.v[:, :common]))
+                i += common
+                if common < child.tokens.size:
+                    break
+                node = child
+            if i == 0:
+                return None
+            for n in nodes:
+                n.refs += 1
+            return PrefixHandle(nodes, parts, i)
+
+    def release(self, handle):
+        """Drop a match's pins. Idempotent per handle."""
+        if handle is None or handle._released:
+            return
+        handle._released = True
+        with self._lock:
+            for n in handle._nodes:
+                n.refs -= 1
+
+    # ---------- insert / evict ----------
+
+    def insert(self, tokens, kv):
+        """Cache the KV for `tokens` (kv: {"k": [layers, T, kv_heads,
+        head_dim], "v": ...}, T == len(tokens)). Shared prefixes with
+        existing entries are deduplicated via node splits; only the
+        novel suffix adds bytes. Evicts LRU leaves if over budget."""
+        tokens = _as_tokens(tokens)
+        k, v = kv["k"], kv["v"]
+        if k.shape[1] != tokens.size:
+            raise ValueError("kv length %d != token count %d"
+                             % (k.shape[1], tokens.size))
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            i = 0
+            while i < tokens.size:
+                child = node.children.get(int(tokens[i]))
+                if child is None:
+                    # copy the suffix: a view would pin the caller's FULL
+                    # prompt-KV buffer, breaking the byte-budget accounting
+                    leaf = _Node(tokens[i:].copy(), k[:, i:].copy(),
+                                 v[:, i:].copy(), node)
+                    leaf.last_use = self._clock
+                    node.children[int(tokens[i])] = leaf
+                    self._bytes += leaf.nbytes()
+                    self._nodes += 1
+                    self._tokens += int(leaf.tokens.size)
+                    break
+                child.last_use = self._clock
+                common = _common_prefix(child.tokens, tokens[i:])
+                if common < child.tokens.size:
+                    # split the edge: a NEW prefix node takes the head;
+                    # `child` (possibly pinned) keeps its object identity
+                    # and becomes the suffix below it
+                    mid = _Node(child.tokens[:common], child.k[:, :common],
+                                child.v[:, :common], node)
+                    mid.last_use = self._clock
+                    node.children[int(child.tokens[0])] = mid
+                    child.tokens = child.tokens[common:]
+                    child.k = child.k[:, common:]
+                    child.v = child.v[:, common:]
+                    child.parent = mid
+                    mid.children[int(child.tokens[0])] = child
+                    self._nodes += 1
+                    node = mid
+                    i += common
+                    continue
+                node = child
+                i += common
+            self._evict_locked()
+
+    def _evict_locked(self):
+        freed_nodes = freed_tokens = freed_bytes = 0
+        while self._bytes > self.max_bytes:
+            victim = None
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n is self._root or n.children or n.refs > 0:
+                    continue
+                if victim is None or n.last_use < victim.last_use:
+                    victim = n
+            if victim is None:
+                break  # everything left is pinned or interior
+            victim.parent.children.pop(int(victim.tokens[0]))
+            nb = victim.nbytes()
+            self._bytes -= nb
+            self._nodes -= 1
+            self._tokens -= int(victim.tokens.size)
+            freed_nodes += 1
+            freed_tokens += int(victim.tokens.size)
+            freed_bytes += nb
+        if freed_nodes:
+            self._evictions += 1
+            self._evicted_nodes += freed_nodes
+            self._evicted_tokens += freed_tokens
+            telemetry.event("serve.prefix.evict", data={
+                "nodes": freed_nodes, "tokens": freed_tokens,
+                "bytes": freed_bytes})
+
+    # ---------- introspection ----------
+
+    def pinned_nodes(self):
+        with self._lock:
+            count = 0
+            stack = [self._root]
+            while stack:
+                n = stack.pop()
+                stack.extend(n.children.values())
+                if n is not self._root and n.refs > 0:
+                    count += 1
+            return count
+
+    def stats(self):
+        with self._lock:
+            return {
+                "nodes": self._nodes,
+                "cached_tokens": self._tokens,
+                "cached_bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "evictions": self._evictions,
+                "evicted_nodes": self._evicted_nodes,
+                "evicted_tokens": self._evicted_tokens,
+            }

@@ -163,6 +163,9 @@ def build_parser():
     p.add_argument("--attn-impl", default="auto")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--step-delay-ms", type=float, default=None)
+    p.add_argument("--role", default="unified",
+                   choices=("unified", "prefill", "decode"))
+    p.add_argument("--prefix-cache-mb", type=int, default=None)
     return p
 
 
@@ -203,8 +206,17 @@ def main(argv=None):
     if delay_ms > 0:
         _add_step_delay(engine, delay_ms / 1000.0)
 
-    scheduler = Scheduler(engine, max_queue=args.max_queue)
-    server = ServingServer(scheduler, host=args.host, port=args.port)
+    from .prefix_cache import RadixPrefixCache
+
+    if args.prefix_cache_mb is not None:
+        cache = (RadixPrefixCache(args.prefix_cache_mb << 20)
+                 if args.prefix_cache_mb > 0 else None)
+    else:
+        cache = RadixPrefixCache.from_env()
+    scheduler = Scheduler(engine, max_queue=args.max_queue,
+                          prefix_cache=cache)
+    server = ServingServer(scheduler, host=args.host, port=args.port,
+                           role=args.role)
     server.install_signal_handlers()
     server.start()
     if args.port_file:

@@ -62,7 +62,8 @@ class Request(object):
 
     def __init__(self, tokens, max_new_tokens, temperature=0.0, top_k=None,
                  top_p=None, eos_id=None, rng=0, deadline=None,
-                 request_id=None, traceparent=None):
+                 request_id=None, traceparent=None, prefill_only=False,
+                 prefilled=None):
         self.id = str(request_id) if request_id is not None \
             else "req-%d" % next(_request_ids)
         # W3C trace context for this request (minted by the fleet router
@@ -93,6 +94,13 @@ class Request(object):
         self.t_done = None
         self.admit_iteration = None
         self.finish_iteration = None
+        # disaggregation: a prefill-only request stops after its first
+        # token and parks {"first", "kv"} in `handoff`; a `prefilled`
+        # request carries that dict in and enters decode directly
+        self.prefill_only = bool(prefill_only)
+        self.prefilled = prefilled
+        self.handoff = None
+        self._prefix_handle = None   # pinned prefix-cache match
         self._cancelled = threading.Event()
 
     def cancel(self):
@@ -126,9 +134,18 @@ class Request(object):
 
 
 class Scheduler(object):
-    def __init__(self, engine, max_queue=64, prefill_budget=None):
+    def __init__(self, engine, max_queue=64, prefill_budget=None,
+                 prefix_cache=None):
         self.engine = engine
         self.max_queue = int(max_queue)
+        # optional RadixPrefixCache: admit seeds the longest cached
+        # prefix into the slot, prefill resumes at the boundary, and a
+        # finished prefill inserts the slot's KV back for the next hit
+        self.prefix_cache = prefix_cache
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
         # per-iteration prefill token budget: enough to land one chunk
         # per free slot by default, so admission keeps pace with decode
         # without ever stalling active slots behind one long prompt
@@ -219,10 +236,16 @@ class Scheduler(object):
         if req.slot is not None:
             self.engine.release(req.slot)
             del self._slots[req.slot]
+        if req._prefix_handle is not None:
+            # every terminal path drops the pin — including cancel /
+            # deadline / shutdown mid-prefill, so no eviction-blocking
+            # refs leak from requests that never finished prefill
+            self.prefix_cache.release(req._prefix_handle)
+            req._prefix_handle = None
         req.reason = reason
         req.t_done = time.time()
         req.finish_iteration = self.iteration
-        ok = reason in ("eos", "length")
+        ok = reason in ("eos", "length", "prefilled")
         req.state = "finished" if ok else "cancelled"
         name = ("serve.request.finished" if ok
                 else "serve.request.cancelled")
@@ -303,10 +326,19 @@ class Scheduler(object):
                                  else "deadline")
                     req = None
             try:
-                self.engine.admit(
-                    slot, req.tokens, req.max_new_tokens,
-                    temperature=req.temperature, top_k=req.top_k,
-                    top_p=req.top_p, rng=req.rng)
+                if req.prefilled is not None:
+                    # disaggregation decode side: KV arrived with the
+                    # request; seed it and skip prefill entirely
+                    self.engine.admit_prefilled(
+                        slot, req.tokens, req.prefilled["first"],
+                        req.prefilled["kv"], req.max_new_tokens,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, rng=req.rng)
+                else:
+                    self.engine.admit(
+                        slot, req.tokens, req.max_new_tokens,
+                        temperature=req.temperature, top_k=req.top_k,
+                        top_p=req.top_p, rng=req.rng)
             except ValueError as ex:
                 # oversized request: reject it, keep serving
                 req.reason = "rejected"
@@ -331,7 +363,33 @@ class Scheduler(object):
             telemetry.event("serve.request.prefill", data=self._tdata(req, {
                 "request_id": req.id, "slot": slot,
                 "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)}))
+            if req.prefilled is not None:
+                # already past prefill: emit the first token now so the
+                # stream carries ALL tokens and eos/length still apply
+                req.state = "decode"
+                self._deliver(req, int(req.prefilled["first"]))
+            elif self.prefix_cache is not None:
+                self._seed_from_cache(req, slot)
         return admitted
+
+    def _seed_from_cache(self, req, slot):
+        # match prompt[:-1]: at least one token must prefill so the
+        # final chunk's logits exist for first-token sampling
+        self.prefix_prompt_tokens += len(req.tokens)
+        handle = self.prefix_cache.match(req.tokens[:-1])
+        if handle is None:
+            self.prefix_misses += 1
+            telemetry.event("serve.prefix.miss", data=self._tdata(req, {
+                "request_id": req.id,
+                "prompt_tokens": len(req.tokens)}))
+            return
+        self.engine.seed_prefix(slot, handle.kv())
+        req._prefix_handle = handle
+        self.prefix_hits += 1
+        self.prefix_hit_tokens += handle.length
+        telemetry.event("serve.prefix.hit", data=self._tdata(req, {
+            "request_id": req.id, "matched_tokens": handle.length,
+            "prompt_tokens": len(req.tokens)}))
 
     def _prefill(self):
         budget = self.prefill_budget
@@ -362,9 +420,38 @@ class Scheduler(object):
             budget -= consumed
             worked = True
             if first is not None:
-                req.state = "decode"
-                self._deliver(req, first)
+                self._prefill_done(req, slot, first)
         return worked
+
+    def _prefill_done(self, req, slot, first):
+        """The final prefill chunk landed: populate the prefix cache,
+        drop the request's pin, and either enter decode or (prefill-only
+        mode) park the KV handoff and finish."""
+        kv = None
+        if self.prefix_cache is not None or req.prefill_only:
+            kv = self.engine.extract_kv(slot, len(req.tokens))
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.tokens, kv)
+            if req._prefix_handle is not None:
+                self.prefix_cache.release(req._prefix_handle)
+                req._prefix_handle = None
+        if req.prefill_only:
+            now = time.time()
+            req.generated.append(int(first))
+            req.token_times.append(now)
+            req.t_first = now
+            self._ttft_window.append((now - req.t_submit) * 1000)
+            telemetry.event("serve.request.first_token",
+                            data=self._tdata(req, {
+                                "request_id": req.id, "slot": req.slot,
+                                "ttft_ms": round(
+                                    (now - req.t_submit) * 1000, 3)}))
+            req.handoff = {"first": int(first), "kv": kv}
+            req.out.put(int(first))
+            self._finish(req, "prefilled")
+            return
+        req.state = "decode"
+        self._deliver(req, first)
 
     def _decode(self):
         active = [r for r in self._slots.values() if r.state == "decode"]
@@ -488,4 +575,28 @@ class Scheduler(object):
             "p99_ttft_ms": _pctl(list(self._ttft_window), 0.99),
             "p50_itl_ms": _pctl(list(self._itl_window), 0.50),
             "p99_itl_ms": _pctl(list(self._itl_window), 0.99),
+            "prefix_cache": self.prefix_stats(),
         }
+
+    def prefix_stats(self):
+        """Prefix-cache effectiveness for /v1/stats and /healthz.
+        `prefill_tokens_skipped_frac` is the FLOPs-skip proxy: prefill
+        cost is linear in tokens at fixed model size, so the fraction of
+        prompt tokens served from cache IS the fraction of prefill FLOPs
+        never spent (the ROADMAP >=90% gate measures this)."""
+        out = {
+            "enabled": self.prefix_cache is not None,
+            "hits": self.prefix_hits,
+            "misses": self.prefix_misses,
+            "hit_rate": round(
+                self.prefix_hits
+                / max(1, self.prefix_hits + self.prefix_misses), 4),
+            "hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens": self.prefix_prompt_tokens,
+            "prefill_tokens_skipped_frac": round(
+                self.prefix_hit_tokens
+                / max(1, self.prefix_prompt_tokens), 4),
+        }
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.stats())
+        return out

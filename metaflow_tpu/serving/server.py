@@ -27,17 +27,29 @@ draining, rejects new work with 503, lets every accepted request finish
 """
 
 import json
+import math
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import tracing
+from .disagg import decode_handoff, encode_handoff
 from .scheduler import DrainingError, QueueFullError, Request
 
 STREAM_TIMEOUT_S = 300.0
 
 
-def _request_from_payload(payload):
+def retry_after_hint(pending, capacity):
+    """Retry-After seconds for a shed response: pending work units per
+    unit of capacity, clamped to [1, 60]. Deliberately coarse — the
+    point is that a backoff proportional to observed pressure stops
+    clients from hammering a shedding server, not that the estimate is
+    exact."""
+    return int(min(60, max(1, math.ceil(
+        float(pending) / max(1.0, float(capacity))))))
+
+
+def _request_from_payload(payload, prefill_only=False, prefilled=None):
     if not isinstance(payload, dict):
         raise ValueError("body must be a JSON object")
     tokens = payload.get("tokens")
@@ -59,6 +71,8 @@ def _request_from_payload(payload):
         rng=int(payload.get("seed", 0)),
         deadline=deadline,
         request_id=payload.get("request_id"),
+        prefill_only=prefill_only,
+        prefilled=prefilled,
     )
 
 
@@ -74,13 +88,31 @@ class _Handler(BaseHTTPRequestHandler):
     def scheduler(self):
         return self.server.scheduler
 
-    def _json(self, code, obj):
+    def _json(self, code, obj, headers=None):
         body = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _bytes(self, code, data, content_type="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _shed_headers(self, draining):
+        """Retry-After for 429/503: queue pressure when shedding on
+        backpressure, remaining in-flight work when draining."""
+        stats = self.scheduler.stats()
+        pending = (stats["in_flight"] if draining
+                   else stats["queue_depth"] + stats["in_flight"])
+        return {"Retry-After": str(retry_after_hint(pending,
+                                                    stats["slots"]))}
 
     def do_GET(self):
         if self.path == "/healthz":
@@ -89,9 +121,11 @@ class _Handler(BaseHTTPRequestHandler):
             # readiness, drain state, queue pressure, slot occupancy.
             # Schema pinned in tests/schema_validate.py::HEALTHZ_SCHEMA.
             stats = self.scheduler.stats()
+            prefix = stats["prefix_cache"]
             self._json(200, {
                 "ok": True,
                 "draining": self.server.draining or stats["draining"],
+                "role": self.server.role,
                 "queue_depth": stats["queue_depth"],
                 "in_flight": stats["in_flight"],
                 "slots": stats["slots"],
@@ -101,6 +135,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "p99_ttft_ms": stats["p99_ttft_ms"],
                 "p50_itl_ms": stats["p50_itl_ms"],
                 "p99_itl_ms": stats["p99_itl_ms"],
+                # prefix-cache effectiveness (hit rate / bytes / evictions)
+                "prefix_cache": {
+                    "enabled": prefix["enabled"],
+                    "hit_rate": prefix["hit_rate"],
+                    "cached_bytes": prefix.get("cached_bytes", 0),
+                    "evictions": prefix.get("evictions", 0),
+                },
             })
             return
         if self.path == "/v1/stats":
@@ -109,16 +150,20 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(404, {"error": "not found"})
 
     def do_POST(self):
-        if self.path != "/v1/generate":
+        if self.path == "/v1/generate":
+            self._post_generate()
+        elif self.path == "/v1/prefill":
+            self._post_prefill()
+        elif self.path == "/v1/decode":
+            self._post_decode()
+        else:
             self._json(404, {"error": "not found"})
-            return
-        try:
-            length = int(self.headers.get("Content-Length", "0"))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            req = _request_from_payload(payload)
-        except (ValueError, TypeError) as ex:
-            self._json(400, {"error": str(ex)})
-            return
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length)
+
+    def _bind_trace(self, req):
         # trace context: the fleet router forwards a per-attempt
         # Traceparent header; a direct (router-less) request gets a root
         # traceparent minted here so its records still form a tree
@@ -126,14 +171,32 @@ class _Handler(BaseHTTPRequestHandler):
         if not tp and tracing.trace_requests_enabled():
             tp = tracing.request_traceparent(req.id)
         req.traceparent = tp or None
-        stream = bool(payload.get("stream", False))
+
+    def _submit_or_shed(self, req):
+        """Submit into the scheduler; on shed, answer 429/503 WITH a
+        Retry-After derived from current pressure and return False."""
         try:
             self.scheduler.submit(req)
+            return True
         except QueueFullError as ex:
-            self._json(429, {"error": str(ex)})
-            return
+            self._json(429, {"error": str(ex)},
+                       headers=self._shed_headers(draining=False))
+            return False
         except DrainingError as ex:
-            self._json(503, {"error": str(ex)})
+            self._json(503, {"error": str(ex)},
+                       headers=self._shed_headers(draining=True))
+            return False
+
+    def _post_generate(self):
+        try:
+            payload = json.loads(self._read_body() or b"{}")
+            req = _request_from_payload(payload)
+        except (ValueError, TypeError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        self._bind_trace(req)
+        stream = bool(payload.get("stream", False))
+        if not self._submit_or_shed(req):
             return
         if stream:
             self._stream(req)
@@ -156,6 +219,79 @@ class _Handler(BaseHTTPRequestHandler):
                 "usage": {"prompt_tokens": len(req.tokens),
                           "new_tokens": len(tokens)},
             })
+
+    # ---------- disaggregation endpoints ----------
+
+    def _post_prefill(self):
+        """Prefill-worker entry: run chunked prefill only, answer with
+        the KV handoff frame (disagg.encode_handoff) the router ships to
+        a decode replica."""
+        try:
+            payload = json.loads(self._read_body() or b"{}")
+            req = _request_from_payload(payload, prefill_only=True)
+        except (ValueError, TypeError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        self._bind_trace(req)
+        if not self._submit_or_shed(req):
+            return
+        try:
+            req.result(timeout=STREAM_TIMEOUT_S)
+        except TimeoutError:
+            req.cancel()
+            self._json(504, {"error": "prefill timed out"})
+            return
+        if req.reason == "rejected":
+            self._json(400, {"error": getattr(req, "error", "rejected")})
+            return
+        if req.reason != "prefilled" or req.handoff is None:
+            self._json(500, {"error": "prefill ended as %r" % req.reason})
+            return
+        # the frame embeds the ORIGINAL payload: a router can POST it to
+        # a decode replica's /v1/decode verbatim, no re-framing needed
+        self._bytes(200, encode_handoff(
+            {"id": req.id, "first": req.handoff["first"],
+             "prompt_tokens": len(req.tokens), "payload": payload},
+            req.handoff["kv"]))
+
+    def _post_decode(self):
+        """Decode-replica entry: accept a KV handoff frame whose header
+        carries the ORIGINAL generate payload plus the first sampled
+        token, seed a slot with the KV, and stream/answer exactly like
+        /v1/generate (the first token included, so clients and the
+        router see an identical response shape)."""
+        try:
+            meta, kv = decode_handoff(self._read_body())
+            payload = meta["payload"]
+            req = _request_from_payload(
+                payload, prefilled={"first": int(meta["first"]), "kv": kv})
+        except (ValueError, TypeError, KeyError) as ex:
+            self._json(400, {"error": str(ex)})
+            return
+        self._bind_trace(req)
+        stream = bool(payload.get("stream", False))
+        if not self._submit_or_shed(req):
+            return
+        if stream:
+            self._stream(req)
+            return
+        try:
+            tokens = req.result(timeout=STREAM_TIMEOUT_S)
+        except TimeoutError:
+            req.cancel()
+            self._json(504, {"error": "generation timed out"})
+            return
+        if req.reason == "rejected":
+            self._json(400, {"error": getattr(req, "error", "rejected")})
+            return
+        self._json(200, {
+            "id": req.id,
+            "tokens": req.tokens + tokens,
+            "new_tokens": tokens,
+            "reason": req.reason,
+            "usage": {"prompt_tokens": len(req.tokens),
+                      "new_tokens": len(tokens)},
+        })
 
     # ---------- chunked streaming ----------
 
@@ -207,12 +343,18 @@ class _Handler(BaseHTTPRequestHandler):
 class ServingServer(object):
     """The listener + its scheduler, with graceful-drain plumbing."""
 
-    def __init__(self, scheduler, host="127.0.0.1", port=0):
+    def __init__(self, scheduler, host="127.0.0.1", port=0,
+                 role="unified"):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError("role must be unified/prefill/decode, got %r"
+                             % (role,))
         self.scheduler = scheduler
+        self.role = role
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.scheduler = scheduler
         self._httpd.draining = False
+        self._httpd.role = role
         self._thread = None
 
     @property

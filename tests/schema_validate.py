@@ -359,10 +359,29 @@ SERVING_EVENT_DATA_SCHEMAS = {
     ),
     "serve.request.finished": _obj(
         {"request_id": _STR, "slot": _INT,
-         "reason": {"enum": ["eos", "length"]},
+         # "prefilled": the disaggregated handoff terminal — a
+         # prefill-only request ends after the first token; its KV ships
+         # to a decode replica (serving/disagg.py)
+         "reason": {"enum": ["eos", "length", "prefilled"]},
          "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM,
          "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason", "new_tokens"),
+    ),
+    # radix prefix cache (serving/prefix_cache.py + scheduler admit):
+    # hit/miss per admitted request, evict per LRU sweep
+    "serve.prefix.hit": _obj(
+        {"request_id": _STR, "matched_tokens": _INT,
+         "prompt_tokens": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "matched_tokens", "prompt_tokens"),
+    ),
+    "serve.prefix.miss": _obj(
+        {"request_id": _STR, "prompt_tokens": _INT,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "prompt_tokens"),
+    ),
+    "serve.prefix.evict": _obj(
+        {"nodes": _INT, "tokens": _INT, "bytes": _INT},
+        required=("nodes", "tokens", "bytes"),
     ),
     "serve.request.cancelled": _obj(
         {"request_id": _STR, "slot": _INT,
@@ -715,8 +734,9 @@ FLEET_SHED_REASONS = ["queue_full", "deadline", "draining", "no_replica",
 
 FLEET_EVENT_DATA_SCHEMAS = {
     "fleet.replica.spawn": _obj(
-        {"replica": _INT, "generation": _INT, "restarts": _INT},
-        required=("replica", "generation", "restarts"),
+        {"replica": _INT, "generation": _INT, "restarts": _INT,
+         "role": {"enum": ["unified", "prefill", "decode"]}},
+        required=("replica", "generation", "restarts", "role"),
     ),
     "fleet.replica.ready": _obj(
         {"replica": _INT, "pid": _INT, "port": _INT, "spawn_ms": _NUM},
@@ -732,6 +752,8 @@ FLEET_EVENT_DATA_SCHEMAS = {
     ),
     "fleet.request.dispatch": _obj(
         {"request_id": _STR, "replica": _INT, "dispatch": _INT,
+         # disaggregated mode stamps which phase this hop serves
+         "phase": {"enum": ["prefill", "decode"]},
          "trace": _TRACE_HEX, "span": _SPAN_HEX,
          "parent_span": _SPAN_HEX},
         required=("request_id", "replica", "dispatch"),
@@ -748,6 +770,26 @@ FLEET_EVENT_DATA_SCHEMAS = {
     "chaos.replica_kill": _obj(
         {"dispatch": _INT, "replica": _INT, "replicas": _INT},
         required=("dispatch", "replica", "replicas"),
+    ),
+    # autoscaler decisions (fleet._autoscale_tick / scale_out / scale_in)
+    "fleet.scale_out": _obj(
+        {"replica": _INT, "from_replicas": _INT, "to_replicas": _INT,
+         "queue_per_replica": _NUM},
+        required=("replica", "from_replicas", "to_replicas",
+                  "queue_per_replica"),
+    ),
+    "fleet.scale_in": _obj(
+        {"replica": _INT, "from_replicas": _INT, "to_replicas": _INT},
+        required=("replica", "from_replicas", "to_replicas"),
+    ),
+    # rolling upgrade lifecycle (fleet.rolling_reload): start ->
+    # replica (per replacement) -> done | abort
+    "fleet.rollout": _obj(
+        {"phase": {"enum": ["start", "replica", "done", "abort"]},
+         "fleet_generation": _INT, "replicas": _INT,
+         "old_replica": _INT, "new_replica": _INT, "replaced": _INT,
+         "shed_requests": _INT, "ms": _NUM},
+        required=("phase", "fleet_generation"),
     ),
 }
 
@@ -783,10 +825,23 @@ def validate_fleet_record(record):
 
 # single-server /healthz (serving/server.py): a load balancer's health
 # probe AND the fleet router's per-replica probe both key on this shape.
+# per-replica prefix-cache effectiveness, embedded in both healthz tiers
+PREFIX_CACHE_HEALTH_SCHEMA = _obj(
+    {
+        "enabled": _BOOL,
+        "hit_rate": _NUM,
+        "cached_bytes": _INT,
+        "evictions": _INT,
+    },
+    required=("enabled", "hit_rate", "cached_bytes", "evictions"),
+)
+
 HEALTHZ_SCHEMA = _obj(
     {
         "ok": _BOOL,
         "draining": _BOOL,
+        # disaggregated serving: which phase this replica runs
+        "role": {"enum": ["unified", "prefill", "decode"]},
         "queue_depth": _INT,
         "in_flight": _INT,
         "slots": _INT,
@@ -797,17 +852,20 @@ HEALTHZ_SCHEMA = _obj(
         "p99_ttft_ms": _NUM,
         "p50_itl_ms": _NUM,
         "p99_itl_ms": _NUM,
+        "prefix_cache": PREFIX_CACHE_HEALTH_SCHEMA,
     },
-    required=("ok", "draining", "queue_depth", "in_flight", "slots",
-              "occupancy", "p50_ttft_ms", "p99_ttft_ms", "p50_itl_ms",
-              "p99_itl_ms"),
+    required=("ok", "draining", "role", "queue_depth", "in_flight",
+              "slots", "occupancy", "p50_ttft_ms", "p99_ttft_ms",
+              "p50_itl_ms", "p99_itl_ms", "prefix_cache"),
 )
 
 _REPLICA_DESCRIBE = _obj(
     {
         "index": _INT,
-        "state": {"enum": ["starting", "ready", "backoff", "dead",
-                           "stopped"]},
+        # "draining": scale-in / rollout retirement in progress
+        "state": {"enum": ["starting", "ready", "draining", "backoff",
+                           "dead", "stopped"]},
+        "role": {"enum": ["unified", "prefill", "decode"]},
         "pid": {"type": ["integer", "null"]},
         "port": {"type": ["integer", "null"]},
         "inflight": _INT,
@@ -817,7 +875,7 @@ _REPLICA_DESCRIBE = _obj(
         "queue_depth": {"type": ["integer", "null"]},
         "occupancy": {"type": ["number", "null"]},
     },
-    required=("index", "state", "pid", "inflight", "dispatched",
+    required=("index", "state", "role", "pid", "inflight", "dispatched",
               "restarts", "generation"),
 )
 
@@ -837,6 +895,18 @@ SLO_BREACH_SCHEMA = _obj(
 # fleet-router /healthz (serving/fleet.py): the supervisor's aggregate
 # view — per-replica state plus fleet readiness, tail latency (worst
 # ready replica; null until samples exist) and SLO breach state.
+# per-pool occupancy in the fleet healthz: the decode pool (decode +
+# unified replicas) and the dedicated prefill pool
+_FLEET_POOL = _obj(
+    {
+        "replicas": _INT,
+        "ready": _INT,
+        "inflight": _INT,
+        "occupancy": _NUM,
+    },
+    required=("replicas", "ready", "inflight", "occupancy"),
+)
+
 FLEET_HEALTHZ_SCHEMA = _obj(
     {
         "ok": _BOOL,
@@ -844,6 +914,14 @@ FLEET_HEALTHZ_SCHEMA = _obj(
         "replicas": _arr(_REPLICA_DESCRIBE),
         "ready": _INT,
         "inflight": _INT,
+        # rolling-upgrade generation: bumped by each /v1/admin/reload
+        "fleet_generation": _INT,
+        "pools": _obj(
+            {"decode": _FLEET_POOL, "prefill": _FLEET_POOL},
+            required=("decode", "prefill"),
+        ),
+        # fleet-wide prefix-cache rollup over ready replicas
+        "prefix_cache": PREFIX_CACHE_HEALTH_SCHEMA,
         "p99_ttft_ms": {"type": ["number", "null"]},
         "p99_itl_ms": {"type": ["number", "null"]},
         "slo": _obj(
@@ -852,6 +930,7 @@ FLEET_HEALTHZ_SCHEMA = _obj(
         ),
     },
     required=("ok", "draining", "replicas", "ready", "inflight",
+              "fleet_generation", "pools", "prefix_cache",
               "p99_ttft_ms", "p99_itl_ms", "slo"),
 )
 

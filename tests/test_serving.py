@@ -481,6 +481,10 @@ class TestServingTelemetry:
             validate_serving_record(rec)
         names = {r["name"] for r in serve}
         for lifecycle in SERVING_EVENT_DATA_SCHEMAS:
+            if lifecycle.startswith("serve.prefix."):
+                # prefix-cache events need an armed cache; pinned in
+                # test_prefix_serving.py
+                continue
             assert lifecycle in names, "missing %s" % lifecycle
         assert "serve.batch_occupancy" in names
         assert "serve.decode_step" in names
@@ -605,7 +609,9 @@ class TestServeBench:
         assert set(subs) == {"serve_p50_ms", "serve_p99_ms",
                              "serve_batch_occupancy",
                              "serve_tracing_overhead_pct",
-                             "serve_ttft_decomp_err_pct"}
+                             "serve_ttft_decomp_err_pct",
+                             "prefix_prefill_flops_skipped_frac",
+                             "rollout_shed_requests"}
         assert subs["serve_p99_ms"] >= subs["serve_p50_ms"] > 0
         assert 0 < subs["serve_batch_occupancy"] <= 1
         # request tracing must be ~free (min-of-3 interleaved passes) and
@@ -616,3 +622,9 @@ class TestServeBench:
             "TTFT decomposition inconsistent with measured TTFT: %s" % result
         assert result["extra"]["speedup_vs_lockstep"] >= 1.5, \
             "continuous batching must beat lockstep by 1.5x: %s" % result
+        # prefix reuse must skip nearly all shared-prefix prefill work
+        # and the rolling upgrade must shed nothing
+        assert subs["prefix_prefill_flops_skipped_frac"] >= 0.9, \
+            "prefix cache skipped too little prefill: %s" % result
+        assert subs["rollout_shed_requests"] == 0, \
+            "rolling upgrade shed requests: %s" % result
