@@ -1290,6 +1290,94 @@ def bench_elastic_goodput():
     }
 
 
+def bench_hang_recovery():
+    """Time-to-recovery under one seeded wedge (TPUFLOW_CHAOS hang
+    fault): the gang watchdog's detect → forensics → kill → elastic
+    retry pipeline vs the undetected baseline, whose only escape is the
+    bounded gang worker wait (TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S — the
+    stand-in for however long an operator takes to notice a run that
+    stopped making progress). Both runs finish the same token-exact
+    trajectory (the flow's `end` step asserts it); only the wall-clock
+    to get there differs. Gate: detected must be >= 1.2x faster."""
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    flow = os.path.join(here, "tests", "flows", "hang_chaos_flow.py")
+    ranks = int(os.environ.get("BENCH_HANG_RANKS", "2"))
+    steps = int(os.environ.get("BENCH_HANG_STEPS", "6"))
+    sleep = os.environ.get("BENCH_HANG_SLEEP", "0.05")
+    # the undetected baseline's only bound on the wedge
+    wait_s = float(os.environ.get("BENCH_HANG_WAIT_S", "12"))
+
+    def run_once(detect):
+        with tempfile.TemporaryDirectory() as root:
+            env = dict(os.environ)
+            env.update({
+                "TPUFLOW_DATASTORE_SYSROOT_LOCAL": root,
+                "TPUFLOW_CLIENT_CACHE": os.path.join(root, "cache"),
+                "PYTHONPATH": here,
+                "JAX_PLATFORMS": "cpu",
+                "TPUFLOW_CHAOS": "3:1:hang",
+                "TPUFLOW_CHAOS_DIR": os.path.join(root, "chaos"),
+                "TPUFLOW_RETRY_BACKOFF_BASE_S": "0.05",
+                "TPUFLOW_RETRY_BACKOFF_SEED": "0",
+                "HANG_FLOW_RANKS": str(ranks),
+                "HANG_FLOW_STEPS": str(steps),
+                "HANG_FLOW_SLEEP": str(sleep),
+            })
+            if detect:
+                env.update({
+                    "TPUFLOW_HANG_DETECT": "1",
+                    "TPUFLOW_HANG_FLOOR_S": "2",
+                    "TPUFLOW_HANG_POLL_S": "0.5",
+                    "TPUFLOW_HANG_COMPILE_GRACE_S": "3",
+                    "TPUFLOW_HANG_KILL_GRACE_S": "1",
+                    "TPUFLOW_HANG_DUMP_WAIT_S": "0.3",
+                    "TPUFLOW_PROGRESS_EVERY_S": "0",
+                })
+            else:
+                env.update({
+                    "TPUFLOW_HANG_DETECT": "0",
+                    "TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S": "%g" % wait_s,
+                })
+            t0 = time.perf_counter()
+            proc = subprocess.run([sys.executable, flow, "run"], env=env,
+                                  capture_output=True, text=True)
+            wall = time.perf_counter() - t0
+            out = proc.stdout + proc.stderr
+            if proc.returncode != 0 or "hang run ok" not in out:
+                raise SystemExit(
+                    "hang bench flow failed (detect=%s):\n%s"
+                    % (detect, out[-2000:]))
+            return wall
+
+    detected_wall = run_once(True)
+    undetected_wall = run_once(False)
+    ratio = undetected_wall / detected_wall
+    return {
+        "metric": "hang_recovery_ratio",
+        "value": round(ratio, 2),
+        "unit": "x (watchdog kill-to-recover vs undetected bounded-wait "
+                "baseline, same seeded wedge)",
+        "vs_baseline": _vs_baseline(ratio),
+        "extra": {
+            "ranks": ranks,
+            "useful_steps": steps,
+            "hang_step": 3,
+            "undetected_wait_s": wait_s,
+        },
+        "submetrics": [
+            {"metric": "hang_detected_wall_s",
+             "value": round(detected_wall, 2),
+             "unit": "s to token-exact completion (watchdog on)"},
+            {"metric": "hang_undetected_wall_s",
+             "value": round(undetected_wall, 2),
+             "unit": "s to token-exact completion (bounded wait only)"},
+        ],
+    }
+
+
 def _fleet_replica_env(here):
     """CPU-pinned env for fleet replica subprocesses: like every other
     subprocess bench, replicas must never touch the axon TPU tunnel."""
@@ -1965,6 +2053,10 @@ if __name__ == "__main__":
         # scheduler-policy metric: subprocess flows on a CPU mesh by
         # design — no chip involved, never a degraded fallback
         result = bench_elastic_goodput()
+    elif mode == "hang":
+        # watchdog-policy metric: subprocess flows on a CPU mesh by
+        # design — same shape as the elastic bench, no chip involved
+        result = bench_hang_recovery()
     elif mode == "fleet":
         # router-policy metric: subprocess replicas on the CPU
         # device-emulation delay by design — pin this process too so

@@ -29,7 +29,12 @@ from .plugins.tpu.tpu_parallel import TpuParallelDecorator
 from .runtime import NativeRuntime
 from .task import MetaflowTask
 from .unbounded_foreach import UBF_CONTROL
-from .util import decompress_list, read_latest_run_id, resolve_identity
+from .util import (
+    decompress_list,
+    get_tpuflow_root,
+    read_latest_run_id,
+    resolve_identity,
+)
 
 # the step command records its argv here so gang control tasks can replay it
 # for worker ranks (plugins/parallel_decorator.py)
@@ -784,12 +789,34 @@ def make_cli(flow, state):
                 age = state.metadata.task_heartbeat_age(
                     flow.name, run_id, step_name, task_id
                 )
+                # progress beat (tasks running an instrumented train
+                # loop stamp _progress.json every step): distinguishes
+                # HUNG? (alive by heartbeat, stalled by progress) from
+                # DEAD? (no heartbeat at all)
+                from .progress import read_progress
+
+                beat = read_progress(
+                    get_tpuflow_root(), flow.name, run_id, step_name,
+                    task_id)
+                prog = ""
+                if beat and not beat.get("done"):
+                    import time as _time
+
+                    page = _time.time() - float(beat.get("ts") or 0.0)
+                    prog = " step=%s prog=%.0fs" % (
+                        beat.get("step_num"), max(0, page))
                 if ds.is_done():
                     word = "done"
                 elif age is not None and age < 30:
                     # a live heartbeat wins over a prior attempt's failure
                     # record (a retry may be running right now)
                     word = "running"
+                    deadline = float(
+                        (beat or {}).get("deadline_s") or 0.0)
+                    if (beat and not beat.get("done") and deadline > 0
+                            and page > deadline):
+                        word = ("HUNG? (no progress %.0fs, deadline %.0fs)"
+                                % (page, deadline))
                 elif meta.get("attempt_ok") == "false":
                     word = "FAILED"
                 elif age is not None:
@@ -798,6 +825,7 @@ def make_cli(flow, state):
                     word = "pending"
                 duration = meta.get("duration-ms")
                 extra = " %sms" % duration if duration else ""
+                extra += prog
                 echo("  %-20s %-8s attempt=%s%s"
                      % ("%s/%s" % (step_name, task_id), word,
                         ds.attempt if ds.has_attempt() else "-", extra))

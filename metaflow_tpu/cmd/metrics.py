@@ -45,6 +45,10 @@ def aggregate(records, profiles=None):
     fleet_chaos_kills = 0
     fleet_scale = {"out": 0, "in": 0}
     fleet_rollouts = []
+    # gang hang watchdog (elastic/watchdog.py) + chaos fault kinds
+    hang_detections = []
+    chaos_hangs = 0
+    chaos_slows = 0
     # serve.prefix.* radix-cache events (serving/prefix_cache.py)
     prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
               "prompt_tokens": 0, "evictions": 0, "evicted_tokens": 0,
@@ -142,6 +146,26 @@ def aggregate(records, profiles=None):
                     prefix["evicted_tokens"] += int(
                         data.get("tokens", 0))
                     prefix["evicted_bytes"] += int(data.get("bytes", 0))
+            if name == "hang.detected":
+                data = rec.get("data") or {}
+                hang_detections.append({
+                    "pathspec": data.get("pathspec"),
+                    "laggard_rank": data.get("laggard_rank"),
+                    "step_num": data.get("step_num"),
+                    "progress_age_s": data.get("progress_age_s"),
+                    "deadline_s": data.get("deadline_s"),
+                    # time-to-detection: how long past the deadline the
+                    # stall ran before the watchdog caught it (poll
+                    # cadence + dump wait)
+                    "detect_lag_s": round(
+                        max(0.0, (data.get("progress_age_s") or 0.0)
+                            - (data.get("deadline_s") or 0.0)), 3),
+                    "forensics": data.get("forensics"),
+                })
+            elif name == "chaos.hang":
+                chaos_hangs += 1
+            elif name == "chaos.slow":
+                chaos_slows += 1
             if name.startswith(("fleet.", "chaos.replica_kill")):
                 data = rec.get("data") or {}
                 if name == "fleet.request.dispatch":
@@ -292,6 +316,20 @@ def aggregate(records, profiles=None):
             "rollouts": fleet_rollouts,
         }
 
+    hangs = {}
+    if hang_detections or chaos_hangs or chaos_slows:
+        lags = [h["detect_lag_s"] for h in hang_detections
+                if h.get("detect_lag_s") is not None]
+        hangs = {
+            "count": len(hang_detections),
+            "chaos_hangs": chaos_hangs,
+            "chaos_slows": chaos_slows,
+            "detections": hang_detections,
+        }
+        if lags:
+            hangs["mean_detect_lag_s"] = round(statistics.mean(lags), 3)
+            hangs["max_detect_lag_s"] = round(max(lags), 3)
+
     prefix_cache = {}
     looked_up = prefix["hits"] + prefix["misses"]
     if looked_up or prefix["evictions"]:
@@ -317,6 +355,7 @@ def aggregate(records, profiles=None):
         "events": dict(sorted(events.items())),
         "train": train,
         "fleet": fleet,
+        "hangs": hangs,
         "prefix_cache": prefix_cache,
         "timeline": timeline,
         "profiles": list(profiles or []),
@@ -456,6 +495,26 @@ def render_summary(run_id, agg, echo=print):
                 echo("    replica %s attempt %s: wait %ss"
                      % (r.get("replica"), r.get("attempt"),
                         r.get("delay_s")))
+    hangs = agg.get("hangs") or {}
+    if hangs:
+        echo("")
+        echo("hangs (gang watchdog):")
+        line = "  %d hang(s) detected" % hangs.get("count", 0)
+        if hangs.get("chaos_hangs") or hangs.get("chaos_slows"):
+            line += "  (chaos: %d hang(s), %d straggler(s) injected)" % (
+                hangs.get("chaos_hangs", 0), hangs.get("chaos_slows", 0))
+        echo(line)
+        if "mean_detect_lag_s" in hangs:
+            echo("  time-to-detection past deadline: mean %.1fs, "
+                 "max %.1fs" % (hangs["mean_detect_lag_s"],
+                                hangs["max_detect_lag_s"]))
+        for h in hangs.get("detections") or []:
+            echo("  %s: rank %s stalled at step %s for %.0fs "
+                 "(deadline %.0fs); forensics: %s"
+                 % (h.get("pathspec"), h.get("laggard_rank"),
+                    h.get("step_num"), h.get("progress_age_s") or 0.0,
+                    h.get("deadline_s") or 0.0,
+                    h.get("forensics") or "-"))
     prefix_cache = agg.get("prefix_cache") or {}
     if prefix_cache:
         echo("")

@@ -72,6 +72,8 @@ class WatchState(object):
         # incidents
         self.desync_count = 0
         self.flush_failures = 0
+        self.hang_count = 0
+        self.last_hang = None         # latest hang.detected event data
         self.breach_events = []       # persisted slo.breach records
 
     def ingest(self, records):
@@ -140,6 +142,9 @@ class WatchState(object):
                     self.last_rollout = data
                 elif name == "sanitize.desync":
                     self.desync_count += 1
+                elif name == "hang.detected":
+                    self.hang_count += 1
+                    self.last_hang = data
                 elif name == "slo.breach":
                     self.breach_events.append(rec)
             elif rtype == "counter" and name == "telemetry.flush_failed":
@@ -154,6 +159,7 @@ class WatchState(object):
             "replica_flaps": self.replica_flaps,
             "desync_count": float(self.desync_count),
             "flush_failures": self.flush_failures,
+            "hang_count": float(self.hang_count),
         }
         # restart rate over the final observed minute (record-clock, so
         # it works identically on live and finished runs)
@@ -250,9 +256,20 @@ def render_frame(state, run_id, breaches=(), echo=print):
             ("  (%s replaced, %s shed)"
              % (ro.get("replaced"), ro.get("shed_requests")))
             if ro.get("phase") == "done" else ""))
-    if state.desync_count or state.flush_failures:
-        echo("  incidents: desync %d  flush_failed %d"
-             % (state.desync_count, state.flush_failures))
+    if state.desync_count or state.flush_failures or state.hang_count:
+        echo("  incidents: desync %d  flush_failed %d  hangs %d"
+             % (state.desync_count, state.flush_failures,
+                state.hang_count))
+    if state.last_hang is not None:
+        h = state.last_hang
+        echo("  hang.detected: %s rank %s stalled at step %s "
+             "(%.0fs past a %.0fs deadline) — gang killed for elastic "
+             "retry" % (
+                 h.get("pathspec"), h.get("laggard_rank"),
+                 h.get("step_num"),
+                 max(0.0, (h.get("progress_age_s") or 0.0)
+                     - (h.get("deadline_s") or 0.0)),
+                 h.get("deadline_s") or 0.0))
     for b in breaches:
         echo("  SLO BREACH: %s %s=%s > %s" % (
             b["rule"], b["metric"], b["value"], b["threshold"]))

@@ -31,6 +31,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 from .. import telemetry
 from ..exception import TpuFlowException
@@ -154,50 +155,69 @@ class ShardReader(object):
         shard_ids = [int(s) for s in shard_ids]
         if not shard_ids:
             return
+        from ..datastore.storage import storage_timeout_s
+
         sizes = [self._manifest["shards"][s]["bytes"] for s in shard_ids]
+        # consumer-side deadline (TPUFLOW_STORAGE_TIMEOUT_S, 0 = none):
+        # the retried network layer underneath has its own per-attempt
+        # deadline, so allow the full retry budget's worth of wall clock
+        # before declaring the fetch wedged
+        timeout_s = storage_timeout_s()
+        fetch_timeout = (timeout_s * 8) if timeout_s > 0 else None
         pending = collections.deque()  # (shard_id, size, future)
         inflight = 0
         nxt = 0
-        with ThreadPoolExecutor(max_workers=self._max_workers) as pool:
-            try:
-                while pending or nxt < len(shard_ids):
-                    # top up: always at least one in flight; beyond that,
-                    # submit while the byte window has room
-                    while nxt < len(shard_ids) and (
-                            not pending
-                            or inflight + sizes[nxt] <= self._readahead):
-                        sid = shard_ids[nxt]
-                        pending.append(
-                            (sid, sizes[nxt],
-                             pool.submit(self._fetch, sid)))
-                        inflight += sizes[nxt]
-                        nxt += 1
-                    occ = min(1.0, inflight / float(self._readahead))
-                    with self._stats_lock:
-                        self.stats["occupancy_sum"] += occ
-                        self.stats["occupancy_samples"] += 1
-                    telemetry.gauge(
-                        "data.readahead_occupancy", round(occ, 4),
-                        data={"bytes": inflight, "shards": len(pending),
-                              "window_bytes": self._readahead})
-                    sid, size, fut = pending.popleft()
-                    t0 = time.perf_counter()
-                    tokens = fut.result()
-                    with self._stats_lock:
-                        self.stats["wait_ms"] += (
-                            time.perf_counter() - t0) * 1000
-                    inflight -= size
-                    yield sid, tokens
-            finally:
-                # an abandoned generator (consumer broke out early) exits
-                # through GeneratorExit here: cancel the fetches still
-                # queued behind the workers — the default pool shutdown
-                # would WAIT for them, stalling teardown by up to a full
-                # readahead window of downloads nobody will consume —
-                # then the with-block waits out only the ≤max_workers
-                # already running
-                for _sid, _size, fut in pending:
-                    fut.cancel()
+        pool = ThreadPoolExecutor(max_workers=self._max_workers)
+        wedged = False
+        try:
+            while pending or nxt < len(shard_ids):
+                # top up: always at least one in flight; beyond that,
+                # submit while the byte window has room
+                while nxt < len(shard_ids) and (
+                        not pending
+                        or inflight + sizes[nxt] <= self._readahead):
+                    sid = shard_ids[nxt]
+                    pending.append(
+                        (sid, sizes[nxt],
+                         pool.submit(self._fetch, sid)))
+                    inflight += sizes[nxt]
+                    nxt += 1
+                occ = min(1.0, inflight / float(self._readahead))
+                with self._stats_lock:
+                    self.stats["occupancy_sum"] += occ
+                    self.stats["occupancy_samples"] += 1
+                telemetry.gauge(
+                    "data.readahead_occupancy", round(occ, 4),
+                    data={"bytes": inflight, "shards": len(pending),
+                          "window_bytes": self._readahead})
+                sid, size, fut = pending.popleft()
+                t0 = time.perf_counter()
+                try:
+                    tokens = fut.result(timeout=fetch_timeout)
+                except FuturesTimeout:
+                    wedged = True
+                    raise TimeoutError(
+                        "shard %d fetch exceeded %.1fs — wedged transfer "
+                        "(TPUFLOW_STORAGE_TIMEOUT_S)"
+                        % (sid, fetch_timeout))
+                with self._stats_lock:
+                    self.stats["wait_ms"] += (
+                        time.perf_counter() - t0) * 1000
+                inflight -= size
+                yield sid, tokens
+        finally:
+            # an abandoned generator (consumer broke out early) exits
+            # through GeneratorExit here: cancel the fetches still
+            # queued behind the workers — the default pool shutdown
+            # would WAIT for them, stalling teardown by up to a full
+            # readahead window of downloads nobody will consume — then
+            # wait out only the ≤max_workers already running. UNLESS a
+            # fetch wedged past its deadline: then even the running
+            # workers are unjoinable and the pool is abandoned outright
+            # (the TimeoutError must reach the caller, not hang here)
+            for _sid, _size, fut in pending:
+                fut.cancel()
+            pool.shutdown(wait=not wedged, cancel_futures=wedged)
 
     def mean_occupancy(self):
         n = self.stats["occupancy_samples"]

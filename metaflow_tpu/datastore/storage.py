@@ -37,9 +37,13 @@ def _storage_retry(fn, what, policy=None, attempts=None):
     attempts = max(0, int(attempts))
     if policy is None:
         policy = BackoffPolicy.from_env()
+    # per-attempt wall-clock deadline (TPUFLOW_STORAGE_TIMEOUT_S): a
+    # stalled-but-connected transfer becomes a TimeoutError that rides
+    # this very retry budget instead of wedging the caller forever
+    deadline_s = storage_timeout_s()
     for attempt in range(attempts + 1):
         try:
-            return fn()
+            return run_with_deadline(fn, what, deadline_s)
         except (GSTransientError, ConnectionError, TimeoutError) as ex:
             if attempt >= attempts:
                 sys.stderr.write(
@@ -53,6 +57,54 @@ def _storage_retry(fn, what, policy=None, attempts=None):
                 "in %.2fs\n" % (what, ex, attempt + 1, attempts, delay))
             sys.stderr.flush()
             time.sleep(delay)
+
+
+def storage_timeout_s(env=None):
+    """TPUFLOW_STORAGE_TIMEOUT_S: per-operation deadline for blocking
+    GS gets/puts and shard fetches (0 / unset = no deadline, the
+    historical behavior). A stalled-but-connected socket otherwise hangs
+    the caller forever with a live heartbeat — exactly the wedge the
+    gang watchdog has to escalate on; the deadline turns it into a
+    TimeoutError that rides the normal _storage_retry budget instead."""
+    try:
+        return float((env or os.environ).get(
+            "TPUFLOW_STORAGE_TIMEOUT_S", "0") or 0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def run_with_deadline(fn, what, timeout_s):
+    """Run fn() with a wall-clock deadline; raise TimeoutError on expiry.
+
+    The op runs on a daemon thread and is ABANDONED when the deadline
+    fires — a client wedged in an uninterruptible read cannot be
+    cancelled from Python, so the worker thread may stay blocked. That
+    leak is the point: the caller gets its TimeoutError (and its retry)
+    instead of inheriting the wedge. timeout_s <= 0 calls fn() inline."""
+    if timeout_s <= 0:
+        return fn()
+    import threading
+
+    result = []  # [("ok", value)] or [("err", exc)]
+
+    def _run():
+        try:
+            result.append(("ok", fn()))
+        except BaseException as ex:
+            result.append(("err", ex))
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="storage-deadline")
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        raise TimeoutError(
+            "storage: %s exceeded the %.1fs deadline "
+            "(TPUFLOW_STORAGE_TIMEOUT_S)" % (what, timeout_s))
+    kind, value = result[0]
+    if kind == "err":
+        raise value
+    return value
 
 
 class CloseAfterUse(object):

@@ -167,17 +167,30 @@ class GS(object):
         future aborting the whole pool.map mid-batch."""
         if not items:
             return []
-        with ThreadPoolExecutor(
-            max_workers=min(MAX_WORKERS, len(items))
-        ) as pool:
+        from .datastore.storage import storage_timeout_s
+
+        # per-key deadline (TPUFLOW_STORAGE_TIMEOUT_S, 0 = none): the
+        # retried network layer underneath has its own per-attempt
+        # deadline, so give each future the whole retry budget's worth
+        # of headroom — this is the backstop for a transfer wedged in a
+        # way the inner deadline can't see (e.g. a stuck local filesystem)
+        timeout_s = storage_timeout_s()
+        per_key_timeout = (timeout_s * 8) if timeout_s > 0 else None
+        pool = ThreadPoolExecutor(max_workers=min(MAX_WORKERS, len(items)))
+        try:
             futures = [pool.submit(fn, item) for item in items]
             results, failures = [], []
             for item, fut in zip(items, futures):
                 try:
-                    results.append(fut.result())
+                    results.append(fut.result(timeout=per_key_timeout))
                 except Exception as ex:
                     failures.append((key_of(item), ex))
                     results.append(None)
+        finally:
+            # wait=False: a future wedged past its deadline must not
+            # block pool teardown (the abandoned worker thread is the
+            # cost of getting the batch verdict out)
+            pool.shutdown(wait=False, cancel_futures=True)
         if failures:
             raise GSBatchFailure(op, failures)
         return results

@@ -18,8 +18,16 @@ Environment contract (read by `from_env`, ticked by
                                 default_rng(seed) over the horizon
     TPUFLOW_CHAOS=3:1,7:0       explicit schedule: kill rank 1 at step 3,
                                 rank 0 at step 7
+    TPUFLOW_CHAOS=3:1:hang      explicit fault KIND: rank 1 wedges
+                                forever at step 3 (never exits — the
+                                gang hang watchdog's prey)
+    TPUFLOW_CHAOS=3:1:slow      bounded straggler: rank 1 sleeps
+                                TPUFLOW_CHAOS_SLOW_S once at step 3,
+                                then keeps training (the watchdog
+                                false-positive guard)
     TPUFLOW_CHAOS_STEPS=N       seeded horizon (default 10)
     TPUFLOW_CHAOS_NKILLS=K      kills drawn from the seed (default 1)
+    TPUFLOW_CHAOS_SLOW_S=T      straggler delay for :slow (default 1.0)
     TPUFLOW_CHAOS_DIR=path      once-only ledger dir (defaults to a
                                 per-run dir under the system tempdir)
 
@@ -33,6 +41,7 @@ they make shrink/grow/repeated-kill scenarios deterministic.
 
 import os
 import tempfile
+import time
 
 from .. import telemetry
 
@@ -40,6 +49,14 @@ CHAOS_ENV = "TPUFLOW_CHAOS"
 STEPS_ENV = "TPUFLOW_CHAOS_STEPS"
 NKILLS_ENV = "TPUFLOW_CHAOS_NKILLS"
 DIR_ENV = "TPUFLOW_CHAOS_DIR"
+SLOW_S_ENV = "TPUFLOW_CHAOS_SLOW_S"
+
+# fault kinds an explicit schedule entry may name ("step:rank:kind")
+KIND_KILL = "kill"    # spot-notice marker + SIGTERM (the default)
+KIND_HANG = "hang"    # wedge forever in-step: main thread sleeps until
+                      # something from outside kills the process
+KIND_SLOW = "slow"    # bounded once-only straggler delay, then proceed
+FAULT_KINDS = (KIND_KILL, KIND_HANG, KIND_SLOW)
 
 # serving-fleet variant: kills are indexed by DISPATCH COUNT (the
 # router's monotonically increasing request-dispatch counter), not train
@@ -50,22 +67,48 @@ FLEET_NKILLS_ENV = "TPUFLOW_CHAOS_FLEET_NKILLS"
 
 
 class KillSchedule(object):
-    """An immutable set of (step, rank) kill events."""
+    """An immutable set of (step, rank) fault events.
 
-    def __init__(self, kills):
+    `.kills` stays a tuple of 2-tuples — the seeded replay tests and the
+    fleet injector iterate it positionally — while the optional fault
+    kind of each event rides beside it in `.kinds` (missing = "kill")."""
+
+    def __init__(self, kills, kinds=None):
         self.kills = tuple(sorted({(int(s), int(r)) for s, r in kills}))
+        self.kinds = {
+            (int(s), int(r)): str(k)
+            for (s, r), k in (kinds or {}).items()
+        }
+
+    def kind_of(self, step, rank):
+        return self.kinds.get((int(step), int(rank)), KIND_KILL)
 
     @classmethod
     def parse(cls, spec):
-        """"3:1,7:0" -> kill rank 1 at step 3, rank 0 at step 7."""
+        """"3:1,7:0" -> kill rank 1 at step 3, rank 0 at step 7.
+        A third field names the fault kind: "3:1:hang", "5:0:slow"."""
         kills = []
+        kinds = {}
         for part in str(spec).split(","):
             part = part.strip()
             if not part:
                 continue
-            step, rank = part.split(":")
-            kills.append((int(step), int(rank)))
-        return cls(kills)
+            fields = part.split(":")
+            if len(fields) not in (2, 3):
+                raise ValueError(
+                    "chaos schedule entry %r is not step:rank[:kind]"
+                    % part)
+            step, rank = int(fields[0]), int(fields[1])
+            kills.append((step, rank))
+            if len(fields) == 3:
+                kind = fields[2].strip().lower()
+                if kind not in FAULT_KINDS:
+                    raise ValueError(
+                        "unknown chaos fault kind %r (one of %s)"
+                        % (kind, ", ".join(FAULT_KINDS)))
+                if kind != KIND_KILL:
+                    kinds[(step, rank)] = kind
+        return cls(kills, kinds)
 
     @classmethod
     def seeded(cls, seed, n_steps, world, n_kills=1):
@@ -108,15 +151,19 @@ class ChaosInjector(object):
         self.world = int(world)
         self.ledger_dir = ledger_dir
         self._notify = notify
-        self._my_steps = set(schedule.kills_for_rank(self.rank))
+        self._my_steps = {
+            s: schedule.kind_of(s, self.rank)
+            for s in schedule.kills_for_rank(self.rank)
+        }
 
-    def _claim(self, step):
+    def _claim(self, step, kind=KIND_KILL):
         """True iff THIS call is the first delivery of (step, rank) in
         the run — O_EXCL on a ledger file arbitrates across attempts
-        (and across racing processes on the same host)."""
+        (and across racing processes on the same host). Kill events keep
+        their historical ledger name; other kinds are kind-prefixed."""
         os.makedirs(self.ledger_dir, exist_ok=True)
         path = os.path.join(
-            self.ledger_dir, "kill-%d-%d" % (int(step), self.rank))
+            self.ledger_dir, "%s-%d-%d" % (kind, int(step), self.rank))
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
@@ -124,13 +171,45 @@ class ChaosInjector(object):
         os.close(fd)
         return True
 
+    def _hang(self, step):
+        """Wedge this rank forever, exactly like a stuck collective or
+        deadlocked I/O would: the thread-driven heartbeat keeps beating,
+        progress stops, and nothing here ever returns. Flush first — a
+        SIGKILLed process loses buffered records, and the event is the
+        e2e's proof the fault fired."""
+        telemetry.event(
+            "chaos.hang",
+            data={"step": int(step), "rank": self.rank,
+                  "world": self.world})
+        telemetry.flush()
+        while True:
+            time.sleep(3600)
+
+    def _slow(self, step):
+        """Bounded straggler: one long-but-finite delay, then the step
+        proceeds. Progress resumes before any sane deadline, so the hang
+        watchdog must NOT fire (the false-positive guard)."""
+        delay_s = float(os.environ.get(SLOW_S_ENV, "1.0"))
+        telemetry.event(
+            "chaos.slow",
+            data={"step": int(step), "rank": self.rank,
+                  "world": self.world, "delay_s": delay_s})
+        time.sleep(delay_s)
+
     def on_step(self, step):
-        """Deliver any scheduled kill for (step, this rank). Returns True
-        when a notice was just delivered (the SIGTERM raise is typically
-        already unwinding the stack by then)."""
-        if int(step) not in self._my_steps:
+        """Deliver any scheduled fault for (step, this rank). Returns
+        True when a kill notice was just delivered (the SIGTERM raise is
+        typically already unwinding the stack by then); a hang never
+        returns."""
+        kind = self._my_steps.get(int(step))
+        if kind is None:
             return False
-        if not self._claim(step):
+        if not self._claim(step, kind):
+            return False
+        if kind == KIND_HANG:
+            self._hang(step)
+        if kind == KIND_SLOW:
+            self._slow(step)
             return False
         telemetry.event(
             "chaos.kill",

@@ -14,6 +14,13 @@ Failure classes drive what a retry MEANS:
   grow        the supervisor itself asked the gang to exit at a
               checkpoint boundary so it can relaunch larger: retry
               immediately at the new size.
+  hang        the gang watchdog killed the gang because a rank was alive
+              by heartbeat but past its progress deadline (stuck
+              collective, deadlocked I/O): checkpointed work resumes on
+              the elastic budget — a wedge is a capacity event, not a
+              user error. A repeated hang AT THE SAME STEP is capped by
+              the supervisor (the wedge is deterministic; retrying burns
+              capacity at zero progress).
   user        the step raised (attempt_ok metadata was recorded): honor
               the @retry budget, short backoff — retrying faster never
               fixes user code, retrying slower never hurts it.
@@ -26,17 +33,23 @@ import os
 
 CLASS_PREEMPTION = "preemption"
 CLASS_GROW = "grow"
+CLASS_HANG = "hang"
 CLASS_USER = "user"
 CLASS_INFRA = "infra"
 
 
 def classify_failure(spot_notice=False, grow_notice=False,
-                     attempt_recorded=True):
+                     attempt_recorded=True, hang_notice=False):
     """Map one failed attempt's observable outcome to a failure class.
 
     spot_notice / grow_notice: a fresh notice marker was recorded (by the
     preemption monitor, the chaos harness, or the supervisor's own grow
     request) on the task or any of its gang ranks.
+    hang_notice: the gang watchdog recorded its `hung` verdict before
+    killing the gang — it outranks the spot notice (the watchdog's own
+    SIGTERM unwinds each rank through the preemption handler, which can
+    leave secondary markers) but never a grow notice (a gang asked to
+    grow legitimately idles at the checkpoint boundary).
     attempt_recorded: the task got far enough to register its attempt_ok
     metadata — i.e. user code ran and raised, vs the process being torn
     from under it. (The exit code deliberately plays no part: a -TERM
@@ -45,6 +58,8 @@ def classify_failure(spot_notice=False, grow_notice=False,
     """
     if grow_notice:
         return CLASS_GROW
+    if hang_notice:
+        return CLASS_HANG
     if spot_notice:
         return CLASS_PREEMPTION
     if attempt_recorded:

@@ -39,6 +39,7 @@ from .oracle import oracle_from_env
 from .policy import (
     BackoffPolicy,
     CLASS_GROW,
+    CLASS_HANG,
     CLASS_PREEMPTION,
     classify_failure,
 )
@@ -60,7 +61,8 @@ class _GangState(object):
 
     __slots__ = ("first_launch_ts", "running_s", "launched_ts", "resizes",
                  "consecutive_preemptions", "current_size", "pending_grow",
-                 "last_grow_poll", "grow_notified_ts", "had_elastic_event")
+                 "last_grow_poll", "grow_notified_ts", "had_elastic_event",
+                 "hang_step_counts", "last_hang_forensics")
 
     def __init__(self):
         self.first_launch_ts = None
@@ -73,6 +75,8 @@ class _GangState(object):
         self.last_grow_poll = 0.0
         self.grow_notified_ts = None
         self.had_elastic_event = False
+        self.hang_step_counts = {}     # laggard step_num -> hangs seen
+        self.last_hang_forensics = None
 
 
 class ElasticGangSupervisor(object):
@@ -107,9 +111,14 @@ class ElasticGangSupervisor(object):
         # adaptive (oracle-less) policy knobs
         self._shrink_after = env_int("TPUFLOW_ELASTIC_SHRINK_AFTER", 2)
         self._grow_every_s = env_float("TPUFLOW_ELASTIC_GROW_EVERY_S", 5.0)
+        # repeated-hang cap: the same laggard step hanging again after a
+        # checkpoint-restore retry means the wedge is deterministic —
+        # keep retrying and the gang burns capacity at zero progress
+        self._hang_same_step_max = env_int("TPUFLOW_HANG_SAME_STEP_MAX", 2)
         self.run_id = None  # set by the runtime once the run id exists
         self._state = {}
         self._facts = None  # lazy analysis facts for mesh validation
+        self._last_hang_notice = None  # classify() side channel
 
     # ------------------------------------------------------------------
     # bookkeeping hooks (called by the runtime)
@@ -169,10 +178,14 @@ class ElasticGangSupervisor(object):
 
     @staticmethod
     def _notice_fields(records, attempt):
-        """(spot, grow) notice flags recorded at `attempt` in one task's
-        metadata record list."""
+        """(spot, grow, hang) notice flags recorded at `attempt` in one
+        task's metadata record list. The hang verdict is the watchdog's
+        own marker (a JSON payload naming the laggard rank/step and the
+        forensics path), registered on the control task before the gang
+        kill."""
         tag = "attempt_id:%d" % attempt
         spot = grow = False
+        hang = None
         for m in records:
             if tag not in (m.get("tags") or []):
                 continue
@@ -180,7 +193,12 @@ class ElasticGangSupervisor(object):
                 spot = True
             elif m.get("field_name") == "resize":
                 grow = True
-        return spot, grow
+            elif m.get("field_name") == "hung":
+                try:
+                    hang = json.loads(m.get("value") or "{}")
+                except (ValueError, TypeError):
+                    hang = {}
+        return spot, grow, hang
 
     @staticmethod
     def _gang_members(control_task_id, control_records):
@@ -210,19 +228,23 @@ class ElasticGangSupervisor(object):
         else:
             members = [task.task_id]
         spot = grow = attempt_recorded = False
+        hang = None
         tag = "attempt_id:%d" % task.attempt
         for member in members:
             records = (control_records if member == task.task_id
                        else self._task_metadata(task.step, member))
-            s, g = self._notice_fields(records, task.attempt)
+            s, g, h = self._notice_fields(records, task.attempt)
             spot = spot or s
             grow = grow or g
+            hang = hang if hang is not None else h
         for m in control_records:
             if (m.get("field_name") == "attempt_ok"
                     and tag in (m.get("tags") or [])):
                 attempt_recorded = True
+        self._last_hang_notice = hang
         return classify_failure(spot_notice=spot, grow_notice=grow,
-                                attempt_recorded=attempt_recorded)
+                                attempt_recorded=attempt_recorded,
+                                hang_notice=hang is not None)
 
     # ------------------------------------------------------------------
     # size selection + pre-relaunch validation
@@ -358,13 +380,35 @@ class ElasticGangSupervisor(object):
             # the next attempt.
             fclass = CLASS_GROW
 
-        if fclass in (CLASS_PREEMPTION, CLASS_GROW):
+        if fclass in (CLASS_PREEMPTION, CLASS_GROW, CLASS_HANG):
             g.consecutive_preemptions += (1 if fclass == CLASS_PREEMPTION
                                           else 0)
             budget = max(user_budget, self._elastic_retries)
         else:
             g.consecutive_preemptions = 0
             budget = user_budget
+
+        if fclass == CLASS_HANG:
+            notice = self._last_hang_notice or {}
+            hang_step = notice.get("step_num")
+            forensics = notice.get("forensics")
+            if forensics:
+                g.last_hang_forensics = forensics
+            count = g.hang_step_counts.get(hang_step, 0) + 1
+            g.hang_step_counts[hang_step] = count
+            g.had_elastic_event = True
+            if count >= self._hang_same_step_max:
+                # checkpoint restore replayed into the same wedge: this
+                # is deterministic, not transient — fail LOUDLY with the
+                # evidence instead of burning the elastic budget
+                reason = (
+                    "gang hung %d time(s) at step %s (rank %s) — the "
+                    "wedge reproduces across checkpoint restore; "
+                    "forensics: %s"
+                    % (count, hang_step, notice.get("rank"),
+                       g.last_hang_forensics or "(upload failed)"))
+                self._echo("Elastic supervisor: " + reason)
+                return Decision("fail", 0.0, None, fclass, reason)
 
         if task.attempt >= min(budget, max_attempts - 1):
             return Decision("fail", 0.0, None, fclass,
@@ -373,6 +417,11 @@ class ElasticGangSupervisor(object):
 
         new_size = None
         reason = fclass
+        if fclass == CLASS_HANG:
+            notice = self._last_hang_notice or {}
+            reason = ("hung at step %s (laggard rank %s); killed by "
+                      "watchdog — resuming from checkpoint"
+                      % (notice.get("step_num"), notice.get("rank")))
         if is_gang and pending_grow and fclass == CLASS_GROW:
             # the gang exited at its checkpoint boundary because WE asked:
             # relaunch at the size the grow poll validated

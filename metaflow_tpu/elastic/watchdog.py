@@ -1,0 +1,339 @@
+"""GangWatchdog: progress-based hang detection for running gangs.
+
+Polled from the NativeRuntime scheduler loop (next to _persist_runstate),
+it closes the one failure mode the fail-stop machinery cannot see: a
+rank that WEDGES — stuck collective, deadlocked I/O, infinite retry
+loop — keeps heartbeating (the beat is a daemon thread) while making
+zero progress, so the run looks alive forever. The watchdog cross-reads
+two channels per gang rank:
+
+  heartbeat (_heartbeat.json mtime)   "the process exists"
+  progress  (_progress.json beats)    "the main thread is doing work"
+
+A rank that is alive by heartbeat but past its own progress deadline
+(progress.py: max(floor, mult × step-EMA), compile-grace aware) flags
+the gang HUNG. Detection then runs the forensics pipeline before any
+kill destroys the evidence:
+
+  1. SIGQUIT every beating rank pid → faulthandler dumps all-thread
+     stacks into each rank's _stacks.txt (C-level: works while the main
+     thread is blocked in a syscall);
+  2. stack dumps + a JSON hang report + the tail of the sanitizer
+     signature journal are uploaded to `_telemetry/hangs/` in the run's
+     datastore;
+  3. a pinned `hang.detected` event names the laggard rank, and a
+     `hung` metadata marker (the JSON verdict) lands on the control
+     task so the elastic supervisor classifies the failure as
+     CLASS_HANG (policy.py) and resumes from checkpoint on the elastic
+     budget;
+  4. the gang is killed: group SIGTERM first (checkpoint shields and
+     preemption handlers unwind cleanly), group SIGKILL after
+     TPUFLOW_HANG_KILL_GRACE_S for ranks too wedged to die.
+
+Detection is default-ON with conservative deadlines (a 60s floor and
+8× the step-time EMA); TPUFLOW_HANG_DETECT=0 disables it. Tasks that
+never emit a progress beat are never watched — the watchdog only
+watches volunteers, so plain steps and joins cannot false-positive.
+"""
+
+import json
+import os
+import signal
+import time
+
+from .. import progress
+from ..metadata.metadata import MetaDatum
+from ..telemetry import HANGS_PREFIX
+from ..unbounded_foreach import UBF_CONTROL
+from ..util import env_float, get_tpuflow_root
+
+DETECT_ENV = "TPUFLOW_HANG_DETECT"
+POLL_ENV = "TPUFLOW_HANG_POLL_S"
+KILL_GRACE_ENV = "TPUFLOW_HANG_KILL_GRACE_S"
+DUMP_WAIT_ENV = "TPUFLOW_HANG_DUMP_WAIT_S"
+
+# a heartbeat older than this means the rank is DYING, not hung — the
+# fail-stop path (process reap, classification) owns that case
+HEARTBEAT_STALE_S = 30.0
+
+
+def hang_detect_enabled(env=None):
+    return (env or os.environ).get(DETECT_ENV, "1") == "1"
+
+
+class GangWatchdog(object):
+    def __init__(self, flow_name, metadata, recorder=None, echo=None,
+                 root=None):
+        self._flow_name = flow_name
+        self._metadata = metadata
+        self._recorder = recorder
+        self._echo = echo or (lambda line: print(line, flush=True))
+        self._root = root or get_tpuflow_root()
+        self._poll_every = env_float(POLL_ENV, 5.0)
+        self._kill_grace = env_float(KILL_GRACE_ENV, 5.0)
+        self._dump_wait = env_float(DUMP_WAIT_ENV, 0.5)
+        self.run_id = None  # set by the runtime once the run id exists
+        self._last_poll = 0.0
+        # (step, task_id, attempt) -> SIGTERM ts, for SIGKILL escalation.
+        # Attempt is part of the key: the retried worker reuses the same
+        # step/task_id and must NOT inherit its predecessor's death warrant.
+        self._terminated = {}
+        self.hangs_detected = 0
+
+    # ------------------------------------------------------------------
+    # scheduler hook
+    # ------------------------------------------------------------------
+
+    def poll(self, active_workers):
+        """Called every scheduler loop iteration; internally throttled to
+        TPUFLOW_HANG_POLL_S. Never raises — a watchdog bug must not take
+        down the scheduler it guards."""
+        now = time.time()
+        if now - self._last_poll < self._poll_every:
+            return
+        self._last_poll = now
+        for worker in list(active_workers.values()):
+            try:
+                self._poll_worker(worker, now)
+            except Exception as ex:
+                self._echo("WARNING: hang watchdog error on %s/%s: %s"
+                           % (worker.task.step, worker.task.task_id, ex))
+
+    def _poll_worker(self, worker, now):
+        task = worker.task
+        key = (task.step, str(task.task_id), task.attempt)
+        if key in self._terminated:
+            # gang already condemned: escalate to SIGKILL once the
+            # grace expires (non-blocking across polls)
+            if now - self._terminated[key] >= self._kill_grace:
+                worker.proc.kill()
+            return
+        verdict = self._inspect(task, now)
+        if verdict is None:
+            return
+        self._handle_hang(task, worker, verdict, now)
+
+    # ------------------------------------------------------------------
+    # detection
+    # ------------------------------------------------------------------
+
+    def _members(self, task):
+        """All rank task ids of this attempt's gang (control first)."""
+        if task.ubf_context == UBF_CONTROL and task.num_parallel:
+            records = self._task_metadata(task.step, task.task_id)
+            for m in records:
+                if m.get("field_name") == "control-mapper-tasks":
+                    try:
+                        return [p.split("/")[-1]
+                                for p in json.loads(m.get("value") or "[]")]
+                    except (ValueError, TypeError):
+                        pass
+            size = int(task.elastic_size or task.num_parallel)
+            return [str(task.task_id)] + [
+                "%s-node-%d" % (task.task_id, i) for i in range(1, size)]
+        return [str(task.task_id)]
+
+    def _task_metadata(self, step, task_id):
+        try:
+            return self._metadata.get_task_metadata(
+                self._flow_name, self.run_id, step, task_id) or []
+        except Exception:
+            return []
+
+    def _heartbeat_age(self, step, task_id):
+        try:
+            return self._metadata.task_heartbeat_age(
+                self._flow_name, self.run_id, step, task_id)
+        except Exception:
+            return None
+
+    def _inspect(self, task, now):
+        """The HUNG verdict for one active gang, or None.
+
+        A rank counts as the laggard when its latest progress beat (for
+        THIS attempt, not yet marked done) is past its self-declared
+        deadline while its heartbeat is still fresh. Ranks that never
+        beat are not watched; ranks with stale heartbeats are dying, not
+        hung."""
+        laggard = None
+        beats = {}
+        members = self._members(task)
+        for member in members:
+            beat = progress.read_progress(
+                self._root, self._flow_name, self.run_id, task.step,
+                member)
+            if (not beat or beat.get("done")
+                    or beat.get("attempt") != task.attempt):
+                continue
+            beats[member] = beat
+            age = now - float(beat.get("ts") or 0.0)
+            deadline = float(beat.get("deadline_s") or 0.0)
+            if deadline <= 0 or age <= deadline:
+                continue
+            hb_age = self._heartbeat_age(task.step, member)
+            if hb_age is None or hb_age > HEARTBEAT_STALE_S:
+                continue  # DEAD?, not HUNG — fail-stop machinery owns it
+            if laggard is None or age - deadline > laggard["overshoot"]:
+                laggard = {
+                    "task_id": member,
+                    "rank": beat.get("rank"),
+                    "step_num": beat.get("step_num"),
+                    "pid": beat.get("pid"),
+                    "progress_age_s": round(age, 3),
+                    "deadline_s": round(deadline, 3),
+                    "overshoot": age - deadline,
+                }
+        if laggard is None:
+            return None
+        laggard.pop("overshoot")
+        laggard["beats"] = beats
+        # gang size, NOT len(beats): ranks that already finished (done
+        # beats) still count toward the world the hang is reported against
+        laggard["world"] = len(members)
+        return laggard
+
+    # ------------------------------------------------------------------
+    # forensics + kill
+    # ------------------------------------------------------------------
+
+    def _handle_hang(self, task, worker, verdict, now):
+        beats = verdict.pop("beats")
+        world = verdict.pop("world")
+        pathspec = "/".join((str(self.run_id), task.step,
+                             str(task.task_id)))
+        self.hangs_detected += 1
+        self._echo(
+            "HANG detected: gang %s rank %s (task %s) stalled at step %s "
+            "for %.1fs (deadline %.1fs) with a live heartbeat — dumping "
+            "stacks and killing the gang."
+            % (pathspec, verdict.get("rank"), verdict["task_id"],
+               verdict.get("step_num"), verdict["progress_age_s"],
+               verdict["deadline_s"]))
+        forensics = self._collect_forensics(task, verdict, beats, now,
+                                            world)
+        if self._recorder is not None:
+            self._recorder.event(
+                "hang.detected",
+                data={"pathspec": pathspec,
+                      "laggard_rank": int(verdict.get("rank") or 0),
+                      "laggard_task_id": verdict["task_id"],
+                      "step_num": verdict.get("step_num"),
+                      "progress_age_s": verdict["progress_age_s"],
+                      "deadline_s": verdict["deadline_s"],
+                      "world": world,
+                      "attempt": task.attempt,
+                      "forensics": forensics})
+            self._recorder.flush()
+        # the `hung` marker is what the elastic supervisor classifies on
+        # (CLASS_HANG: elastic budget + same-step cap); registered on the
+        # CONTROL task, tagged with the attempt, before the kill
+        try:
+            self._metadata.register_metadata(
+                self.run_id, task.step, task.task_id,
+                [MetaDatum(
+                    "hung",
+                    json.dumps({"step_num": verdict.get("step_num"),
+                                "rank": verdict.get("rank"),
+                                "task_id": verdict["task_id"],
+                                "forensics": forensics}),
+                    "hang",
+                    ["attempt_id:%d" % task.attempt])])
+        except Exception as ex:
+            self._echo("WARNING: could not record hang verdict: %s" % ex)
+        # group SIGTERM (preemption handlers + checkpoint shields unwind
+        # cleanly); SIGKILL escalation happens on a later poll
+        self._terminated[(task.step, str(task.task_id), task.attempt)] = now
+        try:
+            worker.proc.terminate()
+        except Exception:
+            pass
+
+    def _collect_forensics(self, task, verdict, beats, now, world):
+        """SIGQUIT every beating rank, gather the stack dumps + sanitizer
+        journal tail, upload the bundle under _telemetry/hangs/. Returns
+        the datastore path of the report (or None when upload failed)."""
+        dump_sig = int(os.environ.get(progress.DUMP_SIGNAL_ENV, "0") or 0) \
+            or signal.SIGQUIT
+        dumped = set()
+        for member, beat in beats.items():
+            pid = beat.get("pid")
+            if not pid:
+                continue
+            try:
+                os.kill(int(pid), dump_sig)
+                dumped.add(member)
+            except (OSError, ValueError):
+                pass
+        if dumped:
+            time.sleep(self._dump_wait)  # let faulthandler finish writing
+        ranks = []
+        artifacts = []
+        stamp = "%s-%s-attempt%d-%d" % (
+            task.step, task.task_id, task.attempt, int(now))
+        for member, beat in sorted(beats.items()):
+            entry = {
+                "task_id": member,
+                "rank": beat.get("rank"),
+                "step_num": beat.get("step_num"),
+                "pid": beat.get("pid"),
+                "progress_age_s": round(now - float(beat.get("ts") or 0.0),
+                                        3),
+                "laggard": member == verdict["task_id"],
+                "stacks": None,
+            }
+            if member in dumped:
+                try:
+                    with open(progress.stacks_path(
+                            self._root, self._flow_name, self.run_id,
+                            task.step, member), "rb") as f:
+                        payload = f.read()
+                except OSError:
+                    payload = b""
+                if payload:
+                    entry["stacks"] = "%s/rank%s-stacks.txt" % (
+                        stamp, beat.get("rank"))
+                    artifacts.append((entry["stacks"], payload))
+            ranks.append(entry)
+        report = {
+            "pathspec": "/".join((str(self.run_id), task.step,
+                                  str(task.task_id))),
+            "attempt": task.attempt,
+            "detected_ts": now,
+            "laggard_rank": int(verdict.get("rank") or 0),
+            "laggard_task_id": verdict["task_id"],
+            "step_num": verdict.get("step_num"),
+            "progress_age_s": verdict["progress_age_s"],
+            "deadline_s": verdict["deadline_s"],
+            "world": world,
+            "ranks": ranks,
+            "sanitize_journal": self._sanitize_tail(),
+        }
+        report_name = "%s/report.json" % stamp
+        artifacts.append((report_name,
+                          json.dumps(report, indent=2).encode("utf-8")))
+        report_path = None
+        if self._recorder is not None:
+            for name, payload in artifacts:
+                saved = self._recorder.save_artifact(
+                    name, payload, prefix=HANGS_PREFIX)
+                if name == report_name:
+                    report_path = saved
+        return report_path
+
+    def _sanitize_tail(self, limit=8):
+        """The newest few sanitizer signature-journal paths of the run —
+        the 'which collective was rank N in' breadcrumb a stuck-
+        collective hang wants next to the stacks."""
+        if self._recorder is None:
+            return []
+        try:
+            from ..spmd.sanitizer import SANITIZE_PREFIX
+
+            fds = self._recorder._fds
+            prefix = fds.storage.path_join(
+                fds.flow_name, str(self.run_id), SANITIZE_PREFIX)
+            paths = [p for p, is_file in fds.storage.list_content([prefix])
+                     if is_file]
+            return sorted(paths)[-limit:]
+        except Exception:
+            return []

@@ -315,8 +315,31 @@ class ParallelDecorator(StepDecorator):
             watcher_stop.set()
             watcher.join(timeout=5)
             failed = []
+            # TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S bounds how long the
+            # control rank waits for each worker to exit (0 = forever).
+            # Without it a wedged worker parks the control here with a
+            # live heartbeat — the exact shape the gang watchdog exists
+            # to break; the bound is the belt-and-suspenders fallback
+            # (and the bench's "undetected hang" baseline).
+            wait_s = float(
+                os.environ.get("TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S", "0") or 0
+            )
             for proc, task_id in zip(procs, mapper_task_ids[1:]):
-                if proc.wait() != 0:
+                try:
+                    rc = proc.wait(timeout=wait_s if wait_s > 0 else None)
+                except subprocess.TimeoutExpired:
+                    # reap every still-running worker before failing the
+                    # attempt: a wedged rank must not outlive its gang as
+                    # a sleeping orphan
+                    for p in procs:
+                        if p.poll() is None:
+                            p.kill()
+                    raise TpuFlowException(
+                        "Gang worker task %s did not exit within %.0fs of "
+                        "the control rank finishing its step — presumed "
+                        "hung" % (task_id, wait_s)
+                    )
+                if rc != 0:
                     failed.append(task_id)
             if failed:
                 raise TpuFlowException(

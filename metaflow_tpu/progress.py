@@ -1,0 +1,227 @@
+"""Per-rank progress beats: the liveness signal heartbeats cannot give.
+
+Task heartbeats (`_heartbeat.json`) are thread-driven mtime beats — a
+rank wedged in a stuck collective or deadlocked I/O keeps heartbeating
+forever, so `tpuflow status` reports it alive and nothing fires. A
+progress beat is different: it is stamped from the MAIN thread at each
+unit of real forward progress (a train step, a prefill chunk, a persist
+batch), so a wedge makes it go stale while the heartbeat stays fresh —
+exactly the HUNG signature the GangWatchdog (elastic/watchdog.py) keys
+on.
+
+Each beat atomically rewrites `_progress.json` in the rank's own task
+directory (the same `<root>/<flow>/<run>/<step>/<task>` tree the local
+metadata provider owns):
+
+    {ts, step_num, pid, rank, attempt, phase, deadline_s, done}
+
+The task computes its OWN deadline — `max(floor, mult × step-time EMA)`,
+with a much larger grace while compiles are still possible — because
+only the task knows its step cadence; the watchdog just compares
+`now - ts > deadline_s`. A terminal `done()` beat tells the watchdog to
+stop watching (a gang control rank that finished its loop legitimately
+idles while reaping workers). Beats carry the attempt number so a
+retried attempt never inherits the previous attempt's stale file.
+
+`install_hang_forensics()` arms the stack-dump channel: faulthandler on
+SIGQUIT (the classic thread-dump signal; SIGUSR1 belongs to the gang
+worker-failure watcher, SIGUSR2 to ProfileTrigger) writing ALL thread
+stacks to `_stacks.txt` in the task dir. faulthandler dumps at C level,
+so it works even while the main thread is blocked in a syscall — the
+watchdog SIGQUITs the laggard pid, reads the file, and uploads it to
+`_telemetry/hangs/` before killing the gang.
+"""
+
+import faulthandler
+import json
+import os
+import signal
+import time
+
+from .util import env_float, get_tpuflow_root
+
+PROGRESS_FILE = "_progress.json"
+STACKS_FILE = "_stacks.txt"
+
+BEAT_EVERY_ENV = "TPUFLOW_PROGRESS_EVERY_S"      # write throttle
+FLOOR_ENV = "TPUFLOW_HANG_FLOOR_S"               # deadline floor
+MULT_ENV = "TPUFLOW_HANG_DEADLINE_MULT"          # k in max(floor, k*EMA)
+COMPILE_GRACE_ENV = "TPUFLOW_HANG_COMPILE_GRACE_S"
+DUMP_SIGNAL_ENV = "TPUFLOW_HANG_DUMP_SIGNAL"
+
+DEFAULT_FLOOR_S = 60.0
+DEFAULT_MULT = 8.0
+DEFAULT_COMPILE_GRACE_S = 600.0
+
+
+def task_dir(root, flow_name, run_id, step_name, task_id):
+    return os.path.join(
+        root, flow_name, str(run_id), step_name, str(task_id))
+
+
+def progress_path(root, flow_name, run_id, step_name, task_id):
+    return os.path.join(
+        task_dir(root, flow_name, run_id, step_name, task_id),
+        PROGRESS_FILE)
+
+
+def stacks_path(root, flow_name, run_id, step_name, task_id):
+    return os.path.join(
+        task_dir(root, flow_name, run_id, step_name, task_id),
+        STACKS_FILE)
+
+
+def read_progress(root, flow_name, run_id, step_name, task_id):
+    """The rank's latest beat dict, or None (never beaten / unreadable).
+    Torn reads are impossible (atomic rename) but a racing attempt's
+    partial tree is — any failure reads as 'no beat'."""
+    try:
+        with open(progress_path(root, flow_name, run_id, step_name,
+                                task_id)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def hang_deadline_s(ema_s=None, compile_possible=False):
+    """The adaptive progress deadline: max(floor, mult × EMA), swapped
+    for the (much larger) compile grace while a compile could still be
+    in flight — jit cache detection only marks a compile AFTER the step
+    returns, so suspension must be prospective."""
+    floor = env_float(FLOOR_ENV, DEFAULT_FLOOR_S)
+    if compile_possible:
+        return max(floor, env_float(COMPILE_GRACE_ENV,
+                                    DEFAULT_COMPILE_GRACE_S))
+    if ema_s:
+        return max(floor, env_float(MULT_ENV, DEFAULT_MULT) * ema_s)
+    return floor
+
+
+class ProgressBeater(object):
+    """Throttled atomic writer of one rank's `_progress.json`."""
+
+    def __init__(self, path, rank=0, attempt=0, every_s=None):
+        self.path = path
+        self.rank = int(rank)
+        self.attempt = int(attempt)
+        self.every_s = (env_float(BEAT_EVERY_ENV, 1.0)
+                        if every_s is None else float(every_s))
+        self._last_write = 0.0
+
+    def beat(self, step_num=None, phase="progress", deadline_s=None,
+             done=False):
+        now = time.time()
+        if not done and now - self._last_write < self.every_s:
+            return
+        payload = {
+            "ts": now,
+            "step_num": step_num,
+            "pid": os.getpid(),
+            "rank": self.rank,
+            "attempt": self.attempt,
+            "phase": phase,
+            "deadline_s": (hang_deadline_s() if deadline_s is None
+                           else float(deadline_s)),
+            "done": bool(done),
+        }
+        tmp = "%s.%d" % (self.path, os.getpid())
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            return
+        self._last_write = now
+
+    def done(self, step_num=None, phase="done"):
+        """Terminal beat: 'stop watching me' — never throttled."""
+        self.beat(step_num=step_num, phase=phase, done=True)
+
+
+# ---------------------------------------------------------------------------
+# module-level API bound to the ambient task identity (current)
+# ---------------------------------------------------------------------------
+
+_beater = None
+_beater_key = None
+
+
+def _current_beater():
+    """The process's ProgressBeater for the ambient task, or None outside
+    a task context. Re-resolved when the task identity changes (gang
+    worker ranks set it once; the control's fork loop mutates env)."""
+    global _beater, _beater_key
+    try:
+        from .current import current
+
+        if not current.is_running_flow:
+            return None
+        key = (current.flow_name, current.run_id, current.step_name,
+               current.task_id, current.retry_count, os.getpid())
+    except Exception:
+        return None
+    if _beater is None or _beater_key != key:
+        try:
+            path = progress_path(get_tpuflow_root(), key[0], key[1],
+                                 key[2], key[3])
+        except Exception:
+            return None
+        _beater = ProgressBeater(
+            path,
+            rank=int(os.environ.get("MF_PARALLEL_NODE_INDEX", "0")),
+            attempt=key[4])
+        _beater_key = key
+    return _beater
+
+
+def beat(step_num=None, phase="progress", deadline_s=None):
+    """Generic progress beat for non-train loops (prefill, dataset
+    build, persist): call once per unit of real progress. No-op outside
+    a task context."""
+    b = _current_beater()
+    if b is not None:
+        b.beat(step_num=step_num, phase=phase, deadline_s=deadline_s)
+
+
+def done(step_num=None):
+    """Mark this rank's loop complete: the watchdog stops watching."""
+    b = _current_beater()
+    if b is not None:
+        b.done(step_num=step_num)
+
+
+def finish():
+    """Task-exit hook: terminal beat IF this process ever beat. Tasks
+    that never reported progress (join steps, plain steps) never get a
+    progress file at all — the watchdog only watches volunteers."""
+    if _beater is not None:
+        _beater.done()
+
+
+def install_hang_forensics():
+    """Arm the signal-driven all-thread stack dump for this task: the
+    watchdog's SIGQUIT lands here. Returns the dump path, or None when
+    the channel could not be armed (no task context, exotic platform).
+    The file is pre-opened and kept open — faulthandler needs a live fd
+    at signal time, and a wedged main thread cannot open one."""
+    try:
+        from .current import current
+
+        if not current.is_running_flow:
+            return None
+        path = stacks_path(get_tpuflow_root(), current.flow_name,
+                           current.run_id, current.step_name,
+                           current.task_id)
+    except Exception:
+        return None
+    signum = int(os.environ.get(DUMP_SIGNAL_ENV, "0") or 0) \
+        or signal.SIGQUIT
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        f = open(path, "w")
+        faulthandler.register(signum, file=f, all_threads=True,
+                              chain=False)
+    except (OSError, ValueError, AttributeError):
+        return None
+    return path

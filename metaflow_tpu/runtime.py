@@ -23,6 +23,7 @@ from collections import deque
 
 from . import telemetry, tracing
 from .datastore.task_datastore import MAX_ATTEMPTS
+from .elastic.watchdog import GangWatchdog, hang_detect_enabled
 from .exception import TpuFlowException
 from .metadata.metadata import MetaDatum
 from .unbounded_foreach import UBF_CONTROL
@@ -305,6 +306,18 @@ class NativeRuntime(object):
             )
             self._elastic.run_id = self.run_id
 
+        # gang hang watchdog: a rank alive by heartbeat but past its
+        # progress deadline wedges the whole gang — detect, dump rank
+        # stacks to _telemetry/hangs/, and kill-to-recover through the
+        # elastic retry path. TPUFLOW_HANG_DETECT=0 disables.
+        self._watchdog = None
+        if hang_detect_enabled():
+            self._watchdog = GangWatchdog(
+                flow.name, metadata, recorder=self._recorder,
+                echo=self._echo,
+            )
+            self._watchdog.run_id = self.run_id
+
         # resume support: index the origin run's finished tasks
         self._origin_index = {}
         self._cloned_pathspecs = set()
@@ -377,6 +390,12 @@ class NativeRuntime(object):
                     if self._recorder is not None:
                         self._recorder.flush()
                 self._persist_runstate()
+
+                # hang watch: progress-deadline check over active gangs
+                # (internally throttled; kills condemned gangs and lets
+                # the normal reap + elastic classification take over)
+                if self._watchdog is not None and self._active:
+                    self._watchdog.poll(self._active)
 
                 if not self._active:
                     # nothing running: sleep toward the earliest due task

@@ -348,6 +348,13 @@ class MetaflowTask(object):
         preemption = PreemptionHandler().install()
         current._update_env({"preemption": preemption})
 
+        # arm the hang-forensics channel: the GangWatchdog's SIGQUIT
+        # dumps all thread stacks into this task's _stacks.txt even when
+        # the main thread is wedged in a syscall (faulthandler is C-level)
+        from . import progress
+
+        progress.install_hang_forensics()
+
         exception = None
         suppressed = False
         try:
@@ -435,6 +442,9 @@ class MetaflowTask(object):
             flow._exception_str = "%s: %s" % (type(ex).__name__, ex)
         finally:
             preemption.uninstall()
+            # terminal progress beat (only if this task ever beat): the
+            # post-loop persist/teardown must not read as a stall
+            progress.finish()
             if node.type != "end" and flow._transition is None and (
                 exception is None or suppressed
             ):

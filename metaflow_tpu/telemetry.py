@@ -49,6 +49,7 @@ from contextlib import contextmanager
 RECORD_VERSION = 1
 TELEMETRY_PREFIX = "_telemetry"
 PROFILE_PREFIX = "_telemetry/profiles"
+HANGS_PREFIX = "_telemetry/hangs"
 
 _current = None
 
@@ -246,11 +247,12 @@ class FlightRecorder(object):
 
     # ---------- artifacts (profiler traces, ...) ----------
 
-    def save_artifact(self, name, payload):
-        """Persist an opaque artifact under the run's telemetry profiles
-        prefix; returns the datastore-relative path (or None on error)."""
+    def save_artifact(self, name, payload, prefix=PROFILE_PREFIX):
+        """Persist an opaque artifact under the run's telemetry tree
+        (profiles by default; hang forensics pass HANGS_PREFIX); returns
+        the datastore-relative path (or None on error)."""
         path = self._fds.storage.path_join(
-            self._fds.flow_name, self.run_id, PROFILE_PREFIX, name)
+            self._fds.flow_name, self.run_id, prefix, name)
         try:
             self._fds.storage.save_bytes([(path, payload)], overwrite=True)
         except Exception:
@@ -426,6 +428,24 @@ def list_run_profiles(flow_datastore, run_id):
     prefix = storage.path_join(
         flow_datastore.flow_name, str(run_id), PROFILE_PREFIX)
     return [p for p, is_file in storage.list_content([prefix]) if is_file]
+
+
+def list_run_hangs(flow_datastore, run_id):
+    """Datastore paths of hang-forensics artifacts (stack dumps + report
+    bundles the gang watchdog uploaded) captured for a run. Bundles live
+    one level down (`_telemetry/hangs/<stamp>/...`), so this descends
+    into each per-detection stamp directory."""
+    storage = flow_datastore.storage
+    prefix = storage.path_join(
+        flow_datastore.flow_name, str(run_id), HANGS_PREFIX)
+    paths = []
+    stamps = []
+    for p, is_file in storage.list_content([prefix]):
+        (paths if is_file else stamps).append(p)
+    if stamps:
+        paths.extend(p for p, is_file in storage.list_content(stamps)
+                     if is_file)
+    return sorted(paths)
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ import functools
 import os
 import time
 
+from .. import progress as progress_mod
 from .. import telemetry
 
 # bf16 peak TFLOP/s per chip, from published TPU specs (substring-matched
@@ -163,6 +164,7 @@ class TrainStepTelemetry(object):
         self._profile = None
         self._want_profile = profile
         self._closed = False
+        self._step_ema_s = None  # steady-state step-time EMA (hang deadline)
 
     # ---------- lazy hardware context ----------
 
@@ -199,6 +201,20 @@ class TrainStepTelemetry(object):
             self._emit_step(self.step_num - 1, now - self._prev_start,
                             stall_s=stall_s)
         self._prev_start = now
+        # per-rank progress beat: the hang watchdog's liveness channel.
+        # Deadline is adaptive (max(floor, mult × EMA)); while a compile
+        # is still POSSIBLE — no steady-state interval yet, or the step
+        # just before this one compiled (retraces come in bursts) — the
+        # much larger compile grace applies, so a long first-step compile
+        # never reads as a hang.
+        compile_possible = (
+            self._step_ema_s is None
+            or (self.step_num - 1) in self._compile_steps)
+        progress_mod.beat(
+            step_num=self.step_num, phase=self.prefix,
+            deadline_s=progress_mod.hang_deadline_s(
+                ema_s=self._step_ema_s,
+                compile_possible=compile_possible))
         return now
 
     def after_step(self, step_fn, call_started, pre_cache, args, kwargs):
@@ -292,6 +308,9 @@ class TrainStepTelemetry(object):
             self._intervals.append(interval_s)
             if stall_s is not None:
                 self._stalls.append(stall_s)
+            self._step_ema_s = (
+                interval_s if self._step_ema_s is None
+                else 0.8 * self._step_ema_s + 0.2 * interval_s)
         if stall_s is not None:
             data["input_stall_ms"] = round(stall_s * 1000, 3)
         if self._pending_update_ms is not None:
@@ -324,6 +343,9 @@ class TrainStepTelemetry(object):
         if self._prev_start is not None and self.step_num > 0:
             self._emit_step(self.step_num - 1,
                             time.perf_counter() - self._prev_start)
+        # terminal progress beat: the loop is over — a control rank
+        # idling in worker reap after its last step is NOT hung
+        progress_mod.done(step_num=self.step_num)
         if self._profile is not None:
             self._profile.stop(self.step_num)
         summary = self.report()

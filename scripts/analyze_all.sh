@@ -71,7 +71,8 @@ if [ "$#" -eq 0 ]; then
     for required in preempt_gang_flow.py elastic_train_flow.py \
                     sanitize_gang_flow.py data_resume_flow.py \
                     fleet_serve_flow.py watch_slo_flow.py \
-                    zero_train_flow.py prefix_serve_flow.py; do
+                    zero_train_flow.py prefix_serve_flow.py \
+                    hang_chaos_flow.py; do
         if [ ! -f "$ROOT/tests/flows/$required" ]; then
             echo "analyze_all: required flow missing from sweep: $required" >&2
             fail=1

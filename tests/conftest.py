@@ -23,6 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (pytest -m 'not slow')")
+
+
 @pytest.fixture()
 def tpuflow_root(tmp_path, monkeypatch):
     """Isolated datastore/metadata root per test."""

@@ -666,7 +666,7 @@ ELASTIC_EVENT_DATA_SCHEMAS = {
     ),
     "elastic.backoff": _obj(
         {"pathspec": _STR,
-         "failure_class": {"enum": ["preemption", "grow", "user",
+         "failure_class": {"enum": ["preemption", "grow", "hang", "user",
                                     "infra"]},
          "attempt": _INT, "delay_s": _NUM,
          "waiting_for_capacity": _BOOL},
@@ -676,7 +676,47 @@ ELASTIC_EVENT_DATA_SCHEMAS = {
         {"step": _INT, "rank": _INT, "world": _INT},
         required=("step", "rank", "world"),
     ),
+    # new chaos fault kinds (step:rank:kind): a rank that wedges forever
+    # vs a bounded straggler that must NOT trip the watchdog
+    "chaos.hang": _obj(
+        {"step": _INT, "rank": _INT, "world": _INT},
+        required=("step", "rank", "world"),
+    ),
+    "chaos.slow": _obj(
+        {"step": _INT, "rank": _INT, "world": _INT, "delay_s": _NUM},
+        required=("step", "rank", "world", "delay_s"),
+    ),
+    # gang watchdog verdict (elastic/watchdog.py): emitted by the
+    # scheduler recorder the moment a gang is declared HUNG, before the
+    # kill — names the laggard rank and the uploaded forensics bundle
+    "hang.detected": _obj(
+        {"pathspec": _STR, "laggard_rank": _INT, "laggard_task_id": _STR,
+         "step_num": {"type": ["integer", "null"]},
+         "progress_age_s": _NUM, "deadline_s": _NUM, "world": _INT,
+         "attempt": _INT, "forensics": {"type": ["string", "null"]}},
+        required=("pathspec", "laggard_rank", "step_num",
+                  "progress_age_s", "deadline_s", "world", "attempt"),
+    ),
 }
+
+# the watchdog's uploaded forensics bundle (report.json under
+# _telemetry/hangs/): per-rank progress snapshot + stack-dump paths
+HANG_REPORT_SCHEMA = _obj(
+    {"pathspec": _STR, "attempt": _INT, "detected_ts": _NUM,
+     "laggard_rank": _INT, "laggard_task_id": _STR,
+     "step_num": {"type": ["integer", "null"]},
+     "progress_age_s": _NUM, "deadline_s": _NUM, "world": _INT,
+     "ranks": _arr(_obj(
+         {"task_id": _STR, "rank": {"type": ["integer", "null"]},
+          "step_num": {"type": ["integer", "null"]},
+          "pid": {"type": ["integer", "null"]},
+          "progress_age_s": _NUM, "laggard": _BOOL,
+          "stacks": {"type": ["string", "null"]}},
+         required=("task_id", "laggard"))),
+     "sanitize_journal": _arr(_STR)},
+    required=("pathspec", "attempt", "laggard_rank", "step_num",
+              "progress_age_s", "deadline_s", "world", "ranks"),
+)
 
 # the goodput gauge: value = running seconds / total wall seconds of the
 # gang step across all attempts, backoff and relaunch overhead included

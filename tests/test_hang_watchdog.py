@@ -1,0 +1,227 @@
+"""Unit tier for the gang hang watchdog stack: progress beats + adaptive
+deadlines (metaflow_tpu/progress.py), hang failure classification
+(elastic/policy.py), the step:rank:kind chaos schedule grammar
+(devtools/chaos.py), and the TPUFLOW_STORAGE_TIMEOUT_S deadline path
+(datastore/storage.py + datatools + data/reader) — the fake-GCS
+stall-injection coverage. The live end-to-end layer (real wedged gangs)
+is tests/test_zhang_e2e.py.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from metaflow_tpu import progress
+from metaflow_tpu.datastore.storage import (
+    _storage_retry,
+    run_with_deadline,
+    storage_timeout_s,
+)
+from metaflow_tpu.devtools.chaos import (
+    KIND_HANG,
+    KIND_KILL,
+    KIND_SLOW,
+    KillSchedule,
+)
+from metaflow_tpu.elastic.policy import (
+    CLASS_GROW,
+    CLASS_HANG,
+    CLASS_INFRA,
+    CLASS_PREEMPTION,
+    CLASS_USER,
+    classify_failure,
+)
+
+
+class TestHangDeadline:
+    def test_floor_applies_without_ema(self):
+        assert progress.hang_deadline_s() == progress.DEFAULT_FLOOR_S
+
+    def test_ema_scales_deadline(self):
+        # 8x a 30s step EMA beats the 60s floor
+        assert progress.hang_deadline_s(ema_s=30.0) == pytest.approx(240.0)
+        # a fast loop stays pinned at the floor
+        assert progress.hang_deadline_s(ema_s=0.01) == \
+            progress.DEFAULT_FLOOR_S
+
+    def test_compile_window_gets_grace(self):
+        # a possible compile suspends the EMA deadline entirely: the
+        # much larger compile grace applies, so a 10-minute first-step
+        # trace never reads as a hang
+        d = progress.hang_deadline_s(ema_s=0.01, compile_possible=True)
+        assert d == progress.DEFAULT_COMPILE_GRACE_S
+        assert d > progress.hang_deadline_s(ema_s=0.01)
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv(progress.FLOOR_ENV, "5")
+        monkeypatch.setenv(progress.MULT_ENV, "2")
+        monkeypatch.setenv(progress.COMPILE_GRACE_ENV, "7")
+        assert progress.hang_deadline_s(ema_s=4.0) == pytest.approx(8.0)
+        assert progress.hang_deadline_s(ema_s=1.0) == pytest.approx(5.0)
+        assert progress.hang_deadline_s(compile_possible=True) == \
+            pytest.approx(7.0)
+
+
+class TestProgressBeater:
+    def test_beat_roundtrip(self, tmp_path):
+        path = str(tmp_path / "Flow" / "1" / "train" / "t1"
+                   / progress.PROGRESS_FILE)
+        b = progress.ProgressBeater(path, rank=3, attempt=1, every_s=0.0)
+        b.beat(step_num=7, deadline_s=12.5)
+        got = progress.read_progress(str(tmp_path), "Flow", "1", "train",
+                                     "t1")
+        assert got["step_num"] == 7
+        assert got["rank"] == 3
+        assert got["attempt"] == 1
+        assert got["deadline_s"] == 12.5
+        assert got["pid"] == os.getpid()
+        assert not got["done"]
+        assert got["ts"] == pytest.approx(time.time(), abs=5.0)
+
+    def test_done_beat_never_throttled(self, tmp_path):
+        path = str(tmp_path / "F" / "1" / "s" / "t" / progress.PROGRESS_FILE)
+        b = progress.ProgressBeater(path, every_s=3600.0)
+        b.beat(step_num=1)
+        b.beat(step_num=2)  # throttled away
+        got = progress.read_progress(str(tmp_path), "F", "1", "s", "t")
+        assert got["step_num"] == 1
+        b.done(step_num=2)  # terminal beat always writes
+        got = progress.read_progress(str(tmp_path), "F", "1", "s", "t")
+        assert got["done"] and got["step_num"] == 2
+
+    def test_read_missing_or_garbage_is_none(self, tmp_path):
+        assert progress.read_progress(str(tmp_path), "F", "1", "s",
+                                      "t") is None
+        p = tmp_path / "F" / "1" / "s" / "t"
+        p.mkdir(parents=True)
+        (p / progress.PROGRESS_FILE).write_text("{not json")
+        assert progress.read_progress(str(tmp_path), "F", "1", "s",
+                                      "t") is None
+
+
+class TestHangClassification:
+    def test_hang_class_priority(self):
+        # grow outranks hang (a gang asked to grow idles legitimately);
+        # hang outranks the spot notice its own SIGTERM can leave behind
+        assert classify_failure(hang_notice=True) == CLASS_HANG
+        assert classify_failure(hang_notice=True,
+                                spot_notice=True) == CLASS_HANG
+        assert classify_failure(hang_notice=True,
+                                grow_notice=True) == CLASS_GROW
+        assert classify_failure(spot_notice=True) == CLASS_PREEMPTION
+        assert classify_failure() == CLASS_USER
+        assert classify_failure(attempt_recorded=False) == CLASS_INFRA
+
+
+class TestChaosFaultKinds:
+    def test_parse_kinds(self):
+        s = KillSchedule.parse("3:1:hang,5:0:slow,7:2")
+        # .kills stays plain (step, rank) 2-tuples — seeded-replay
+        # consumers sort/compare them directly
+        assert sorted(s.kills) == [(3, 1), (5, 0), (7, 2)]
+        assert s.kind_of(3, 1) == KIND_HANG
+        assert s.kind_of(5, 0) == KIND_SLOW
+        assert s.kind_of(7, 2) == KIND_KILL
+
+    def test_parse_rejects_unknown_kind_and_bad_arity(self):
+        with pytest.raises(ValueError):
+            KillSchedule.parse("3:1:explode")
+        with pytest.raises(ValueError):
+            KillSchedule.parse("3:1:hang:extra")
+
+    def test_kill_schedule_2tuple_back_compat(self):
+        s = KillSchedule.parse("3:2")
+        assert s.kills == ((3, 2),)
+        assert s.kind_of(3, 2) == KIND_KILL
+        # iterating destructures into 2-tuples (FleetChaosInjector)
+        for dispatch, replica in s.kills:
+            assert (dispatch, replica) == (3, 2)
+
+
+class TestStorageDeadline:
+    def test_disabled_runs_inline(self):
+        assert storage_timeout_s({}) == 0.0
+        assert run_with_deadline(lambda: 41 + 1, "op", 0) == 42
+
+    def test_deadline_fires_on_stall(self):
+        with pytest.raises(TimeoutError) as ei:
+            run_with_deadline(lambda: time.sleep(30), "stalled get", 0.2)
+        assert "stalled get" in str(ei.value)
+        assert "TPUFLOW_STORAGE_TIMEOUT_S" in str(ei.value)
+
+    def test_inner_exception_passes_through(self):
+        with pytest.raises(KeyError):
+            run_with_deadline(lambda: {}["x"], "op", 5.0)
+
+    def test_timeout_rides_storage_retry(self, monkeypatch):
+        """The per-attempt deadline inside _storage_retry: a stalled op
+        times out, is retried on the normal budget, and a recovered
+        retry succeeds."""
+        monkeypatch.setenv("TPUFLOW_STORAGE_TIMEOUT_S", "0.2")
+        monkeypatch.setenv("TPUFLOW_RETRY_BACKOFF_BASE_S", "0.01")
+        calls = {"n": 0}
+
+        def flaky_stall():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(10)  # first attempt: wedged transfer
+            return "blob"
+
+        assert _storage_retry(flaky_stall, "get(x)", attempts=2) == "blob"
+        assert calls["n"] == 2
+
+    def test_timeout_exhausts_retry_budget(self, monkeypatch):
+        monkeypatch.setenv("TPUFLOW_STORAGE_TIMEOUT_S", "0.1")
+        monkeypatch.setenv("TPUFLOW_RETRY_BACKOFF_BASE_S", "0.01")
+        with pytest.raises(TimeoutError):
+            _storage_retry(lambda: time.sleep(10), "get(y)", attempts=1)
+
+
+class TestStalledShardFetch:
+    def test_stream_raises_instead_of_wedging(self, monkeypatch,
+                                              tpuflow_root):
+        """Fake-GCS stall injection one level up: a shard fetch that
+        never returns must surface as a TimeoutError from stream(), not
+        park the training loop forever."""
+        from metaflow_tpu.data import build_corpus
+        from metaflow_tpu.data.reader import ShardReader
+        from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+
+        fds = FlowDataStore("StallFlow", LocalStorage)
+        tokens = np.arange(4096, dtype=np.int64)
+        manifest = build_corpus(fds, "stall", tokens,
+                                shard_tokens=1024)
+        reader = ShardReader(fds, manifest, max_workers=2)
+        monkeypatch.setenv("TPUFLOW_STORAGE_TIMEOUT_S", "0.05")
+        monkeypatch.setattr(
+            ShardReader, "_fetch",
+            lambda self, shard_id: time.sleep(60))
+        with pytest.raises(TimeoutError) as ei:
+            list(reader.stream([0, 1]))
+        assert "wedged transfer" in str(ei.value)
+
+    def test_datatools_batch_stall_raises(self, monkeypatch, tmp_path):
+        """The datatools batch path: one stalled key fails its future on
+        the deadline instead of hanging get_many, and the batch verdict
+        names it."""
+        from metaflow_tpu.datatools import GS, GSBatchFailure
+
+        monkeypatch.setenv("TPUFLOW_DATATOOLS_ROOT",
+                           str(tmp_path / "data_gs"))
+        monkeypatch.setenv("TPUFLOW_STORAGE_TIMEOUT_S", "0.05")
+        with GS() as gs:
+            gs.put("ok-key", b"payload")
+            orig_get = GS.get
+
+            def stalling_get(self, key):
+                if key == "stuck-key":
+                    time.sleep(60)
+                return orig_get(self, key)
+
+            monkeypatch.setattr(GS, "get", stalling_get)
+            with pytest.raises(GSBatchFailure) as ei:
+                gs.get_many(["ok-key", "stuck-key"])
+            assert any(k == "stuck-key" for k, _e in ei.value.failures)
