@@ -27,6 +27,7 @@ from .spmd_check import (
     check_logical_rules,
     check_mesh_axes,
     check_mesh_devices,
+    check_mpmd_plan,
     check_pipeline,
 )
 
@@ -47,6 +48,7 @@ __all__ = [
     "check_logical_rules",
     "check_mesh_axes",
     "check_mesh_devices",
+    "check_mpmd_plan",
     "check_pipeline",
     "extract_flow_facts",
     "pre_run_gate",

@@ -206,6 +206,23 @@ class HybridMeshLiteral(object):
         self.lineno = lineno
 
 
+class MPMDPlanLiteral(object):
+    """An mpmd.plan_stages(...) call with statically-known arguments —
+    the MPMD stage/topology/layer-divisibility pass (spmd_check) runs
+    the same validation the plan constructor enforces, before launch."""
+    __slots__ = ("num_microbatches", "num_virtual_stages", "num_stages",
+                 "n_layers", "lineno")
+    kind = "mpmd_plan"
+
+    def __init__(self, num_microbatches, num_virtual_stages, num_stages,
+                 n_layers, lineno):
+        self.num_microbatches = num_microbatches
+        self.num_virtual_stages = num_virtual_stages
+        self.num_stages = num_stages
+        self.n_layers = n_layers
+        self.lineno = lineno
+
+
 class GangCall(object):
     """A call relevant to gang consistency (analysis/divergence.py).
 
@@ -236,7 +253,7 @@ class GangCall(object):
 class StepFacts(object):
     __slots__ = ("step", "events", "wildcard_write", "lineno",
                  "source_file", "mesh_literals", "hybrid_literals",
-                 "self_calls", "returns_rank")
+                 "mpmd_literals", "self_calls", "returns_rank")
 
     def __init__(self, step, lineno, source_file):
         self.step = step
@@ -246,6 +263,7 @@ class StepFacts(object):
         self.source_file = source_file
         self.mesh_literals = []
         self.hybrid_literals = []
+        self.mpmd_literals = []
         # names of self.<method>() calls: non-step helper methods write
         # artifacts on the step's behalf
         self.self_calls = set()
@@ -433,6 +451,7 @@ class _StepExtractor(object):
         # MeshSpec / create_hybrid_mesh literal construction (SPMD checks)
         self._maybe_mesh_literal(node)
         in_hybrid = self._maybe_hybrid_literal(node)
+        self._maybe_mpmd_literal(node)
         # rank-returning calls: jax.process_index() etc., plus helper
         # methods whose Return carries a rank (fixpointed summary)
         tainted = False
@@ -732,6 +751,25 @@ class _StepExtractor(object):
             HybridMeshLiteral(ici_axes, dcn_axis, num_slices,
                               self._ln(node)))
         return True
+
+    def _maybe_mpmd_literal(self, node):
+        """Capture an mpmd.plan_stages(M, V, S, n_layers) call (only
+        literal arguments survive; a non-literal field disables the
+        checks that need it, never invents a finding)."""
+        if _call_name(node.func) != "plan_stages":
+            return
+        names = ("num_microbatches", "num_virtual_stages", "num_stages",
+                 "n_layers")
+        values = dict.fromkeys(names)
+        for i, arg in enumerate(node.args[:4]):
+            value = _literal(arg)
+            values[names[i]] = value if isinstance(value, int) else None
+        for kw in node.keywords:
+            if kw.arg in values:
+                value = _literal(kw.value)
+                values[kw.arg] = value if isinstance(value, int) else None
+        self.facts.mpmd_literals.append(
+            MPMDPlanLiteral(lineno=self._ln(node), **values))
 
     # -- statements ---------------------------------------------------------
 
@@ -1073,10 +1111,11 @@ def extract_flow_facts(flow_cls, graph):
         # positionally optimistic (may-analysis), which can only suppress
         # findings, never invent them
         (h_writes, h_reads, h_wildcard, h_mesh, h_gang,
-         h_hybrid) = _helper_effects(sf.self_calls, helpers)
+         h_hybrid, h_mpmd) = _helper_effects(sf.self_calls, helpers)
         sf.wildcard_write = sf.wildcard_write or h_wildcard
         sf.mesh_literals.extend(h_mesh)
         sf.hybrid_literals.extend(h_hybrid)
+        sf.mpmd_literals.extend(h_mpmd)
         for e in reversed(h_writes):
             sf.events.insert(
                 0, Write(e.name, e.lineno, conditional=True))
@@ -1110,7 +1149,7 @@ def _helper_effects(called, helpers, _seen=None):
     helper→helper calls with a cycle guard. Events keep the helper's own
     linenos so findings (e.g. a dead artifact written inside a helper)
     point at the real assignment."""
-    writes, reads, mesh, gang, hybrid = [], [], [], [], []
+    writes, reads, mesh, gang, hybrid, mpmd = [], [], [], [], [], []
     wildcard = False
     seen = _seen if _seen is not None else set()
     for name in sorted(called):
@@ -1128,12 +1167,14 @@ def _helper_effects(called, helpers, _seen=None):
                 gang.append(e)
         mesh.extend(hf.mesh_literals)
         hybrid.extend(hf.hybrid_literals)
-        w2, r2, wc2, m2, g2, h2 = _helper_effects(
+        mpmd.extend(hf.mpmd_literals)
+        w2, r2, wc2, m2, g2, h2, p2 = _helper_effects(
             hf.self_calls, helpers, seen)
         writes.extend(w2)
         reads.extend(r2)
         mesh.extend(m2)
         gang.extend(g2)
         hybrid.extend(h2)
+        mpmd.extend(p2)
         wildcard = wildcard or wc2
-    return writes, reads, wildcard, mesh, gang, hybrid
+    return writes, reads, wildcard, mesh, gang, hybrid, mpmd

@@ -200,6 +200,51 @@ def check_pipeline(n_layers, n_stages, num_microbatches=None,
     return problems
 
 
+def check_mpmd_plan(num_microbatches, num_virtual_stages, num_stages,
+                    n_layers, gang_size=None, n_hosts=None):
+    """Validate an MPMD stage plan (spmd/mpmd.py plan_stages) before any
+    stage gang compiles: the same arithmetic MPMDPlan.__init__ enforces
+    at runtime, plus the launch-shape cross-checks only the flow graph
+    knows (gang size = one rank per stage; a stage boundary is a host
+    boundary on a multi-host topology, since activations cross stages
+    over DCN). Returns a list of problem strings; None fields skip the
+    checks that need them."""
+    problems = []
+    if num_microbatches is not None and num_microbatches < 1:
+        problems.append("num_microbatches must be >= 1, got %d"
+                        % num_microbatches)
+    if num_virtual_stages is not None and num_virtual_stages < 1:
+        problems.append("num_virtual_stages must be >= 1, got %d"
+                        % num_virtual_stages)
+    if num_stages is not None:
+        if num_stages < 2:
+            problems.append(
+                "MPMD needs num_stages >= 2 (one gang per stage), got %d "
+                "— a single stage is the plain microbatched loss"
+                % num_stages)
+        else:
+            if (n_layers is not None and num_virtual_stages is not None
+                    and num_virtual_stages >= 1
+                    and n_layers % (num_virtual_stages * num_stages)):
+                problems.append(
+                    "%d layers do not split into num_virtual_stages*"
+                    "num_stages=%d chunks"
+                    % (n_layers, num_virtual_stages * num_stages))
+            if gang_size is not None and gang_size != num_stages:
+                problems.append(
+                    "plan has %d stages but the gang launches "
+                    "num_parallel=%d rank(s): MPMD runs one stage per "
+                    "rank, so the schedule's ring peers will never "
+                    "assemble" % (num_stages, gang_size))
+            if n_hosts is not None and n_hosts > 1 and n_hosts % num_stages:
+                problems.append(
+                    "%d stages do not align to %d host(s): a stage "
+                    "boundary is a host boundary (activations cross "
+                    "stages over DCN, which links hosts)"
+                    % (num_stages, n_hosts))
+    return problems
+
+
 # -- flow-level static pass --------------------------------------------------
 
 
@@ -332,5 +377,24 @@ def analyze_spmd(flow_cls, graph, facts=None):
                         % (node.name, problem,
                            " (topology %r)" % topo if topo else ""),
                         step=node.name, lineno=hl.lineno,
+                        source_file=f.source_file))
+            # MPMD stage plans: validate stage count against the gang
+            # size and topology, and the layer stack against the chunk
+            # split, BEFORE the first stage gang compiles
+            size, _split = gang_size.get(node.name, (None, None))
+            if not (size and node.parallel_step and _split is not None
+                    and getattr(_split, "num_parallel_literal", False)):
+                size = None
+            for pl in f.mpmd_literals:
+                for problem in check_mpmd_plan(
+                        pl.num_microbatches, pl.num_virtual_stages,
+                        pl.num_stages, pl.n_layers,
+                        gang_size=size, n_hosts=hosts):
+                    findings.append(Finding(
+                        "mpmd-plan-invalid", ERROR,
+                        "Step *%s*: plan_stages(...): %s%s"
+                        % (node.name, problem,
+                           " (topology %r)" % topo if topo else ""),
+                        step=node.name, lineno=pl.lineno,
                         source_file=f.source_file))
     return findings

@@ -15,9 +15,14 @@ needed) and `python flow.py metrics [RUN]` (flow context known).
 """
 
 import json
+import re
 import statistics
 
 from .. import telemetry
+
+# per-stage MPMD step timers (training/mpmd_trainer.py instruments each
+# stage's step with prefix "mpmd.stage<k>")
+_MPMD_STEP_RE = re.compile(r"^mpmd\.stage(\d+)\.step$")
 
 
 def _pathspec(rec):
@@ -53,6 +58,12 @@ def aggregate(records, profiles=None):
     prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
               "prompt_tokens": 0, "evictions": 0, "evicted_tokens": 0,
               "evicted_bytes": 0}
+    # MPMD per-stage pipeline gangs (spmd/mpmd.py + mpmd_trainer.py):
+    # each rank runs ONE stage, so per-stage series key on the stage id
+    # in the timer name, never averaged across ranks
+    mpmd_stages = {}
+    mpmd_transfer = {}
+    mpmd_plan = {}
 
     for rec in records:
         name = rec.get("name", "")
@@ -112,6 +123,14 @@ def aggregate(records, profiles=None):
                 if "optimizer_update_ms" in data:
                     s["optimizer_update_ms"].append(
                         data["optimizer_update_ms"])
+                mpmd_m = _MPMD_STEP_RE.match(name)
+                if mpmd_m:
+                    st = mpmd_stages.setdefault(int(mpmd_m.group(1)), {
+                        "samples": [], "stall_ms": []})
+                    st["samples"].append((ms, bool(data.get("compile"))))
+                    if ("transfer_stall_ms" in data
+                            and not data.get("compile")):
+                        st["stall_ms"].append(data["transfer_stall_ms"])
         elif rtype == "counter":
             counters[name] = counters.get(name, 0) + rec.get("inc", 1)
         elif rtype == "gauge":
@@ -146,6 +165,25 @@ def aggregate(records, profiles=None):
                     prefix["evicted_tokens"] += int(
                         data.get("tokens", 0))
                     prefix["evicted_bytes"] += int(data.get("bytes", 0))
+            if name == "mpmd.transfer":
+                data = rec.get("data") or {}
+                t = mpmd_transfer.setdefault(
+                    int(data.get("stage", rec.get("rank", 0))), {
+                        "frames_sent": 0, "frames_recv": 0,
+                        "bytes_sent": 0, "bytes_recv": 0,
+                        "stall_ms": 0.0, "double_buffer": None})
+                for k in ("frames_sent", "frames_recv", "bytes_sent",
+                          "bytes_recv"):
+                    t[k] += int(data.get(k, 0))
+                t["stall_ms"] += float(data.get("stall_ms", 0.0))
+                if "double_buffer" in data:
+                    t["double_buffer"] = bool(data["double_buffer"])
+            elif name == "mpmd.stage.trace":
+                data = rec.get("data") or {}
+                for k in ("num_microbatches", "num_virtual_stages",
+                          "num_stages", "n_layers", "n_cycles"):
+                    if k in data:
+                        mpmd_plan[k] = data[k]
             if name == "hang.detected":
                 data = rec.get("data") or {}
                 hang_detections.append({
@@ -330,6 +368,56 @@ def aggregate(records, profiles=None):
             hangs["mean_detect_lag_s"] = round(statistics.mean(lags), 3)
             hangs["max_detect_lag_s"] = round(max(lags), 3)
 
+    # MPMD per-stage section: one row per pipeline stage; the slowest
+    # stage is the bubble — when the OTHER stages spend >= 10% of their
+    # step blocked on the wire, the run is PIPELINE-BOUND on it (the
+    # MPMD mirror of the INPUT-BOUND verdict)
+    mpmd = {}
+    if mpmd_stages or mpmd_transfer:
+        stage_rows = []
+        for k in sorted(set(mpmd_stages) | set(mpmd_transfer)):
+            row = {"stage": k}
+            st = mpmd_stages.get(k)
+            if st and st["samples"]:
+                steady = [ms for ms, comp in st["samples"] if not comp]
+                pick = steady or [ms for ms, _comp in st["samples"]]
+                row["steps"] = len(st["samples"])
+                row["mean_step_ms"] = round(statistics.mean(pick), 3)
+                if st["stall_ms"]:
+                    row["transfer_stall_ms"] = round(
+                        statistics.mean(st["stall_ms"]), 3)
+                    if row["mean_step_ms"]:
+                        row["transfer_stall_frac"] = round(
+                            row["transfer_stall_ms"]
+                            / row["mean_step_ms"], 4)
+            compiles = counters.get(
+                "mpmd.stage%d.compile_cache_miss" % k)
+            if compiles is not None:
+                row["compiles"] = int(compiles)
+            t = mpmd_transfer.get(k)
+            if t:
+                row.update({
+                    "frames_sent": t["frames_sent"],
+                    "frames_recv": t["frames_recv"],
+                    "bytes_sent": t["bytes_sent"],
+                    "bytes_recv": t["bytes_recv"],
+                    "wire_stall_ms": round(t["stall_ms"], 3),
+                })
+                if t["double_buffer"] is not None:
+                    row["double_buffer"] = t["double_buffer"]
+            stage_rows.append(row)
+        mpmd = {"stages": stage_rows}
+        if mpmd_plan:
+            mpmd["plan"] = dict(mpmd_plan)
+        timed = [r for r in stage_rows if "mean_step_ms" in r]
+        if timed:
+            slowest = max(timed, key=lambda r: r["mean_step_ms"])
+            mpmd["bottleneck_stage"] = slowest["stage"]
+            others = [r for r in timed
+                      if r["stage"] != slowest["stage"]]
+            mpmd["pipeline_bound"] = any(
+                r.get("transfer_stall_frac", 0) >= 0.1 for r in others)
+
     prefix_cache = {}
     looked_up = prefix["hits"] + prefix["misses"]
     if looked_up or prefix["evictions"]:
@@ -354,6 +442,7 @@ def aggregate(records, profiles=None):
         "counters": dict(sorted(counters.items())),
         "events": dict(sorted(events.items())),
         "train": train,
+        "mpmd": mpmd,
         "fleet": fleet,
         "hangs": hangs,
         "prefix_cache": prefix_cache,
@@ -462,6 +551,39 @@ def render_summary(run_id, agg, echo=print):
                 for label, v in mem_split if v is not None))
         if extras:
             echo("  " + ", ".join(extras))
+    mpmd = agg.get("mpmd") or {}
+    if mpmd:
+        echo("")
+        plan = mpmd.get("plan") or {}
+        note = ""
+        if plan:
+            note = " (M=%s V=%s S=%s over %s layers)" % (
+                plan.get("num_microbatches"),
+                plan.get("num_virtual_stages"),
+                plan.get("num_stages"), plan.get("n_layers"))
+        echo("mpmd pipeline (per-stage gangs)%s:" % note)
+        for row in mpmd.get("stages") or []:
+            line = "  stage %d:" % row["stage"]
+            if "mean_step_ms" in row:
+                line += " %s/step" % _fmt_ms(row["mean_step_ms"])
+            if "transfer_stall_ms" in row:
+                line += ", transfer stall %s/step" % _fmt_ms(
+                    row["transfer_stall_ms"])
+                if "transfer_stall_frac" in row:
+                    line += " (%.0f%%)" % (
+                        row["transfer_stall_frac"] * 100)
+            if "compiles" in row:
+                line += ", %d compile(s)" % row["compiles"]
+            if "bytes_sent" in row:
+                line += ", %.1f MB sent / %.1f MB recv" % (
+                    row["bytes_sent"] / 2**20, row["bytes_recv"] / 2**20)
+            if row.get("double_buffer") is False:
+                line += " [sync transport]"
+            if (mpmd.get("pipeline_bound")
+                    and row["stage"] == mpmd.get("bottleneck_stage")):
+                # the stage every other stage is stalling on
+                line += "  <- PIPELINE-BOUND"
+            echo(line)
     fleet = agg.get("fleet") or {}
     if fleet:
         echo("")

@@ -173,6 +173,15 @@ class ParallelDecorator(StepDecorator):
         os.environ.setdefault(
             "MF_PARALLEL_COORDINATOR_PORT", str(self._free_port())
         )
+        # MPMD stage-gang rendezvous (spmd/mpmd.py): one address per
+        # rank, index = pipeline stage = MF_PARALLEL_NODE_INDEX. Workers
+        # inherit it through the fork env; external launchers (Argo
+        # JobSet, TPU-VM) pre-set it with real DCN host addresses.
+        if "MF_MPMD_PEERS" not in os.environ:
+            os.environ["MF_MPMD_PEERS"] = ",".join(
+                "127.0.0.1:%d" % self._free_port()
+                for _ in range(num_parallel)
+            )
 
         # worker argv: replay this process's own step command with a new
         # task-id and ubf context (recorded by the CLI in the environment);

@@ -28,7 +28,11 @@ def _as_varying(z, axis_name):
             return z
     except (AttributeError, TypeError):
         pass
-    return jax.lax.pcast(z, (axis_name,), to="varying")
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        # older jax: no vma annotations exist, nothing to satisfy
+        return z
+    return pcast(z, (axis_name,), to="varying")
 
 
 def _shard_map(fn, mesh, in_specs, out_specs, manual_axes=None):

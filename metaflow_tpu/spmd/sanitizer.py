@@ -149,6 +149,11 @@ COLLECTIVE_NAMES = (
     "zero.reduce_scatter",
     "zero.shard",
     "zero.all_gather",
+    # MPMD stage handoffs (spmd/mpmd.py StageTransport): journaled per
+    # transfer with the (ring, microbatch, chunk) identity as the key,
+    # so a stage desync report names the first diverging transfer
+    "mpmd.send",
+    "mpmd.recv",
 )
 
 

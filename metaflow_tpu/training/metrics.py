@@ -160,6 +160,8 @@ class TrainStepTelemetry(object):
         self._mem_split = {}
         self._update_ms = []
         self._pending_update_ms = None
+        self._transfer_ms = []
+        self._pending_transfer_ms = None
         self._per_chip = None  # (n_devices, peak_tflops) lazy
         self._profile = None
         self._want_profile = profile
@@ -250,6 +252,12 @@ class TrainStepTelemetry(object):
         update_ms = getattr(step_fn, "last_update_ms", None)
         if update_ms is not None:
             self._pending_update_ms = float(update_ms)
+        # MPMD stage steps expose the wall-clock they spent BLOCKED on
+        # the stage transport (spmd/mpmd.py) the same way — the
+        # PIPELINE-BOUND signal `tpuflow metrics` surfaces per stage
+        transfer_ms = getattr(step_fn, "last_transfer_stall_ms", None)
+        if transfer_ms is not None:
+            self._pending_transfer_ms = float(transfer_ms)
         self.step_num += 1
         self._prev_return = time.perf_counter()
 
@@ -318,6 +326,11 @@ class TrainStepTelemetry(object):
             if "compile" not in data:
                 self._update_ms.append(self._pending_update_ms)
             self._pending_update_ms = None
+        if self._pending_transfer_ms is not None:
+            data["transfer_stall_ms"] = round(self._pending_transfer_ms, 3)
+            if "compile" not in data:
+                self._transfer_ms.append(self._pending_transfer_ms)
+            self._pending_transfer_ms = None
         if self.tokens_per_step:
             data["tokens_per_sec"] = round(
                 self.tokens_per_step / interval_s, 1)
@@ -351,6 +364,7 @@ class TrainStepTelemetry(object):
         summary = self.report()
         for key in ("steps", "mean_step_ms", "tokens_per_sec", "mfu",
                     "input_stall_ms", "optimizer_update_ms",
+                    "transfer_stall_ms",
                     "memory_params_bytes", "memory_opt_state_bytes",
                     "memory_activations_bytes",
                     "compiles", "compile_ms", "device_memory_peak_bytes"):
@@ -372,6 +386,9 @@ class TrainStepTelemetry(object):
         if self._update_ms:
             out["optimizer_update_ms"] = round(
                 sum(self._update_ms) / len(self._update_ms), 3)
+        if self._transfer_ms:
+            out["transfer_stall_ms"] = round(
+                sum(self._transfer_ms) / len(self._transfer_ms), 3)
         if not self._intervals:
             return out
         mean = sum(self._intervals) / len(self._intervals)

@@ -1,0 +1,149 @@
+"""Train a FULL Llama through MPMD pipeline parallelism.
+
+The per-stage analogue of pipeline_trainer.py: the same
+layer_fn/loss_fn/embedding-cotangent bridging, but instead of one SPMD
+program ticking the whole schedule, THIS process runs exactly one
+stage's row of the interleaved-1F1B timetable (spmd/mpmd.py) and trades
+activations/cotangents with its ring neighbours over the stage
+transport. Stage 0 owns the embedding (its gradient chains from the
+schedule's input cotangent via the scatter-add transpose of the
+gather); the last stage owns final norm + lm_head, differentiated
+inside its last-chunk loss slots.
+
+Telemetry: construction emits one `mpmd.stage.trace` event (the MPMD
+mirror of `pipeline.trace`); every step emits one `mpmd.transfer` event
+with that step's frame/byte/stall deltas, and exposes the stall as
+`last_transfer_stall_ms` so `instrument_train_step` rides it into the
+per-step record — `tpuflow metrics` aggregates both into the per-stage
+MPMD section that names the bubble stage.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..models import llama
+from ..ops import rms_norm, rope_frequencies
+from ..spmd import mpmd
+
+
+def make_stage_step(cfg, plan, stage, transport, seq_len):
+    """Build this stage's step callable: step(params, tokens) ->
+    {"loss": mean loss (last stage, else None),
+     "grads": dict of THIS stage's parameter gradients — "layers" in
+         the stage's local chunk order (plan.layers_for_stage maps back
+         to natural indices), plus "embed" on stage 0 and
+         "final_norm"/"lm_head" on the last stage}.
+
+    `params` is the full Llama pytree; each stage reads only its own
+    slice (at scale each gang would only ever materialize that slice —
+    the slicing is the ownership contract). seq_len is the TOKEN count
+    per example (the model sees seq_len-1 after the shift).
+    """
+    stage = int(stage)
+    dt = llama.param_dtype(cfg)
+    cos, sin = rope_frequencies(
+        cfg.head_dim, int(seq_len) - 1, cfg.rope_theta, dtype=dt,
+        llama3_scaling=cfg.rope_llama3_scaling,
+    )
+
+    def layer_fn(x, lp):
+        return llama._layer(cfg, cos, sin, x, lp)
+
+    def loss_fn(out, y, head):
+        # the same chunk-safe CE the non-pipelined loss uses (fp32
+        # logits never materialize beyond one chunk)
+        h = rms_norm(out, head["final_norm"], cfg.norm_eps)
+        loss_sum, count = llama._ce_sums(h, head["lm_head"], y, None)
+        return loss_sum / jnp.maximum(count, 1)
+
+    executor = mpmd.StageExecutor(
+        plan, stage, transport, layer_fn,
+        loss_fn=loss_fn if stage == plan.S - 1 else None,
+        return_input_grad=(stage == 0),
+    )
+    telemetry.event(
+        "mpmd.stage.trace",
+        data=dict(plan.describe(), stage=stage,
+                  layers=plan.layers_for_stage(stage),
+                  seq=int(seq_len) - 1))
+
+    def step(params, tokens):
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        M = plan.M
+        mb = inp.shape[0] // M
+        x_mbs = y_mbs = head = None
+        if stage == 0:
+            x = params["embed"][inp].astype(dt)
+            x_mbs = x.reshape((M, mb) + x.shape[1:])
+        if stage == plan.S - 1:
+            y_mbs = tgt.reshape((M, mb) + tgt.shape[1:])
+            head = {"final_norm": params["final_norm"],
+                    "lm_head": params["lm_head"]}
+        before = transport.stats()
+        res = executor.run(
+            mpmd.slice_stage_params(plan, stage, params["layers"]),
+            x_mbs=x_mbs, y_mbs=y_mbs, head_params=head)
+        after = transport.stats()
+        step.last_transfer_stall_ms = executor.last_transfer_stall_ms
+        telemetry.event(
+            "mpmd.transfer",
+            data={"stage": stage,
+                  "double_buffer": bool(after["double_buffer"]),
+                  "frames_sent": int(after["frames_sent"]
+                                     - before["frames_sent"]),
+                  "frames_recv": int(after["frames_recv"]
+                                     - before["frames_recv"]),
+                  "bytes_sent": int(after["bytes_sent"]
+                                    - before["bytes_sent"]),
+                  "bytes_recv": int(after["bytes_recv"]
+                                    - before["bytes_recv"]),
+                  "stall_ms": round(after["stall_ms"]
+                                    - before["stall_ms"], 3)})
+        grads = {"layers": res["grads"]}
+        if stage == 0:
+            # embedding gradient: the gather's transpose is a
+            # scatter-add of the input cotangent over the token ids
+            dx = res["input_grad"].reshape((M * mb,) + inp.shape[1:]
+                                           + (cfg.dim,))
+            grads["embed"] = jnp.zeros(
+                (cfg.vocab_size, cfg.dim), jnp.float32).at[inp].add(dx)
+        if stage == plan.S - 1:
+            grads["final_norm"] = res["head_grads"]["final_norm"]
+            grads["lm_head"] = res["head_grads"]["lm_head"]
+        return {"loss": res["loss"], "grads": grads}
+
+    # instrument_train_step probes this for compile-cache growth: the
+    # three chunk programs ARE this stage's compile footprint
+    step._cache_size = executor.compile_count
+    step.last_transfer_stall_ms = 0.0
+    step.executor = executor
+    return step
+
+
+def run_stage_steps(cfg, plan, stage, transport, tokens, num_steps=1,
+                    params=None, instrument=True):
+    """Drive `num_steps` schedule passes on one stage gang — the demo
+    flow / bench entrypoint. Returns (last step's result, telemetry
+    summary dict or None)."""
+    from .metrics import instrument_train_step
+
+    if params is None:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        params = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+    step = make_stage_step(cfg, plan, stage, transport,
+                           seq_len=tokens.shape[1])
+    fn = step
+    if instrument:
+        fn = instrument_train_step(
+            step, tokens_per_step=int(tokens.shape[0])
+            * (int(tokens.shape[1]) - 1),
+            prefix="mpmd.stage%d" % int(stage), profile=False)
+    out = None
+    for _ in range(int(num_steps)):
+        out = fn(params, tokens)
+    summary = None
+    if instrument:
+        fn.telemetry.close()
+        summary = fn.telemetry.report()
+    return out, summary

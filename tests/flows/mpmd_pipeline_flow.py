@@ -1,0 +1,73 @@
+"""MPMD pipeline demo: a 2-stage gang where each rank is ONE pipeline
+stage running its own program (spmd/mpmd.py), activations/cotangents
+exchanged over the stage transport the gang launch wires up through
+MF_MPMD_PEERS. The `plan_stages` call below is literal ON PURPOSE: the
+`check --deep` SPMD pass validates stage count vs gang size vs layer
+divisibility before launch (analyze_all.sh guards this flow stays
+clean; tests/test_analysis.py seeds the failing variants)."""
+
+import os
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu.decorators import make_step_decorator
+from metaflow_tpu.plugins import STEP_DECORATORS
+
+# plain gang, no jax.distributed: each stage is its own single-process
+# jit program — the transport, not an XLA collective, couples them
+tpu_parallel = make_step_decorator(STEP_DECORATORS["tpu_parallel"])
+
+
+class MPMDPipelineFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    # retry: a chaos-killed stage (TPUFLOW_CHAOS=step:rank) fails its
+    # peers promptly through the bounded recv deadline, and the gang
+    # relaunches as a whole — the MPMD recovery contract
+    @tpu_parallel(jax_distributed=False)
+    @metaflow_tpu.retry(times=1, minutes_between_retries=0)
+    @step
+    def train(self):
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.spmd import mpmd
+        from metaflow_tpu.training.mpmd_trainer import run_stage_steps
+
+        cfg = llama.LlamaConfig.tiny(n_layers=4)
+        plan = mpmd.plan_stages(num_microbatches=4, num_virtual_stages=2,
+                                num_stages=2, n_layers=4)
+        stage = current.parallel.node_index
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab_size)
+        num_steps = int(os.environ.get("MPMD_FLOW_STEPS", "2"))
+        with mpmd.transport_from_env().start() as transport:
+            out, summary = run_stage_steps(
+                cfg, plan, stage, transport, tokens, num_steps=num_steps)
+        self.stage = stage
+        self.loss = None if out["loss"] is None else float(out["loss"])
+        self.steps_seen = (summary or {}).get("steps", 0)
+        self.next(self.join_gang)
+
+    @step
+    def join_gang(self, inputs):
+        losses = [i.loss for i in inputs if i.loss is not None]
+        # exactly one stage (the last) owns the loss
+        assert len(losses) == 1, losses
+        # every stage ticked the same schedule: same step count
+        assert len({i.steps_seen for i in inputs}) == 1
+        self.loss = losses[0]
+        self.ranks = sorted(i.stage for i in inputs)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        assert self.ranks == [0, 1], self.ranks
+        assert self.loss == self.loss and self.loss > 0, self.loss
+        print("mpmd pipeline done: loss=%.4f" % self.loss)
+
+
+if __name__ == "__main__":
+    MPMDPipelineFlow()

@@ -532,8 +532,70 @@ TRAIN_STEP_DATA_SCHEMA = _obj(
         # wall time of the (possibly ZeRO-sharded) weight update — only
         # present in the diagnostic timed_update split-step mode
         "optimizer_update_ms": _NUM,
+        # wall time this step spent BLOCKED on the MPMD stage transport
+        # (send backpressure + recv waits) — only present for MPMD
+        # per-stage steps; `tpuflow metrics` keys the PIPELINE-BOUND
+        # verdict on it
+        "transfer_stall_ms": _NUM,
     },
 )
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel training (training/pipeline_trainer.py single-program
+# shard_map pipeline + spmd/mpmd.py per-stage MPMD gangs): the pinned
+# event surface for the schedule configuration traces and the per-step
+# MPMD transfer accounting. `tpuflow metrics` keys its per-stage MPMD
+# section on mpmd.transfer, and the parity tests key on both traces
+# reporting the SAME schedule — they must not drift silently.
+# ---------------------------------------------------------------------------
+
+PIPELINE_EVENT_DATA_SCHEMAS = {
+    # one per compile of the single-program interleaved pipeline
+    # (pipeline_trainer.pipeline_loss_and_grads)
+    "pipeline.trace": _obj(
+        {"num_microbatches": _INT, "num_virtual_stages": _INT,
+         "axis_name": _STR, "batch": _INT, "seq": _INT, "n_layers": _INT},
+        required=("num_microbatches", "num_virtual_stages", "axis_name",
+                  "batch", "seq", "n_layers"),
+    ),
+    # one per stage-step construction (training/mpmd_trainer.py): the
+    # plan this stage ticks plus the physical layers it owns
+    "mpmd.stage.trace": _obj(
+        {"num_microbatches": _INT, "num_virtual_stages": _INT,
+         "num_stages": _INT, "n_layers": _INT, "n_cycles": _INT,
+         "stage": _INT, "layers": _arr(_INT), "seq": _INT},
+        required=("num_microbatches", "num_virtual_stages", "num_stages",
+                  "n_layers", "n_cycles", "stage", "layers", "seq"),
+    ),
+    # one per train step per stage: that step's frame/byte deltas and
+    # the wall time spent blocked on the wire
+    "mpmd.transfer": _obj(
+        {"stage": _INT, "double_buffer": _BOOL,
+         "frames_sent": _INT, "frames_recv": _INT,
+         "bytes_sent": _INT, "bytes_recv": _INT, "stall_ms": _NUM},
+        required=("stage", "double_buffer", "frames_sent", "frames_recv",
+                  "bytes_sent", "bytes_recv", "stall_ms"),
+    ),
+}
+
+
+def validate_pipeline_record(record):
+    """Validate one pipeline.*/mpmd.* flight-recorder record: base v1
+    record shape, a pinned name, and the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name not in PIPELINE_EVENT_DATA_SCHEMAS:
+        raise jsonschema.ValidationError(
+            "unknown pipeline record name %r (pinned: %s)"
+            % (name, sorted(PIPELINE_EVENT_DATA_SCHEMAS)))
+    if record.get("type") != "event":
+        raise jsonschema.ValidationError(
+            "%s must be an event record, got %r"
+            % (name, record.get("type")))
+    jsonschema.validate(record.get("data", {}),
+                        PIPELINE_EVENT_DATA_SCHEMAS[name],
+                        cls=jsonschema.Draft202012Validator)
 
 
 def validate_train_step_record(record):
@@ -573,6 +635,10 @@ SANITIZE_COLLECTIVE_NAMES = (
     "zero.reduce_scatter",
     "zero.shard",
     "zero.all_gather",
+    # MPMD stage-transport handoffs (spmd/mpmd.py): journaled per frame
+    # so a stage desync names the first diverging transfer
+    "mpmd.send",
+    "mpmd.recv",
 )
 
 _SIG = {"type": "string",

@@ -813,6 +813,138 @@ def test_positional_dcn_axis_is_parsed():
 
 
 # ---------------------------------------------------------------------------
+# MPMD stage-plan pass: literal plan_stages(...) calls validated against
+# the gang size and topology before any stage gang compiles
+# ---------------------------------------------------------------------------
+
+
+class MPMDPlanFlow(FlowSpec):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=2)
+
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import mpmd
+
+        plan = mpmd.plan_stages(num_microbatches=4, num_virtual_stages=2,
+                                num_stages=2, n_layers=4)
+        self.n_cycles = plan.describe()["n_cycles"]
+        self.next(self.joiner)
+
+    @step
+    def joiner(self, inputs):
+        self.cycles = [i.n_cycles for i in inputs]
+        self.next(self.end)
+
+    @step
+    def end(self):
+        print(self.cycles)
+
+
+def test_mpmd_plan_clean_flow_has_no_errors():
+    """A consistent plan (2 stages = gang of 2, 4 layers / (2*2) chunks)
+    must pass the whole analyzer clean — the regression gate for false
+    positives on the shipped MPMD demo flow."""
+    assert _findings(MPMDPlanFlow, severity="error") == []
+
+
+class BadMPMDLayersFlow(MPMDPlanFlow):
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import mpmd
+
+        plan = mpmd.plan_stages(num_microbatches=4,  # MARK-mpmd-layers
+                                num_virtual_stages=2,
+                                num_stages=2, n_layers=6)
+        self.n_cycles = plan.describe()["n_cycles"]
+        self.next(self.joiner)
+
+
+def test_mpmd_plan_layer_divisibility():
+    found = _findings(BadMPMDLayersFlow, code="mpmd-plan-invalid")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert "6 layers" in f.message and "chunks" in f.message
+    assert f.lineno == _line_of(BadMPMDLayersFlow, "MARK-mpmd-layers")
+
+
+class BadMPMDGangFlow(MPMDPlanFlow):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=3)
+
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import mpmd
+
+        plan = mpmd.plan_stages(num_microbatches=4,  # MARK-mpmd-gang
+                                num_virtual_stages=2,
+                                num_stages=2, n_layers=4)
+        self.n_cycles = plan.describe()["n_cycles"]
+        self.next(self.joiner)
+
+
+def test_mpmd_plan_gang_size_mismatch():
+    """One rank per stage: a num_parallel that differs from num_stages
+    leaves ring peers that never assemble."""
+    found = _findings(BadMPMDGangFlow, code="mpmd-plan-invalid")
+    assert len(found) == 1, found
+    f = found[0]
+    assert f.severity == "error" and f.step == "train"
+    assert "num_parallel=3" in f.message
+    assert "never assemble" in f.message
+    assert f.lineno == _line_of(BadMPMDGangFlow, "MARK-mpmd-gang")
+
+
+class BadMPMDHostsFlow(MPMDPlanFlow):
+    @step
+    def start(self):
+        self.next(self.train, num_parallel=3)
+
+    @metaflow_tpu.tpu(topology="v5p-32")  # 4 hosts
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import mpmd
+
+        plan = mpmd.plan_stages(num_microbatches=4,  # MARK-mpmd-hosts
+                                num_virtual_stages=2,
+                                num_stages=3, n_layers=6)
+        self.n_cycles = plan.describe()["n_cycles"]
+        self.next(self.joiner)
+
+
+def test_mpmd_plan_stage_host_alignment():
+    """Activations cross stages over DCN (host links): 3 stages cannot
+    tile a 4-host slice."""
+    found = _findings(BadMPMDHostsFlow, code="mpmd-plan-invalid")
+    assert len(found) == 1, found
+    f = found[0]
+    assert "host boundary" in f.message
+    assert "(topology 'v5p-32')" in f.message
+    assert f.lineno == _line_of(BadMPMDHostsFlow, "MARK-mpmd-hosts")
+
+
+class MPMDNonLiteralFlow(MPMDPlanFlow):
+    @step
+    def train(self):
+        from metaflow_tpu.spmd import mpmd
+
+        n = len(str(self.__class__.__name__))  # not a literal
+        plan = mpmd.plan_stages(num_microbatches=4, num_virtual_stages=2,
+                                num_stages=2, n_layers=n)
+        self.n_cycles = plan.describe()["n_cycles"]
+        self.next(self.joiner)
+
+
+def test_mpmd_plan_non_literal_fields_skip_checks():
+    """A runtime-computed field disables the checks that need it (never
+    invents a finding); the rest of the plan is still validated."""
+    assert _findings(MPMDNonLiteralFlow, code="mpmd-plan-invalid") == []
+
+
+# ---------------------------------------------------------------------------
 # gang-divergence pass: seeded violations (analysis/divergence.py)
 # ---------------------------------------------------------------------------
 
