@@ -755,8 +755,14 @@ class _StepExtractor(object):
     def _maybe_mpmd_literal(self, node):
         """Capture an mpmd.plan_stages(M, V, S, n_layers) call (only
         literal arguments survive; a non-literal field disables the
-        checks that need it, never invents a finding)."""
+        checks that need it, never invents a finding). Provenance is
+        required: the receiver must be the `mpmd` module (bare or fully
+        dotted), so an unrelated user helper that happens to be named
+        plan_stages cannot raise spurious ERROR-level plan findings."""
         if _call_name(node.func) != "plan_stages":
+            return
+        receiver = _receiver_source(node.func)
+        if receiver != "mpmd" and not receiver.endswith(".mpmd"):
             return
         names = ("num_microbatches", "num_virtual_stages", "num_stages",
                  "n_layers")

@@ -42,10 +42,12 @@ wire latency off the critical path) and a background receiver thread
 k+1 overlaps the compute of microbatch k. `double_buffer=False` degrades
 to the synchronous send-then-compute baseline the BENCH_MODE=mpmd gate
 measures against. Every recv carries a BOUNDED deadline
-(TPUFLOW_MPMD_RECV_TIMEOUT_S): a peer stage dying mid-transfer surfaces
-as MPMDTransferError/Timeout on the survivors, which fails the rank
-promptly so the elastic supervisor can relaunch the gang instead of the
-fleet wedging on an infinite block.
+(TPUFLOW_MPMD_RECV_TIMEOUT_S), and sends get their own generous deadline
+(TPUFLOW_MPMD_SEND_TIMEOUT_S, default = the recv deadline — backpressure
+from a peer mid-compile is normal and must NOT look like death): a peer
+stage dying mid-transfer surfaces as MPMDTransferError/Timeout on the
+survivors, which fails the rank promptly so the elastic supervisor can
+relaunch the gang instead of the fleet wedging on an infinite block.
 
 Env contract (plumbed by the @parallel gang launch alongside
 MF_PARALLEL_*): MF_MPMD_PEERS is a comma-separated host:port list, one
@@ -258,7 +260,8 @@ class StageTransport(object):
     QUEUE_DEPTH = 8
 
     def __init__(self, stage, world, peers, double_buffer=True,
-                 recv_timeout_s=None, link_latency_ms=None):
+                 recv_timeout_s=None, send_timeout_s=None,
+                 link_latency_ms=None):
         if world < 2:
             raise ValueError("StageTransport needs world >= 2")
         if len(peers) < world:
@@ -271,6 +274,14 @@ class StageTransport(object):
         self.recv_timeout_s = float(
             os.environ.get("TPUFLOW_MPMD_RECV_TIMEOUT_S", "60")
             if recv_timeout_s is None else recv_timeout_s)
+        # sends tolerate backpressure (peer mid-compile, full prefetch
+        # queue, genuine DCN latency) far longer than any liveness
+        # signal: their deadline defaults to the recv deadline, never to
+        # the 1s connect timeout. <= 0 means unbounded.
+        self.send_timeout_s = float(
+            os.environ.get("TPUFLOW_MPMD_SEND_TIMEOUT_S",
+                           str(self.recv_timeout_s))
+            if send_timeout_s is None else send_timeout_s)
         self.link_latency_ms = float(
             os.environ.get("TPUFLOW_MPMD_LINK_LATENCY_MS", "0")
             if link_latency_ms is None else link_latency_ms)
@@ -324,7 +335,19 @@ class StageTransport(object):
                     conn, _ = listener.accept()
                 except socket.timeout:
                     continue
-                hello = _recv_exact(conn, len(_HELLO) + 8, "hello")
+                # accepted sockets are BLOCKING (a listener's timeout
+                # does not propagate): bound the hello read so one
+                # stray/half-open connection cannot park the acceptor
+                # past the rendezvous deadline. Real peers send the
+                # hello immediately after connecting, so a short cap
+                # keeps the acceptor servicing other inbound dials.
+                conn.settimeout(
+                    min(2.0, max(0.2, deadline - time.monotonic())))
+                try:
+                    hello = _recv_exact(conn, len(_HELLO) + 8, "hello")
+                except MPMDTransferError:
+                    conn.close()
+                    continue
                 if not hello.startswith(_HELLO):
                     conn.close()
                     continue
@@ -381,6 +404,13 @@ class StageTransport(object):
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 sock.sendall(_HELLO + struct.pack(
                     "<II", self.stage, 0 if chan == CHAN_ACT else 1))
+                # the 1s timeout above is a CONNECT timeout only — left
+                # in place it would turn any >1s sendall backpressure
+                # (peer mid-jit-compile, full prefetch queue, real DCN
+                # latency) into a spurious peer-death verdict. Steady-
+                # state sends get the generous send deadline instead.
+                sock.settimeout(self.send_timeout_s
+                                if self.send_timeout_s > 0 else None)
                 return sock
             except OSError as exc:
                 last = exc
@@ -403,10 +433,27 @@ class StageTransport(object):
             key=key)
         t0 = time.perf_counter()
         if self.double_buffer:
-            err = self._send_error.get(chan)
-            if err is not None:
-                raise err
-            self._send_q[chan].put((arr, dict(meta)))
+            # bounded backpressure: a full queue is normal (that IS the
+            # double-buffer), but the put must re-check the sender
+            # thread's health each beat — if the thread died after an
+            # initial check, an unbounded put would wedge this stage
+            # forever, unreachable by the recv deadline.
+            give_up = (time.monotonic() + self.send_timeout_s
+                       if self.send_timeout_s > 0 else None)
+            while True:
+                err = self._send_error.get(chan)
+                if err is not None:
+                    raise err
+                try:
+                    self._send_q[chan].put((arr, dict(meta)), timeout=0.1)
+                    break
+                except queue.Full:
+                    if give_up is not None and time.monotonic() > give_up:
+                        raise MPMDTransferTimeout(
+                            "stage %d: %s send queue full for %.1fs "
+                            "(peer stage not draining — bounded by "
+                            "TPUFLOW_MPMD_SEND_TIMEOUT_S)"
+                            % (self.stage, chan, self.send_timeout_s))
         else:
             self._wire_send(chan, arr, meta)
         self._bump("stall_send_ms", (time.perf_counter() - t0) * 1e3)
@@ -442,7 +489,16 @@ class StageTransport(object):
             # modeled DCN latency: paid inline in synchronous mode,
             # hidden behind compute by the sender thread when buffered
             time.sleep(self.link_latency_ms / 1e3)
-        _send_msg(self._out[chan], payload)
+        try:
+            _send_msg(self._out[chan], payload)
+        except socket.timeout:
+            raise MPMDTransferTimeout(
+                "stage %d: %s send stalled past %.1fs (peer stage not "
+                "draining — bounded by TPUFLOW_MPMD_SEND_TIMEOUT_S)"
+                % (self.stage, chan, self.send_timeout_s))
+        except OSError as exc:
+            raise MPMDTransferError(
+                "stage %d: %s send failed: %s" % (self.stage, chan, exc))
         self._bump("bytes_sent", len(payload))
         self._bump("frames_sent", 1)
 
@@ -461,9 +517,8 @@ class StageTransport(object):
             arr, meta = item
             try:
                 self._wire_send(chan, arr, meta)
-            except OSError as exc:
-                self._send_error[chan] = MPMDTransferError(
-                    "stage %d: %s send failed: %s" % (self.stage, chan, exc))
+            except MPMDTransferError as exc:
+                self._send_error[chan] = exc
                 return
 
     def _receiver_loop(self, chan):

@@ -944,6 +944,27 @@ def test_mpmd_plan_non_literal_fields_skip_checks():
     assert _findings(MPMDNonLiteralFlow, code="mpmd-plan-invalid") == []
 
 
+class UnrelatedPlanStagesFlow(MPMDPlanFlow):
+    def plan_stages(self, a, b, c, d):
+        return {"layout": (a, b, c, d)}
+
+    @step
+    def train(self):
+        # same NAME, nothing to do with mpmd: "stages"=3 on a gang of
+        # 2 and an indivisible "layer" count would both fire ERROR
+        # findings if the matcher keyed on the bare callee name
+        plan = self.plan_stages(4, 2, 3, 7)
+        self.n_cycles = len(plan)
+        self.next(self.joiner)
+
+
+def test_mpmd_plan_requires_mpmd_receiver():
+    """Provenance regression: only `mpmd.plan_stages(...)` attribute
+    calls are captured — a user helper that happens to share the name
+    must not block `check --deep` on a correct flow."""
+    assert _findings(UnrelatedPlanStagesFlow, code="mpmd-plan-invalid") == []
+
+
 # ---------------------------------------------------------------------------
 # gang-divergence pass: seeded violations (analysis/divergence.py)
 # ---------------------------------------------------------------------------

@@ -14,10 +14,12 @@ rtol=1e-4/atol=1e-5 (reduction order differs only inside the vjp)."""
 
 import json
 import os
+import queue
 import socket
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -339,6 +341,116 @@ class TestBoundedRecv:
     def test_world_of_one_rejected(self):
         with pytest.raises(ValueError, match="world >= 2"):
             mpmd.StageTransport(0, 1, ["127.0.0.1:1"])
+
+
+class TestBoundedSend:
+    def test_outbound_sockets_use_send_deadline_not_connect_timeout(self):
+        """Regression: `create_connection`'s 1s CONNECT timeout must not
+        govern steady-state sendall — >1s of send backpressure (peer
+        mid-jit-compile, full prefetch queue, real DCN latency) is
+        normal operation, not peer death. Sends get their own generous
+        deadline, defaulting to the recv deadline."""
+        t0, t1 = _paired_transports(True, recv_timeout_s=30.0)
+        try:
+            for t in (t0, t1):
+                assert t.send_timeout_s == pytest.approx(30.0)
+                for sock in t._out.values():
+                    assert sock.gettimeout() == pytest.approx(
+                        t.send_timeout_s)
+        finally:
+            t0.close()
+            t1.close()
+
+    def test_send_queue_put_is_bounded_when_sender_dies(self):
+        """The double-buffered put re-checks sender-thread health every
+        beat and carries an overall deadline: a sender thread that died
+        leaving the queue full raises instead of wedging the stage in a
+        `put` the recv deadline can never reach."""
+        t0, t1 = _paired_transports(True, recv_timeout_s=30.0)
+        orig_q = None
+        try:
+            t0.send_timeout_s = 0.5
+            # model the sender thread dying between the error check and
+            # the put: a full queue that nothing drains
+            dead_q = queue.Queue(maxsize=1)
+            dead_q.put_nowait(("stale", {}))
+            orig_q = t0._send_q[mpmd.CHAN_ACT]
+            t0._send_q[mpmd.CHAN_ACT] = dead_q
+            start = time.perf_counter()
+            with pytest.raises(mpmd.MPMDTransferTimeout,
+                               match="send queue full"):
+                t0.send(mpmd.CHAN_ACT, np.zeros((2,), np.float32),
+                        {"m": 0, "v": 1})
+            assert time.perf_counter() - start < 5.0
+        finally:
+            if orig_q is not None:
+                # the real sender thread still drains the ORIGINAL
+                # queue: put it back so close() can hand it the stop
+                # sentinel instead of burning the join timeout
+                t0._send_q[mpmd.CHAN_ACT] = orig_q
+            t0.close()
+            t1.close()
+
+    def test_dead_sender_error_preempts_the_put(self):
+        """A recorded sender-thread error surfaces on the NEXT send even
+        when the queue has room (the pre-put health check)."""
+        t0, t1 = _paired_transports(True, recv_timeout_s=30.0)
+        try:
+            boom = mpmd.MPMDTransferError("sender thread died")
+            t0._send_error[mpmd.CHAN_COT] = boom
+            with pytest.raises(mpmd.MPMDTransferError,
+                               match="sender thread died"):
+                t0.send(mpmd.CHAN_COT, np.zeros((2,), np.float32),
+                        {"m": 0, "v": 0})
+        finally:
+            t0.close()
+            t1.close()
+
+
+class TestRendezvousRobustness:
+    def test_stray_connection_does_not_wedge_rendezvous(self, monkeypatch):
+        """A port-scanner-style connection that never sends its hello
+        must not park the acceptor past the rendezvous deadline: an
+        accepted socket is BLOCKING (the listener's timeout does not
+        propagate), so the hello read needs its own bound."""
+        monkeypatch.setenv("TPUFLOW_MPMD_CONNECT_TIMEOUT_S", "15")
+        peers = _free_peers(2)
+        stray = {}
+        stray_in = threading.Event()
+
+        def _stray_dial():
+            addr = mpmd._parse_addr(peers[0])
+            while "sock" not in stray:
+                try:
+                    stray["sock"] = socket.create_connection(
+                        addr, timeout=0.2)
+                except OSError:
+                    time.sleep(0.02)
+            stray_in.set()
+
+        threading.Thread(target=_stray_dial, daemon=True).start()
+
+        def stage_main(d):
+            if d == 1:
+                # hold stage 1 back until the silent stray has reached
+                # stage 0's listener, so the acceptor services the
+                # hello-less socket before the real peer's dials
+                assert stray_in.wait(timeout=10)
+                time.sleep(0.3)
+            return mpmd.StageTransport(
+                d, 2, peers, recv_timeout_s=10.0).start()
+
+        t0, t1 = _run_stage_threads(2, stage_main)
+        try:
+            t0.send(mpmd.CHAN_ACT, np.arange(3, dtype=np.float32),
+                    {"m": 0, "v": 1})
+            meta, arr = t1.recv(mpmd.CHAN_ACT)
+            assert meta["m"] == 0 and arr.shape == (3,)
+        finally:
+            if "sock" in stray:
+                stray["sock"].close()
+            t0.close()
+            t1.close()
 
 
 class TestEnvPlumbing:
