@@ -542,6 +542,21 @@ def watch(flow_run, run_id, datastore, datastore_root, once, check,
                    "(0 disables; default: TPUFLOW_PREFIX_CACHE_MB). "
                    "Cached prompt-prefix KV skips recompute on shared "
                    "system prompts (docs/serving.md#prefix-cache).")
+@click.option("--paged", is_flag=True,
+              help="Use the paged-KV engine: a global page pool + "
+                   "per-slot block tables instead of one static KV "
+                   "stripe per slot. Prefix hits share pages zero-copy "
+                   "and page exhaustion backpressures admission "
+                   "(docs/serving.md#paged-kv).")
+@click.option("--page-tokens", default=None, type=int,
+              help="Tokens per KV page (default: "
+                   "TPUFLOW_KV_PAGE_TOKENS or 16). Paged engine only.")
+@click.option("--spec-k", default=None, type=int,
+              help="Speculative decoding draft length: propose K "
+                   "self-drafted tokens and verify them in one fused "
+                   "step (greedy traffic only; 0 disables; default: "
+                   "TPUFLOW_SPEC_K). Paged engine only "
+                   "(docs/serving.md#speculative-decoding).")
 @click.option("--reload", "reload_checkpoint", is_flag=True,
               help="Don't start a server: roll the named checkpoint "
                    "onto the RUNNING fleet at --host/--port via a "
@@ -550,7 +565,8 @@ def watch(flow_run, run_id, datastore, datastore_root, once, check,
 def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
           model, host, port, replicas, slots, max_seq_len, prefill_chunk,
           max_queue, mesh_spec, attn_impl, prefill_workers,
-          prefix_cache_mb, reload_checkpoint):
+          prefix_cache_mb, paged, page_tokens, spec_k,
+          reload_checkpoint):
     from .cmd.serve import serve as serve_impl
     from .exception import TpuFlowException
 
@@ -564,6 +580,7 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
                    mesh_spec=mesh_spec, attn_impl=attn_impl,
                    prefill_workers=prefill_workers,
                    prefix_cache_mb=prefix_cache_mb,
+                   paged=paged, page_tokens=page_tokens, spec_k=spec_k,
                    reload_checkpoint=reload_checkpoint,
                    echo=click.echo)
     except TpuFlowException as ex:

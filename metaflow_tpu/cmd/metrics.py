@@ -58,6 +58,11 @@ def aggregate(records, profiles=None):
     prefix = {"hits": 0, "misses": 0, "hit_tokens": 0,
               "prompt_tokens": 0, "evictions": 0, "evicted_tokens": 0,
               "evicted_bytes": 0}
+    # serve.kv.* paged-pool events + pool/spec gauges (serving/paged.py)
+    kv = {"page_allocs": 0, "pages_allocated": 0, "page_frees": 0,
+          "pages_freed": 0, "page_shares": 0, "pages_shared": 0,
+          "shared_tokens": 0, "exhausted": 0}
+    kv_gauges = {}  # last-seen occupancy / cow_pages / spec accept rate
     # MPMD per-stage pipeline gangs (spmd/mpmd.py + mpmd_trainer.py):
     # each rank runs ONE stage, so per-stage series key on the stage id
     # in the timer name, never averaged across ranks
@@ -140,6 +145,12 @@ def aggregate(records, profiles=None):
                 train_summary.setdefault(
                     name[len("train.summary."):], []).append(
                         rec.get("value"))
+            if name == "serve.kv.page_occupancy":
+                kv_gauges["occupancy"] = rec.get("value")
+            elif name == "serve.kv.cow_pages":
+                kv_gauges["cow_pages"] = rec.get("value")
+            elif name == "serve.spec.accept_rate":
+                kv_gauges["spec_accept_rate"] = rec.get("value")
             if name.startswith("train.memory."):
                 # per-step memory-split gauges normalize onto the same
                 # keys the summary gauges use (memory_params_bytes, ...)
@@ -165,6 +176,20 @@ def aggregate(records, profiles=None):
                     prefix["evicted_tokens"] += int(
                         data.get("tokens", 0))
                     prefix["evicted_bytes"] += int(data.get("bytes", 0))
+            if name.startswith("serve.kv."):
+                data = rec.get("data") or {}
+                if name == "serve.kv.page_alloc":
+                    kv["page_allocs"] += 1
+                    kv["pages_allocated"] += int(data.get("pages", 0))
+                elif name == "serve.kv.page_free":
+                    kv["page_frees"] += 1
+                    kv["pages_freed"] += int(data.get("pages", 0))
+                elif name == "serve.kv.page_shared":
+                    kv["page_shares"] += 1
+                    kv["pages_shared"] += int(data.get("pages", 0))
+                    kv["shared_tokens"] += int(data.get("tokens", 0))
+                elif name == "serve.kv.exhausted":
+                    kv["exhausted"] += 1
             if name == "mpmd.transfer":
                 data = rec.get("data") or {}
                 t = mpmd_transfer.setdefault(
@@ -429,6 +454,15 @@ def aggregate(records, profiles=None):
         prefix_cache["prefill_tokens_skipped_frac"] = round(
             prefix["hit_tokens"] / max(1, prefix["prompt_tokens"]), 4)
 
+    kv_pages = {}
+    if any(kv.values()) or kv_gauges:
+        kv_pages = dict(kv)
+        kv_pages.update(kv_gauges)
+        # leak detector: every reserved page must come back on some
+        # terminal path — nonzero here after a drained run is a leak
+        kv_pages["pages_outstanding"] = (kv["pages_allocated"]
+                                         - kv["pages_freed"])
+
     task_rows = sorted(
         tasks.values(),
         key=lambda t: (t["step"], str(t["task_id"])))
@@ -446,6 +480,7 @@ def aggregate(records, profiles=None):
         "fleet": fleet,
         "hangs": hangs,
         "prefix_cache": prefix_cache,
+        "kv_pages": kv_pages,
         "timeline": timeline,
         "profiles": list(profiles or []),
     }
@@ -655,6 +690,26 @@ def render_summary(run_id, agg, echo=print):
                  % (prefix_cache["evictions"],
                     prefix_cache["evicted_tokens"],
                     prefix_cache["evicted_bytes"] / 2**20))
+    kv_pages = agg.get("kv_pages") or {}
+    if kv_pages:
+        echo("")
+        echo("paged KV pool:")
+        echo("  %d reservation(s) (%d pages), %d release(s) (%d pages), "
+             "%d outstanding"
+             % (kv_pages["page_allocs"], kv_pages["pages_allocated"],
+                kv_pages["page_frees"], kv_pages["pages_freed"],
+                kv_pages["pages_outstanding"]))
+        if kv_pages.get("page_shares"):
+            echo("  %d zero-copy prefix attach(es): %d page(s) / %d "
+                 "token(s) shared"
+                 % (kv_pages["page_shares"], kv_pages["pages_shared"],
+                    kv_pages["shared_tokens"]))
+        if kv_pages.get("exhausted"):
+            echo("  %d exhaustion episode(s) (admission backpressure)"
+                 % kv_pages["exhausted"])
+        if kv_pages.get("spec_accept_rate") is not None:
+            echo("  speculative decode accept rate %.0f%%"
+                 % (kv_pages["spec_accept_rate"] * 100))
     if agg["counters"]:
         echo("")
         echo("counters:")

@@ -70,11 +70,13 @@ def extract_params(restored, params_key="params"):
 
 
 def build_engine(params, cfg, slots=8, max_seq_len=None, prefill_chunk=64,
-                 mesh_spec=None, attn_impl="auto"):
+                 mesh_spec=None, attn_impl="auto", paged=False,
+                 page_tokens=None, spec_k=None):
     """Shard params over a mesh (the training rule table) and build the
-    slot engine. mesh_spec: None, or a MeshSpec factory name
-    ('dp'|'fsdp'|'fsdp_tp')."""
-    from ..serving import SlotEngine
+    engine: the slot engine, or (paged=True / TPUFLOW_PAGED=1) the
+    paged-KV engine with optional speculative decoding. mesh_spec:
+    None, or a MeshSpec factory name ('dp'|'fsdp'|'fsdp_tp')."""
+    from ..serving import PagedEngine, SlotEngine
 
     mesh = None
     if mesh_spec:
@@ -98,9 +100,38 @@ def build_engine(params, cfg, slots=8, max_seq_len=None, prefill_chunk=64,
                      if isinstance(cfg, mixtral_mod.MixtralConfig)
                      else llama_mod)
         params = shard_tree(params, model_mod.logical_axes(cfg), mesh)
+    if paged or os.environ.get("TPUFLOW_PAGED", "0") not in ("0", ""):
+        return PagedEngine(params, cfg, max_slots=slots,
+                           max_seq_len=max_seq_len,
+                           prefill_chunk=prefill_chunk, mesh=mesh,
+                           attn_impl=attn_impl, page_tokens=page_tokens,
+                           spec_k=spec_k)
     return SlotEngine(params, cfg, max_slots=slots,
                       max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
                       mesh=mesh, attn_impl=attn_impl)
+
+
+def build_prefix_cache(engine, prefix_cache_mb=None):
+    """The prefix cache matched to the engine: a zero-copy
+    PagedPrefixIndex over the paged engine's own pool, a host-side
+    RadixPrefixCache otherwise. Same opt-in contract either way:
+    no byte budget (flag or TPUFLOW_PREFIX_CACHE_MB), no cache."""
+    from ..serving import PagedPrefixIndex, RadixPrefixCache
+
+    pool = getattr(engine, "pool", None)
+    if pool is not None:
+        if prefix_cache_mb is None:
+            return PagedPrefixIndex.from_env(pool)
+        if int(prefix_cache_mb) <= 0:
+            return None
+        pages = max(1, (int(prefix_cache_mb) << 20)
+                    // max(1, pool.page_bytes()))
+        return PagedPrefixIndex(pool,
+                                max_pages=min(pages, pool.usable_pages))
+    if prefix_cache_mb is None:
+        return RadixPrefixCache.from_env()
+    return (RadixPrefixCache(int(prefix_cache_mb) << 20)
+            if int(prefix_cache_mb) > 0 else None)
 
 
 def _init_serve_telemetry(flow_name, run_id, task_prefix="server"):
@@ -149,7 +180,8 @@ def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
                 host="127.0.0.1", port=8000, replicas=2, slots=8,
                 max_seq_len=None, prefill_chunk=64, max_queue=64,
                 mesh_spec=None, attn_impl="auto", prefill_workers=0,
-                prefix_cache_mb=None, echo=print, block=True):
+                prefix_cache_mb=None, paged=False, page_tokens=None,
+                spec_k=None, echo=print, block=True):
     """`tpuflow serve FLOW/RUN --replicas N`: fork N replica workers
     (each loading the run's checkpoint through load_run_checkpoint) and
     front them with the health-checked failover router
@@ -182,6 +214,12 @@ def serve_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
         replica_args += ["--mesh", mesh_spec]
     if prefix_cache_mb is not None:
         replica_args += ["--prefix-cache-mb", str(prefix_cache_mb)]
+    if paged:
+        replica_args += ["--paged"]
+    if page_tokens is not None:
+        replica_args += ["--page-tokens", str(page_tokens)]
+    if spec_k is not None:
+        replica_args += ["--spec-k", str(spec_k)]
     config = FleetConfig.from_env()
     spawner = SubprocessReplicaSpawner(
         replica_args, spawn_timeout_s=config.spawn_timeout_s)
@@ -268,7 +306,8 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
           host="127.0.0.1", port=8000, replicas=1, slots=8,
           max_seq_len=None, prefill_chunk=64, max_queue=64,
           mesh_spec=None, attn_impl="auto", prefill_workers=0,
-          prefix_cache_mb=None, reload_checkpoint=False, echo=print,
+          prefix_cache_mb=None, paged=False, page_tokens=None,
+          spec_k=None, reload_checkpoint=False, echo=print,
           block=True):
     """Load FLOW/RUN's checkpoint and serve it. Returns the running
     ServingServer when block=False (tests); otherwise serves until
@@ -280,7 +319,7 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
     --host/--port via a zero-shed rolling upgrade."""
     from .. import telemetry
     from ..inference import load_run_checkpoint
-    from ..serving import RadixPrefixCache, Scheduler, ServingServer
+    from ..serving import Scheduler, ServingServer
 
     if reload_checkpoint:
         return reload_fleet(flow_run, run_id=run_id,
@@ -296,7 +335,9 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
             max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
             max_queue=max_queue, mesh_spec=mesh_spec,
             attn_impl=attn_impl, prefill_workers=int(prefill_workers),
-            prefix_cache_mb=prefix_cache_mb, echo=echo, block=block)
+            prefix_cache_mb=prefix_cache_mb, paged=paged,
+            page_tokens=page_tokens, spec_k=spec_k, echo=echo,
+            block=block)
 
     # resolve the run HERE (not only inside load_run_checkpoint) so
     # telemetry lands under the real run id, next to its training
@@ -310,20 +351,25 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
     engine = build_engine(params, cfg, slots=slots,
                           max_seq_len=max_seq_len,
                           prefill_chunk=prefill_chunk,
-                          mesh_spec=mesh_spec, attn_impl=attn_impl)
+                          mesh_spec=mesh_spec, attn_impl=attn_impl,
+                          paged=paged, page_tokens=page_tokens,
+                          spec_k=spec_k)
     _init_serve_telemetry(flow_name, run_id)
-    if prefix_cache_mb is not None:
-        cache = (RadixPrefixCache(int(prefix_cache_mb) << 20)
-                 if int(prefix_cache_mb) > 0 else None)
-    else:
-        cache = RadixPrefixCache.from_env()
+    cache = build_prefix_cache(engine, prefix_cache_mb)
     scheduler = Scheduler(engine, max_queue=max_queue,
                           prefix_cache=cache)
     server = ServingServer(scheduler, host=host, port=port)
-    echo("serving %s/%s on http://%s:%d  (%d slots x %d positions, "
-         "attn=%s)" % (flow_name, run_id, server.host,
-                       server.port, engine.max_slots, engine.max_seq_len,
-                       engine.attn_impl))
+    if hasattr(engine, "pool"):
+        echo("serving %s/%s on http://%s:%d  (paged: %d slots, %d pages "
+             "x %d tokens, spec_k=%d, attn=%s)"
+             % (flow_name, run_id, server.host, server.port,
+                engine.max_slots, engine.pool.usable_pages,
+                engine.page_tokens, engine.spec_k, engine.attn_impl))
+    else:
+        echo("serving %s/%s on http://%s:%d  (%d slots x %d positions, "
+             "attn=%s)" % (flow_name, run_id, server.host,
+                           server.port, engine.max_slots,
+                           engine.max_seq_len, engine.attn_impl))
     echo("  POST /v1/generate  {\"tokens\": [...], \"max_new_tokens\": N,"
          " \"stream\": true}")
     if not block:

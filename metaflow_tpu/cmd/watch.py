@@ -62,6 +62,12 @@ class WatchState(object):
         self.prefix_hit_tokens = 0
         self.prefix_prompt_tokens = 0
         self.prefix_evictions = 0
+        # paged KV pool + speculative decoding
+        self.kv_occupancy = None
+        self.kv_cow_pages = None
+        self.kv_shares = 0
+        self.kv_exhausted = 0
+        self.spec_accept_rate = None
         # fleet
         self.replicas_ready = None
         self.replica_flaps = 0
@@ -107,6 +113,12 @@ class WatchState(object):
                     self.occupancy = rec.get("value")
                 elif name == "fleet.replicas_ready":
                     self.replicas_ready = rec.get("value")
+                elif name == "serve.kv.page_occupancy":
+                    self.kv_occupancy = rec.get("value")
+                elif name == "serve.kv.cow_pages":
+                    self.kv_cow_pages = rec.get("value")
+                elif name == "serve.spec.accept_rate":
+                    self.spec_accept_rate = rec.get("value")
             elif rtype == "event":
                 if name == "serve.request.first_token":
                     if data.get("ttft_ms") is not None:
@@ -130,6 +142,10 @@ class WatchState(object):
                         data.get("prompt_tokens") or 0
                 elif name == "serve.prefix.evict":
                     self.prefix_evictions += data.get("nodes") or 0
+                elif name == "serve.kv.page_shared":
+                    self.kv_shares += 1
+                elif name == "serve.kv.exhausted":
+                    self.kv_exhausted += 1
                 elif name == "fleet.replica.dead":
                     self.replica_flaps += 1
                 elif name == "fleet.replica.restart":
@@ -199,6 +215,10 @@ class WatchState(object):
             m["prefix_tokens_skipped_frac"] = round(
                 self.prefix_hit_tokens
                 / max(1, self.prefix_prompt_tokens), 4)
+        if self.kv_occupancy is not None:
+            m["kv_page_occupancy"] = round(float(self.kv_occupancy), 4)
+        if self.spec_accept_rate is not None:
+            m["spec_accept_rate"] = round(float(self.spec_accept_rate), 4)
         return m
 
 
@@ -239,6 +259,17 @@ def render_frame(state, run_id, breaches=(), echo=print):
                  m.get("prefix_hit_rate", 0.0) * 100,
                  m.get("prefix_tokens_skipped_frac", 0.0) * 100,
                  state.prefix_evictions))
+    if state.kv_occupancy is not None or state.kv_exhausted:
+        line = "  kv: pages %.0f%%" % (
+            (state.kv_occupancy or 0.0) * 100)
+        if state.kv_cow_pages is not None:
+            line += "  cow %d" % int(state.kv_cow_pages)
+        line += "  shares %d  exhausted %d" % (state.kv_shares,
+                                               state.kv_exhausted)
+        if state.spec_accept_rate is not None:
+            line += "  spec accept %.0f%%" % (
+                state.spec_accept_rate * 100)
+        echo(line)
     if state.replicas_ready is not None or state.replica_flaps:
         line = "  fleet: ready %s  flaps %d  restarts/min %s" % (
             state.replicas_ready

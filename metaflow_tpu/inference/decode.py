@@ -95,36 +95,29 @@ def _default_decode_chunk():
 DECODE_CHUNK = _default_decode_chunk()
 
 
-def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
-    """Flash-decode: the same attention reading ONLY the filled prefix.
+def _streamed_attention(q, pos, chunk, n_chunks, fetch):
+    """Online-softmax attention over KV streamed in `chunk`-sized blocks
+    (the flash-decode accumulation shared by the contiguous-cache and
+    paged-cache paths; only HOW a block is fetched differs).
 
-    KV chunks stream through an online-softmax accumulation
-    (lax.fori_loop with a TRACED trip count ceil((pos+T)/chunk), lowered
-    to a while_loop) — per emitted token the HBM traffic is O(filled),
-    not O(Smax), which is what long-context serving needs. Numerics
-    match the dense path: same fp32 logits, same masking; the edge
-    chunk's clamped slice re-reads earlier keys, masked out by the
-    `key >= chunk start` term."""
+    fetch(i) -> (k_blk [B, chunk, KV, Hd], v_blk, key_idx [chunk]): the
+    i-th KV block and the absolute key positions it holds. Keys are
+    visible iff key_idx <= q_pos AND key_idx >= i * chunk — the second
+    term masks a clamped edge block's re-read of earlier keys (a paged
+    fetch never re-reads, so the term is a no-op there)."""
     B, T, H, Hd = q.shape
-    Smax = cache_k.shape[1]
-    chunk = min(chunk, Smax)
     scale = 1.0 / math.sqrt(Hd)
     qf = q.astype(jnp.float32)
-    # traced trip count; with per-slot [B] positions the loop runs to the
-    # DEEPEST slot's fill (shallower slots just mask the extra chunks)
-    n_chunks = (jnp.max(jnp.asarray(pos)) + T + chunk - 1) // chunk
     q_pos = _mask_positions(_query_positions(pos, T))
 
     def body(i, carry):
         m, l, acc = carry
-        start = jnp.minimum(i * chunk, Smax - chunk)
-        k_blk = _broadcast_gqa(
-            jax.lax.dynamic_slice_in_dim(cache_k, start, chunk, 1), H)
-        v_blk = _broadcast_gqa(
-            jax.lax.dynamic_slice_in_dim(cache_v, start, chunk, 1), H)
+        k_raw, v_raw, key_pos = fetch(i)
+        k_blk = _broadcast_gqa(k_raw, H)
+        v_blk = _broadcast_gqa(v_raw, H)
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             k_blk.astype(jnp.float32)) * scale
-        key_idx = (start + jnp.arange(chunk))[None, None, None, :]
+        key_idx = key_pos[None, None, None, :]
         visible = (key_idx <= q_pos) & (key_idx >= i * chunk)
         logits = jnp.where(visible, logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
@@ -143,15 +136,39 @@ def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, T, H, Hd]
 
 
-def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
-                  mesh=None, attn_impl="dense"):
-    """One block over T new tokens, reading+extending this layer's cache.
-    Dense (Llama) or MoE (Mixtral) FFN is picked off the parameter tree —
-    the attention/cache half is identical."""
-    B, T, D = x.shape
-    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    lp = layer_params
+def _chunked_cached_attention(q, cache_k, cache_v, pos, chunk=DECODE_CHUNK):
+    """Flash-decode: the same attention reading ONLY the filled prefix.
 
+    KV chunks stream through an online-softmax accumulation
+    (lax.fori_loop with a TRACED trip count ceil((pos+T)/chunk), lowered
+    to a while_loop) — per emitted token the HBM traffic is O(filled),
+    not O(Smax), which is what long-context serving needs. Numerics
+    match the dense path: same fp32 logits, same masking; the edge
+    chunk's clamped slice re-reads earlier keys, masked out by the
+    `key >= chunk start` term."""
+    T = q.shape[1]
+    Smax = cache_k.shape[1]
+    chunk = min(chunk, Smax)
+    # traced trip count; with per-slot [B] positions the loop runs to the
+    # DEEPEST slot's fill (shallower slots just mask the extra chunks)
+    n_chunks = (jnp.max(jnp.asarray(pos)) + T + chunk - 1) // chunk
+
+    def fetch(i):
+        start = jnp.minimum(i * chunk, Smax - chunk)
+        k_blk = jax.lax.dynamic_slice_in_dim(cache_k, start, chunk, 1)
+        v_blk = jax.lax.dynamic_slice_in_dim(cache_v, start, chunk, 1)
+        return k_blk, v_blk, start + jnp.arange(chunk)
+
+    return _streamed_attention(q, pos, chunk, n_chunks, fetch)
+
+
+def _attn_qkv(cfg, cos, sin, pos, x, lp):
+    """The pre-attention half of a block: attn-norm, QKV projections and
+    rope at the absolute positions `pos` implies. Shared verbatim by the
+    contiguous-cache layer below and the paged-cache layer
+    (serving/paged.py) so both paths stay numerically identical."""
+    B, T, _ = x.shape
+    H, KV, Hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ lp["wq"]).reshape(B, T, H, Hd)
     k = (h @ lp["wk"]).reshape(B, T, KV, Hd)
@@ -159,27 +176,15 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
     positions = _query_positions(pos, T)
     q = apply_rope(q, cos, sin, positions=positions)
     k = apply_rope(k, cos, sin, positions=positions)
+    return q, k, v
 
-    if jnp.ndim(pos) == 0:
-        cache_k = jax.lax.dynamic_update_slice_in_dim(
-            cache_k, k.astype(cache_k.dtype), pos, axis=1)
-        cache_v = jax.lax.dynamic_update_slice_in_dim(
-            cache_v, v.astype(cache_v.dtype), pos, axis=1)
-    else:
-        # per-slot offsets: every batch row writes its T new positions at
-        # its OWN cursor (lowered to a batched scatter)
-        _write = jax.vmap(
-            lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(
-                c, u, p, axis=0))
-        cache_k = _write(cache_k, k.astype(cache_k.dtype), pos)
-        cache_v = _write(cache_v, v.astype(cache_v.dtype), pos)
 
-    if attn_impl == "chunked":
-        attn = _chunked_cached_attention(q, cache_k, cache_v, pos)
-    else:
-        attn = _cached_attention(q, cache_k, cache_v, pos)
-    x = x + attn.reshape(B, T, H * Hd) @ lp["wo"]
-
+def _block_ffn(cfg, x, attn, lp, mesh=None):
+    """The post-attention half of a block: output projection, residual,
+    and the dense (Llama) or MoE (Mixtral) FFN picked off the parameter
+    tree. Shared by the contiguous and paged cache paths."""
+    B, T, _ = x.shape
+    x = x + attn.reshape(B, T, cfg.n_heads * cfg.head_dim) @ lp["wo"]
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     if "router" in lp:  # Mixtral: token-choice MoE FFN
         from ..ops.moe import moe_ffn
@@ -202,6 +207,36 @@ def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
         gate = jax.nn.silu(h @ lp["w_gate"])
         up = h @ lp["w_up"]
         x = x + (gate * up) @ lp["w_down"]
+    return x
+
+
+def _decode_layer(cfg, cos, sin, pos, x, layer_params, cache_k, cache_v,
+                  mesh=None, attn_impl="dense"):
+    """One block over T new tokens, reading+extending this layer's cache.
+    Dense (Llama) or MoE (Mixtral) FFN is picked off the parameter tree —
+    the attention/cache half is identical."""
+    lp = layer_params
+    q, k, v = _attn_qkv(cfg, cos, sin, pos, x, lp)
+
+    if jnp.ndim(pos) == 0:
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, k.astype(cache_k.dtype), pos, axis=1)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, v.astype(cache_v.dtype), pos, axis=1)
+    else:
+        # per-slot offsets: every batch row writes its T new positions at
+        # its OWN cursor (lowered to a batched scatter)
+        _write = jax.vmap(
+            lambda c, u, p: jax.lax.dynamic_update_slice_in_dim(
+                c, u, p, axis=0))
+        cache_k = _write(cache_k, k.astype(cache_k.dtype), pos)
+        cache_v = _write(cache_v, v.astype(cache_v.dtype), pos)
+
+    if attn_impl == "chunked":
+        attn = _chunked_cached_attention(q, cache_k, cache_v, pos)
+    else:
+        attn = _cached_attention(q, cache_k, cache_v, pos)
+    x = _block_ffn(cfg, x, attn, lp, mesh=mesh)
     return x, cache_k, cache_v
 
 

@@ -1,6 +1,7 @@
-"""Continuous-batching serving engine: slot-based KV cache, request
-scheduler, HTTP API, radix prefix cache, prefill/decode disaggregation,
-and the fault-tolerant autoscaling replica fleet. See docs/serving.md."""
+"""Continuous-batching serving engine: slot-based KV cache, paged-KV
+engine with speculative decoding, request scheduler, HTTP API, radix
+prefix cache, prefill/decode disaggregation, and the fault-tolerant
+autoscaling replica fleet. See docs/serving.md."""
 
 from .disagg import decode_handoff, encode_handoff
 from .engine import SlotEngine, request_step_keys, sample_slots
@@ -10,8 +11,20 @@ from .fleet import (
     ServingFleet,
     SubprocessReplicaSpawner,
 )
-from .prefix_cache import PrefixHandle, RadixPrefixCache
+from .paged import (
+    PagedEngine,
+    PageExhaustedError,
+    PagePool,
+    ngram_draft,
+)
+from .prefix_cache import (
+    PagedPrefixHandle,
+    PagedPrefixIndex,
+    PrefixHandle,
+    RadixPrefixCache,
+)
 from .scheduler import (
+    CapacityError,
     DrainingError,
     QueueFullError,
     Request,
@@ -21,12 +34,17 @@ from .server import ServingServer, retry_after_hint
 
 __all__ = [
     "SlotEngine",
+    "PagedEngine",
+    "PagePool",
+    "PageExhaustedError",
+    "ngram_draft",
     "request_step_keys",
     "sample_slots",
     "Request",
     "Scheduler",
     "QueueFullError",
     "DrainingError",
+    "CapacityError",
     "ServingServer",
     "ServingFleet",
     "FleetConfig",
@@ -34,6 +52,8 @@ __all__ = [
     "SubprocessReplicaSpawner",
     "RadixPrefixCache",
     "PrefixHandle",
+    "PagedPrefixIndex",
+    "PagedPrefixHandle",
     "encode_handoff",
     "decode_handoff",
     "retry_after_hint",

@@ -250,6 +250,17 @@ class SlotEngine(object):
     def occupancy(self):
         return float(self.active.sum()) / self.max_slots
 
+    def fits(self, prompt_len, max_new_tokens):
+        """Could this request EVER be admitted? False is a permanent
+        413 at submit time (the scheduler's admission capacity check),
+        not backpressure."""
+        return prompt_len + max_new_tokens <= self.max_seq_len
+
+    def max_context_tokens(self):
+        """The largest prompt+max_new any request may carry — the
+        scalar the fleet router sheds oversized dispatches against."""
+        return self.max_seq_len
+
     def compile_counts(self):
         """jit cache entries per program — each decode variant must stay
         at <= 1, prefill at <= number of chunk buckets."""

@@ -1069,6 +1069,22 @@ class ServingFleet(object):
             self._shed(handler, request_id, "queue_full", 429,
                        "fleet in-flight budget exhausted")
             return
+        # never-fits capacity check: a request whose prompt+max_new
+        # exceeds every ready replica's reported max_context_tokens
+        # would 413 at each dispatch anyway — shed it HERE, before any
+        # replica spends prefill or a failover loop burns attempts
+        cap = self._max_context_tokens()
+        if cap is not None:
+            try:
+                need = len(payload.get("tokens") or ()) \
+                    + int(payload.get("max_new_tokens") or 1)
+            except (TypeError, ValueError):
+                need = 0  # malformed: let the replica 400 it
+            if need > cap:
+                self._shed(handler, request_id, "capacity", 413,
+                           "prompt + max_new_tokens (%d) exceeds fleet "
+                           "max context (%d tokens)" % (need, cap))
+                return
 
         # ---- disaggregation: prefill hop first when workers exist ----
         # the returned frame (KV + first token + original payload) is
@@ -1404,6 +1420,38 @@ class ServingFleet(object):
             }
         return pools
 
+    def _max_context_tokens(self):
+        """The fleet's admission bound: the LARGEST context any single
+        ready replica can hold (a request only needs one replica that
+        fits it). None until a ready replica has reported healthz."""
+        caps = [h.last_stats.get("max_context_tokens")
+                for h in self.handles if h.state == "ready"]
+        caps = [int(c) for c in caps if c is not None]
+        return max(caps) if caps else None
+
+    def _kv_rollup(self):
+        """Fleet-wide paged-KV pool view, summed over the per-replica
+        healthz blocks the health loop last probed."""
+        blocks = [h.last_stats.get("kv_pages") for h in self.handles
+                  if isinstance(h.last_stats.get("kv_pages"), dict)]
+        enabled = [b for b in blocks if b.get("enabled")]
+        if not enabled:
+            return {"enabled": False}
+        total = sum(int(b.get("pages_total") or 0) for b in enabled)
+        free = sum(int(b.get("pages_free") or 0) for b in enabled)
+        return {
+            "enabled": True,
+            "pages_total": total,
+            "pages_free": free,
+            "occupancy": round((total - free) / max(1, total), 4),
+            "shared_pages": sum(int(b.get("shared_pages") or 0)
+                                for b in enabled),
+            "cow_pages": sum(int(b.get("cow_pages") or 0)
+                             for b in enabled),
+            "exhausted": sum(int(b.get("exhausted") or 0)
+                             for b in enabled),
+        }
+
     def _prefix_rollup(self):
         """Fleet-wide prefix-cache view, summed over the per-replica
         healthz blocks the health loop last probed."""
@@ -1436,6 +1484,8 @@ class ServingFleet(object):
             "fleet_generation": self.fleet_generation,
             "pools": self._pools(),
             "prefix_cache": self._prefix_rollup(),
+            "kv_pages": self._kv_rollup(),
+            "max_context_tokens": self._max_context_tokens(),
             # fleet tail latency (worst ready replica; null = no samples)
             "p99_ttft_ms": metrics.get("p99_ttft_ms"),
             "p99_itl_ms": metrics.get("p99_itl_ms"),

@@ -33,6 +33,7 @@ time, so a later split neither moves a pin nor invalidates captured
 arrays.
 """
 
+import hashlib
 import os
 import threading
 
@@ -273,3 +274,265 @@ class RadixPrefixCache(object):
                 "evicted_nodes": self._evicted_nodes,
                 "evicted_tokens": self._evicted_tokens,
             }
+
+
+# ---------------------------------------------------------------------------
+# Page-granular prefix index (the paged engine's zero-copy counterpart)
+# ---------------------------------------------------------------------------
+
+
+class _PageEntry(object):
+    __slots__ = ("pid", "key", "prev", "last_use")
+
+    def __init__(self, pid, key, prev, last_use):
+        self.pid = pid          # device page id (index-owned pool ref)
+        self.key = key
+        self.prev = prev        # parent chain key (eviction bookkeeping)
+        self.last_use = last_use
+
+
+class _TailEntry(object):
+    __slots__ = ("pid", "tokens", "last_use")
+
+    def __init__(self, pid, tokens, last_use):
+        self.pid = pid
+        self.tokens = tokens    # np.int32 [<page_tokens] valid prefix
+        self.last_use = last_use
+
+
+class PagedPrefixHandle(object):
+    """A pinned page-granular match: `pages` full device pages holding
+    the first len(pages)*page_tokens prompt tokens verbatim, plus an
+    optional `partial` (page_id, n_tokens) tail the engine privatizes
+    with one copy-on-write page copy. `length` is the total matched
+    token count. The handle holds one pool ref per referenced page
+    until release()."""
+
+    __slots__ = ("pages", "length", "partial", "_pool", "_released")
+
+    def __init__(self, pool, pages, length, partial):
+        self.pages = pages
+        self.length = length
+        self.partial = partial
+        self._pool = pool
+        self._released = False
+
+
+class PagedPrefixIndex(object):
+    """Prefix reuse at PAGE granularity over the paged engine's pool —
+    the zero-copy successor of the radix tree above (vLLM hash-chain
+    lineage). A FULL page of prompt tokens is keyed by the digest chain
+    of every page before it plus its own tokens, so a key identifies
+    both content and position; a hit points the new slot's block table
+    at the SAME device pages (PagedEngine.seed_pages) and no KV bytes
+    move. A partially-filled tail page is indexed with its token prefix
+    and shared via copy-on-write (the one copy a hit can cost).
+
+    Ownership: the index holds ONE pool ref per registered page, so
+    "eviction" is simply dropping that ref — a page a live slot still
+    reads survives until its last ref drains, which is what makes
+    eviction always safe (no pinned_nodes() dance needed). match()
+    additionally refs every returned page for the handle's lifetime so
+    an eviction between match and seed cannot free them.
+    """
+
+    MAX_TAILS_PER_CHAIN = 4   # bounded CoW candidates per chain point
+
+    def __init__(self, pool, max_pages=None):
+        self.pool = pool
+        self.page_tokens = pool.page_tokens
+        # default budget: the whole pool — the refcounts already keep
+        # live pages safe, and unreferenced cached pages are exactly
+        # what a KV cache is for
+        self.max_pages = int(max_pages) if max_pages else pool.usable_pages
+        if self.max_pages < 1:
+            raise ValueError("max_pages must be >= 1")
+        self._lock = threading.Lock()
+        self._full = {}       # chain key -> _PageEntry
+        self._tails = {}      # chain key -> [_TailEntry, ...]
+        self._clock = 0
+        self._evictions = 0
+        self._evicted_pages = 0
+
+    @classmethod
+    def from_env(cls, pool, default_mb=0):
+        """Budget from TPUFLOW_PREFIX_CACHE_MB (page-rounded); 0/unset
+        disables — the same opt-in contract as RadixPrefixCache."""
+        mb = float(os.environ.get("TPUFLOW_PREFIX_CACHE_MB", default_mb))
+        if mb <= 0:
+            return None
+        pages = max(1, int(mb * 1024 * 1024) // max(1, pool.page_bytes()))
+        return cls(pool, max_pages=min(pages, pool.usable_pages))
+
+    @staticmethod
+    def _chain(prev_key, tokens):
+        h = hashlib.sha1(prev_key)
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    # ---------- lookup ----------
+
+    def match(self, tokens):
+        """Longest page-aligned cached prefix of `tokens` (plus at most
+        one partial tail page): a pinned PagedPrefixHandle, or None."""
+        tokens = _as_tokens(tokens)
+        ptok = self.page_tokens
+        with self._lock:
+            self._clock += 1
+            key = b"root"
+            pages = []
+            n_full = tokens.size // ptok
+            for i in range(n_full):
+                page = tokens[i * ptok:(i + 1) * ptok]
+                key = self._chain(key, page)
+                entry = self._full.get(key)
+                if entry is None:
+                    break
+                entry.last_use = self._clock
+                pages.append(entry.pid)
+            partial = None
+            # a tail can only extend a FULLY matched page chain: tail
+            # entries hang off the chain key of everything before them
+            if len(pages) == n_full:
+                rem = tokens[n_full * ptok:]
+                if rem.size > 0:
+                    best, best_m = None, 0
+                    for t in self._tails.get(key, []):
+                        m = _common_prefix(t.tokens, rem)
+                        if m > best_m:
+                            best, best_m = t, m
+                    if best is not None:
+                        best.last_use = self._clock
+                        partial = (best.pid, best_m)
+            length = len(pages) * ptok + (partial[1] if partial else 0)
+            if length == 0:
+                return None
+            pinned = list(pages) + ([partial[0]] if partial else [])
+            self.pool.ref(pinned)
+            return PagedPrefixHandle(self.pool, list(pages), length,
+                                     partial)
+
+    def release(self, handle):
+        """Drop a match's pins. Idempotent per handle."""
+        if handle is None or handle._released:
+            return
+        handle._released = True
+        pinned = list(handle.pages)
+        if handle.partial is not None:
+            pinned.append(handle.partial[0])
+        self.pool.unref(pinned)
+
+    # ---------- insert / evict ----------
+
+    def insert_pages(self, tokens, full_pids, tail_pid=None):
+        """Register a finished prompt's pages: full_pids cover the
+        len(tokens) // page_tokens complete pages IN ORDER, tail_pid
+        (optional) holds the remainder. The index refs every NEWLY
+        registered page (dedup: an already-cached chain point keeps its
+        existing page — the new slot's copy stays private and drains
+        with the slot)."""
+        tokens = _as_tokens(tokens)
+        ptok = self.page_tokens
+        n_full = tokens.size // ptok
+        if len(full_pids) < n_full:
+            raise ValueError("need %d full pages, got %d"
+                             % (n_full, len(full_pids)))
+        with self._lock:
+            self._clock += 1
+            key = b"root"
+            for i in range(n_full):
+                page = tokens[i * ptok:(i + 1) * ptok]
+                prev = key
+                key = self._chain(key, page)
+                entry = self._full.get(key)
+                if entry is not None:
+                    entry.last_use = self._clock
+                    continue
+                pid = int(full_pids[i])
+                self.pool.ref([pid])
+                self._full[key] = _PageEntry(pid, key, prev, self._clock)
+            rem = tokens[n_full * ptok:]
+            if rem.size and tail_pid is not None:
+                bucket = self._tails.setdefault(key, [])
+                covered = any(
+                    t.tokens.size >= rem.size
+                    and _common_prefix(t.tokens, rem) == rem.size
+                    for t in bucket)
+                if not covered:
+                    self.pool.ref([int(tail_pid)])
+                    bucket.append(_TailEntry(int(tail_pid), rem.copy(),
+                                             self._clock))
+                    if len(bucket) > self.MAX_TAILS_PER_CHAIN:
+                        bucket.sort(key=lambda t: t.last_use)
+                        old = bucket.pop(0)
+                        self.pool.unref([old.pid])
+            self._evict_locked()
+
+    # scheduler duck-typing: the radix cache's insert(tokens, kv) has no
+    # page-sharing analogue — the scheduler calls insert_pages instead
+
+    def _evict_locked(self):
+        over = self._registered_locked() - self.max_pages
+        if over <= 0:
+            return
+        victims = sorted(
+            [("full", k, e) for k, e in self._full.items()]
+            + [("tail", k, t) for k, ts in self._tails.items()
+               for t in ts],
+            key=lambda item: item[2].last_use)
+        freed = 0
+        for kind, key, entry in victims:
+            if freed >= over:
+                break
+            if kind == "full":
+                del self._full[key]
+            else:
+                bucket = self._tails.get(key, [])
+                if entry in bucket:
+                    bucket.remove(entry)
+                    if not bucket:
+                        del self._tails[key]
+            self.pool.unref([entry.pid])
+            freed += 1
+        if freed:
+            self._evictions += 1
+            self._evicted_pages += freed
+            telemetry.event("serve.prefix.evict", data={
+                "nodes": freed,
+                "tokens": freed * self.page_tokens,
+                "bytes": freed * self.pool.page_bytes()})
+
+    def _registered_locked(self):
+        return len(self._full) + sum(len(ts)
+                                     for ts in self._tails.values())
+
+    def clear(self):
+        """Drop every registered page ref (drain/shutdown teardown; a
+        leak assert after clear() expects the pool fully free)."""
+        with self._lock:
+            entries = list(self._full.values()) + [
+                t for ts in self._tails.values() for t in ts]
+            self._full.clear()
+            self._tails.clear()
+        self.pool.unref([e.pid for e in entries])
+
+    # ---------- introspection ----------
+
+    def registered_pages(self):
+        with self._lock:
+            return self._registered_locked()
+
+    def stats(self):
+        with self._lock:
+            full = len(self._full)
+            tails = sum(len(ts) for ts in self._tails.values())
+            tail_tokens = sum(int(t.tokens.size)
+                              for ts in self._tails.values() for t in ts)
+        return {
+            "pages": full + tails,
+            "cached_tokens": full * self.page_tokens + tail_tokens,
+            "cached_bytes": (full + tails) * self.pool.page_bytes(),
+            "max_bytes": self.max_pages * self.pool.page_bytes(),
+            "evictions": self._evictions,
+            "evicted_pages": self._evicted_pages,
+        }

@@ -92,7 +92,9 @@ def _build_synthetic(args):
                         max_seq_len=args.max_seq_len,
                         prefill_chunk=args.prefill_chunk,
                         mesh_spec=args.mesh or None,
-                        attn_impl=args.attn_impl)
+                        attn_impl=args.attn_impl, paged=args.paged,
+                        page_tokens=args.page_tokens,
+                        spec_k=args.spec_k)
 
 
 def _build_from_checkpoint(args):
@@ -109,7 +111,9 @@ def _build_from_checkpoint(args):
                         max_seq_len=args.max_seq_len,
                         prefill_chunk=args.prefill_chunk,
                         mesh_spec=args.mesh or None,
-                        attn_impl=args.attn_impl)
+                        attn_impl=args.attn_impl, paged=args.paged,
+                        page_tokens=args.page_tokens,
+                        spec_k=args.spec_k)
 
 
 def _init_replica_telemetry(flow_name, run_id, index):
@@ -166,6 +170,9 @@ def build_parser():
     p.add_argument("--role", default="unified",
                    choices=("unified", "prefill", "decode"))
     p.add_argument("--prefix-cache-mb", type=int, default=None)
+    p.add_argument("--paged", action="store_true")
+    p.add_argument("--page-tokens", type=int, default=None)
+    p.add_argument("--spec-k", type=int, default=None)
     return p
 
 
@@ -206,13 +213,9 @@ def main(argv=None):
     if delay_ms > 0:
         _add_step_delay(engine, delay_ms / 1000.0)
 
-    from .prefix_cache import RadixPrefixCache
+    from ..cmd.serve import build_prefix_cache
 
-    if args.prefix_cache_mb is not None:
-        cache = (RadixPrefixCache(args.prefix_cache_mb << 20)
-                 if args.prefix_cache_mb > 0 else None)
-    else:
-        cache = RadixPrefixCache.from_env()
+    cache = build_prefix_cache(engine, args.prefix_cache_mb)
     scheduler = Scheduler(engine, max_queue=args.max_queue,
                           prefix_cache=cache)
     server = ServingServer(scheduler, host=args.host, port=args.port,

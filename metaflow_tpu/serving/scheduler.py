@@ -35,6 +35,7 @@ from collections import deque
 
 from .. import telemetry
 from .. import tracing
+from .paged import PageExhaustedError
 
 _request_ids = itertools.count(1)
 
@@ -54,6 +55,13 @@ class QueueFullError(Exception):
 
 class DrainingError(Exception):
     """The scheduler is draining (SIGTERM) and admits no new requests."""
+
+
+class CapacityError(Exception):
+    """The request can NEVER be served by this engine (prompt +
+    max_new_tokens exceeds max_seq_len or the whole page pool) — a
+    permanent 413 at admission time, not backpressure. Queueing it
+    would only fail later, mid-decode or at admit."""
 
 
 class Request(object):
@@ -163,10 +171,16 @@ class Scheduler(object):
         self._thread = None
         self.iteration = 0
         self._prefill_rr = 0      # round-robin cursor over prefill slots
+        # paged-engine plumbing (duck-typed: the slot engine has none of
+        # these surfaces and every branch degrades to the old behavior)
+        self._paged = hasattr(engine, "kv_stats")
+        self.kv_exhausted = 0      # admission stalls on page exhaustion
+        self._exhausted_blocked = False
         # stats
         self.served = 0
         self.cancelled_count = 0
         self.decode_steps = 0
+        self.peak_in_flight = 0
         self._occupancy_sum = 0.0
         # rolling latency windows for /v1/stats and /healthz percentiles:
         # bounded so a long-lived server reports RECENT tail latency, not
@@ -178,10 +192,20 @@ class Scheduler(object):
     # ---------- intake ----------
 
     def submit(self, request):
-        """Enqueue a request; raises QueueFullError (backpressure) or
-        DrainingError (shutdown in progress)."""
+        """Enqueue a request; raises QueueFullError (backpressure),
+        DrainingError (shutdown in progress), or CapacityError (the
+        request can never fit this engine — reject NOW instead of
+        failing after it reaches a slot)."""
         import queue as _q
 
+        fits = getattr(self.engine, "fits", None)
+        if fits is not None and not fits(len(request.tokens),
+                                         request.max_new_tokens):
+            raise CapacityError(
+                "prompt (%d) + max_new_tokens (%d) can never fit this "
+                "engine (max context %d tokens)"
+                % (len(request.tokens), request.max_new_tokens,
+                   self.max_context_tokens()))
         with self._cond:
             if self._draining or self._stopped:
                 raise DrainingError("scheduler is draining")
@@ -234,7 +258,16 @@ class Scheduler(object):
             # sentinel into the stream
             return
         if req.slot is not None:
-            self.engine.release(req.slot)
+            if self._paged:
+                before = self.engine.pool.free_pages()
+                self.engine.release(req.slot)
+                freed = self.engine.pool.free_pages() - before
+                telemetry.event("serve.kv.page_free", data=self._tdata(
+                    req, {"request_id": req.id, "slot": req.slot,
+                          "pages": int(freed),
+                          "free_pages": self.engine.pool.free_pages()}))
+            else:
+                self.engine.release(req.slot)
             del self._slots[req.slot]
         if req._prefix_handle is not None:
             # every terminal path drops the pin — including cancel /
@@ -304,14 +337,44 @@ class Scheduler(object):
                 self._finish(req, "cancelled" if req.cancelled
                              else "deadline")
 
+    def max_context_tokens(self):
+        """The largest prompt+max_new this engine can ever hold."""
+        mct = getattr(self.engine, "max_context_tokens", None)
+        return int(mct() if mct is not None else self.engine.max_seq_len)
+
+    def _kv_exhausted(self, req):
+        """Admission blocked on page exhaustion: head-of-line waits
+        (FIFO order is preserved — backpressure, not rejection). The
+        event fires once per blocked EPISODE, not per spin."""
+        if self._exhausted_blocked:
+            return
+        self._exhausted_blocked = True
+        self.kv_exhausted += 1
+        telemetry.event("serve.kv.exhausted", data=self._tdata(req, {
+            "request_id": req.id,
+            "needed_pages": self.engine._pages_needed(
+                len(req.tokens), req.max_new_tokens),
+            "free_pages": self.engine.pool.free_pages(),
+            "queue_depth": len(self._queue)}))
+
     def _admit(self):
         free = self.engine.free_slots()
         admitted = 0
+        can_admit = getattr(self.engine, "can_admit", None)
         for slot in free:
             req = None
             while req is None:
                 with self._cond:
                     if not self._queue:
+                        return admitted
+                    head = self._queue[0]
+                    blocked = (
+                        can_admit is not None
+                        and not head.cancelled
+                        and not can_admit(len(head.tokens),
+                                          head.max_new_tokens))
+                    if blocked:
+                        self._kv_exhausted(head)
                         return admitted
                     req = self._queue.popleft()
                 # the reap->admit race: a request cancelled (or expired)
@@ -339,6 +402,14 @@ class Scheduler(object):
                         slot, req.tokens, req.max_new_tokens,
                         temperature=req.temperature, top_k=req.top_k,
                         top_p=req.top_p, rng=req.rng)
+            except PageExhaustedError:
+                # backstop: can_admit raced a concurrent alloc (e.g. a
+                # prefix-index insert). Requeue at the HEAD — this is
+                # backpressure, FIFO order holds, next tick retries.
+                with self._cond:
+                    self._queue.appendleft(req)
+                self._kv_exhausted(req)
+                return admitted
             except ValueError as ex:
                 # oversized request: reject it, keep serving
                 req.reason = "rejected"
@@ -360,6 +431,15 @@ class Scheduler(object):
             req.admit_iteration = self.iteration
             self._slots[slot] = req
             admitted += 1
+            self.peak_in_flight = max(self.peak_in_flight,
+                                      len(self._slots))
+            if self._paged:
+                # a successful admit ends any exhaustion episode
+                self._exhausted_blocked = False
+                telemetry.event("serve.kv.page_alloc", data=self._tdata(
+                    req, {"request_id": req.id, "slot": slot,
+                          "pages": int(self.engine._n_pages[slot]),
+                          "free_pages": self.engine.pool.free_pages()}))
             telemetry.event("serve.request.prefill", data=self._tdata(req, {
                 "request_id": req.id, "slot": slot,
                 "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)}))
@@ -383,7 +463,18 @@ class Scheduler(object):
                 "request_id": req.id,
                 "prompt_tokens": len(req.tokens)}))
             return
-        self.engine.seed_prefix(slot, handle.kv())
+        if hasattr(handle, "pages"):
+            # paged engine + paged index: ZERO-COPY attach — the slot's
+            # block table repoints at the shared pages (one device copy
+            # only for a partially-filled tail page, CoW)
+            self.engine.seed_pages(slot, handle)
+            telemetry.event("serve.kv.page_shared", data=self._tdata(
+                req, {"request_id": req.id, "slot": slot,
+                      "pages": len(handle.pages)
+                      + (1 if handle.partial is not None else 0),
+                      "tokens": handle.length}))
+        else:
+            self.engine.seed_prefix(slot, handle.kv())
         req._prefix_handle = handle
         self.prefix_hits += 1
         self.prefix_hit_tokens += handle.length
@@ -428,9 +519,22 @@ class Scheduler(object):
         drop the request's pin, and either enter decode or (prefill-only
         mode) park the KV handoff and finish."""
         kv = None
-        if self.prefix_cache is not None or req.prefill_only:
+        paged_insert = (self.prefix_cache is not None
+                        and hasattr(self.prefix_cache, "insert_pages")
+                        and hasattr(self.engine, "slot_prefix_pages"))
+        if req.prefill_only or (self.prefix_cache is not None
+                                and not paged_insert):
             kv = self.engine.extract_kv(slot, len(req.tokens))
-        if self.prefix_cache is not None:
+        if paged_insert:
+            # paged path: register the slot's OWN pages with the index
+            # (it takes its own refs) — no KV bytes move
+            full, tail = self.engine.slot_prefix_pages(
+                slot, len(req.tokens))
+            self.prefix_cache.insert_pages(req.tokens, full, tail)
+            if req._prefix_handle is not None:
+                self.prefix_cache.release(req._prefix_handle)
+                req._prefix_handle = None
+        elif self.prefix_cache is not None:
             self.prefix_cache.insert(req.tokens, kv)
             if req._prefix_handle is not None:
                 self.prefix_cache.release(req._prefix_handle)
@@ -466,9 +570,25 @@ class Scheduler(object):
         self.decode_steps += 1
         self._occupancy_sum += self.engine.occupancy()
         telemetry.gauge("serve.batch_occupancy", self.engine.occupancy())
-        for slot, token in tokens.items():
+        if self._paged:
+            ks = self.engine.kv_stats()
+            telemetry.gauge("serve.kv.page_occupancy", ks["occupancy"])
+            telemetry.gauge("serve.kv.cow_pages", ks["cow_pages"])
+            ss = self.engine.spec_stats()
+            if ss["enabled"]:
+                telemetry.gauge("serve.spec.accept_rate",
+                                ss["accept_rate"])
+        for slot, toks in tokens.items():
             req = self._slots.get(slot)
-            if req is not None and req.state == "decode":
+            if req is None:
+                continue
+            # speculative decode emits up to spec_k+1 tokens per slot
+            # per step; eos/length inside the burst stops delivery of
+            # the remainder (the engine over-generated, the stream must
+            # not)
+            for token in (toks if isinstance(toks, list) else [toks]):
+                if req.state != "decode":
+                    break
                 self._deliver(req, token)
         return True
 
@@ -575,8 +695,22 @@ class Scheduler(object):
             "p99_ttft_ms": _pctl(list(self._ttft_window), 0.99),
             "p50_itl_ms": _pctl(list(self._itl_window), 0.50),
             "p99_itl_ms": _pctl(list(self._itl_window), 0.99),
+            "peak_in_flight": self.peak_in_flight,
+            "max_context_tokens": self.max_context_tokens(),
             "prefix_cache": self.prefix_stats(),
+            "kv_pages": self.kv_pages_stats(),
+            "speculative": (self.engine.spec_stats() if self._paged
+                            else {"enabled": False}),
         }
+
+    def kv_pages_stats(self):
+        """Page-pool health for /v1/stats and /healthz; {"enabled":
+        False} on the slot engine so the schema stays total."""
+        if not self._paged:
+            return {"enabled": False}
+        out = self.engine.kv_stats()
+        out["exhausted"] = self.kv_exhausted
+        return out
 
     def prefix_stats(self):
         """Prefix-cache effectiveness for /v1/stats and /healthz.

@@ -34,7 +34,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import tracing
 from .disagg import decode_handoff, encode_handoff
-from .scheduler import DrainingError, QueueFullError, Request
+from .scheduler import (CapacityError, DrainingError, QueueFullError,
+                        Request)
 
 STREAM_TIMEOUT_S = 300.0
 
@@ -122,6 +123,7 @@ class _Handler(BaseHTTPRequestHandler):
             # Schema pinned in tests/schema_validate.py::HEALTHZ_SCHEMA.
             stats = self.scheduler.stats()
             prefix = stats["prefix_cache"]
+            kv = stats["kv_pages"]
             self._json(200, {
                 "ok": True,
                 "draining": self.server.draining or stats["draining"],
@@ -130,6 +132,20 @@ class _Handler(BaseHTTPRequestHandler):
                 "in_flight": stats["in_flight"],
                 "slots": stats["slots"],
                 "occupancy": stats["occupancy"],
+                # admission capacity: the fleet router sheds requests
+                # that can never fit ANY ready replica against this
+                "max_context_tokens": stats["max_context_tokens"],
+                # paged-KV pool health ({"enabled": False} on the slot
+                # engine — the schema stays total either way)
+                "kv_pages": ({
+                    "enabled": True,
+                    "occupancy": kv["occupancy"],
+                    "pages_free": kv["pages_free"],
+                    "pages_total": kv["pages_total"],
+                    "shared_pages": kv["shared_pages"],
+                    "cow_pages": kv["cow_pages"],
+                    "exhausted": kv["exhausted"],
+                } if kv["enabled"] else {"enabled": False}),
                 # rolling tail latency: the SLO monitor polls this
                 "p50_ttft_ms": stats["p50_ttft_ms"],
                 "p99_ttft_ms": stats["p99_ttft_ms"],
@@ -178,6 +194,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             self.scheduler.submit(req)
             return True
+        except CapacityError as ex:
+            # the request can NEVER fit this engine: a permanent 413,
+            # not backpressure — but still carry Retry-After so generic
+            # clients that only look at the header back off sanely
+            self._json(413, {"error": str(ex)},
+                       headers=self._shed_headers(draining=False))
+            return False
         except QueueFullError as ex:
             self._json(429, {"error": str(ex)},
                        headers=self._shed_headers(draining=False))

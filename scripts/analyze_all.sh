@@ -72,7 +72,8 @@ if [ "$#" -eq 0 ]; then
                     sanitize_gang_flow.py data_resume_flow.py \
                     fleet_serve_flow.py watch_slo_flow.py \
                     zero_train_flow.py prefix_serve_flow.py \
-                    hang_chaos_flow.py mpmd_pipeline_flow.py; do
+                    hang_chaos_flow.py mpmd_pipeline_flow.py \
+                    paged_serve_flow.py; do
         if [ ! -f "$ROOT/tests/flows/$required" ]; then
             echo "analyze_all: required flow missing from sweep: $required" >&2
             fail=1

@@ -391,6 +391,30 @@ SERVING_EVENT_DATA_SCHEMAS = {
          "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason"),
     ),
+    # paged-KV pool (serving/paged.py + scheduler): page reservation per
+    # admit, release per terminal path, zero-copy prefix attach, and the
+    # once-per-episode exhaustion backpressure signal
+    "serve.kv.page_alloc": _obj(
+        {"request_id": _STR, "slot": _INT, "pages": _INT,
+         "free_pages": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "slot", "pages", "free_pages"),
+    ),
+    "serve.kv.page_free": _obj(
+        {"request_id": _STR, "slot": _INT, "pages": _INT,
+         "free_pages": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "slot", "pages", "free_pages"),
+    ),
+    "serve.kv.page_shared": _obj(
+        {"request_id": _STR, "slot": _INT, "pages": _INT, "tokens": _INT,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "slot", "pages", "tokens"),
+    ),
+    "serve.kv.exhausted": _obj(
+        {"request_id": _STR, "needed_pages": _INT, "free_pages": _INT,
+         "queue_depth": _INT, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "needed_pages", "free_pages",
+                  "queue_depth"),
+    ),
 }
 
 # non-event serving records: gauges + timers the bench/metrics consume
@@ -399,6 +423,11 @@ SERVING_METRIC_NAMES = {
     "serve.batch_occupancy": "gauge",
     "serve.decode_step": "timer",
     "serve.prefill_chunk": "timer",
+    # paged-KV pool health + speculative-decoding acceptance, emitted
+    # once per decode step by the scheduler on a paged engine
+    "serve.kv.page_occupancy": "gauge",
+    "serve.kv.cow_pages": "gauge",
+    "serve.spec.accept_rate": "gauge",
 }
 
 
@@ -836,7 +865,7 @@ def validate_elastic_record(record):
 # ---------------------------------------------------------------------------
 
 FLEET_SHED_REASONS = ["queue_full", "deadline", "draining", "no_replica",
-                      "replica_lost", "failover_exhausted"]
+                      "replica_lost", "failover_exhausted", "capacity"]
 
 FLEET_EVENT_DATA_SCHEMAS = {
     "fleet.replica.spawn": _obj(
@@ -942,6 +971,21 @@ PREFIX_CACHE_HEALTH_SCHEMA = _obj(
     required=("enabled", "hit_rate", "cached_bytes", "evictions"),
 )
 
+# paged-KV pool health, embedded in both healthz tiers: {"enabled":
+# False} on a slot-engine replica so the schema stays total either way
+KV_PAGES_HEALTH_SCHEMA = _obj(
+    {
+        "enabled": _BOOL,
+        "occupancy": _NUM,
+        "pages_free": _INT,
+        "pages_total": _INT,
+        "shared_pages": _INT,
+        "cow_pages": _INT,
+        "exhausted": _INT,
+    },
+    required=("enabled",),
+)
+
 HEALTHZ_SCHEMA = _obj(
     {
         "ok": _BOOL,
@@ -952,6 +996,10 @@ HEALTHZ_SCHEMA = _obj(
         "in_flight": _INT,
         "slots": _INT,
         "occupancy": _NUM,
+        # the admission capacity bound: the fleet router sheds requests
+        # that can never fit any ready replica against this
+        "max_context_tokens": _INT,
+        "kv_pages": KV_PAGES_HEALTH_SCHEMA,
         # rolling-window tail latency (scheduler.stats): what the fleet
         # SLO monitor polls; 0.0 until the window has samples
         "p50_ttft_ms": _NUM,
@@ -961,7 +1009,8 @@ HEALTHZ_SCHEMA = _obj(
         "prefix_cache": PREFIX_CACHE_HEALTH_SCHEMA,
     },
     required=("ok", "draining", "role", "queue_depth", "in_flight",
-              "slots", "occupancy", "p50_ttft_ms", "p99_ttft_ms",
+              "slots", "occupancy", "max_context_tokens", "kv_pages",
+              "p50_ttft_ms", "p99_ttft_ms",
               "p50_itl_ms", "p99_itl_ms", "prefix_cache"),
 )
 
@@ -1028,6 +1077,10 @@ FLEET_HEALTHZ_SCHEMA = _obj(
         ),
         # fleet-wide prefix-cache rollup over ready replicas
         "prefix_cache": PREFIX_CACHE_HEALTH_SCHEMA,
+        # fleet-wide paged-KV rollup + the admission bound the router
+        # sheds against (max over ready replicas; null until one reports)
+        "kv_pages": KV_PAGES_HEALTH_SCHEMA,
+        "max_context_tokens": {"type": ["integer", "null"]},
         "p99_ttft_ms": {"type": ["number", "null"]},
         "p99_itl_ms": {"type": ["number", "null"]},
         "slo": _obj(
@@ -1036,7 +1089,8 @@ FLEET_HEALTHZ_SCHEMA = _obj(
         ),
     },
     required=("ok", "draining", "replicas", "ready", "inflight",
-              "fleet_generation", "pools", "prefix_cache",
+              "fleet_generation", "pools", "prefix_cache", "kv_pages",
+              "max_context_tokens",
               "p99_ttft_ms", "p99_itl_ms", "slo"),
 )
 
