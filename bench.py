@@ -529,10 +529,6 @@ def bench_serve():
             jax.block_until_ready(out)
         return time.perf_counter() - t0
 
-    lockstep_pass()  # warm every group's prompt bucket
-    lockstep_dt = min(lockstep_pass() for _ in range(2))
-    lockstep_tps = useful_tokens / lockstep_dt
-
     # ---- continuous batching: same trace through the slot engine ----
     # ONE engine: its three jitted programs compile once and serve every
     # pass (slots drain back to free between passes)
@@ -549,11 +545,27 @@ def bench_serve():
         sched.run_until_idle(max_iterations=100_000)
         return time.perf_counter() - t0, reqs, sched
 
-    engine_pass()  # warm the three compiled programs
-    runs = [engine_pass() for _ in range(2)]
-    serve_dt, reqs, sched = min(runs, key=lambda r: r[0])
+    # Both sides warm, then INTERLEAVED reps with the MEDIAN per side:
+    # alternating passes expose both paths to the same slice of host
+    # drift (thermal, page cache, background load), and the median is
+    # robust to a one-off slow rep in either direction — min-of-N would
+    # reward whichever side got the single luckiest pass. Methodology is
+    # documented in BASELINE.md; the 1.5x gate assumes it.
+    reps = max(3, int(os.environ.get("BENCH_SERVE_REPS", "3")))
+    lockstep_pass()  # warm every group's prompt bucket
+    engine_pass()    # warm the three compiled programs
+    lockstep_dts, engine_runs = [], []
+    for _ in range(reps):
+        lockstep_dts.append(lockstep_pass())
+        engine_runs.append(engine_pass())
+    lockstep_dt = statistics.median(lockstep_dts)
+    lockstep_tps = useful_tokens / lockstep_dt
+    engine_runs.sort(key=lambda r: r[0])
+    serve_dt, reqs, sched = engine_runs[len(engine_runs) // 2]
+    for dt_i, reqs_i, _s in engine_runs:
+        gen_i = sum(len(r.generated) for r in reqs_i)
+        assert gen_i == useful_tokens, (gen_i, useful_tokens)
     generated = sum(len(r.generated) for r in reqs)
-    assert generated == useful_tokens, (generated, useful_tokens)
     serve_tps = generated / serve_dt
 
     ttft = [(r.t_first - r.t_submit) * 1000 for r in reqs]
@@ -570,7 +582,9 @@ def bench_serve():
     # a live flight recorder on BOTH sides so the delta isolates what
     # TPUFLOW_TRACE_REQUESTS=0 turns off (traceparent derivation + per-
     # event trace/span stamping), not telemetry I/O itself. Interleaved
-    # pairs so host drift cancels; min-of-3 each side. ----
+    # pairs so host drift cancels; MEDIAN-of-3 each side (min-of-N lets
+    # one lucky traced pass mask real overhead, or one lucky plain pass
+    # inflate it — the <=2% gate flaked on exactly that). ----
     import tempfile
 
     from metaflow_tpu import telemetry, tracing
@@ -598,13 +612,14 @@ def bench_serve():
         telemetry.init_recorder(fds, "bench", "_serve", "bench")
         try:
             plain_dts, traced_dts = [], []
-            for _ in range(3):
+            for _ in range(reps):
                 plain_dts.append(timed_pass(False))
                 traced_dts.append(timed_pass(True))
         finally:
             telemetry.close_recorder()
         records = telemetry.read_run_records(fds, "bench")
-    plain_dt, traced_dt = min(plain_dts), min(traced_dts)
+    plain_dt = statistics.median(plain_dts)
+    traced_dt = statistics.median(traced_dts)
     tracing_overhead_pct = max(
         0.0, (traced_dt - plain_dt) / plain_dt * 100) if plain_dt else 0.0
 
@@ -671,7 +686,8 @@ def bench_serve():
     return {
         "metric": "serve_tokens_per_s",
         "value": round(serve_tps, 1),
-        "unit": "useful generated tokens/s (continuous batching)",
+        "unit": "useful generated tokens/s (continuous batching; "
+                "median of %d interleaved reps vs lockstep)" % reps,
         "vs_baseline": _vs_baseline(serve_tps),
         "extra": {
             "backend": jax.default_backend(),
@@ -695,8 +711,9 @@ def bench_serve():
              "unit": "mean fraction of slots active per decode step"},
             {"metric": "serve_tracing_overhead_pct",
              "value": round(tracing_overhead_pct, 2),
-             "unit": "% tok/s cost of request tracing vs "
-                     "TPUFLOW_TRACE_REQUESTS=0 (gate: <= 2.0)"},
+             "unit": "%% tok/s cost of request tracing vs "
+                     "TPUFLOW_TRACE_REQUESTS=0 (median of %d "
+                     "interleaved reps; gate: <= 2.0)" % reps},
             {"metric": "serve_ttft_decomp_err_pct",
              "value": round(decomp_err_pct, 2),
              "unit": "median |TTFT decomposition sum - measured| % "
@@ -1328,7 +1345,16 @@ def bench_elastic_goodput():
     number of useful train steps on the exact same token order (the
     flow's `end` step asserts it); only the wall-clock differs. Grow-back
     is disabled for the measurement so each run's step count is the
-    clean numerator."""
+    clean numerator.
+
+    Both runs' telemetry additionally feeds the goodput ledger
+    (metaflow_tpu/goodput.py), derived here BEFORE each run's tempdir is
+    destroyed: both ledgers must reconcile (attributed >= 95% of
+    observed chip-time), the elastic run must book restore_replay (the
+    scheduled kill forces a checkpoint restore), and the fixed run must
+    book capacity_wait (it cannot resize, so the scripted hole parks it
+    at delay_s x world chip-seconds a tick — the elastic run instead
+    shrinks through the hole, which is the whole point)."""
     import subprocess
     import tempfile
 
@@ -1376,11 +1402,43 @@ def bench_elastic_goodput():
                 raise SystemExit(
                     "elastic bench flow failed (resize=%s):\n%s"
                     % (resize, out[-2000:]))
-            return steps / wall, wall
+            # derive the goodput ledger NOW — the tempdir (and with it
+            # the run's _telemetry/) is gone once this block exits
+            from metaflow_tpu import goodput
+            from metaflow_tpu.datastore import FlowDataStore, LocalStorage
 
-    elastic_goodput, elastic_wall = run_once(True)
-    fixed_goodput, fixed_wall = run_once(False)
+            fds = FlowDataStore("ElasticTrainFlow", LocalStorage,
+                                ds_root=root)
+            run_ids = sorted(fds.list_runs())
+            if not run_ids:
+                raise SystemExit(
+                    "elastic bench flow left no runs in %s" % root)
+            ledger = goodput.derive_run_ledger(fds, run_ids[-1])
+            return steps / wall, wall, ledger
+
+    elastic_goodput, elastic_wall, ledger = run_once(True)
+    fixed_goodput, fixed_wall, fixed_ledger = run_once(False)
     ratio = elastic_goodput / fixed_goodput
+
+    # chip-second accounting gates: every kill in the schedule must be
+    # visible in the ledgers, and each ledger must explain its run
+    cats = ledger["categories"]
+    fixed_cats = fixed_ledger["categories"]
+    for label, led in (("elastic", ledger), ("fixed", fixed_ledger)):
+        if not led["reconciled"]:
+            raise SystemExit(
+                "%s goodput ledger failed reconciliation: coverage "
+                "%.3f < %.3f (unattributed %.1fs of %.1fs observed)"
+                % (label, led["coverage"], 1.0 - led["tolerance"],
+                   led["unattributed_chip_s"], led["observed_chip_s"]))
+    if cats["restore_replay"] <= 0:
+        raise SystemExit(
+            "kill at step %d produced no restore_replay chip-time: %r"
+            % (kill_step, cats))
+    if fixed_cats["capacity_wait"] <= 0:
+        raise SystemExit(
+            "capacity hole (%gs) parked the fixed-size gang but booked "
+            "no capacity_wait chip-time: %r" % (hole_s, fixed_cats))
     return {
         "metric": "elastic_goodput_ratio",
         "value": round(ratio, 2),
@@ -1395,6 +1453,8 @@ def bench_elastic_goodput():
             "capacity_hole_s": hole_s,
             "elastic_wall_s": round(elastic_wall, 2),
             "fixed_wall_s": round(fixed_wall, 2),
+            "ledger_dominant_loss": ledger["dominant_loss"],
+            "ledger_goodput_frac": ledger["goodput_frac"],
         },
         "submetrics": [
             {"metric": "elastic_goodput_steps_per_s",
@@ -1404,6 +1464,19 @@ def bench_elastic_goodput():
              "value": round(fixed_goodput, 3),
              "unit": "useful train steps/s (park until capacity "
                      "returns)"},
+            {"metric": "elastic_ledger_coverage",
+             "value": round(min(ledger["coverage"],
+                                fixed_ledger["coverage"]), 4),
+             "unit": "attributed / observed chip-seconds, worse of the "
+                     "two runs' goodput ledgers (gate: >= 0.95)"},
+            {"metric": "elastic_ledger_restore_replay_s",
+             "value": round(cats["restore_replay"], 3),
+             "unit": "chip-seconds restoring + replaying after the "
+                     "scheduled kill, elastic run (gate: > 0)"},
+            {"metric": "fixed_ledger_capacity_wait_s",
+             "value": round(fixed_cats["capacity_wait"], 3),
+             "unit": "delay_s x world chip-seconds the fixed-size gang "
+                     "parked on the scripted hole (gate: > 0)"},
         ],
     }
 
@@ -1747,10 +1820,28 @@ def bench_telemetry_overhead():
                     instr_dts.append(dt)
                 instr = min(instr_dts)
                 wrapped.telemetry.close()
-                records = len(telemetry.read_run_records(fds, "bench"))
+                recs = telemetry.read_run_records(fds, "bench")
+                records = len(recs)
                 summary = wrapped.telemetry.report()
             finally:
                 telemetry.close_recorder()
+
+    # goodput accounting rides the same records: derive the ledger +
+    # render its OpenMetrics exposition and charge that analysis cost
+    # against the instrumented run it describes (gate: <= 2%). This is
+    # the run-scope exporter's per-scrape work, measured off-loop — the
+    # per-step cost of goodput.interval emission is already inside
+    # `instr` above.
+    from metaflow_tpu import goodput
+
+    t0 = time.perf_counter()
+    ledger = goodput.derive_ledger(recs, run_id="bench")
+    exposition = goodput.render_openmetrics(
+        goodput.ledger_metric_families(ledger))
+    ledger_dt = time.perf_counter() - t0
+    assert exposition.endswith("# EOF\n")
+    timed_s = instr * steps * reps
+    ledger_pct = ledger_dt / timed_s * 100 if timed_s > 0 else 0.0
 
     overhead_pct = (instr - plain) / plain * 100 if plain > 0 else 0.0
     return {
@@ -1769,7 +1860,20 @@ def bench_telemetry_overhead():
             "batch": batch,
             "seq": seq,
             "instrumented_summary": summary,
+            "ledger_categories": {
+                k: v for k, v in ledger["categories"].items() if v > 0},
         },
+        "submetrics": [
+            {"metric": "goodput_ledger_export_overhead_pct",
+             "value": round(ledger_pct, 2),
+             "unit": "% of instrumented train time to derive the "
+                     "goodput ledger + render OpenMetrics (gate: <= "
+                     "2.0)"},
+            {"metric": "goodput_ledger_derive_ms",
+             "value": round(ledger_dt * 1000, 3),
+             "unit": "ms per ledger derivation + exposition render "
+                     "(one run-scope /metrics scrape)"},
+        ],
     }
 
 

@@ -406,6 +406,41 @@ def metrics(flow_run, run_id, datastore, datastore_root, as_json,
                  echo=click.echo)
 
 
+@main.command(
+    help="Chip-second accounting for a run: `goodput FLOW/RUN_ID`. "
+         "Derives the goodput ledger from persisted telemetry — every "
+         "chip-second bucketed into the pinned taxonomy (productive "
+         "step, compile, input/transfer stall, checkpoint, restore "
+         "replay, capacity wait, serve prefill/decode/idle) — "
+         "reconciles it against observed chip-time, and names the "
+         "dominant loss. Exits non-zero when the ledger fails to "
+         "reconcile within tolerance.")
+@click.argument("flow_run")
+@click.argument("run_id", required=False)
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]),
+              help="Storage backend (default: configured default).")
+@click.option("--datastore-root", default=None,
+              help="Datastore root override.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the full ledger document as JSON.")
+@click.option("--openmetrics", is_flag=True,
+              help="Emit the run-scope OpenMetrics text exposition.")
+@click.option("--persist", is_flag=True,
+              help="Persist the ledger to _telemetry/goodput/.")
+def goodput(flow_run, run_id, datastore, datastore_root, as_json,
+            openmetrics, persist):
+    from .cmd.goodput import show_goodput
+
+    fds, run_id = _resolve_run(flow_run, run_id, datastore,
+                               datastore_root)
+    rc = show_goodput(fds, run_id, as_json=as_json,
+                      openmetrics=openmetrics, persist=persist,
+                      echo=click.echo)
+    if rc:
+        raise SystemExit(rc)
+
+
 def _resolve_run(flow_run, run_id, datastore, datastore_root):
     """FLOW/RUN_ID (or FLOW RUN_ID) + backend flags -> (fds, run_id);
     shared by the read-side commands (metrics / trace / watch)."""
@@ -477,14 +512,18 @@ def trace(flow_run, run_id, datastore, datastore_root, request_id,
               help="Refresh interval in seconds.")
 @click.option("--slo", "slo_path", default=None,
               help="JSON SLO rule file (default: TPUFLOW_SLO_* env).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit one machine-readable JSON snapshot per poll "
+                   "instead of the rendered frame.")
 def watch(flow_run, run_id, datastore, datastore_root, once, check,
-          interval, slo_path):
+          interval, slo_path, as_json):
     from .cmd.watch import watch as watch_run
 
     fds, run_id = _resolve_run(flow_run, run_id, datastore,
                                datastore_root)
     rc = watch_run(fds, run_id, once=once, check=check,
-                   interval=interval, slo_path=slo_path, echo=click.echo)
+                   interval=interval, slo_path=slo_path,
+                   as_json=as_json, echo=click.echo)
     if rc:
         raise SystemExit(rc)
 

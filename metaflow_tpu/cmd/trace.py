@@ -34,6 +34,11 @@ _REQUEST_PREFIXES = ("serve.request.", "fleet.request.")
 # chunk-level child slices of the prefill phase
 _CHUNK_TIMER = "serve.prefill_chunk"
 
+# MPMD pipeline stages stamp a per-stage child span of the run
+# traceparent into these (training/mpmd_trainer.py): the train-path
+# analogue of a request subtree
+_MPMD_TRANSFER = "mpmd.transfer"
+
 
 def _data(rec):
     return rec.get("data") or {}
@@ -194,6 +199,51 @@ def ttft_decomposition(tree):
     }
 
 
+def build_stage_spans(records):
+    """Per-stage MPMD transfer spans: one row per pipeline stage,
+    aggregated over that stage's `mpmd.transfer` records (stamped with
+    the stage's child span of the run traceparent). Returns a
+    stage-ordered list of dicts; [] for runs without MPMD records."""
+    stages = {}
+    for rec in sorted(records, key=lambda r: r.get("ts", 0)):
+        if rec.get("name") != _MPMD_TRANSFER:
+            continue
+        d = _data(rec)
+        stage = int(d.get("stage", 0))
+        row = stages.get(stage)
+        if row is None:
+            row = stages[stage] = {
+                "stage": stage, "trace": d.get("trace"),
+                "span": d.get("span"), "steps": 0, "stall_ms": 0.0,
+                "frames_sent": 0, "frames_recv": 0,
+                "bytes_sent": 0, "bytes_recv": 0,
+                "t_first": rec.get("ts"), "t_last": rec.get("ts"),
+            }
+        row["steps"] += 1
+        row["stall_ms"] += float(d.get("stall_ms") or 0.0)
+        for key in ("frames_sent", "frames_recv",
+                    "bytes_sent", "bytes_recv"):
+            row[key] += int(d.get(key) or 0)
+        row["t_last"] = rec.get("ts")
+    out = [stages[s] for s in sorted(stages)]
+    for row in out:
+        row["stall_ms"] = round(row["stall_ms"], 3)
+    return out
+
+
+def render_stage_spans(spans, echo=print):
+    echo("mpmd stage transfer spans:")
+    for row in spans:
+        line = ("  stage %d: %d step(s), stall %.1fms, "
+                "%d frame(s) out / %d in, %d B out / %d B in"
+                % (row["stage"], row["steps"], row["stall_ms"],
+                   row["frames_sent"], row["frames_recv"],
+                   row["bytes_sent"], row["bytes_recv"]))
+        if row.get("span"):
+            line += "  span=%s" % row["span"]
+        echo(line)
+
+
 # ---------------------------------------------------------------------------
 # Chrome/Perfetto trace-event JSON
 # ---------------------------------------------------------------------------
@@ -304,20 +354,40 @@ def perfetto_export_timers(records):
     persist.* / checkpoint.* / elastic.* spans open in Perfetto too."""
     timers = [r for r in records
               if r.get("type") == "timer" and r.get("ms") is not None]
+    # MPMD transfer events render as stall slices on the stage's lane:
+    # the interval the stage sat blocked on the transport, ending at the
+    # record's timestamp
+    transfers = [r for r in records
+                 if r.get("name") == _MPMD_TRANSFER
+                 and float(_data(r).get("stall_ms") or 0.0) > 0]
     out = []
     t0 = min((r["ts"] - r["ms"] / 1000.0 for r in timers), default=0.0)
     pids = {}
-    for rec in timers:
+
+    def _pid(rec):
         key = "%s/%s" % (rec.get("step", "?"), rec.get("task_id", "?"))
         if key not in pids:
             pids[key] = len(pids) + 1
             out.append(_meta("process_name", key, pids[key], 0))
-        pid = pids[key]
+        return pids[key]
+
+    for rec in timers:
+        pid = _pid(rec)
         tid = int(rec.get("rank") or 0)
         out.append(_slice(rec.get("name", "span"),
                           _us(rec["ts"] - rec["ms"] / 1000.0, t0),
                           rec["ms"] * 1000, pid, tid,
                           _data(rec) or None))
+    for rec in transfers:
+        d = _data(rec)
+        stall_ms = float(d.get("stall_ms") or 0.0)
+        args = {"stage": d.get("stage"), "stall_ms": stall_ms}
+        if d.get("span"):
+            args["span"] = d["span"]
+        out.append(_slice("mpmd.transfer_stall",
+                          _us(rec["ts"] - stall_ms / 1000.0, t0),
+                          stall_ms * 1000, _pid(rec),
+                          int(rec.get("rank") or 0), args))
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
@@ -369,6 +439,7 @@ def show_trace(flow_datastore, run_id, request=None, perfetto=None,
         echo("no telemetry records for run %s" % run_id)
         return 0
     trees = build_request_traces(records)
+    stage_spans = build_stage_spans(records) if request is None else []
     if request is not None:
         trees = [t for t in trees if str(t["request_id"]) == str(request)]
         if not trees:
@@ -381,7 +452,7 @@ def show_trace(flow_datastore, run_id, request=None, perfetto=None,
             json.dump(doc, f)
         echo("wrote %d trace events to %s"
              % (len(doc["traceEvents"]), perfetto))
-    if not trees:
+    if not trees and not stage_spans:
         echo("no request traces in run %s (%d records; train-side timer "
              "spans export via --perfetto)" % (run_id, len(records)))
         return 0
@@ -398,8 +469,13 @@ def show_trace(flow_datastore, run_id, request=None, perfetto=None,
                     for att in tree["attempts"]],
                 "ttft": ttft_decomposition(tree),
             })
-        echo(json.dumps(payload, indent=2, sort_keys=True))
+        doc = {"requests": payload}
+        if stage_spans:
+            doc["mpmd_stages"] = stage_spans
+        echo(json.dumps(doc, indent=2, sort_keys=True))
     else:
         for tree in trees:
             render_tree(tree, echo)
+        if stage_spans:
+            render_stage_spans(stage_spans, echo)
     return len(trees)

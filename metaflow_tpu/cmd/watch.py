@@ -17,11 +17,14 @@ on any breach — or on a pinned `slo.breach` event already persisted by
 the fleet supervisor — so CI can gate on a run's health.
 """
 
+import json
 import time
 from collections import deque
 
 from .. import slo as slo_rules_mod
 from .. import telemetry
+
+SNAPSHOT_VERSION = 1
 
 
 def _mean(vals):
@@ -221,6 +224,51 @@ class WatchState(object):
             m["spec_accept_rate"] = round(float(self.spec_accept_rate), 4)
         return m
 
+    def snapshot(self, run_id, breaches=()):
+        """One machine-readable frame: the same data render_frame
+        prints, as one JSON document per poll (`tpuflow watch --json`).
+        Schema pinned in tests/schema_validate.py::WATCH_SNAPSHOT_SCHEMA."""
+        return {
+            "v": SNAPSHOT_VERSION,
+            "run_id": str(run_id),
+            "records": self.records_total,
+            "last_ts": self.last_ts,
+            "last_step_num": self.last_step_num,
+            "metrics": self.metrics(),
+            "serve": {
+                "queue_depth": self.queue_depth,
+                "occupancy": self.occupancy,
+            },
+            "prefix": {
+                "hits": self.prefix_hits,
+                "misses": self.prefix_misses,
+                "evictions": self.prefix_evictions,
+            },
+            "kv": {
+                "occupancy": self.kv_occupancy,
+                "cow_pages": self.kv_cow_pages,
+                "shares": self.kv_shares,
+                "exhausted": self.kv_exhausted,
+                "spec_accept_rate": self.spec_accept_rate,
+            },
+            "fleet": {
+                "replicas_ready": self.replicas_ready,
+                "replica_flaps": self.replica_flaps,
+                "scale_outs": self.scale_outs,
+                "scale_ins": self.scale_ins,
+                "rollout": self.last_rollout,
+            },
+            "incidents": {
+                "desync": self.desync_count,
+                "flush_failures": self.flush_failures,
+                "hangs": self.hang_count,
+                "last_hang": self.last_hang,
+            },
+            "breaches": [dict(b) for b in breaches],
+            "breach_events": [rec.get("data") or {}
+                              for rec in self.breach_events],
+        }
+
 
 def render_frame(state, run_id, breaches=(), echo=print):
     m = state.metrics()
@@ -312,9 +360,11 @@ def render_frame(state, run_id, breaches=(), echo=print):
 
 
 def watch(flow_datastore, run_id, once=False, check=False, interval=2.0,
-          slo_path=None, echo=print, max_frames=None):
+          slo_path=None, echo=print, max_frames=None, as_json=False):
     """Tail a run. Returns the process exit code: 0, or 1 when --check
-    and an SLO breach was observed (live-evaluated or persisted)."""
+    and an SLO breach was observed (live-evaluated or persisted).
+    as_json: emit one machine-readable JSON snapshot per poll instead
+    of the rendered frame (external dashboards)."""
     tail = telemetry.TelemetryTail(flow_datastore, run_id)
     rules = slo_rules_mod.load_rules(slo_path)
     state = WatchState()
@@ -323,7 +373,11 @@ def watch(flow_datastore, run_id, once=False, check=False, interval=2.0,
     while True:
         state.ingest(tail.poll())
         breaches = slo_rules_mod.evaluate(rules, state.metrics())
-        render_frame(state, run_id, breaches, echo)
+        if as_json:
+            echo(json.dumps(state.snapshot(run_id, breaches),
+                            sort_keys=True))
+        else:
+            render_frame(state, run_id, breaches, echo)
         frames += 1
         if once or (max_frames is not None and frames >= max_frames):
             break

@@ -169,10 +169,12 @@ class FlowDataStore(object):
         out = []
         for path, is_file in self.storage.list_content([self.flow_name]):
             name = self.storage.basename(path)
-            # 'data' is the CAS; '_'-prefixed dirs are flow-level state
-            # (_checkpoints, ...) — neither is a run (gc would otherwise
-            # age them out as phantom runs)
-            if not is_file and name != "data" and not name.startswith("_"):
+            # 'data' is the CAS; 'checkpoints' is the @checkpoint
+            # decorator's tree; '_'-prefixed dirs are flow-level state —
+            # none is a run (gc would otherwise age them out as phantom
+            # runs, and run listings would surface them)
+            if (not is_file and name not in ("data", "checkpoints")
+                    and not name.startswith("_")):
                 out.append(name)
         return out
 

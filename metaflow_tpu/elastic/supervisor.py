@@ -609,4 +609,8 @@ class ElasticGangSupervisor(object):
                       "failure_class": fclass,
                       "attempt": task.attempt,
                       "delay_s": round(float(delay), 3),
-                      "waiting_for_capacity": bool(waiting)})
+                      "waiting_for_capacity": bool(waiting),
+                      # gang size the park withholds: the goodput ledger
+                      # charges delay_s x world to capacity_wait
+                      "world": int(task.elastic_size
+                                   or task.num_parallel or 1)})

@@ -15,6 +15,7 @@ async barrier); on GCS roots orbax streams from TPU-VM host DRAM directly.
 
 import os
 
+from ... import tracing
 from ...current import current
 from ...decorators import StepDecorator
 
@@ -57,7 +58,10 @@ class Checkpointer(object):
     def save(self, state, step=0):
         """Save a pytree checkpoint for logical step `step`."""
         path = os.path.join(self._root, "step_%d" % step)
-        self._checkpointer().save(path, state, force=True)
+        # the span lands in the run's flight recorder, where the goodput
+        # ledger books it as checkpoint_blocked chip-time
+        with tracing.span("checkpoint.snapshot", {"step": int(step)}):
+            self._checkpointer().save(path, state, force=True)
         return path
 
     def load(self, step=None, like=None):
@@ -73,13 +77,16 @@ class Checkpointer(object):
             if chosen not in steps:
                 continue
             path = os.path.join(root, "step_%d" % chosen)
-            restore_args = None
-            if like is not None:
-                import orbax.checkpoint as ocp
+            # restore time is part of the run's recovery cost: the
+            # goodput ledger books it under restore_replay
+            with tracing.span("checkpoint.restore", {"step": int(chosen)}):
+                restore_args = None
+                if like is not None:
+                    import orbax.checkpoint as ocp
 
-                restore_args = ocp.args.PyTreeRestore(like)  # noqa: F841
-                return self._checkpointer().restore(path, item=like)
-            return self._checkpointer().restore(path)
+                    restore_args = ocp.args.PyTreeRestore(like)  # noqa: F841
+                    return self._checkpointer().restore(path, item=like)
+                return self._checkpointer().restore(path)
         return None
 
     @property

@@ -308,6 +308,25 @@ class _FleetHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/admin/rollout":
             self._json(200, self.fleet.rollout_status())
             return
+        if self.path == "/metrics":
+            # OpenMetrics text rendered from the SAME dicts /v1/stats
+            # and /healthz serve (vocabulary pinned in schema_validate)
+            from .. import goodput
+
+            text = goodput.render_openmetrics(
+                goodput.fleet_metric_families(self.fleet.stats(),
+                                              self.fleet.healthz()))
+            body = text.encode("utf-8")
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 goodput.OPENMETRICS_CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True
+            return
         self._json(404, {"error": "not found"})
 
     def do_POST(self):

@@ -182,6 +182,11 @@ class Scheduler(object):
         self.decode_steps = 0
         self.peak_in_flight = 0
         self._occupancy_sum = 0.0
+        # goodput accounting: device-busy seconds split prefill/decode;
+        # idle = elapsed - busy (stats()["goodput"], /metrics)
+        self.busy_prefill_s = 0.0
+        self.busy_decode_s = 0.0
+        self._t_started = time.perf_counter()
         # rolling latency windows for /v1/stats and /healthz percentiles:
         # bounded so a long-lived server reports RECENT tail latency, not
         # an all-time blend that a morning incident pollutes forever
@@ -504,9 +509,11 @@ class Scheduler(object):
             chunk_data = dict(ctx) if ctx \
                 else self._tdata(req, {"request_id": req.id})
             chunk_data.update({"slot": slot, "tokens": consumed})
+            chunk_s = time.perf_counter() - t0
+            self.busy_prefill_s += chunk_s
             telemetry.emit(
                 "timer", "serve.prefill_chunk",
-                ms=(time.perf_counter() - t0) * 1000, ok=True,
+                ms=chunk_s * 1000, ok=True,
                 data=chunk_data)
             budget -= consumed
             worked = True
@@ -563,9 +570,11 @@ class Scheduler(object):
             return False
         t0 = time.perf_counter()
         tokens = self.engine.decode_step()
+        step_s = time.perf_counter() - t0
+        self.busy_decode_s += step_s
         telemetry.emit(
             "timer", "serve.decode_step",
-            ms=(time.perf_counter() - t0) * 1000, ok=True,
+            ms=step_s * 1000, ok=True,
             data={"active": len(tokens)})
         self.decode_steps += 1
         self._occupancy_sum += self.engine.occupancy()
@@ -701,6 +710,20 @@ class Scheduler(object):
             "kv_pages": self.kv_pages_stats(),
             "speculative": (self.engine.spec_stats() if self._paged
                             else {"enabled": False}),
+            "goodput": self.goodput_stats(),
+        }
+
+    def goodput_stats(self):
+        """Chip-second split in the goodput taxonomy
+        (metaflow_tpu/goodput.py): device-busy prefill/decode seconds
+        plus the scheduler-lifetime remainder as idle."""
+        elapsed = max(0.0, time.perf_counter() - self._t_started)
+        busy = self.busy_prefill_s + self.busy_decode_s
+        return {
+            "serve_prefill_s": round(self.busy_prefill_s, 3),
+            "serve_decode_s": round(self.busy_decode_s, 3),
+            "serve_idle_s": round(max(0.0, elapsed - busy), 3),
+            "elapsed_s": round(elapsed, 3),
         }
 
     def kv_pages_stats(self):

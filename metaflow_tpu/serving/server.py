@@ -20,6 +20,8 @@ compiled programs.
                          "slots", "occupancy"} — one probe carries the
                          admission signals (fleet router / external LB)
     GET /v1/stats       scheduler + engine counters
+    GET /metrics        the same counters as OpenMetrics text (for
+                        Prometheus-style scrapers; see docs/observability.md)
 
 Graceful shutdown: SIGTERM (install_signal_handlers) flips /healthz to
 draining, rejects new work with 503, lets every accepted request finish
@@ -162,6 +164,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/v1/stats":
             self._json(200, self.scheduler.stats())
+            return
+        if self.path == "/metrics":
+            # OpenMetrics text for Prometheus-style scrapers, rendered
+            # from the SAME stats dict /v1/stats serves (vocabulary
+            # pinned in tests/schema_validate.py)
+            from .. import goodput
+
+            text = goodput.render_openmetrics(
+                goodput.scheduler_metric_families(self.scheduler.stats()))
+            self._bytes(200, text.encode("utf-8"),
+                        content_type=goodput.OPENMETRICS_CONTENT_TYPE)
             return
         self._json(404, {"error": "not found"})
 

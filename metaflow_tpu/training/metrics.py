@@ -361,6 +361,13 @@ class TrainStepTelemetry(object):
         progress_mod.done(step_num=self.step_num)
         if self._profile is not None:
             self._profile.stop(self.step_num)
+        interval = self._goodput_interval()
+        if interval is not None:
+            # per-rank chip-second rollup in the goodput taxonomy
+            # (metaflow_tpu/goodput.py): rides the crash-safe recorder
+            # so the ledger CLI can cross-check its derivation against
+            # what the rank itself tallied
+            telemetry.event("goodput.interval", data=interval)
         summary = self.report()
         for key in ("steps", "mean_step_ms", "tokens_per_sec", "mfu",
                     "input_stall_ms", "optimizer_update_ms",
@@ -372,6 +379,30 @@ class TrainStepTelemetry(object):
             if value is not None:
                 telemetry.gauge("%s.summary.%s" % (self.prefix, key), value)
         telemetry.flush()
+
+    def _goodput_interval(self):
+        """This rank's step time split into goodput categories
+        (seconds): the `goodput.interval` event payload, schema pinned
+        in tests/schema_validate.py::GOODPUT_INTERVAL_DATA_SCHEMA."""
+        steady_s = sum(self._intervals)
+        compile_s = self.compile_ms / 1000.0
+        if steady_s <= 0 and compile_s <= 0:
+            return None
+        stall_s = sum(self._stalls)
+        update_s = sum(self._update_ms) / 1000.0
+        transfer_s = sum(self._transfer_ms) / 1000.0
+        productive = max(0.0, steady_s - stall_s - update_s - transfer_s)
+        return {
+            "span_s": round(steady_s + compile_s, 3),
+            "steps": len(self._intervals),
+            "categories": {
+                "productive_step": round(productive, 3),
+                "compile": round(compile_s, 3),
+                "input_stall": round(stall_s, 3),
+                "transfer_stall": round(transfer_s, 3),
+                "update": round(update_s, 3),
+            },
+        }
 
     def report(self):
         """Summary dict over the recorded steps (steady-state: the first

@@ -18,10 +18,12 @@ per-step record — `tpuflow metrics` aggregates both into the per-stage
 MPMD section that names the bubble stage.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..models import llama
 from ..ops import rms_norm, rope_frequencies
 from ..spmd import mpmd
@@ -62,11 +64,28 @@ def make_stage_step(cfg, plan, stage, transport, seq_len):
         loss_fn=loss_fn if stage == plan.S - 1 else None,
         return_input_grad=(stage == 0),
     )
+    # join the run's trace tree: each stage gets a deterministic child
+    # span of the ambient run traceparent, stamped into its records so
+    # `tpuflow trace` can show per-stage transfer spans alongside the
+    # request trees (and Perfetto exports can lane them per stage)
+    ambient_tp = os.environ.get("TRACEPARENT", "")
+    stage_trace = stage_span = ""
+    if ambient_tp:
+        stage_tp = tracing.child_traceparent(
+            ambient_tp, "mpmd-stage-%d" % stage)
+        stage_trace, stage_span = tracing.traceparent_ids(stage_tp)
+
+    def _trace_data(data):
+        if stage_span:
+            data["trace"] = stage_trace
+            data["span"] = stage_span
+        return data
+
     telemetry.event(
         "mpmd.stage.trace",
-        data=dict(plan.describe(), stage=stage,
-                  layers=plan.layers_for_stage(stage),
-                  seq=int(seq_len) - 1))
+        data=_trace_data(dict(plan.describe(), stage=stage,
+                              layers=plan.layers_for_stage(stage),
+                              seq=int(seq_len) - 1)))
 
     def step(params, tokens):
         inp, tgt = tokens[:, :-1], tokens[:, 1:]
@@ -88,18 +107,19 @@ def make_stage_step(cfg, plan, stage, transport, seq_len):
         step.last_transfer_stall_ms = executor.last_transfer_stall_ms
         telemetry.event(
             "mpmd.transfer",
-            data={"stage": stage,
-                  "double_buffer": bool(after["double_buffer"]),
-                  "frames_sent": int(after["frames_sent"]
-                                     - before["frames_sent"]),
-                  "frames_recv": int(after["frames_recv"]
-                                     - before["frames_recv"]),
-                  "bytes_sent": int(after["bytes_sent"]
-                                    - before["bytes_sent"]),
-                  "bytes_recv": int(after["bytes_recv"]
-                                    - before["bytes_recv"]),
-                  "stall_ms": round(after["stall_ms"]
-                                    - before["stall_ms"], 3)})
+            data=_trace_data(
+                {"stage": stage,
+                 "double_buffer": bool(after["double_buffer"]),
+                 "frames_sent": int(after["frames_sent"]
+                                    - before["frames_sent"]),
+                 "frames_recv": int(after["frames_recv"]
+                                    - before["frames_recv"]),
+                 "bytes_sent": int(after["bytes_sent"]
+                                   - before["bytes_sent"]),
+                 "bytes_recv": int(after["bytes_recv"]
+                                   - before["bytes_recv"]),
+                 "stall_ms": round(after["stall_ms"]
+                                   - before["stall_ms"], 3)}))
         grads = {"layers": res["grads"]}
         if stage == 0:
             # embedding gradient: the gather's transpose is a

@@ -24,7 +24,7 @@ import time
 import numpy as np
 
 import metaflow_tpu
-from metaflow_tpu import FlowSpec, current, step
+from metaflow_tpu import FlowSpec, current, step, telemetry
 from metaflow_tpu.decorators import make_step_decorator
 from metaflow_tpu.plugins import STEP_DECORATORS
 
@@ -111,21 +111,29 @@ class ElasticTrainFlow(FlowSpec):
             # chaos tick: a scheduled (step, rank) kill delivers a REAL
             # spot notice to this process, once per run
             maybe_chaos_step(i)
-            batch = next(it)
-            loss, w, checksum = sgd_step(w, batch["tokens"])
-            history.append([i, world, checksum, loss])
-            if rank == 0:
-                # rank 0 owns the shared-scope checkpoint in this local
-                # gang; the shield makes every save a clean boundary for
-                # both spot reclaims and supervisor grow notices
-                with current.preemption.shield():
-                    ckpt.save(
-                        {"w": w, "step": i,
-                         "attempt": current.retry_count,
-                         "data_state": batch["data_state"],
-                         "history": history},
-                        step=i)
-            time.sleep(self.step_sleep)
+            # the step timer makes each rank's loop a gapless goodput
+            # lane: batch fetch + sgd + (rank 0) save + the simulated
+            # chip work all ride one train.step interval, so the run's
+            # ledger reconciles instead of booking inter-record gaps as
+            # unattributed. The chaos tick stays OUTSIDE — a kill must
+            # not be mistaken for a long step.
+            with telemetry.timer("train.step", step_num=i):
+                batch = next(it)
+                loss, w, checksum = sgd_step(w, batch["tokens"])
+                history.append([i, world, checksum, loss])
+                if rank == 0:
+                    # rank 0 owns the shared-scope checkpoint in this
+                    # local gang; the shield makes every save a clean
+                    # boundary for both spot reclaims and supervisor
+                    # grow notices
+                    with current.preemption.shield():
+                        ckpt.save(
+                            {"w": w, "step": i,
+                             "attempt": current.retry_count,
+                             "data_state": batch["data_state"],
+                             "history": history},
+                            step=i)
+                time.sleep(self.step_sleep)
             i += 1
         self.final_w = w
         self.history = history if rank == 0 else None

@@ -589,20 +589,25 @@ PIPELINE_EVENT_DATA_SCHEMAS = {
                   "batch", "seq", "n_layers"),
     ),
     # one per stage-step construction (training/mpmd_trainer.py): the
-    # plan this stage ticks plus the physical layers it owns
+    # plan this stage ticks plus the physical layers it owns. trace/span
+    # are the run traceparent's deterministic per-stage child span,
+    # present whenever the launcher exported TRACEPARENT.
     "mpmd.stage.trace": _obj(
         {"num_microbatches": _INT, "num_virtual_stages": _INT,
          "num_stages": _INT, "n_layers": _INT, "n_cycles": _INT,
-         "stage": _INT, "layers": _arr(_INT), "seq": _INT},
+         "stage": _INT, "layers": _arr(_INT), "seq": _INT,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("num_microbatches", "num_virtual_stages", "num_stages",
                   "n_layers", "n_cycles", "stage", "layers", "seq"),
     ),
     # one per train step per stage: that step's frame/byte deltas and
-    # the wall time spent blocked on the wire
+    # the wall time spent blocked on the wire, stamped with the same
+    # per-stage trace/span so `tpuflow trace` can render transfer spans
     "mpmd.transfer": _obj(
         {"stage": _INT, "double_buffer": _BOOL,
          "frames_sent": _INT, "frames_recv": _INT,
-         "bytes_sent": _INT, "bytes_recv": _INT, "stall_ms": _NUM},
+         "bytes_sent": _INT, "bytes_recv": _INT, "stall_ms": _NUM,
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("stage", "double_buffer", "frames_sent", "frames_recv",
                   "bytes_sent", "bytes_recv", "stall_ms"),
     ),
@@ -764,7 +769,10 @@ ELASTIC_EVENT_DATA_SCHEMAS = {
          "failure_class": {"enum": ["preemption", "grow", "hang", "user",
                                     "infra"]},
          "attempt": _INT, "delay_s": _NUM,
-         "waiting_for_capacity": _BOOL},
+         "waiting_for_capacity": _BOOL,
+         # gang size the park withholds: the goodput ledger charges
+         # delay_s x world chip-seconds to capacity_wait
+         "world": _INT},
         required=("pathspec", "failure_class", "attempt", "delay_s"),
     ),
     "chaos.kill": _obj(
@@ -1236,3 +1244,284 @@ def validate_manifest(manifest):
             % (kind, sorted(_BY_KIND)))
     jsonschema.validate(manifest, schema,
                         cls=jsonschema.Draft202012Validator)
+
+
+# ---------------------------------------------------------------------------
+# Goodput ledger (metaflow_tpu/goodput.py + cmd/goodput.py): the pinned
+# chip-second taxonomy, the ledger document `tpuflow goodput --json`
+# emits / save_ledger persists, the per-rank goodput.interval event, the
+# `tpuflow watch --json` snapshot, and the OpenMetrics metric-name
+# vocabulary the /metrics endpoints expose. additionalProperties: false
+# throughout — a category or metric name the code invents (or renames)
+# fails validation, so dashboards keyed on the taxonomy cannot drift.
+# ---------------------------------------------------------------------------
+
+# the chip-second taxonomy, pinned to goodput.CATEGORIES (a test asserts
+# they stay equal). `unattributed` is the explicit remainder bucket, a
+# ledger output rather than an attribution category.
+GOODPUT_CATEGORIES = (
+    "productive_step", "compile", "input_stall", "transfer_stall",
+    "update", "checkpoint_blocked", "restore_replay", "capacity_wait",
+    "serve_prefill", "serve_decode", "serve_idle",
+)
+
+GOODPUT_ALL_BUCKETS = GOODPUT_CATEGORIES + ("unattributed",)
+
+# per-rank rollup emitted at TrainStepTelemetry.close(): only the train
+# categories a single rank can attribute locally
+GOODPUT_INTERVAL_DATA_SCHEMA = _obj(
+    {
+        "span_s": _NUM,
+        "steps": _INT,
+        "categories": _obj(
+            {"productive_step": _NUM, "compile": _NUM,
+             "input_stall": _NUM, "transfer_stall": _NUM,
+             "update": _NUM},
+            required=("productive_step", "compile", "input_stall",
+                      "transfer_stall", "update"),
+        ),
+    },
+    required=("span_s", "steps", "categories"),
+)
+
+_CAT_SECONDS = _obj({c: _NUM for c in GOODPUT_CATEGORIES})
+
+_LEDGER_LANE = _obj(
+    {
+        "step": _STR,
+        "task_id": _STR,
+        "attempt": _INT,
+        "rank": _INT,
+        "kind": {"enum": ["train", "serve", "mixed"]},
+        "span_s": _NUM,
+        "observed_s": _NUM,
+        "unattributed_s": _NUM,
+        "categories": _CAT_SECONDS,
+    },
+    required=("step", "task_id", "attempt", "rank", "kind", "span_s",
+              "observed_s", "unattributed_s", "categories"),
+)
+
+_LEDGER_PARKED = _obj(
+    {"pathspec": _STR, "attempt": _INT, "delay_s": _NUM, "world": _INT},
+    required=("pathspec", "attempt", "delay_s", "world"),
+)
+
+GOODPUT_LEDGER_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "run_id": {"type": ["string", "null"]},
+        "wall_clock_s": _NUM,
+        "observed_chip_s": _NUM,
+        "attributed_chip_s": _NUM,
+        "unattributed_chip_s": _NUM,
+        "coverage": _NUM,
+        "goodput_frac": _NUM,
+        "tolerance": _NUM,
+        "reconciled": _BOOL,
+        # every category key present, even when zero: a consumer can
+        # index without .get()
+        "categories": _obj({c: _NUM for c in GOODPUT_CATEGORIES},
+                           required=GOODPUT_CATEGORIES),
+        "dominant_loss": {
+            "oneOf": [{"type": "null"},
+                      {"enum": [c for c in GOODPUT_ALL_BUCKETS
+                                if c not in ("productive_step", "update",
+                                             "serve_prefill",
+                                             "serve_decode")]}],
+        },
+        "dominant_loss_s": _NUM,
+        "parked": _arr(_LEDGER_PARKED),
+        "lanes": _arr(_LEDGER_LANE),
+    },
+    required=("v", "run_id", "wall_clock_s", "observed_chip_s",
+              "attributed_chip_s", "unattributed_chip_s", "coverage",
+              "goodput_frac", "tolerance", "reconciled", "categories",
+              "dominant_loss", "dominant_loss_s", "parked", "lanes"),
+)
+
+
+def validate_goodput_interval_record(record):
+    """Validate a pinned goodput.interval flight-recorder event."""
+    validate_telemetry_record(record)
+    if record.get("type") != "event" \
+            or record.get("name") != "goodput.interval":
+        raise jsonschema.ValidationError(
+            "expected a goodput.interval event record, got type=%r "
+            "name=%r" % (record.get("type"), record.get("name")))
+    jsonschema.validate(record.get("data", {}),
+                        GOODPUT_INTERVAL_DATA_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+def validate_goodput_ledger(ledger):
+    """Validate a derived/persisted goodput ledger document, plus the
+    cross-field invariants a JSON schema cannot express."""
+    jsonschema.validate(ledger, GOODPUT_LEDGER_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+    cat_sum = sum(ledger["categories"].values())
+    total = ledger["attributed_chip_s"]
+    if abs(cat_sum - total) > max(0.01, 0.001 * max(cat_sum, total)):
+        raise jsonschema.ValidationError(
+            "categories sum %.3f != attributed_chip_s %.3f"
+            % (cat_sum, total))
+    whole = ledger["attributed_chip_s"] + ledger["unattributed_chip_s"]
+    observed = ledger["observed_chip_s"]
+    if whole - observed > max(0.01, 0.001 * observed):
+        raise jsonschema.ValidationError(
+            "attributed + unattributed %.3f exceeds observed %.3f"
+            % (whole, observed))
+
+
+# `tpuflow watch --json` snapshot (cmd/watch.py::WatchState.snapshot):
+# one machine-readable frame per poll. metrics keys are conditional on
+# samples existing (an idle server has no p99), so only the always-
+# present counters are required.
+_WATCH_METRICS = _obj(
+    {
+        "records": _INT,
+        "replica_flaps": _INT,
+        "desync_count": _NUM,
+        "flush_failures": _NUM,
+        "hang_count": _NUM,
+        "replica_restart_rate_per_min": _NUM,
+        "step_ms": _NUM,
+        "input_stall_frac": _NUM,
+        "train_tokens_per_sec": _NUM,
+        "mfu": _NUM,
+        "straggler_skew": _NUM,
+        "p50_ttft_ms": _NUM,
+        "p99_ttft_ms": _NUM,
+        "p50_itl_ms": _NUM,
+        "p99_itl_ms": _NUM,
+        "serve_tokens_per_sec": _NUM,
+        "prefix_hit_rate": _NUM,
+        "prefix_tokens_skipped_frac": _NUM,
+        "kv_page_occupancy": _NUM,
+        "spec_accept_rate": _NUM,
+    },
+    required=("records", "replica_flaps", "desync_count",
+              "flush_failures", "hang_count"),
+)
+
+_NULL_NUM = {"type": ["number", "null"]}
+
+WATCH_SNAPSHOT_SCHEMA = _obj(
+    {
+        "v": {"const": 1},
+        "run_id": _STR,
+        "records": _INT,
+        "last_ts": _NUM,
+        "last_step_num": {"type": ["integer", "null"]},
+        "metrics": _WATCH_METRICS,
+        "serve": _obj(
+            {"queue_depth": _NULL_NUM, "occupancy": _NULL_NUM},
+            required=("queue_depth", "occupancy"),
+        ),
+        "prefix": _obj(
+            {"hits": _INT, "misses": _INT, "evictions": _INT},
+            required=("hits", "misses", "evictions"),
+        ),
+        "kv": _obj(
+            {"occupancy": _NULL_NUM, "cow_pages": _NULL_NUM,
+             "shares": _INT, "exhausted": _INT,
+             "spec_accept_rate": _NULL_NUM},
+            required=("occupancy", "cow_pages", "shares", "exhausted",
+                      "spec_accept_rate"),
+        ),
+        "fleet": _obj(
+            {"replicas_ready": _NULL_NUM, "replica_flaps": _INT,
+             "scale_outs": _INT, "scale_ins": _INT,
+             "rollout": {"type": ["object", "null"]}},
+            required=("replicas_ready", "replica_flaps", "scale_outs",
+                      "scale_ins", "rollout"),
+        ),
+        "incidents": _obj(
+            {"desync": _INT, "flush_failures": _NUM, "hangs": _INT,
+             "last_hang": {"type": ["object", "null"]}},
+            required=("desync", "flush_failures", "hangs", "last_hang"),
+        ),
+        "breaches": _arr(SLO_BREACH_SCHEMA),
+        "breach_events": _arr(SLO_BREACH_SCHEMA),
+    },
+    required=("v", "run_id", "records", "last_ts", "last_step_num",
+              "metrics", "serve", "prefix", "kv", "fleet", "incidents",
+              "breaches", "breach_events"),
+)
+
+
+def validate_watch_snapshot(snapshot):
+    """Validate one `tpuflow watch --json` frame."""
+    jsonschema.validate(snapshot, WATCH_SNAPSHOT_SCHEMA,
+                        cls=jsonschema.Draft202012Validator)
+
+
+# OpenMetrics metric-name vocabulary: every family name each /metrics
+# endpoint may expose (conditional families — prefix cache, paged KV,
+# speculation — are included; an endpoint may emit a subset but never a
+# name outside its set).
+OPENMETRICS_SERVE_METRICS = {
+    "tpuflow_serve_queue_depth": "gauge",
+    "tpuflow_serve_in_flight": "gauge",
+    "tpuflow_serve_slots": "gauge",
+    "tpuflow_serve_occupancy": "gauge",
+    "tpuflow_serve_mean_batch_occupancy": "gauge",
+    "tpuflow_serve_draining": "gauge",
+    "tpuflow_serve_peak_in_flight": "gauge",
+    "tpuflow_serve_max_context_tokens": "gauge",
+    "tpuflow_serve_requests": "counter",
+    "tpuflow_serve_decode_steps": "counter",
+    "tpuflow_serve_iterations": "counter",
+    "tpuflow_serve_ttft_ms": "summary",
+    "tpuflow_serve_itl_ms": "summary",
+    "tpuflow_serve_prefix_lookups": "counter",
+    "tpuflow_serve_prefix_hit_rate": "gauge",
+    "tpuflow_serve_prefix_tokens_skipped_frac": "gauge",
+    "tpuflow_serve_kv_pages": "gauge",
+    "tpuflow_serve_kv_occupancy": "gauge",
+    "tpuflow_serve_kv_exhausted": "counter",
+    "tpuflow_serve_spec_accept_rate": "gauge",
+    "tpuflow_serve_goodput_seconds": "counter",
+}
+
+OPENMETRICS_FLEET_METRICS = {
+    "tpuflow_fleet_requests": "counter",
+    "tpuflow_fleet_failovers": "counter",
+    "tpuflow_fleet_restarts": "counter",
+    "tpuflow_fleet_prefill_handoffs": "counter",
+    "tpuflow_fleet_disagg_fallbacks": "counter",
+    "tpuflow_fleet_scale_events": "counter",
+    "tpuflow_fleet_inflight": "gauge",
+    "tpuflow_fleet_max_inflight": "gauge",
+    "tpuflow_fleet_draining": "gauge",
+    "tpuflow_fleet_generation": "gauge",
+    "tpuflow_fleet_replicas": "gauge",
+    "tpuflow_fleet_kv_pages": "gauge",
+    "tpuflow_fleet_kv_occupancy": "gauge",
+    "tpuflow_fleet_prefix_hit_rate": "gauge",
+    "tpuflow_fleet_ttft_ms": "summary",
+    "tpuflow_fleet_itl_ms": "summary",
+    "tpuflow_fleet_slo_breached": "gauge",
+}
+
+OPENMETRICS_RUN_METRICS = {
+    "tpuflow_goodput_chip_seconds": "counter",
+    "tpuflow_goodput_coverage_ratio": "gauge",
+    "tpuflow_goodput_fraction": "gauge",
+    "tpuflow_goodput_wall_clock_seconds": "gauge",
+    "tpuflow_goodput_lanes": "gauge",
+}
+
+
+def validate_openmetrics_families(families, vocabulary):
+    """Validate parse_openmetrics() output against one of the pinned
+    vocabularies: every family name AND type must match its pin."""
+    for name, fam in families.items():
+        if name not in vocabulary:
+            raise jsonschema.ValidationError(
+                "unknown metric family %r (pinned: %s)"
+                % (name, sorted(vocabulary)))
+        if fam["type"] != vocabulary[name]:
+            raise jsonschema.ValidationError(
+                "family %r must be a %s, got %s"
+                % (name, vocabulary[name], fam["type"]))

@@ -353,6 +353,6 @@ class TestTraceCLI:
         # --json emits machine-readable summaries with decomposition
         jlines = []
         show_trace(fds, "1", as_json=True, echo=jlines.append)
-        docs = json.loads(jlines[-1])
+        docs = json.loads(jlines[-1])["requests"]
         assert {d["request_id"] for d in docs} == {"traced-0", "traced-1"}
         assert all(d["ttft"] is not None for d in docs)
