@@ -20,6 +20,8 @@ import sys
 
 import click
 
+from . import knobs
+
 
 @click.group()
 def main():
@@ -101,7 +103,7 @@ def configure_list():
     from .metaflow_config import _profile_path
 
     root = os.path.dirname(_profile_path())
-    active = os.environ.get("TPUFLOW_PROFILE", "") or "(default)"
+    active = knobs.get_str("TPUFLOW_PROFILE") or "(default)"
     if not os.path.isdir(root):
         click.echo("no profiles yet (%s does not exist)" % root)
         return
@@ -624,6 +626,31 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
                    echo=click.echo)
     except TpuFlowException as ex:
         raise click.ClickException(str(ex))
+
+
+@main.command(
+    name="knobs",
+    help="The TPUFLOW_* knob registry (metaflow_tpu/knobs.py): every "
+         "environment knob with its type, default, unit, and owning "
+         "subsystem. --markdown regenerates docs/knobs.md; --check-env "
+         "validates the live environment against the deadline-ordering "
+         "lattice and exits non-zero on violations.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Machine-readable registry dump.")
+@click.option("--markdown", is_flag=True,
+              help="Emit docs/knobs.md content (byte-identical).")
+@click.option("--ordering", is_flag=True,
+              help="Show the deadline-ordering lattice edges.")
+@click.option("--check-env", is_flag=True,
+              help="Validate the live environment against the lattice; "
+                   "exit 1 on any violation.")
+def knobs_cmd(as_json, markdown, ordering, check_env):
+    from .cmd.knobs import show_knobs
+
+    rc = show_knobs(as_json=as_json, markdown=markdown, ordering=ordering,
+                    check_env=check_env, echo=click.echo)
+    if rc:
+        raise SystemExit(rc)
 
 
 @main.group(help="Sharded streaming dataset corpora: pack token files "

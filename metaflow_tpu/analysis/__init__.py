@@ -1,6 +1,7 @@
-"""Static analysis beyond graph-shape lint: artifact dataflow and SPMD
-configuration checks that catch run-killing errors before a gang-scheduled
-TPU run burns hours of pod time (see docs/static-analysis.md).
+"""Static analysis beyond graph-shape lint: artifact dataflow, SPMD
+configuration, gang divergence, determinism, and configuration-contract
+checks that catch run-killing errors before a gang-scheduled TPU run
+burns hours of pod time (see docs/static-analysis.md).
 
 Entry points:
 
@@ -13,8 +14,10 @@ promotes error-severity findings to a hard failure, and TPUFLOW_ANALYZE=0
 skips the gate entirely.
 """
 
+import inspect
 import os
 
+from .. import knobs
 from ..exception import TpuFlowException
 from .dataflow import ArtifactDataflow, analyze_artifacts
 from .determinism import analyze_determinism, scan_paths
@@ -41,6 +44,7 @@ __all__ = [
     "INFO",
     "analyze_flow",
     "analyze_artifacts",
+    "analyze_contracts",
     "analyze_determinism",
     "analyze_divergence",
     "analyze_spmd",
@@ -54,6 +58,15 @@ __all__ = [
     "pre_run_gate",
     "scan_paths",
 ]
+
+
+def analyze_contracts(flow_file, env=None):
+    """Per-file contracts analysis (knob lint + deadline lattice); thin
+    lazy-import wrapper over .contracts.analyze_flow_file so that module
+    stays runnable as an entrypoint without a runpy double-import."""
+    from .contracts import analyze_flow_file
+
+    return analyze_flow_file(flow_file, env=env)
 
 
 class AnalysisError(TpuFlowException):
@@ -93,6 +106,16 @@ def analyze_flow(flow_cls, graph=None):
     report.analyses.append("determinism")
     report.extend(analyze_determinism(flow_cls, graph))
     report.checks_run += 3  # artifact / data-order / checkpoint sinks
+
+    try:
+        flow_file = inspect.getsourcefile(flow_cls)
+    except TypeError:
+        flow_file = None
+    if flow_file and os.path.exists(flow_file):
+        report.analyses.append("contracts")
+        contracts = analyze_contracts(flow_file)
+        report.extend(contracts.findings)
+        report.checks_run += contracts.checks_run
     return report
 
 
@@ -100,7 +123,7 @@ def pre_run_gate(flow, graph, echo):
     """Pre-run analysis gate (cli run/resume via NativeRuntime.execute):
     warnings by default, TPUFLOW_STRICT_CHECK=1 promotes errors to a hard
     failure, TPUFLOW_ANALYZE=0 disables."""
-    if os.environ.get("TPUFLOW_ANALYZE", "1") == "0":
+    if not knobs.get_bool("TPUFLOW_ANALYZE"):
         return None
     flow_cls = flow if isinstance(flow, type) else flow.__class__
     try:
@@ -110,7 +133,15 @@ def pre_run_gate(flow, graph, echo):
         echo("    Static analysis skipped (%s: %s)"
              % (type(ex).__name__, ex))
         return None
-    strict = os.environ.get("TPUFLOW_STRICT_CHECK") == "1"
+    strict = knobs.get_bool("TPUFLOW_STRICT_CHECK")
+    if strict:
+        # deadline-order is warn-by-default over the live environment;
+        # strict mode makes a mis-ordered deadline chain as fatal as any
+        # other error (a hang watchdog that fires before a recv timeout
+        # misclassifies every slow collective as a hang)
+        for f in report.findings:
+            if f.code == "deadline-order" and f.severity == WARNING:
+                f.severity = ERROR
     if report.errors and strict:
         raise AnalysisError(report)
     for f in report.sorted_findings():

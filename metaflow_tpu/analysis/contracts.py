@@ -1,0 +1,588 @@
+"""Configuration-and-contract static analysis: knob lint, the deadline
+ordering lattice, and telemetry schema drift.
+
+Three passes over library/flow source, all pure AST (nothing is
+imported or executed):
+
+* **knob lint** — every literal ``TPUFLOW_*`` env read must go through
+  the registry (metaflow_tpu/knobs.py). Findings: ``knob-unregistered``
+  (raw ``os.environ``/``os.getenv``/``env.get`` read outside knobs.py,
+  error), ``knob-unknown`` (a registry accessor called with a name that
+  is not registered — with a did-you-mean when it edit-distance-matches
+  a real knob, error), ``knob-inconsistent-default`` (the same knob
+  read with different literal defaults at two sites, or a literal
+  default that disagrees with the registry, error), and
+  ``knob-undocumented`` (a registered knob missing from the generated
+  docs table, warning).
+
+* **deadline ordering** — ``knobs.ORDERING`` evaluated over the
+  registry defaults (a violation there is a registry bug: error) and
+  over a live environment (misconfiguration: warning by default; the
+  pre-run gate escalates under ``TPUFLOW_STRICT_CHECK=1``). Finding
+  code: ``deadline-order``.
+
+* **telemetry schema drift** — every literal
+  ``record.event/gauge/timer/counter`` emit site in the library is
+  cross-checked both ways against the pins in
+  tests/schema_validate.py: an emitted name with no pin is
+  ``telemetry-unpinned-event`` (error: its payload schema is not under
+  test), a pinned name with no emit site is ``telemetry-dead-schema``
+  (warning: the pin tests nothing). The pin tables are read from the
+  schema module's AST (``*_EVENT_DATA_SCHEMAS`` / ``*_METRIC_NAMES`` /
+  ``*_EVENT_NAMES`` dict keys plus the ``EXTRA_PINNED_TELEMETRY_NAMES``
+  and ``DYNAMIC_EMIT_PREFIXES`` tuples), so the analyzer never imports
+  test code.
+
+Run over the library (the migration-completeness gate wired into
+scripts/analyze_all.sh)::
+
+    python -m metaflow_tpu.analysis.contracts metaflow_tpu \
+        --schema tests/schema_validate.py --docs docs/knobs.md
+
+Per-flow, the knob lint + live-env lattice ride along inside
+``check --deep`` as the ``contracts`` analysis (see analyze_flow).
+"""
+
+import ast
+import os
+
+from .. import knobs
+from .report import AnalysisReport, ERROR, Finding, WARNING
+
+#: module whose raw environ reads are sanctioned (the registry itself)
+REGISTRY_BASENAME = "knobs.py"
+
+#: accessor functions exported by metaflow_tpu.knobs
+ACCESSOR_NAMES = ("get", "get_str", "get_int", "get_float", "get_bool",
+                  "get_raw", "is_set")
+
+#: legacy env helper names whose first argument is an env var name
+ENV_HELPER_NAMES = ("env_int", "env_float", "_env_int", "_env_float")
+
+#: telemetry emit methods on a recorder (or the telemetry module)
+EMIT_ATTRS = ("event", "gauge", "timer", "counter")
+
+CONTRACT_FINDING_CODES = (
+    "knob-unregistered",
+    "knob-unknown",
+    "knob-inconsistent-default",
+    "knob-undocumented",
+    "deadline-order",
+    "telemetry-unpinned-event",
+    "telemetry-dead-schema",
+)
+
+
+class EnvReadSite(object):
+    __slots__ = ("path", "lineno", "name", "default", "has_default",
+                 "via_accessor")
+
+    def __init__(self, path, lineno, name, default, has_default,
+                 via_accessor):
+        self.path = path
+        self.lineno = lineno
+        self.name = name
+        self.default = default          # literal value, when literal
+        self.has_default = has_default  # False when default is dynamic
+        self.via_accessor = via_accessor
+
+
+class EmitSite(object):
+    __slots__ = ("path", "lineno", "rtype", "name")
+
+    def __init__(self, path, lineno, rtype, name):
+        self.path = path
+        self.lineno = lineno
+        self.rtype = rtype
+        self.name = name
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _string_consts(tree):
+    """Module-level NAME = "TPUFLOW_..." constants, for indirected
+    reads like ``os.environ.get(DETECT_ENV, "1")``."""
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _env_name(node, consts):
+    """Resolve a call argument to a TPUFLOW_* name, or None."""
+    name = _const_str(node)
+    if name is None and isinstance(node, ast.Name):
+        name = consts.get(node.id)
+    if name and name.startswith("TPUFLOW_"):
+        return name
+    return None
+
+
+def _is_environ_expr(node):
+    """os.environ / environ / env / self._env-style receivers."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("environ", "env", "_env")
+    if isinstance(node, ast.Name):
+        return node.id in ("environ", "env", "_env")
+    return False
+
+
+def _literal_default(args, keywords):
+    """(value, is_literal) for the default argument of a get()-style
+    read. A missing default is the literal None (that IS the contract
+    at such a site); a non-constant default is dynamic."""
+    default_node = args[1] if len(args) > 1 else None
+    if default_node is None:
+        for kw in keywords:
+            if kw.arg in ("default", "fallback"):
+                default_node = kw.value
+                break
+    if default_node is None:
+        return None, True
+    if isinstance(default_node, ast.Constant):
+        return default_node.value, True
+    return None, False
+
+
+def _has_explicit_default(args, keywords):
+    """True when a get()-style call passes a default at the call site,
+    positionally or via default=/fallback=."""
+    if len(args) > 1:
+        return True
+    return any(kw.arg in ("default", "fallback") for kw in keywords)
+
+
+class _FileScanner(ast.NodeVisitor):
+    def __init__(self, path, consts):
+        self.path = path
+        self.consts = consts
+        self.reads = []        # raw env reads
+        self.accessor_calls = []
+        self.emits = []
+
+    # -- env reads ---------------------------------------------------------
+
+    def _record_read(self, node, name_node, via_accessor=False):
+        name = _env_name(name_node, self.consts)
+        if name is None:
+            return
+        default, is_literal = _literal_default(node.args, node.keywords)
+        if via_accessor and not _has_explicit_default(node.args,
+                                                      node.keywords):
+            # a bare accessor call reads the registry default — there is
+            # no call-site default to check for drift (only a literal
+            # fallback= can disagree with the registry)
+            is_literal = False
+        site = EnvReadSite(self.path, node.lineno, name, default,
+                           is_literal, via_accessor)
+        (self.accessor_calls if via_accessor else self.reads).append(site)
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if fn.attr in ACCESSOR_NAMES and isinstance(recv, ast.Name) \
+                    and recv.id == "knobs" and node.args:
+                self._record_read(node, node.args[0], via_accessor=True)
+            elif fn.attr == "get" and _is_environ_expr(recv) and node.args:
+                self._record_read(node, node.args[0])
+            elif fn.attr == "getenv" and node.args:
+                self._record_read(node, node.args[0])
+            elif fn.attr in ENV_HELPER_NAMES and node.args:
+                self._record_read(node, node.args[0])
+            elif fn.attr in EMIT_ATTRS and node.args:
+                name = _const_str(node.args[0])
+                if name is not None:
+                    self.emits.append(EmitSite(self.path, node.lineno,
+                                               fn.attr, name))
+            elif fn.attr == "emit" and len(node.args) >= 2:
+                rtype = _const_str(node.args[0])
+                name = _const_str(node.args[1])
+                if rtype in EMIT_ATTRS and name is not None:
+                    self.emits.append(EmitSite(self.path, node.lineno,
+                                               rtype, name))
+        elif isinstance(fn, ast.Name):
+            if fn.id in ENV_HELPER_NAMES and node.args:
+                self._record_read(node, node.args[0])
+            elif fn.id == "getenv" and node.args:
+                self._record_read(node, node.args[0])
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        # os.environ["TPUFLOW_X"] as a *read* (store/del contexts are
+        # writes — setting knobs for children is sanctioned)
+        if isinstance(node.ctx, ast.Load) and _is_environ_expr(node.value):
+            name = _env_name(node.slice, self.consts)
+            if name is not None:
+                self.reads.append(EnvReadSite(
+                    self.path, node.lineno, name, None, False, False))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        # "TPUFLOW_X" in os.environ — a set-ness read
+        if (len(node.ops) == 1 and isinstance(node.ops[0], (ast.In,
+                                                            ast.NotIn))
+                and _is_environ_expr(node.comparators[0])):
+            name = _env_name(node.left, self.consts)
+            if name is not None:
+                self.reads.append(EnvReadSite(
+                    self.path, node.lineno, name, None, False, False))
+        self.generic_visit(node)
+
+
+def scan_source(path, src):
+    """Scan one file's source; returns a _FileScanner with the read,
+    accessor, and emit sites (or None when the file does not parse)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    scanner = _FileScanner(path, _string_consts(tree))
+    scanner.visit(tree)
+    return scanner
+
+
+def _iter_py_files(root):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def scan_paths(paths):
+    """Scan every .py file under the given paths; returns (reads,
+    accessor_calls, emits) across all of them."""
+    reads, accessor_calls, emits = [], [], []
+    for root in paths:
+        for path in _iter_py_files(root):
+            if os.path.basename(path) == REGISTRY_BASENAME:
+                continue
+            try:
+                with open(path) as handle:
+                    src = handle.read()
+            except OSError:
+                continue
+            scanner = scan_source(path, src)
+            if scanner is None:
+                continue
+            reads.extend(scanner.reads)
+            accessor_calls.extend(scanner.accessor_calls)
+            emits.extend(scanner.emits)
+    return reads, accessor_calls, emits
+
+
+# ---------------------------------------------------------------------------
+# pass 1: knob lint
+# ---------------------------------------------------------------------------
+
+def _canonical_default(name, value):
+    """Literal defaults canonicalized through the knob's type so '60',
+    60 and 60.0 compare equal where the knob is numeric, and a missing
+    default (None) compares equal to the falsy default of its type —
+    ``environ.get("TPUFLOW_DEBUG")`` used truthily IS default-off."""
+    knob = knobs.KNOBS.get(name)
+    if knob is not None and knob.ktype in ("int", "float"):
+        if value is None:
+            return None
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return value
+    if knob is not None and knob.ktype == "bool":
+        if value is None:
+            return False
+        if isinstance(value, str):
+            return value.strip().lower() not in knobs._FALSEY + ("",)
+        return bool(value)
+    return value if value != "" else None
+
+
+def knob_lint(reads, accessor_calls, docs_text=None):
+    """The four knob findings over scanned read sites."""
+    findings = []
+    for site in reads:
+        registered = site.name in knobs.KNOBS
+        if registered:
+            hint = ("bypasses the registry; read it via "
+                    "knobs.%s instead" % _accessor_for(site.name))
+        else:
+            near = knobs._nearest(site.name)
+            hint = "not in the registry; add it to metaflow_tpu/knobs.py"
+            if near:
+                hint += " (did you mean %s?)" % near
+        findings.append(Finding(
+            "knob-unregistered", ERROR,
+            "raw env read of %s %s" % (site.name, hint),
+            lineno=site.lineno, source_file=site.path))
+
+    for site in accessor_calls:
+        if site.name in knobs.KNOBS:
+            continue
+        near = knobs._nearest(site.name)
+        msg = "knob %s is not registered" % site.name
+        if near:
+            msg += " — did you mean %s?" % near
+        findings.append(Finding(
+            "knob-unknown", ERROR, msg,
+            lineno=site.lineno, source_file=site.path))
+
+    # default consistency: the registry default is the reference for a
+    # registered knob; the first-seen literal default otherwise
+    by_name = {}
+    for site in reads + accessor_calls:
+        if site.has_default:
+            by_name.setdefault(site.name, []).append(site)
+    for name, sites in sorted(by_name.items()):
+        knob = knobs.KNOBS.get(name)
+        if knob is not None:
+            reference = _canonical_default(name, knob.default)
+            ref_desc = "registry default %r" % (knob.default,)
+        else:
+            reference = _canonical_default(name, sites[0].default)
+            ref_desc = "default %r at %s:%d" % (
+                sites[0].default, sites[0].path, sites[0].lineno)
+        values = {reference}
+        for site in sites:
+            value = _canonical_default(name, site.default)
+            values.add(value)
+            if value != reference:
+                findings.append(Finding(
+                    "knob-inconsistent-default", ERROR,
+                    "%s read with default %r here but %s elsewhere — "
+                    "defaults must live in the registry, not call sites"
+                    % (name, site.default, ref_desc),
+                    lineno=site.lineno, source_file=site.path))
+
+    if docs_text is not None:
+        for name in sorted(knobs.KNOBS):
+            if name not in docs_text:
+                findings.append(Finding(
+                    "knob-undocumented", WARNING,
+                    "registered knob %s is missing from docs/knobs.md — "
+                    "regenerate it with `python -m metaflow_tpu knobs "
+                    "--markdown`" % name,
+                    source_file=REGISTRY_BASENAME))
+    return findings
+
+
+def _accessor_for(name):
+    knob = knobs.KNOBS[name]
+    return {"str": "get_str", "path": "get_str", "bool": "get_bool",
+            "int": "get_int", "float": "get_float"}[knob.ktype] \
+        + "(%r)" % name
+
+
+# ---------------------------------------------------------------------------
+# pass 2: deadline ordering
+# ---------------------------------------------------------------------------
+
+def deadline_order(env=None, severity=WARNING):
+    """Lattice findings: registry defaults are always checked (error —
+    a violation there is a bug in knobs.py); pass ``env`` to also check
+    a live environment (warning by default; the pre-run gate escalates
+    under TPUFLOW_STRICT_CHECK=1)."""
+    findings = [
+        Finding("deadline-order", ERROR,
+                "registry defaults violate the deadline order: "
+                + violation.render(),
+                source_file=REGISTRY_BASENAME)
+        for violation in knobs.validate_defaults()
+    ]
+    if env is not None:
+        findings.extend(
+            Finding("deadline-order", severity,
+                    "environment violates the deadline order: "
+                    + violation.render(),
+                    source_file="<environment>")
+            for violation in knobs.validate_env(env)
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 3: telemetry schema drift
+# ---------------------------------------------------------------------------
+
+#: pin-table name suffixes whose dict keys are pinned telemetry names
+PIN_TABLE_SUFFIXES = ("_EVENT_DATA_SCHEMAS", "_METRIC_NAMES",
+                      "_EVENT_NAMES", "_RECORD_DATA_SCHEMAS")
+
+#: tuple constants in the schema module listing extra pins / dynamic
+#: name patterns
+EXTRA_PINS_NAME = "EXTRA_PINNED_TELEMETRY_NAMES"
+DYNAMIC_PREFIXES_NAME = "DYNAMIC_EMIT_PREFIXES"
+DYNAMIC_SUFFIXES_NAME = "DYNAMIC_EMIT_SUFFIXES"
+
+
+def load_pins(schema_path):
+    """Pinned telemetry names from the schema module's AST: (pins,
+    dynamic_prefixes, dynamic_suffixes), where pins maps name ->
+    "module:lineno" of its pin."""
+    with open(schema_path) as handle:
+        tree = ast.parse(handle.read())
+    pins, prefixes, suffixes = {}, (), ()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if target.endswith(PIN_TABLE_SUFFIXES) \
+                and isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                name = _const_str(key)
+                if name is not None:
+                    pins.setdefault(name, key.lineno)
+        elif target == EXTRA_PINS_NAME \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                name = _const_str(elt)
+                if name is not None:
+                    pins.setdefault(name, elt.lineno)
+        elif target == DYNAMIC_PREFIXES_NAME \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            prefixes = tuple(_const_str(e) for e in node.value.elts
+                             if _const_str(e) is not None)
+        elif target == DYNAMIC_SUFFIXES_NAME \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            suffixes = tuple(_const_str(e) for e in node.value.elts
+                             if _const_str(e) is not None)
+    return pins, prefixes, suffixes
+
+
+def telemetry_drift(emits, schema_path, library_paths):
+    """Both drift directions against the pins in ``schema_path``."""
+    pins, prefixes, suffixes = load_pins(schema_path)
+    findings = []
+    emitted = set()
+    for site in emits:
+        emitted.add(site.name)
+        if site.name in pins:
+            continue
+        if site.name.startswith(prefixes) and prefixes:
+            continue
+        if site.name.endswith(suffixes) and suffixes:
+            continue
+        findings.append(Finding(
+            "telemetry-unpinned-event", ERROR,
+            "%s %r is emitted here but has no pinned schema in %s — "
+            "its payload can drift silently"
+            % (site.rtype, site.name, os.path.basename(schema_path)),
+            lineno=site.lineno, source_file=site.path))
+
+    # the reverse direction tolerates names built conditionally (e.g.
+    # serve.request.finished picks its literal before the emit call):
+    # a pin is live if its name appears as a string literal anywhere
+    # in the scanned library
+    literals = _all_string_literals(library_paths)
+    for name, lineno in sorted(pins.items()):
+        if name in emitted or name in literals:
+            continue
+        findings.append(Finding(
+            "telemetry-dead-schema", WARNING,
+            "pinned telemetry name %r has no emit site in the library — "
+            "retire the pin or re-wire the emit" % name,
+            lineno=lineno, source_file=schema_path))
+    return findings
+
+
+def _all_string_literals(paths):
+    out = set()
+    for root in paths:
+        for path in _iter_py_files(root):
+            try:
+                with open(path) as handle:
+                    tree = ast.parse(handle.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    out.add(node.value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def analyze_library(paths, schema_path=None, docs_path=None, env=None):
+    """The full contracts sweep over library source trees. Returns an
+    AnalysisReport (flow name "<library>")."""
+    report = AnalysisReport("<library>")
+    report.analyses.append("contracts")
+    reads, accessor_calls, emits = scan_paths(paths)
+    docs_text = None
+    if docs_path and os.path.exists(docs_path):
+        with open(docs_path) as handle:
+            docs_text = handle.read()
+    report.extend(knob_lint(reads, accessor_calls, docs_text=docs_text))
+    report.checks_run += 4
+    report.extend(deadline_order(env=env))
+    report.checks_run += 1
+    if schema_path and os.path.exists(schema_path):
+        report.extend(telemetry_drift(emits, schema_path, paths))
+        report.checks_run += 2
+    return report
+
+
+def analyze_flow_file(flow_file, env=None):
+    """The per-flow contracts analysis that rides inside
+    ``check --deep``: knob lint over the flow's own source (catches a
+    typo'd env read before the gang launches) plus the deadline lattice
+    over the live environment."""
+    report = AnalysisReport(os.path.basename(flow_file))
+    report.analyses.append("contracts")
+    reads, accessor_calls, _emits = scan_paths([flow_file])
+    report.extend(knob_lint(reads, accessor_calls))
+    report.checks_run += 3
+    report.extend(deadline_order(env=env if env is not None
+                                 else dict(os.environ)))
+    report.checks_run += 1
+    return report
+
+
+def main(argv=None):
+    import argparse
+    import json as _json
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m metaflow_tpu.analysis.contracts",
+        description="knob/deadline/telemetry contract analysis")
+    parser.add_argument("paths", nargs="+",
+                        help="library roots or files to scan")
+    parser.add_argument("--schema", default=None,
+                        help="tests/schema_validate.py for telemetry pins")
+    parser.add_argument("--docs", default=None,
+                        help="docs/knobs.md for the undocumented check")
+    parser.add_argument("--check-env", action="store_true",
+                        help="also evaluate the lattice on the live env")
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    report = analyze_library(
+        args.paths, schema_path=args.schema, docs_path=args.docs,
+        env=dict(os.environ) if args.check_env else None)
+    if args.as_json:
+        print(_json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        for line in report.render_lines():
+            print(line)
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

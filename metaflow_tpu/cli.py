@@ -14,6 +14,7 @@ import traceback
 
 import click
 
+from . import knobs
 from .datastore import STORAGE_BACKENDS, FlowDataStore
 from .decorators import (
     _attach_decorators,
@@ -211,9 +212,7 @@ def make_cli(flow, state):
         state.flow_datastore = FlowDataStore(
             flow.name, storage_impl, ds_root=datastore_root
         )
-        if datastore != "local" and os.environ.get(
-            "TPUFLOW_BLOB_CACHE", "1"
-        ) != "0":
+        if datastore != "local" and knobs.get_bool("TPUFLOW_BLOB_CACHE"):
             # task-side reads share the host-local blob cache too — CAS
             # blobs are immutable, so N tasks on one host download each
             # input artifact once, not N times (reference gap:
@@ -1126,7 +1125,7 @@ def main(flow, args=None):
         sys.exit(ex.exit_code)
     except TpuFlowException as ex:
         sys.stderr.write("%s: %s\n" % (ex.headline, str(ex)))
-        if os.environ.get("TPUFLOW_DEBUG"):
+        if knobs.get_bool("TPUFLOW_DEBUG"):
             traceback.print_exc()
         sys.exit(1)
     except click.exceptions.Abort:

@@ -18,14 +18,16 @@ import os
 import tempfile
 import threading
 
+from .. import knobs
+
 
 class FileCache(object):
     """Plugs into ContentAddressedStore.set_blob_cache."""
 
     def __init__(self, cache_dir=None, max_size=4 << 30):
-        self._dir = cache_dir or os.environ.get(
+        self._dir = cache_dir or knobs.get_str(
             "TPUFLOW_CLIENT_CACHE",
-            os.path.join(tempfile.gettempdir(), "tpuflow_cache"),
+            fallback=os.path.join(tempfile.gettempdir(), "tpuflow_cache"),
         )
         self._max_size = max_size
         self._approx_total = None  # lazily initialized running size counter

@@ -1,0 +1,65 @@
+"""`python -m metaflow_tpu knobs`: render and check the knob registry.
+
+Four views over metaflow_tpu/knobs.py (the single source of truth for
+every TPUFLOW_* environment knob):
+
+    knobs               human-readable table, grouped by subsystem
+    knobs --json        machine-readable registry dump (v1)
+    knobs --markdown    the exact content of docs/knobs.md
+    knobs --ordering    the deadline-ordering lattice edges
+    knobs --check-env   validate the LIVE environment against the
+                        lattice; exit 1 on any violation
+
+--check-env is the operator-facing entry of the same check the pre-run
+gate applies to every run (warn by default, fatal under
+TPUFLOW_STRICT_CHECK=1): run it in CI over the environment a template
+exports before the template ships.
+"""
+
+from .. import knobs
+
+
+def show_knobs(as_json=False, markdown=False, ordering=False,
+               check_env=False, echo=print):
+    """Body of `python -m metaflow_tpu knobs`; returns the exit code."""
+    if as_json:
+        echo(knobs.render_json())
+        return 0
+    if markdown:
+        echo(knobs.render_markdown().rstrip("\n"))
+        return 0
+    if ordering:
+        echo("deadline-ordering lattice (lo <= hi):")
+        for edge in knobs.ORDERING:
+            suffix = "  [skipped when either side is 0]" \
+                if edge.skip_if_zero else ""
+            echo("  %s <= %s%s" % (edge.lo, edge.hi, suffix))
+            echo("      %s" % edge.reason)
+        return 0
+    if check_env:
+        violations = knobs.validate_env()
+        overridden = [n for n in sorted(knobs.KNOBS) if knobs.is_set(n)]
+        echo("%d knob(s) set in this environment"
+             % len(overridden))
+        for name in overridden:
+            echo("  %s=%s" % (name, knobs.get_raw(name)))
+        if violations:
+            echo("%d ordering violation(s):" % len(violations))
+            for violation in violations:
+                echo("  %s" % violation.render())
+            return 1
+        echo("deadline ordering: ok (%d edge(s) checked)"
+             % len(knobs.ORDERING))
+        return 0
+
+    for sub, entries in knobs.by_subsystem():
+        echo("%s:" % sub)
+        for knob in entries:
+            star = "*" if knobs.is_set(knob.name) else " "
+            echo("%s %-38s %-6s default=%-12s %s"
+                 % (star, knob.name, knob.ktype,
+                    knobs._default_str(knob), knob.doc))
+    echo("")
+    echo("* = set in the current environment. "
+         "--json / --markdown / --ordering / --check-env for more.")
+    return 0

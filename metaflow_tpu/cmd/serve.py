@@ -13,6 +13,7 @@ records.
 import json
 import os
 
+from .. import knobs
 from ..exception import TpuFlowException
 
 
@@ -100,7 +101,7 @@ def build_engine(params, cfg, slots=8, max_seq_len=None, prefill_chunk=64,
                      if isinstance(cfg, mixtral_mod.MixtralConfig)
                      else llama_mod)
         params = shard_tree(params, model_mod.logical_axes(cfg), mesh)
-    if paged or os.environ.get("TPUFLOW_PAGED", "0") not in ("0", ""):
+    if paged or knobs.get_bool("TPUFLOW_PAGED"):
         return PagedEngine(params, cfg, max_slots=slots,
                            max_seq_len=max_seq_len,
                            prefill_chunk=prefill_chunk, mesh=mesh,

@@ -36,6 +36,8 @@ import tempfile
 import threading
 import traceback
 
+from . import knobs
+
 # Handshake: every request carries the protocol version and a token hashed
 # over the whole package's source, so a stale client from an older
 # checkout cannot silently drive a newer daemon — and a daemon whose
@@ -67,10 +69,10 @@ def checkout_token():
 
 
 def default_socket_path():
-    return os.environ.get(
+    return knobs.get_str(
         "TPUFLOW_DAEMON_SOCKET",
-        os.path.join(tempfile.gettempdir(),
-                     "tpuflow-daemon-%d.sock" % os.getuid()),
+        fallback=os.path.join(tempfile.gettempdir(),
+                              "tpuflow-daemon-%d.sock" % os.getuid()),
     )
 
 

@@ -32,7 +32,7 @@ import time
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 from .ordering import STATE_KEY, epoch_shard_order, shard_window_order
 from .reader import ShardReader, host_slice
 from .shards import DatasetError, load_manifest
@@ -91,7 +91,7 @@ class StreamingTokenBatches(object):
         # Only lockstep-identical geometry is journaled — never the
         # host-specific cursors (per-host slices are disjoint BY DESIGN).
         self._sanitizer = None
-        if _env_int("TPUFLOW_SANITIZE", 0) == 1:
+        if knobs.get_bool("TPUFLOW_SANITIZE"):
             from ..spmd import sanitizer
 
             self._sanitizer = sanitizer

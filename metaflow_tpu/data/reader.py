@@ -33,7 +33,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..exception import TpuFlowException
 from .shards import decode_shard, verify_blob
 
@@ -46,11 +46,7 @@ class ShardCorruptionError(TpuFlowException):
 
 
 def readahead_bytes_from_env():
-    try:
-        mb = float(os.environ.get("TPUFLOW_DATA_READAHEAD_MB",
-                                  str(DEFAULT_READAHEAD_MB)))
-    except ValueError:
-        mb = DEFAULT_READAHEAD_MB
+    mb = knobs.get_float("TPUFLOW_DATA_READAHEAD_MB")
     return max(1, int(mb * 1024 * 1024))
 
 
@@ -77,11 +73,7 @@ class ShardReader(object):
         self._fds = flow_datastore
         self._manifest = manifest
         if max_workers is None:
-            try:
-                max_workers = int(os.environ.get("TPUFLOW_DATA_WORKERS",
-                                                 str(DEFAULT_WORKERS)))
-            except ValueError:
-                max_workers = DEFAULT_WORKERS
+            max_workers = knobs.get_int("TPUFLOW_DATA_WORKERS")
         self._max_workers = max(1, max_workers)
         self._readahead = (readahead_bytes if readahead_bytes
                            else readahead_bytes_from_env())

@@ -7,6 +7,7 @@ get_task_datastores:79 latest-attempt resolution, save_data:348).
 import hashlib
 import os
 
+from .. import knobs
 from .cas import ContentAddressedStore
 from .task_datastore import TaskDataStore
 
@@ -34,7 +35,7 @@ class FlowDataStore(object):
         )
         if blob_cache is None:
             if (self.storage.TYPE != "local"
-                    and os.environ.get("TPUFLOW_BLOB_CACHE", "1") != "0"):
+                    and knobs.get_bool("TPUFLOW_BLOB_CACHE")):
                 from ..client.filecache import FileCache
 
                 self.ca_store.set_blob_cache(FileCache())

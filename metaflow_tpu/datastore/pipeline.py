@@ -41,6 +41,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor, as_completed
 
 from .. import tracing
+from .. import knobs
 from . import serializers
 
 DEFAULT_INFLIGHT_BYTES = 512 << 20
@@ -107,12 +108,12 @@ def persist_pipeline(artifacts, ca_store, raw=False, workers=None,
     items = list(artifacts)
     if not items:
         return []
-    workers = workers or int(
-        os.environ.get("TPUFLOW_PERSIST_WORKERS", DEFAULT_WORKERS))
-    upload_workers = upload_workers or int(
-        os.environ.get("TPUFLOW_PERSIST_UPLOADS", DEFAULT_UPLOADS))
+    workers = workers or knobs.get_int(
+        "TPUFLOW_PERSIST_WORKERS", fallback=DEFAULT_WORKERS)
+    upload_workers = upload_workers or knobs.get_int(
+        "TPUFLOW_PERSIST_UPLOADS", fallback=DEFAULT_UPLOADS)
     cap = max_inflight_bytes or (
-        int(os.environ.get("TPUFLOW_PERSIST_INFLIGHT_MB", "0")) << 20
+        knobs.get_int("TPUFLOW_PERSIST_INFLIGHT_MB") << 20
         or DEFAULT_INFLIGHT_BYTES)
 
     # stage 0: every device array starts its D2H copy NOW — by the time a

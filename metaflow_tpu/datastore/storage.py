@@ -12,6 +12,8 @@ import sys
 import time
 from tempfile import NamedTemporaryFile
 
+from .. import knobs
+
 
 def _storage_retry(fn, what, policy=None, attempts=None):
     """Run an idempotent storage network op with bounded, jittered
@@ -30,10 +32,7 @@ def _storage_retry(fn, what, policy=None, attempts=None):
     from ..gsop import GSTransientError
 
     if attempts is None:
-        try:
-            attempts = int(os.environ.get("TPUFLOW_STORAGE_RETRIES", "3"))
-        except ValueError:
-            attempts = 3
+        attempts = knobs.get_int("TPUFLOW_STORAGE_RETRIES")
     attempts = max(0, int(attempts))
     if policy is None:
         policy = BackoffPolicy.from_env()
@@ -66,11 +65,7 @@ def storage_timeout_s(env=None):
     the caller forever with a live heartbeat — exactly the wedge the
     gang watchdog has to escalate on; the deadline turns it into a
     TimeoutError that rides the normal _storage_retry budget instead."""
-    try:
-        return float((env or os.environ).get(
-            "TPUFLOW_STORAGE_TIMEOUT_S", "0") or 0)
-    except (TypeError, ValueError):
-        return 0.0
+    return knobs.get_float("TPUFLOW_STORAGE_TIMEOUT_S", env=env)
 
 
 def run_with_deadline(fn, what, timeout_s):
@@ -290,9 +285,9 @@ class GCSStorage(DataStoreStorage):
 
     @classmethod
     def get_datastore_root_from_config(cls, echo=None, create_on_absent=True):
-        root = os.environ.get(
+        root = knobs.get_str(
             "TPUFLOW_DATASTORE_SYSROOT_GS",
-            os.environ.get("METAFLOW_DATASTORE_SYSROOT_GS"),
+            fallback=os.environ.get("METAFLOW_DATASTORE_SYSROOT_GS"),
         )
         if not root:
             from ..exception import TpuFlowException
@@ -413,7 +408,7 @@ class GCSStorage(DataStoreStorage):
                     # eat RAM-backed storage
                     import tempfile
 
-                    scratch = os.environ.get("TPUFLOW_SCRATCH_DIR") or None
+                    scratch = knobs.get_str("TPUFLOW_SCRATCH_DIR") or None
                     tmp = tempfile.NamedTemporaryFile(
                         delete=False, dir=scratch
                     )

@@ -12,7 +12,7 @@ import os
 import time
 from functools import wraps
 
-from .. import tracing
+from .. import knobs, tracing
 from ..exception import TpuFlowDataMissing, MetaflowInternalError
 from . import serializers
 
@@ -180,7 +180,7 @@ class TaskDataStore(object):
         if pipelined is None:
             pipelined = (
                 len(items) > 1
-                and os.environ.get("TPUFLOW_PERSIST_PIPELINE", "1") != "0"
+                and knobs.get_bool("TPUFLOW_PERSIST_PIPELINE")
             )
         with tracing.span(
             "persist.save_artifacts",

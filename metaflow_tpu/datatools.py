@@ -17,6 +17,7 @@ import shutil
 import tempfile
 from concurrent.futures import ThreadPoolExecutor
 
+from . import knobs
 from .exception import TpuFlowException
 
 MAX_WORKERS = 32
@@ -66,9 +67,9 @@ class GS(object):
     def __init__(self, gsroot=None, run=None, tmproot=None):
         """gsroot: base URI/dir; run: a FlowSpec — scopes paths to
         <root>/<flow>/<run_id> (the reference's S3(run=self) pattern)."""
-        root = gsroot or os.environ.get(
+        root = gsroot or knobs.get_str(
             "TPUFLOW_DATATOOLS_ROOT",
-            os.path.join(os.getcwd(), ".tpuflow", "data_gs"),
+            fallback=os.path.join(os.getcwd(), ".tpuflow", "data_gs"),
         )
         if run is not None:
             from .current import current

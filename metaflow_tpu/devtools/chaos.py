@@ -43,7 +43,7 @@ import os
 import tempfile
 import time
 
-from .. import telemetry
+from .. import knobs, telemetry
 
 CHAOS_ENV = "TPUFLOW_CHAOS"
 STEPS_ENV = "TPUFLOW_CHAOS_STEPS"
@@ -189,7 +189,7 @@ class ChaosInjector(object):
         """Bounded straggler: one long-but-finite delay, then the step
         proceeds. Progress resumes before any sane deadline, so the hang
         watchdog must NOT fire (the false-positive guard)."""
-        delay_s = float(os.environ.get(SLOW_S_ENV, "1.0"))
+        delay_s = knobs.get_float(SLOW_S_ENV)
         telemetry.event(
             "chaos.slow",
             data={"step": int(step), "rank": self.rank,
@@ -240,13 +240,13 @@ def _default_ledger_dir():
 def schedule_from_env(world, env=None):
     """The configured KillSchedule, or None when chaos is off."""
     env = env if env is not None else os.environ
-    spec = (env.get(CHAOS_ENV) or "").strip()
+    spec = (knobs.get_str(CHAOS_ENV, env=env) or "").strip()
     if not spec:
         return None
     if ":" in spec:
         return KillSchedule.parse(spec)
-    n_steps = int(env.get(STEPS_ENV, "10"))
-    n_kills = int(env.get(NKILLS_ENV, "1"))
+    n_steps = knobs.get_int(STEPS_ENV, env=env)
+    n_kills = knobs.get_int(NKILLS_ENV, env=env)
     return KillSchedule.seeded(int(spec), n_steps, world, n_kills)
 
 
@@ -261,7 +261,7 @@ def from_env(rank=None, world=None, env=None):
     schedule = schedule_from_env(world, env=env)
     if schedule is None:
         return None
-    ledger = env.get(DIR_ENV) or _default_ledger_dir()
+    ledger = knobs.get_str(DIR_ENV, env=env) or _default_ledger_dir()
     return ChaosInjector(schedule, rank, world, ledger)
 
 
@@ -327,13 +327,13 @@ def fleet_schedule_from_env(n_replicas, env=None):
     """The configured fleet KillSchedule, or None when fleet chaos is
     off."""
     env = env if env is not None else os.environ
-    spec = (env.get(FLEET_ENV) or "").strip()
+    spec = (knobs.get_str(FLEET_ENV, env=env) or "").strip()
     if not spec:
         return None
     if ":" in spec:
         return KillSchedule.parse(spec)
-    horizon = int(env.get(FLEET_DISPATCHES_ENV, "8"))
-    n_kills = int(env.get(FLEET_NKILLS_ENV, "1"))
+    horizon = knobs.get_int(FLEET_DISPATCHES_ENV, env=env)
+    n_kills = knobs.get_int(FLEET_NKILLS_ENV, env=env)
     return KillSchedule.seeded(int(spec), horizon, n_replicas, n_kills)
 
 
@@ -344,7 +344,7 @@ def fleet_from_env(n_replicas, env=None):
     schedule = fleet_schedule_from_env(n_replicas, env=env)
     if schedule is None:
         return None
-    ledger = env.get(DIR_ENV) or _default_ledger_dir()
+    ledger = knobs.get_str(DIR_ENV, env=env) or _default_ledger_dir()
     return FleetChaosInjector(schedule, ledger)
 
 
@@ -355,7 +355,7 @@ def maybe_chaos_step(step):
     """Module-level tick for instrumented training loops: no-op unless
     TPUFLOW_CHAOS is set. The injector is cached per (pid, rank) — gang
     worker processes each build their own."""
-    if not os.environ.get(CHAOS_ENV):
+    if not knobs.get_str(CHAOS_ENV):
         return False
     key = (os.getpid(), os.environ.get("MF_PARALLEL_NODE_INDEX", "0"))
     if key not in _injector_cache:

@@ -27,6 +27,8 @@ prod incident.
 import os
 import time
 
+from .. import knobs
+
 
 class CapacityOracle(object):
     def available_hosts(self):
@@ -156,7 +158,8 @@ class GceCapacityOracle(CapacityOracle):
 def oracle_from_env(env=None):
     """Build the configured oracle; None = capacity unknown (adaptive)."""
     env = env if env is not None else os.environ
-    spec = (env.get("TPUFLOW_CAPACITY_ORACLE") or "none").strip()
+    spec = (knobs.get_str("TPUFLOW_CAPACITY_ORACLE", env=env)
+            or "none").strip()
     if spec in ("", "none", "0"):
         return None
     if spec.startswith("static:"):

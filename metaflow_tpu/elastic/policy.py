@@ -31,6 +31,8 @@ Failure classes drive what a retry MEANS:
 
 import os
 
+from .. import knobs
+
 CLASS_PREEMPTION = "preemption"
 CLASS_GROW = "grow"
 CLASS_HANG = "hang"
@@ -108,25 +110,18 @@ class BackoffPolicy(object):
 
     @classmethod
     def from_env(cls, env=None):
-        env = env if env is not None else os.environ
-
-        # a malformed knob degrades to its default — this runs inside
-        # NativeRuntime construction, where a typo'd env var must not
-        # kill every run of every flow before any task starts
-        def _f(name, default):
-            try:
-                return float(env.get(name, default))
-            except (TypeError, ValueError):
-                return default
-
-        seed = env.get("TPUFLOW_RETRY_BACKOFF_SEED")
+        # malformed knobs degrade to their registry defaults (the
+        # accessors' contract) — this runs inside NativeRuntime
+        # construction, where a typo'd env var must not kill every run
+        # of every flow before any task starts
+        seed = knobs.get_raw("TPUFLOW_RETRY_BACKOFF_SEED", env=env)
         try:
             seed = int(seed) if seed is not None else None
         except ValueError:
             seed = None
         return cls(
-            base_s=_f("TPUFLOW_RETRY_BACKOFF_BASE_S", 0.2),
-            cap_s=_f("TPUFLOW_RETRY_BACKOFF_CAP_S", 60.0),
-            jitter=_f("TPUFLOW_RETRY_BACKOFF_JITTER", 0.5),
+            base_s=knobs.get_float("TPUFLOW_RETRY_BACKOFF_BASE_S", env=env),
+            cap_s=knobs.get_float("TPUFLOW_RETRY_BACKOFF_CAP_S", env=env),
+            jitter=knobs.get_float("TPUFLOW_RETRY_BACKOFF_JITTER", env=env),
             seed=seed,
         )

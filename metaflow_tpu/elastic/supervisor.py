@@ -32,6 +32,7 @@ import json
 import os
 import time
 
+from .. import knobs
 from ..plugins.tpu.topologies import TPU_TOPOLOGY_SELECTORS
 from ..unbounded_foreach import UBF_CONTROL
 from ..util import env_float, env_int
@@ -102,19 +103,18 @@ class ElasticGangSupervisor(object):
         self._oracle = oracle
         self._backoff = backoff or BackoffPolicy.from_env()
         if resize_enabled is None:
-            resize_enabled = os.environ.get("TPUFLOW_ELASTIC_RESIZE",
-                                            "1") == "1"
+            resize_enabled = knobs.get_bool("TPUFLOW_ELASTIC_RESIZE")
         self._resize_enabled = resize_enabled
         # extra attempts granted to capacity-classified failures, beyond
         # the user @retry budget (MAX_ATTEMPTS still caps everything)
-        self._elastic_retries = env_int("TPUFLOW_ELASTIC_RETRIES", 8)
+        self._elastic_retries = knobs.get_int("TPUFLOW_ELASTIC_RETRIES")
         # adaptive (oracle-less) policy knobs
-        self._shrink_after = env_int("TPUFLOW_ELASTIC_SHRINK_AFTER", 2)
-        self._grow_every_s = env_float("TPUFLOW_ELASTIC_GROW_EVERY_S", 5.0)
+        self._shrink_after = knobs.get_int("TPUFLOW_ELASTIC_SHRINK_AFTER")
+        self._grow_every_s = knobs.get_float("TPUFLOW_ELASTIC_GROW_EVERY_S")
         # repeated-hang cap: the same laggard step hanging again after a
         # checkpoint-restore retry means the wedge is deterministic —
         # keep retrying and the gang burns capacity at zero progress
-        self._hang_same_step_max = env_int("TPUFLOW_HANG_SAME_STEP_MAX", 2)
+        self._hang_same_step_max = knobs.get_int("TPUFLOW_HANG_SAME_STEP_MAX")
         self.run_id = None  # set by the runtime once the run id exists
         self._state = {}
         self._facts = None  # lazy analysis facts for mesh validation

@@ -41,7 +41,7 @@ import os
 import signal
 import time
 
-from .. import progress
+from .. import knobs, progress
 from ..metadata.metadata import MetaDatum
 from ..telemetry import HANGS_PREFIX
 from ..unbounded_foreach import UBF_CONTROL
@@ -58,7 +58,7 @@ HEARTBEAT_STALE_S = 30.0
 
 
 def hang_detect_enabled(env=None):
-    return (env or os.environ).get(DETECT_ENV, "1") == "1"
+    return knobs.get_bool(DETECT_ENV, env=env)
 
 
 class GangWatchdog(object):
@@ -69,9 +69,9 @@ class GangWatchdog(object):
         self._recorder = recorder
         self._echo = echo or (lambda line: print(line, flush=True))
         self._root = root or get_tpuflow_root()
-        self._poll_every = env_float(POLL_ENV, 5.0)
-        self._kill_grace = env_float(KILL_GRACE_ENV, 5.0)
-        self._dump_wait = env_float(DUMP_WAIT_ENV, 0.5)
+        self._poll_every = knobs.get_float(POLL_ENV)
+        self._kill_grace = knobs.get_float(KILL_GRACE_ENV)
+        self._dump_wait = knobs.get_float(DUMP_WAIT_ENV)
         self.run_id = None  # set by the runtime once the run id exists
         self._last_poll = 0.0
         # (step, task_id, attempt) -> SIGTERM ts, for SIGKILL escalation.
@@ -252,8 +252,8 @@ class GangWatchdog(object):
         """SIGQUIT every beating rank, gather the stack dumps + sanitizer
         journal tail, upload the bundle under _telemetry/hangs/. Returns
         the datastore path of the report (or None when upload failed)."""
-        dump_sig = int(os.environ.get(progress.DUMP_SIGNAL_ENV, "0") or 0) \
-            or signal.SIGQUIT
+        dump_sig = (knobs.get_int(progress.DUMP_SIGNAL_ENV)
+                    or signal.SIGQUIT)
         dumped = set()
         for member, beat in beats.items():
             pid = beat.get("pid")

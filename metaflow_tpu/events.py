@@ -11,6 +11,7 @@ import json
 import os
 import time
 
+from . import knobs
 from .util import get_tpuflow_root
 
 
@@ -53,7 +54,7 @@ class ArgoEvent(object):
 
     def __init__(self, name, url=None):
         self.name = name
-        self.url = url or os.environ.get("TPUFLOW_ARGO_EVENTS_URL")
+        self.url = url or knobs.get_str("TPUFLOW_ARGO_EVENTS_URL")
         self._payload = {}
 
     def add_to_payload(self, key, value):
@@ -155,7 +156,8 @@ class LocalTriggerListener(object):
         self._run_args = list(run_args or [])
         # watch the bus the LAUNCHED flows will publish to (the root in
         # `env`), not necessarily this process's own
-        self._root = self._env.get("TPUFLOW_DATASTORE_SYSROOT_LOCAL")
+        self._root = knobs.get_str("TPUFLOW_DATASTORE_SYSROOT_LOCAL",
+                                   env=self._env)
         self._seen = len(list_events(root=self._root))
 
     def register(self, flow_script):

@@ -33,6 +33,8 @@ import pkgutil
 import sys
 import traceback
 
+from . import knobs
+
 EXT_PKG = "metaflow_tpu_extensions"
 
 # click commands contributed by extensions; cli.main() adds these to every
@@ -163,10 +165,7 @@ def load_extensions(force=False):
     _loaded = True
     if _core_snapshot is None:
         _core_snapshot = _registry_snapshot()
-    if os.environ.get("TPUFLOW_DISABLE_EXTENSIONS", "").lower() in (
-        "1",
-        "true",
-    ):
+    if knobs.get_bool("TPUFLOW_DISABLE_EXTENSIONS"):
         # disabling after a previous load must also UNregister: reset to
         # the pre-extension baseline, not just report empty
         if _loaded_extensions:
@@ -222,6 +221,6 @@ def load_extensions(force=False):
                 "[extensions] skipping broken extension %s (%s)\n"
                 % (full, _failed_extensions[full])
             )
-            if os.environ.get("TPUFLOW_DEBUG"):
+            if knobs.get_bool("TPUFLOW_DEBUG"):
                 traceback.print_exc()
     return list(_loaded_extensions)

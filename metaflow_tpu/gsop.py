@@ -42,6 +42,7 @@ import threading
 import time
 import urllib.parse
 
+from . import knobs
 from .exception import TpuFlowException
 
 DEFAULT_ENDPOINT = "https://storage.googleapis.com"
@@ -134,9 +135,7 @@ class GSClient(object):
     def __init__(self, endpoint=None, inject_failure_rate=0.0, seed=None,
                  part_size=PART_SIZE, ranged_threshold=RANGED_THRESHOLD,
                  max_concurrency=MAX_CONCURRENCY):
-        endpoint = endpoint or os.environ.get(
-            "TPUFLOW_GS_ENDPOINT", DEFAULT_ENDPOINT
-        )
+        endpoint = endpoint or knobs.get_str("TPUFLOW_GS_ENDPOINT")
         parsed = urllib.parse.urlparse(endpoint)
         self._secure = parsed.scheme == "https"
         self._host = parsed.hostname

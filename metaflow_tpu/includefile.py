@@ -21,6 +21,7 @@ versioned uploader protocol (UploaderV1:386, UploaderV2:478). Design here:
 
 import os
 
+from . import knobs
 from .exception import TpuFlowException
 from .parameters import Parameter
 
@@ -191,7 +192,7 @@ class IncludeFile(Parameter):
                                                                  path)
             )
         size = os.path.getsize(path)
-        max_mb = int(os.environ.get(MAX_SIZE_MB_ENV, DEFAULT_MAX_SIZE_MB))
+        max_mb = knobs.get_int(MAX_SIZE_MB_ENV)
         if size > max_mb << 20:
             raise TpuFlowException(
                 "IncludeFile *%s*: '%s' is %.1f MB, over the %d MB limit "

@@ -26,6 +26,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
 from ..models import llama
 from ..ops import rms_norm
 from ..ops.attention import NEG_INF, _broadcast_gqa
@@ -83,10 +84,7 @@ def _cached_attention(q, cache_k, cache_v, pos):
 
 
 def _default_decode_chunk():
-    try:
-        return max(1, int(os.environ.get("TPUFLOW_DECODE_CHUNK", "256")))
-    except ValueError:
-        return 256
+    return max(1, knobs.get_int("TPUFLOW_DECODE_CHUNK"))
 
 
 # KV-chunk size of the flash-decode path, and the pivot of the

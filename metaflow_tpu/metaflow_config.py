@@ -9,14 +9,14 @@ by TPUFLOW_* env vars (METAFLOW_* accepted as aliases), plus a per-project
 import json
 import os
 
+from . import knobs
+
 _conf_cache = None
 
 
 def _profile_path():
-    profile = os.environ.get("TPUFLOW_PROFILE", "")
-    home = os.environ.get(
-        "TPUFLOW_HOME", os.path.expanduser("~/.tpuflowconfig")
-    )
+    profile = knobs.get_str("TPUFLOW_PROFILE")
+    home = os.path.expanduser(knobs.get_str("TPUFLOW_HOME"))
     name = "config_%s.json" % profile if profile else "config.json"
     return os.path.join(home, name)
 

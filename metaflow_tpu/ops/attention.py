@@ -19,11 +19,13 @@ import numpy as np
 
 import os
 
+from .. import knobs
+
 # 128 is the MXU tile floor; the defaults are overridable for tuning
 # sweeps (bench) and odd shapes. Combinations where one block size
 # divides the other keep the causal live-block arithmetic exact.
-BLOCK_Q = int(os.environ.get("TPUFLOW_FLASH_BLOCK_Q", "128"))
-BLOCK_K = int(os.environ.get("TPUFLOW_FLASH_BLOCK_K", "128"))
+BLOCK_Q = knobs.get_int("TPUFLOW_FLASH_BLOCK_Q")
+BLOCK_K = knobs.get_int("TPUFLOW_FLASH_BLOCK_K")
 NEG_INF = -1e30
 
 

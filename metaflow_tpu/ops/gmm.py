@@ -24,6 +24,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
+
 try:  # pallas import is TPU/CPU-interpret capable; keep soft for portability
     from jax.experimental import pallas as pl
 except Exception:  # pragma: no cover
@@ -43,9 +45,9 @@ def _pltpu():
 # on-chip MFU sweep can tune MXU block sizes without code edits —
 # BLOCK_S is also the padding quantum of the grouped layout, so a run
 # must use ONE consistent value end to end
-BLOCK_S = int(os.environ.get("TPUFLOW_GMM_BLOCK_S", "128"))
-BLOCK_F = int(os.environ.get("TPUFLOW_GMM_BLOCK_F", "128"))
-BLOCK_D = int(os.environ.get("TPUFLOW_GMM_BLOCK_D", "128"))
+BLOCK_S = knobs.get_int("TPUFLOW_GMM_BLOCK_S")
+BLOCK_F = knobs.get_int("TPUFLOW_GMM_BLOCK_F")
+BLOCK_D = knobs.get_int("TPUFLOW_GMM_BLOCK_D")
 
 
 def _default_interpret():

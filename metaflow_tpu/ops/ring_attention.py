@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from .. import knobs
+
 from .attention import (
     BLOCK_K,
     BLOCK_Q,
@@ -292,7 +294,7 @@ def _ring_attention_local_flash(q, k, v, axis_name, causal=True, scale=None,
 
 def _resolve_impl(impl, S_local):
     if impl == "auto":
-        impl = os.environ.get("TPUFLOW_RING_IMPL", "auto")
+        impl = knobs.get_str("TPUFLOW_RING_IMPL")
     # same predicate flash_block_fwd/bwd enforce — single source of truth
     aligned = blocks_aligned(S_local)
     if impl == "auto":

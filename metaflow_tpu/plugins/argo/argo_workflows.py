@@ -48,6 +48,7 @@ import os
 import re
 import shlex
 
+from ... import knobs
 from ...exception import TpuFlowException
 
 DEFAULT_IMAGE = "python:3.12"
@@ -1220,7 +1221,7 @@ class ArgoWorkflows(object):
         if self.metadata == "service" and self.service_url:
             env.append({"name": "TPUFLOW_SERVICE_URL",
                         "value": self.service_url})
-        events_url = os.environ.get("TPUFLOW_ARGO_EVENTS_URL")
+        events_url = knobs.get_str("TPUFLOW_ARGO_EVENTS_URL")
         if events_url:
             # pods publish through the Argo Events webhook; without this
             # the onExit publisher falls back to a pod-local JSONL file

@@ -17,7 +17,7 @@ import os
 import subprocess
 import sys
 
-from .. import telemetry, tracing
+from .. import knobs, telemetry, tracing
 from ..current import current, Parallel
 from ..decorators import StepDecorator
 from ..exception import TpuFlowException
@@ -31,7 +31,7 @@ def _elastic_gang_size(num_parallel):
     preempted gang is relaunched at a different size). The override can
     only SHRINK below the flow-requested size — a stale env var from an
     earlier, larger attempt must never over-fork the gang."""
-    override = os.environ.get("TPUFLOW_ELASTIC_SIZE")
+    override = knobs.get_str("TPUFLOW_ELASTIC_SIZE")
     if not override:
         return num_parallel
     try:
@@ -330,9 +330,7 @@ class ParallelDecorator(StepDecorator):
             # live heartbeat — the exact shape the gang watchdog exists
             # to break; the bound is the belt-and-suspenders fallback
             # (and the bench's "undetected hang" baseline).
-            wait_s = float(
-                os.environ.get("TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S", "0") or 0
-            )
+            wait_s = knobs.get_float("TPUFLOW_GANG_NODE_WAIT_TIMEOUT_S")
             for proc, task_id in zip(procs, mapper_task_ids[1:]):
                 try:
                     rc = proc.wait(timeout=wait_s if wait_s > 0 else None)

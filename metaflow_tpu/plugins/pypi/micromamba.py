@@ -22,6 +22,7 @@ import os
 import shutil
 import subprocess
 
+from ... import knobs
 from ...exception import TpuFlowException
 
 
@@ -35,7 +36,7 @@ def find_micromamba():
     An explicitly configured TPUFLOW_MICROMAMBA is returned even if the
     path does not exist — the operator asked for micromamba, so a typo
     must surface as an error at use, not a silent fallback to pip."""
-    explicit = os.environ.get("TPUFLOW_MICROMAMBA")
+    explicit = knobs.get_str("TPUFLOW_MICROMAMBA")
     if explicit:
         return explicit
     return shutil.which("micromamba")
@@ -106,7 +107,7 @@ class Micromamba(object):
             "--prefix",
             prefix,
         ]
-        if offline or os.environ.get("TPUFLOW_CONDA_OFFLINE") == "1":
+        if offline or knobs.get_bool("TPUFLOW_CONDA_OFFLINE"):
             cmd.append("--offline")
         cmd += [item["url"] for item in locked]
         self._call(cmd)
@@ -115,7 +116,7 @@ class Micromamba(object):
     def _call(self, args, extra_env=None):
         env = dict(os.environ)
         # hardlink into the shared package cache when one is configured
-        pkgs_dirs = os.environ.get("TPUFLOW_CONDA_PKGS_DIRS")
+        pkgs_dirs = knobs.get_str("TPUFLOW_CONDA_PKGS_DIRS")
         if pkgs_dirs:
             env["CONDA_PKGS_DIRS"] = pkgs_dirs
         if extra_env:

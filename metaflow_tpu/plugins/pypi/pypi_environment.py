@@ -19,6 +19,7 @@ import subprocess
 import sys
 import venv
 
+from ... import knobs
 from ...exception import TpuFlowException
 
 
@@ -104,7 +105,7 @@ class PyPIEnvironment(object):
             name if version in (None, "", "*") else "%s==%s" % (name, version)
             for name, version in self.packages.items()
         ]
-        wheelhouse = os.environ.get("TPUFLOW_WHEELHOUSE")
+        wheelhouse = knobs.get_str("TPUFLOW_WHEELHOUSE")
 
         uv = _shutil.which("uv") if self.installer == "uv" else None
         if uv:

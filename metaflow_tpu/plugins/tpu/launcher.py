@@ -25,6 +25,7 @@ import subprocess
 import sys
 import time
 
+from ... import knobs
 from ...exception import TpuFlowException
 
 
@@ -89,22 +90,20 @@ class GcloudTpu(object):
 
 class TpuVmLauncher(object):
     def __init__(self, gcloud=None):
-        project = os.environ.get("TPUFLOW_TPU_PROJECT")
-        zone = os.environ.get("TPUFLOW_TPU_ZONE")
+        project = knobs.get_str("TPUFLOW_TPU_PROJECT")
+        zone = knobs.get_str("TPUFLOW_TPU_ZONE")
         if gcloud is None and not (project and zone):
             raise TpuFlowException(
                 "TPU launcher needs TPUFLOW_TPU_PROJECT and TPUFLOW_TPU_ZONE"
             )
         self.gcloud = gcloud or GcloudTpu(project, zone)
-        self.accelerator = os.environ.get(
+        self.accelerator = knobs.get_str(
             "TPUFLOW_TPU_TYPE",
-            os.environ.get("TPUFLOW_TPU_TOPOLOGY", "v5litepod-4"),
+            fallback=knobs.get_str("TPUFLOW_TPU_TOPOLOGY"),
         )
-        self.version = os.environ.get(
-            "TPUFLOW_TPU_VERSION", "tpu-ubuntu2204-base"
-        )
-        self.reuse = os.environ.get("TPUFLOW_TPU_REUSE")
-        self.spot = os.environ.get("TPUFLOW_TPU_SPOT", "0") == "1"
+        self.version = knobs.get_str("TPUFLOW_TPU_VERSION")
+        self.reuse = knobs.get_str("TPUFLOW_TPU_REUSE")
+        self.spot = knobs.get_bool("TPUFLOW_TPU_SPOT")
 
     def _ensure_tpu(self, name):
         if self.reuse:
@@ -129,7 +128,7 @@ class TpuVmLauncher(object):
             return name, True
         except BaseException:
             # never leak a billed slice we provisioned
-            if created and os.environ.get("TPUFLOW_TPU_KEEP", "0") != "1":
+            if created and not knobs.get_bool("TPUFLOW_TPU_KEEP"):
                 self.gcloud.delete(name)
             raise
 
@@ -178,7 +177,7 @@ class TpuVmLauncher(object):
                 echo(line.rstrip("\n"))
             return proc.wait()
         finally:
-            if ephemeral and os.environ.get("TPUFLOW_TPU_KEEP", "0") != "1":
+            if ephemeral and not knobs.get_bool("TPUFLOW_TPU_KEEP"):
                 self.gcloud.delete(name)
 
 
@@ -190,7 +189,7 @@ def main(argv=None):
         argv = argv[1:]
     if not argv:
         raise TpuFlowException("launcher needs a step command after --")
-    package_url = os.environ.get("TPUFLOW_PACKAGE_URL")
+    package_url = knobs.get_str("TPUFLOW_PACKAGE_URL")
     if not package_url:
         raise TpuFlowException(
             "TPUFLOW_PACKAGE_URL not set: the runtime must upload the code "

@@ -36,6 +36,7 @@ import tempfile
 import threading
 import time
 
+from ... import knobs
 from ...exception import TaskPreempted
 
 # GCE metadata: TRUE once the VM is scheduled for preemption
@@ -116,8 +117,7 @@ class PreemptionHandler(object):
         self.grow_notice = False
         self._marker_ttl_s = (
             marker_ttl_s if marker_ttl_s is not None
-            else float(os.environ.get("TPUFLOW_SPOT_MARKER_TTL_S",
-                                      str(MARKER_TTL_S))))
+            else knobs.get_float("TPUFLOW_SPOT_MARKER_TTL_S"))
         self._shield_depth = 0
         self._pending_exc = None
         self._prev_handler = None
@@ -211,8 +211,8 @@ class PreemptionMonitor(object):
 
     def __init__(self, task_pid, metadata_url=None, poll_secs=POLL_SECS):
         self.task_pid = task_pid
-        self.metadata_url = metadata_url or os.environ.get(
-            "TPUFLOW_SPOT_METADATA_URL", DEFAULT_METADATA_URL
+        self.metadata_url = metadata_url or knobs.get_str(
+            "TPUFLOW_SPOT_METADATA_URL"
         )
         self.poll_secs = poll_secs
 

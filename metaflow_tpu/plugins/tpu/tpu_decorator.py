@@ -17,6 +17,7 @@ TPU fleets. Semantics:
 
 import os
 
+from ... import knobs
 from ...current import current
 from ...decorators import StepDecorator
 from ...exception import TpuFlowException
@@ -63,9 +64,9 @@ class TpuDecorator(StepDecorator):
     def runtime_init(self, flow, graph, package, run_id):
         # remote mode: upload the code package once per run so the launcher
         # can bootstrap the TPU VM (reference pattern: package_and_upload)
-        if not os.environ.get("TPUFLOW_TPU_LAUNCHER"):
+        if not knobs.get_str("TPUFLOW_TPU_LAUNCHER"):
             return
-        if os.environ.get("TPUFLOW_PACKAGE_URL"):
+        if knobs.get_str("TPUFLOW_PACKAGE_URL"):
             return
         import sys
 
@@ -77,7 +78,7 @@ class TpuDecorator(StepDecorator):
 
     def runtime_step_cli(self, cli_args, retry_count, max_user_code_retries,
                          ubf_context):
-        launcher = os.environ.get("TPUFLOW_TPU_LAUNCHER")
+        launcher = knobs.get_str("TPUFLOW_TPU_LAUNCHER")
         if launcher:
             # trampoline: rewrite argv so the task launches on a provisioned
             # TPU VM/slice (same pattern as the reference's `batch step`
@@ -129,7 +130,7 @@ class TpuDecorator(StepDecorator):
             {
                 "tpu": TpuInfo(
                     topology=self.attributes["topology"]
-                    or os.environ.get("TPUFLOW_TPU_TOPOLOGY"),
+                    or knobs.get_raw("TPUFLOW_TPU_TOPOLOGY"),
                     num_devices=len(devices),
                     device_kind=devices[0].device_kind if devices else "none",
                     mesh_axes=self.attributes["mesh"],
@@ -137,7 +138,7 @@ class TpuDecorator(StepDecorator):
             }
         )
         self._spot_monitor = None
-        if self.attributes["spot"] or os.environ.get(
+        if self.attributes["spot"] or knobs.is_set(
             "TPUFLOW_SPOT_METADATA_URL"
         ):
             import subprocess
@@ -146,7 +147,7 @@ class TpuDecorator(StepDecorator):
             args = [sys.executable, "-m",
                     "metaflow_tpu.plugins.tpu.preemption",
                     "--task-pid", str(os.getpid())]
-            url = os.environ.get("TPUFLOW_SPOT_METADATA_URL")
+            url = knobs.get_raw("TPUFLOW_SPOT_METADATA_URL")
             if url:
                 args += ["--metadata-url", url]
             self._spot_monitor = subprocess.Popen(args)

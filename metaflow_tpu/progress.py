@@ -38,7 +38,8 @@ import os
 import signal
 import time
 
-from .util import env_float, get_tpuflow_root
+from . import knobs
+from .util import get_tpuflow_root
 
 PROGRESS_FILE = "_progress.json"
 STACKS_FILE = "_stacks.txt"
@@ -88,12 +89,11 @@ def hang_deadline_s(ema_s=None, compile_possible=False):
     for the (much larger) compile grace while a compile could still be
     in flight — jit cache detection only marks a compile AFTER the step
     returns, so suspension must be prospective."""
-    floor = env_float(FLOOR_ENV, DEFAULT_FLOOR_S)
+    floor = knobs.get_float(FLOOR_ENV)
     if compile_possible:
-        return max(floor, env_float(COMPILE_GRACE_ENV,
-                                    DEFAULT_COMPILE_GRACE_S))
+        return max(floor, knobs.get_float(COMPILE_GRACE_ENV))
     if ema_s:
-        return max(floor, env_float(MULT_ENV, DEFAULT_MULT) * ema_s)
+        return max(floor, knobs.get_float(MULT_ENV) * ema_s)
     return floor
 
 
@@ -104,7 +104,7 @@ class ProgressBeater(object):
         self.path = path
         self.rank = int(rank)
         self.attempt = int(attempt)
-        self.every_s = (env_float(BEAT_EVERY_ENV, 1.0)
+        self.every_s = (knobs.get_float(BEAT_EVERY_ENV)
                         if every_s is None else float(every_s))
         self._last_write = 0.0
 
@@ -215,8 +215,7 @@ def install_hang_forensics():
                            current.task_id)
     except Exception:
         return None
-    signum = int(os.environ.get(DUMP_SIGNAL_ENV, "0") or 0) \
-        or signal.SIGQUIT
+    signum = knobs.get_int(DUMP_SIGNAL_ENV) or signal.SIGQUIT
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         f = open(path, "w")

@@ -13,11 +13,12 @@ import os
 import subprocess
 import sys
 
+from .. import knobs
 from ..exception import TpuFlowException
 
 
 def _kubectl():
-    return os.environ.get("TPUFLOW_KUBECTL", "kubectl")
+    return knobs.get_str("TPUFLOW_KUBECTL")
 
 
 class TriggeredRun(object):

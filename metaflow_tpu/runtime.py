@@ -21,7 +21,7 @@ import sys
 import time
 from collections import deque
 
-from . import telemetry, tracing
+from . import knobs, telemetry, tracing
 from .datastore.task_datastore import MAX_ATTEMPTS
 from .elastic.watchdog import GangWatchdog, hang_detect_enabled
 from .exception import TpuFlowException
@@ -297,7 +297,7 @@ class NativeRuntime(object):
         # driven gang resize, and grow-back when capacity returns.
         # TPUFLOW_ELASTIC=0 restores the legacy immediate-re-fork path.
         self._elastic = None
-        if os.environ.get("TPUFLOW_ELASTIC", "1") == "1":
+        if knobs.get_bool("TPUFLOW_ELASTIC"):
             from .elastic import ElasticGangSupervisor
 
             self._elastic = ElasticGangSupervisor(
@@ -870,7 +870,7 @@ class NativeRuntime(object):
         decorator that rewrites the CLI (trampolines need exec). Also skip
         once a JAX backend is live in this process — TPU driver fds must
         not be shared across fork."""
-        if os.environ.get("TPUFLOW_FORK_WORKERS", "1") != "1":
+        if not knobs.get_bool("TPUFLOW_FORK_WORKERS"):
             return False
         if task.ubf_context is not None:
             return False

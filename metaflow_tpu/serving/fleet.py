@@ -63,18 +63,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import knobs
 from .. import slo as slo_rules_mod
 from .. import telemetry
 from .. import tracing
 from ..elastic.policy import BackoffPolicy
 from .server import retry_after_hint
-
-
-def _env_num(env, name, default, cast=float):
-    try:
-        return cast(env.get(name, default))
-    except (TypeError, ValueError):
-        return cast(default)
 
 
 class FleetConfig(object):
@@ -111,38 +105,30 @@ class FleetConfig(object):
 
     @classmethod
     def from_env(cls, env=None):
-        env = env if env is not None else os.environ
-        max_inflight = env.get("TPUFLOW_FLEET_MAX_INFLIGHT")
+        max_inflight = knobs.get_raw("TPUFLOW_FLEET_MAX_INFLIGHT", env=env)
         try:
             max_inflight = int(max_inflight) if max_inflight else None
         except ValueError:
             max_inflight = None
+        _i = lambda name: knobs.get_int(name, env=env)  # noqa: E731
+        _f = lambda name: knobs.get_float(name, env=env)  # noqa: E731
+        _b = lambda name: knobs.get_bool(name, env=env)  # noqa: E731
         return cls(
             max_inflight=max_inflight,
-            failover=env.get("TPUFLOW_FLEET_FAILOVER", "1") != "0",
-            restart=env.get("TPUFLOW_FLEET_RESTART", "1") != "0",
-            max_restarts=_env_num(env, "TPUFLOW_FLEET_MAX_RESTARTS",
-                                  16, int),
-            health_interval_s=_env_num(
-                env, "TPUFLOW_FLEET_HEALTH_INTERVAL_S", 1.0),
-            health_fails=_env_num(env, "TPUFLOW_FLEET_HEALTH_FAILS",
-                                  3, int),
-            spawn_timeout_s=_env_num(env, "TPUFLOW_FLEET_SPAWN_TIMEOUT_S",
-                                     180.0),
-            redispatch_max=_env_num(env, "TPUFLOW_FLEET_REDISPATCH_MAX",
-                                    3, int),
-            wait_s=_env_num(env, "TPUFLOW_FLEET_WAIT_S", 15.0),
-            autoscale=env.get("TPUFLOW_FLEET_AUTOSCALE", "0") != "0",
-            min_replicas=_env_num(env, "TPUFLOW_FLEET_MIN_REPLICAS",
-                                  1, int),
-            max_replicas=_env_num(env, "TPUFLOW_FLEET_MAX_REPLICAS",
-                                  8, int),
-            scale_out_queue=_env_num(env, "TPUFLOW_FLEET_SCALE_OUT_QUEUE",
-                                     2.0),
-            scale_in_occupancy=_env_num(
-                env, "TPUFLOW_FLEET_SCALE_IN_OCC", 0.25),
-            scale_sustain=_env_num(env, "TPUFLOW_FLEET_SCALE_SUSTAIN",
-                                   3, int),
+            failover=_b("TPUFLOW_FLEET_FAILOVER"),
+            restart=_b("TPUFLOW_FLEET_RESTART"),
+            max_restarts=_i("TPUFLOW_FLEET_MAX_RESTARTS"),
+            health_interval_s=_f("TPUFLOW_FLEET_HEALTH_INTERVAL_S"),
+            health_fails=_i("TPUFLOW_FLEET_HEALTH_FAILS"),
+            spawn_timeout_s=_f("TPUFLOW_FLEET_SPAWN_TIMEOUT_S"),
+            redispatch_max=_i("TPUFLOW_FLEET_REDISPATCH_MAX"),
+            wait_s=_f("TPUFLOW_FLEET_WAIT_S"),
+            autoscale=_b("TPUFLOW_FLEET_AUTOSCALE"),
+            min_replicas=_i("TPUFLOW_FLEET_MIN_REPLICAS"),
+            max_replicas=_i("TPUFLOW_FLEET_MAX_REPLICAS"),
+            scale_out_queue=_f("TPUFLOW_FLEET_SCALE_OUT_QUEUE"),
+            scale_in_occupancy=_f("TPUFLOW_FLEET_SCALE_IN_OCC"),
+            scale_sustain=_i("TPUFLOW_FLEET_SCALE_SUSTAIN"),
         )
 
 

@@ -57,6 +57,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
 from ..inference.decode import (
     DECODE_CHUNK,
     _attn_qkv,
@@ -76,19 +77,13 @@ DEFAULT_PAGE_TOKENS = 16
 def page_tokens_from_env(default=DEFAULT_PAGE_TOKENS):
     """TPUFLOW_KV_PAGE_TOKENS: tokens per KV page (the paged engine's
     allocation granule)."""
-    try:
-        return max(1, int(os.environ.get("TPUFLOW_KV_PAGE_TOKENS",
-                                         str(default))))
-    except ValueError:
-        return default
+    return max(1, knobs.get_int("TPUFLOW_KV_PAGE_TOKENS",
+                                fallback=default))
 
 
 def spec_k_from_env(default=0):
     """TPUFLOW_SPEC_K: speculative draft length (0 disables)."""
-    try:
-        return max(0, int(os.environ.get("TPUFLOW_SPEC_K", str(default))))
-    except ValueError:
-        return default
+    return max(0, knobs.get_int("TPUFLOW_SPEC_K", fallback=default))
 
 
 class PageExhaustedError(RuntimeError):

@@ -39,7 +39,7 @@ import threading
 
 import numpy as np
 
-from .. import telemetry
+from .. import knobs, telemetry
 
 
 def _as_tokens(tokens):
@@ -121,7 +121,8 @@ class RadixPrefixCache(object):
     def from_env(cls, default_mb=0):
         """Build from TPUFLOW_PREFIX_CACHE_MB, or None when the budget
         is 0 (the cache is opt-in: no budget, no cache)."""
-        mb = float(os.environ.get("TPUFLOW_PREFIX_CACHE_MB", default_mb))
+        mb = knobs.get_float("TPUFLOW_PREFIX_CACHE_MB",
+                             fallback=default_mb)
         if mb <= 0:
             return None
         return cls(int(mb * 1024 * 1024))
@@ -358,7 +359,8 @@ class PagedPrefixIndex(object):
     def from_env(cls, pool, default_mb=0):
         """Budget from TPUFLOW_PREFIX_CACHE_MB (page-rounded); 0/unset
         disables — the same opt-in contract as RadixPrefixCache."""
-        mb = float(os.environ.get("TPUFLOW_PREFIX_CACHE_MB", default_mb))
+        mb = knobs.get_float("TPUFLOW_PREFIX_CACHE_MB",
+                             fallback=default_mb)
         if mb <= 0:
             return None
         pages = max(1, int(mb * 1024 * 1024) // max(1, pool.page_bytes()))

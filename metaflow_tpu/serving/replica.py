@@ -36,6 +36,8 @@ import os
 import sys
 import time
 
+from .. import knobs
+
 
 def _add_step_delay(engine, delay_s):
     """Emulated device time: each prefill chunk / fused decode step
@@ -193,8 +195,8 @@ def main(argv=None):
         # still point every replica at a shared run's datastore so the
         # chaos / trace e2e can reassemble request trees from replica-
         # side records (TPUFLOW_DATASTORE_SYSROOT_LOCAL scopes the root)
-        t_flow = os.environ.get("TPUFLOW_REPLICA_TELEMETRY_FLOW")
-        t_run = os.environ.get("TPUFLOW_REPLICA_TELEMETRY_RUN")
+        t_flow = knobs.get_str("TPUFLOW_REPLICA_TELEMETRY_FLOW")
+        t_run = knobs.get_str("TPUFLOW_REPLICA_TELEMETRY_RUN")
         if t_flow and t_run:
             _init_replica_telemetry(t_flow, t_run, args.replica_index)
     else:
@@ -205,11 +207,7 @@ def main(argv=None):
         _warm(engine)
     delay_ms = args.step_delay_ms
     if delay_ms is None:
-        try:
-            delay_ms = float(
-                os.environ.get("TPUFLOW_SERVE_STEP_DELAY_MS", "0"))
-        except ValueError:
-            delay_ms = 0.0
+        delay_ms = knobs.get_float("TPUFLOW_SERVE_STEP_DELAY_MS")
     if delay_ms > 0:
         _add_step_delay(engine, delay_ms / 1000.0)
 

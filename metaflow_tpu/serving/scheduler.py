@@ -33,7 +33,7 @@ import threading
 import time
 from collections import deque
 
-from .. import telemetry
+from .. import knobs, telemetry
 from .. import tracing
 from .paged import PageExhaustedError
 
@@ -190,7 +190,7 @@ class Scheduler(object):
         # rolling latency windows for /v1/stats and /healthz percentiles:
         # bounded so a long-lived server reports RECENT tail latency, not
         # an all-time blend that a morning incident pollutes forever
-        window = int(os.environ.get("TPUFLOW_SERVE_LATENCY_WINDOW", "1024"))
+        window = knobs.get_int("TPUFLOW_SERVE_LATENCY_WINDOW")
         self._ttft_window = deque(maxlen=max(1, window))
         self._itl_window = deque(maxlen=max(1, window * 4))
 

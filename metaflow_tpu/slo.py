@@ -30,6 +30,8 @@ evaluated — an idle fleet with no latency samples yet is not in breach.
 import json
 import os
 
+from . import knobs
+
 # env shorthand -> metric name; the metric vocabulary is shared with
 # ServingFleet.slo_metrics() and cmd/watch.WatchState.metrics()
 ENV_RULES = (
@@ -64,7 +66,7 @@ def load_rules(path=None, env=None):
     failed startup."""
     env = os.environ if env is None else env
     rules = []
-    path = path or env.get(SLO_FILE_VAR)
+    path = path or knobs.get_str(SLO_FILE_VAR, env=env)
     if path:
         with open(path) as f:
             doc = json.load(f)

@@ -64,6 +64,7 @@ import time
 
 import numpy as np
 
+from .. import knobs
 from . import sanitizer
 from .pipeline import interleaved_schedule
 
@@ -272,18 +273,18 @@ class StageTransport(object):
         self.peers = [_parse_addr(p) for p in peers[:world]]
         self.double_buffer = bool(double_buffer)
         self.recv_timeout_s = float(
-            os.environ.get("TPUFLOW_MPMD_RECV_TIMEOUT_S", "60")
+            knobs.get_float("TPUFLOW_MPMD_RECV_TIMEOUT_S")
             if recv_timeout_s is None else recv_timeout_s)
         # sends tolerate backpressure (peer mid-compile, full prefetch
         # queue, genuine DCN latency) far longer than any liveness
         # signal: their deadline defaults to the recv deadline, never to
         # the 1s connect timeout. <= 0 means unbounded.
         self.send_timeout_s = float(
-            os.environ.get("TPUFLOW_MPMD_SEND_TIMEOUT_S",
-                           str(self.recv_timeout_s))
+            knobs.get_float("TPUFLOW_MPMD_SEND_TIMEOUT_S",
+                            fallback=self.recv_timeout_s)
             if send_timeout_s is None else send_timeout_s)
         self.link_latency_ms = float(
-            os.environ.get("TPUFLOW_MPMD_LINK_LATENCY_MS", "0")
+            knobs.get_float("TPUFLOW_MPMD_LINK_LATENCY_MS")
             if link_latency_ms is None else link_latency_ms)
         self._lock = threading.Lock()
         self._stats = {"frames_sent": 0, "frames_recv": 0,
@@ -311,8 +312,8 @@ class StageTransport(object):
         listener.bind((host, port))
         listener.listen(4)
         self._listener = listener
-        connect_timeout = float(
-            os.environ.get("TPUFLOW_MPMD_CONNECT_TIMEOUT_S", "30"))
+        connect_timeout = knobs.get_float(
+            "TPUFLOW_MPMD_CONNECT_TIMEOUT_S")
         deadline = time.monotonic() + connect_timeout
 
         # inbound: activations from stage-1, cotangents from stage+1
@@ -605,7 +606,7 @@ def transport_from_env(double_buffer=None, **kwargs):
             "MF_MPMD_PEERS is not set — MPMD stage gangs need the peer "
             "rendezvous addresses the gang launch exports")
     if double_buffer is None:
-        double_buffer = os.environ.get("TPUFLOW_MPMD_SYNC", "0") != "1"
+        double_buffer = not knobs.get_bool("TPUFLOW_MPMD_SYNC")
     return StageTransport(
         stage=int(os.environ.get("MF_PARALLEL_NODE_INDEX", "0")),
         world=int(os.environ.get("MF_PARALLEL_NUM_NODES", str(len(peers)))),

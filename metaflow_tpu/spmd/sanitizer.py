@@ -48,7 +48,7 @@ import threading
 import time
 from collections import deque
 
-from .. import telemetry
+from .. import knobs, telemetry
 from ..exception import TpuFlowException
 
 REPORT_VERSION = 1
@@ -56,7 +56,7 @@ SANITIZE_PREFIX = "_telemetry/sanitize"
 
 
 def enabled():
-    return os.environ.get("TPUFLOW_SANITIZE", "0") == "1"
+    return knobs.get_bool("TPUFLOW_SANITIZE")
 
 
 def _env_int(name, default):
@@ -194,12 +194,11 @@ class GangSanitizer(object):
         self._rank = None if rank is None else int(rank)
         self._world = None if world is None else int(world)
         self.checker = int(checker)
-        window = window or _env_int("TPUFLOW_SANITIZE_WINDOW", 512)
+        window = window or knobs.get_int("TPUFLOW_SANITIZE_WINDOW")
         self.barrier_every = (barrier_every
-                              or _env_int("TPUFLOW_SANITIZE_EVERY", 64))
-        self.timeout_s = (float(os.environ.get(
-            "TPUFLOW_SANITIZE_TIMEOUT", "30"))
-            if timeout_s is None else float(timeout_s))
+                              or knobs.get_int("TPUFLOW_SANITIZE_EVERY"))
+        self.timeout_s = (knobs.get_float("TPUFLOW_SANITIZE_TIMEOUT")
+                          if timeout_s is None else float(timeout_s))
         self.poll_s = poll_s
         self._lock = threading.Lock()
         self._seq = 0

@@ -170,9 +170,9 @@ def zero_enabled(mesh, zero=None):
     TPUFLOW_ZERO env knob ('1' = on); always off when the mesh has no DP
     axis to shard over (the transform would be a no-op)."""
     if zero is None:
-        import os
+        from .. import knobs
 
-        zero = os.environ.get(ZERO_ENV, "0") == "1"
+        zero = knobs.get_bool(ZERO_ENV)
     return bool(zero) and zero_update_axis(mesh) is not None
 
 

@@ -12,6 +12,8 @@ import sys
 import time
 from contextlib import contextmanager
 
+from . import knobs
+
 
 class BaseEventLogger(object):
     TYPE = "null"
@@ -178,13 +180,13 @@ def _resolve_kind(kind, registry, default_cls, what, env_var):
 
 
 def get_monitor(kind=None):
-    kind = kind or os.environ.get("TPUFLOW_MONITOR", "file")
+    kind = kind or knobs.get_str("TPUFLOW_MONITOR")
     return _resolve_kind(kind, MONITORS, BaseMonitor, "monitor",
                          "TPUFLOW_MONITOR")
 
 
 def get_event_logger(kind=None):
-    kind = kind or os.environ.get("TPUFLOW_EVENT_LOGGER", "file")
+    kind = kind or knobs.get_str("TPUFLOW_EVENT_LOGGER")
     return _resolve_kind(kind, EVENT_LOGGERS, BaseEventLogger,
                          "event logger", "TPUFLOW_EVENT_LOGGER")
 

@@ -12,7 +12,7 @@ import sys
 import time
 import traceback
 
-from . import telemetry
+from . import knobs, telemetry
 from .current import current
 from .datastore.task_datastore import TaskDataStore
 from .exception import TaskPreempted, TpuFlowException, MetaflowInternalError
@@ -194,13 +194,13 @@ class MetaflowTask(object):
         # journals its collective/write signature stream for cross-rank
         # desync checks. Env-gated lazy import — the spmd package pulls
         # jax in, which a non-sanitizing task must not pay for.
-        if os.environ.get("TPUFLOW_SANITIZE", "0") == "1":
+        if knobs.get_bool("TPUFLOW_SANITIZE"):
             from .spmd import sanitizer as _sanitizer
 
             _sanitizer.install(self.flow_datastore, run_id,
                                step_name=step_name)
         if recorder is not None:
-            queued_ts = os.environ.get("TPUFLOW_QUEUE_TS")
+            queued_ts = knobs.get_str("TPUFLOW_QUEUE_TS")
             if queued_ts:
                 try:
                     recorder.gauge(
@@ -295,7 +295,7 @@ class MetaflowTask(object):
         # environment (set by the local trigger listener or the Argo
         # sensor's submit template) — expose them as `current.trigger`
         # (reference: metaflow/events.py Trigger via metaflow_current)
-        trigger_json = os.environ.get("TPUFLOW_TRIGGER_EVENTS")
+        trigger_json = knobs.get_str("TPUFLOW_TRIGGER_EVENTS")
         if trigger_json:
             try:
                 from .events import Trigger
@@ -511,7 +511,7 @@ class MetaflowTask(object):
                         "timer", "task.duration", ms=duration,
                         ok=task_ok and finalize_exc is None)
                     telemetry.close_recorder()
-                    if os.environ.get("TPUFLOW_SANITIZE", "0") == "1":
+                    if knobs.get_bool("TPUFLOW_SANITIZE"):
                         from .spmd import sanitizer as _sanitizer
 
                         _sanitizer.uninstall()
@@ -547,9 +547,8 @@ class MetaflowTask(object):
                 "Control task did not record _control_mapper_tasks: the gang "
                 "step must register its worker task pathspecs."
             )
-        deadline = time.time() + float(
-            os.environ.get("TPUFLOW_GANG_FINALIZE_TIMEOUT", "300")
-        )
+        deadline = time.time() + knobs.get_float(
+            "TPUFLOW_GANG_FINALIZE_TIMEOUT")
         for pathspec in mapper_tasks:
             parts = pathspec.split("/")
             run, step, task = parts[-3], parts[-2], parts[-1]

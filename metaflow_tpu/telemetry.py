@@ -46,6 +46,8 @@ import time
 import zipfile
 from contextlib import contextmanager
 
+from . import knobs
+
 RECORD_VERSION = 1
 TELEMETRY_PREFIX = "_telemetry"
 PROFILE_PREFIX = "_telemetry/profiles"
@@ -86,8 +88,7 @@ class FlightRecorder(object):
         self.pid = os.getpid()
         self.trace = trace_id_from_env()
         if flush_every is None:
-            flush_every = int(
-                os.environ.get("TPUFLOW_TELEMETRY_FLUSH_EVERY", "512"))
+            flush_every = knobs.get_int("TPUFLOW_TELEMETRY_FLUSH_EVERY")
         self._flush_every = max(1, flush_every)
         # records arrive from more than one thread (the training loop and
         # the async-checkpoint upload thread both emit through the
@@ -267,7 +268,7 @@ class FlightRecorder(object):
 
 
 def enabled():
-    return os.environ.get("TPUFLOW_TELEMETRY", "1") != "0"
+    return knobs.get_bool("TPUFLOW_TELEMETRY")
 
 
 def init_recorder(flow_datastore, run_id, step_name, task_id, attempt=0,
@@ -493,16 +494,16 @@ class ProfileTrigger(object):
     def __init__(self, recorder=None, steps=None, request_file=None,
                  check_every=1.0):
         self._recorder = recorder
-        spec = steps if steps is not None else os.environ.get(
-            "TPUFLOW_PROFILE_STEPS", "")
+        spec = (steps if steps is not None
+                else knobs.get_str("TPUFLOW_PROFILE_STEPS"))
         self._window = self._parse_window(spec)
-        self._request_file = request_file or os.environ.get(
-            "TPUFLOW_PROFILE_REQUEST", "")
+        self._request_file = request_file or knobs.get_str(
+            "TPUFLOW_PROFILE_REQUEST")
         self._check_every = check_every
         self._last_check = 0.0
         self._signal_pending = [0]
         self._active = None  # (start_step, stop_step, tmpdir)
-        if os.environ.get("TPUFLOW_PROFILE_SIGNAL", "0") == "1":
+        if knobs.get_bool("TPUFLOW_PROFILE_SIGNAL"):
             self.install_signal_trigger()
 
     @staticmethod

@@ -10,6 +10,8 @@ import functools
 import os
 from contextlib import contextmanager
 
+from . import knobs
+
 _ENDPOINT_VAR = "TPUFLOW_OTEL_ENDPOINT"
 _TRACEPARENT_VAR = "TRACEPARENT"
 
@@ -22,7 +24,7 @@ def _init():
     if _initialized:
         return _tracer
     _initialized = True
-    endpoint = os.environ.get(_ENDPOINT_VAR)
+    endpoint = knobs.get_str(_ENDPOINT_VAR)
     if not endpoint:
         return None
     try:
@@ -174,8 +176,7 @@ _TRACE_REQUESTS_VAR = "TPUFLOW_TRACE_REQUESTS"
 
 def trace_requests_enabled(env=None):
     """Per-request tracing is on unless TPUFLOW_TRACE_REQUESTS=0."""
-    return (env if env is not None else os.environ).get(
-        _TRACE_REQUESTS_VAR, "1") != "0"
+    return knobs.get_bool(_TRACE_REQUESTS_VAR, env=env)
 
 
 def _hexdigest(seed, n):

@@ -46,9 +46,9 @@ def _sanitize_journal(kind, name, key=None):
     """Journal a shared-write signature into the collective sanitizer
     (spmd/sanitizer.py) when TPUFLOW_SANITIZE=1. Imported lazily so this
     module stays importable without pulling the spmd package (jax) in."""
-    import os
+    from .. import knobs
 
-    if os.environ.get("TPUFLOW_SANITIZE", "0") != "1":
+    if not knobs.get_bool("TPUFLOW_SANITIZE"):
         return
     from ..spmd import sanitizer
 

@@ -21,6 +21,8 @@ import functools
 import os
 import time
 
+from .. import knobs
+
 from .. import progress as progress_mod
 from .. import telemetry
 
@@ -52,7 +54,7 @@ def peak_tflops(device_kind):
 
     TPUFLOW_PEAK_TFLOPS overrides the table — for chips not yet listed,
     or to get meaningful MFU numbers out of CPU/GPU dev runs."""
-    override = os.environ.get("TPUFLOW_PEAK_TFLOPS")
+    override = knobs.get_raw("TPUFLOW_PEAK_TFLOPS")
     if override:
         try:
             return float(override)
@@ -469,7 +471,7 @@ def instrument_train_step(step_fn, tokens_per_step=None, flops_per_step=None,
     # chaos harness tick (TPUFLOW_CHAOS): any instrumented train loop
     # gets deterministic fault injection for free — the scheduled kill
     # lands at a step boundary, before the step's compute is issued
-    chaos_on = bool(os.environ.get("TPUFLOW_CHAOS"))
+    chaos_on = bool(knobs.get_str("TPUFLOW_CHAOS"))
 
     @functools.wraps(step_fn, assigned=("__name__", "__doc__"), updated=())
     def wrapped(*args, **kwargs):

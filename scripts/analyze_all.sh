@@ -26,6 +26,20 @@ else
 fi
 
 fail=0 checked=0 skipped=0
+
+# library contracts sweep: knob registry lint, deadline-ordering lattice,
+# and telemetry schema drift over the library tree itself (flows get the
+# same knob/lattice checks per-file via check --deep below)
+if [ "$#" -eq 0 ]; then
+    if "$PY" -m metaflow_tpu.analysis.contracts "$ROOT/metaflow_tpu" \
+            --schema "$ROOT/tests/schema_validate.py" \
+            --docs "$ROOT/docs/knobs.md"; then
+        checked=$((checked + 1))
+    else
+        fail=1
+        echo "ERROR findings in library contracts sweep" >&2
+    fi
+fi
 for f in "${files[@]}"; do
     base="$(basename "$f")"
     case "$base" in

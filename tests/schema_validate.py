@@ -966,6 +966,143 @@ def validate_fleet_record(record):
                + sorted(FLEET_METRIC_NAMES)))
 
 
+# ---------------------------------------------------------------------------
+# core task/scheduler lifecycle records (task.py, runtime.py, and the
+# runtime-adjacent emitters). The contracts analyzer (metaflow_tpu/
+# analysis/contracts.py) cross-checks every literal telemetry emit in the
+# library against the union of *_EVENT_DATA_SCHEMAS / *_METRIC_NAMES /
+# *_RECORD_DATA_SCHEMAS keys plus EXTRA_PINNED_TELEMETRY_NAMES below:
+# an emit with no pin here is a telemetry-unpinned-event error, and a pin
+# whose name no longer occurs anywhere in the library is a
+# telemetry-dead-schema warning.
+# ---------------------------------------------------------------------------
+
+CORE_EVENT_DATA_SCHEMAS = {
+    "task.start": _obj({"pathspec": _STR}, required=("pathspec",)),
+    "task.retry_attempt": _obj({"attempt": _INT}, required=("attempt",)),
+    "task.exception": _obj(
+        {"type": _STR, "preempted": _BOOL},
+        required=("type", "preempted"),
+    ),
+    "task.preempted": _obj(
+        {"spot_notice": _BOOL, "grow_notice": _BOOL},
+        required=("spot_notice",),
+    ),
+    "gang.spawned": _obj(
+        {"num_parallel": _INT, "worker_tasks": _arr(_STR)},
+        required=("num_parallel", "worker_tasks"),
+    ),
+    "distributed.initialized": _obj(
+        {"process_index": _INT, "process_count": _INT,
+         "local_devices": _INT, "global_devices": _INT},
+        required=("process_index", "process_count"),
+    ),
+    "sanitize.desync": _obj(
+        {"barrier": _INT, "status": _STR,
+         "diverged_ranks": _arr(_INT),
+         "seq": {"type": ["integer", "null"]}},
+        required=("barrier", "status", "diverged_ranks"),
+    ),
+    "sanitize.barrier": _obj(
+        {"barrier": _INT, "count": _INT},
+        required=("barrier", "count"),
+    ),
+    "profile.start": _obj(
+        {"start_step": _INT, "stop_step": _INT},
+        required=("start_step", "stop_step"),
+    ),
+    "profile.captured": _obj(
+        {"artifact": _STR, "start_step": _INT, "stop_step": _INT,
+         "bytes": _INT},
+        required=("artifact", "start_step", "stop_step", "bytes"),
+    ),
+    "sched.task_launched": _obj(
+        {"pathspec": _STR, "attempt": _INT, "queue_seconds": _NUM,
+         "gang_size": _INT},
+        required=("pathspec", "attempt", "queue_seconds"),
+    ),
+    "sched.task_finished": _obj(
+        {"pathspec": _STR, "attempt": _INT},
+        required=("pathspec", "attempt"),
+    ),
+    "sched.task_retry": _obj(
+        {"pathspec": _STR, "failed_attempt": _INT, "next_attempt": _INT,
+         "returncode": _INT, "failure_class": _STR, "delay_s": _NUM,
+         "gang_size": _INT},
+        required=("pathspec", "failed_attempt", "next_attempt",
+                  "returncode"),
+    ),
+    "sched.task_failed": _obj(
+        {"pathspec": _STR, "attempt": _INT, "returncode": _INT,
+         "failure_class": _STR},
+        required=("pathspec", "attempt", "returncode"),
+    ),
+    "run.finished": _obj(
+        {"failed": _BOOL, "tasks_run": _INT, "tasks_cloned": _INT,
+         "wall_seconds": _NUM},
+        required=("failed", "tasks_run", "tasks_cloned", "wall_seconds"),
+    ),
+}
+
+CORE_METRIC_NAMES = {
+    "task.queue_seconds": "gauge",
+    "task.user_code": "timer",
+    "task.duration": "timer",
+    "multicore.parallel_map": "timer",
+    "distributed.initialize": "timer",
+    "telemetry.flush_failed": "counter",
+    "telemetry.dropped_records": "gauge",
+}
+
+#: names pinned by a dedicated validator elsewhere in this module
+#: (slo.breach at validate_slo_breach, goodput.interval at
+#: validate_goodput_interval) rather than by a pin-table key — listed
+#: here so the contracts analyzer counts them as pinned
+EXTRA_PINNED_TELEMETRY_NAMES = (
+    "slo.breach",
+    "goodput.interval",
+)
+
+#: dynamic emit-name families (training/metrics.py builds names from a
+#: caller-chosen prefix, e.g. "%s.step" % prefix): literal emits ending
+#: with one of these suffixes / starting with one of these prefixes are
+#: exempt from the unpinned-emit check, since the family's shape is
+#: exercised by tests/test_train_metrics.py rather than pinned per-name
+DYNAMIC_EMIT_PREFIXES = ()
+
+DYNAMIC_EMIT_SUFFIXES = (
+    ".compile",
+    ".compile_cache_miss",
+    ".device_memory_bytes",
+    ".cost_analysis",
+)
+
+
+def validate_core_record(record):
+    """Validate one core task/sched lifecycle record: base v1 record
+    shape, a pinned name, and (for events) the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name in CORE_EVENT_DATA_SCHEMAS:
+        if record.get("type") != "event":
+            raise jsonschema.ValidationError(
+                "%s must be an event record, got %r"
+                % (name, record.get("type")))
+        jsonschema.validate(record.get("data", {}),
+                            CORE_EVENT_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+    elif name in CORE_METRIC_NAMES:
+        if record.get("type") != CORE_METRIC_NAMES[name]:
+            raise jsonschema.ValidationError(
+                "%s must be a %s record, got %r"
+                % (name, CORE_METRIC_NAMES[name], record.get("type")))
+    else:
+        raise jsonschema.ValidationError(
+            "unknown core record name %r (pinned: %s)"
+            % (name, sorted(CORE_EVENT_DATA_SCHEMAS)
+               + sorted(CORE_METRIC_NAMES)))
+
+
 # single-server /healthz (serving/server.py): a load balancer's health
 # probe AND the fleet router's per-replica probe both key on this shape.
 # per-replica prefix-cache effectiveness, embedded in both healthz tiers
@@ -1186,6 +1323,19 @@ def validate_perfetto_trace(doc):
 _NULL_STR = {"type": ["string", "null"]}
 _NULL_INT = {"type": ["integer", "null"]}
 
+#: finding codes the contracts analysis pass may emit (metaflow_tpu/
+#: analysis/contracts.py CONTRACT_FINDING_CODES) — pinned here so a
+#: renamed or new code is an explicit schema change, not silent drift
+CONTRACT_FINDING_CODES = (
+    "knob-unregistered",
+    "knob-unknown",
+    "knob-inconsistent-default",
+    "knob-undocumented",
+    "deadline-order",
+    "telemetry-unpinned-event",
+    "telemetry-dead-schema",
+)
+
 _FINDING = _obj(
     {
         "code": _STR,
@@ -1206,7 +1356,7 @@ CHECK_REPORT_SCHEMA = _obj(
         "ok": _BOOL,
         "analyses": _arr({"enum": ["lint", "artifact-dataflow",
                                    "spmd-config", "gang-divergence",
-                                   "determinism"]}),
+                                   "determinism", "contracts"]}),
         "steps_analyzed": _arr(_STR),
         "checks_run": _INT,
         "counts": _obj(

@@ -1475,7 +1475,7 @@ def test_check_deep_json_cli(run_flow, flows_dir):
     assert report["flow"] == "BranchFlow"
     assert set(report["analyses"]) == {"lint", "artifact-dataflow",
                                        "spmd-config", "gang-divergence",
-                                       "determinism"}
+                                       "determinism", "contracts"}
     assert "join" in report["steps_analyzed"]
     assert report["checks_run"] > 20
 
