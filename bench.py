@@ -160,6 +160,27 @@ def _append_history(result):
         f.write(json.dumps(entry) + "\n")
 
 
+def _interleaved_reps(pass_a, pass_b, reps):
+    """Run two zero-arg passes ALTERNATELY `reps` times each and return
+    (a_runs, b_runs). Interleaving exposes both sides to the same slice
+    of host drift (thermal, page cache, background load) instead of
+    measuring side A on a cold machine and side B on a hot one."""
+    a_runs, b_runs = [], []
+    for _ in range(reps):
+        a_runs.append(pass_a())
+        b_runs.append(pass_b())
+    return a_runs, b_runs
+
+
+def _median_run(runs, key=None):
+    """The median element of `runs` ordered by `key` (identity by
+    default). Median, not min: min-of-N rewards whichever side got the
+    single luckiest pass — the round-13 serving gates flaked on exactly
+    that — while the median is robust to a one-off slow OR fast rep."""
+    runs = sorted(runs, key=key or (lambda r: r))
+    return runs[len(runs) // 2]
+
+
 def bench_decode():
     """Autoregressive decode throughput (tokens/s/chip): jitted
     prefill+scan generation from metaflow_tpu.inference on the bench
@@ -554,14 +575,12 @@ def bench_serve():
     reps = max(3, int(os.environ.get("BENCH_SERVE_REPS", "3")))
     lockstep_pass()  # warm every group's prompt bucket
     engine_pass()    # warm the three compiled programs
-    lockstep_dts, engine_runs = [], []
-    for _ in range(reps):
-        lockstep_dts.append(lockstep_pass())
-        engine_runs.append(engine_pass())
-    lockstep_dt = statistics.median(lockstep_dts)
+    lockstep_dts, engine_runs = _interleaved_reps(lockstep_pass,
+                                                  engine_pass, reps)
+    lockstep_dt = _median_run(lockstep_dts)
     lockstep_tps = useful_tokens / lockstep_dt
-    engine_runs.sort(key=lambda r: r[0])
-    serve_dt, reqs, sched = engine_runs[len(engine_runs) // 2]
+    serve_dt, reqs, sched = _median_run(engine_runs,
+                                        key=lambda r: r[0])
     for dt_i, reqs_i, _s in engine_runs:
         gen_i = sum(len(r.generated) for r in reqs_i)
         assert gen_i == useful_tokens, (gen_i, useful_tokens)
@@ -611,15 +630,14 @@ def bench_serve():
         fds = FlowDataStore("ServeBench", LocalStorage, ds_root=troot)
         telemetry.init_recorder(fds, "bench", "_serve", "bench")
         try:
-            plain_dts, traced_dts = [], []
-            for _ in range(reps):
-                plain_dts.append(timed_pass(False))
-                traced_dts.append(timed_pass(True))
+            plain_dts, traced_dts = _interleaved_reps(
+                lambda: timed_pass(False), lambda: timed_pass(True),
+                reps)
         finally:
             telemetry.close_recorder()
         records = telemetry.read_run_records(fds, "bench")
-    plain_dt = statistics.median(plain_dts)
-    traced_dt = statistics.median(traced_dts)
+    plain_dt = _median_run(plain_dts)
+    traced_dt = _median_run(traced_dts)
     tracing_overhead_pct = max(
         0.0, (traced_dt - plain_dt) / plain_dt * 100) if plain_dt else 0.0
 
@@ -779,8 +797,10 @@ def _bench_spec_decode(cfg, params):
     plain pass records every request's exact greedy output, then the
     spec pass re-serves the SAME trace drafting from those recordings
     (k=4) and verifying in one fused step. Outputs are asserted
-    token-identical, so the ratio is pure serving speed. Returns
-    (tok/s ratio, accept rate)."""
+    token-identical, so the ratio is pure serving speed. Timing is
+    interleaved median-of-reps like the other serving gates — this was
+    the last min-of-2 measurement left and it flaked the same way the
+    round-13 gates did. Returns (tok/s ratio, accept rate)."""
     import numpy as np
 
     from metaflow_tpu.serving import PagedEngine, Request, Scheduler
@@ -818,28 +838,33 @@ def _bench_spec_decode(cfg, params):
 
     serve_pass(False)
     serve_pass(True)  # warm both program sets (plain + spec verify)
-    plain_dt, plain_reqs = min(
-        (serve_pass(False) for _ in range(2)), key=lambda r: r[0])
+    # a recording pass (untimed) populates the replay draft source so
+    # every TIMED spec pass drafts from the true greedy outputs
+    _dt, plain_reqs = serve_pass(False)
     refs[:] = [list(p) + list(r.generated)
                for (p, _n), r in zip(trace, plain_reqs)]
     engine.spec_proposed = engine.spec_accepted = engine.spec_steps = 0
-    spec_dt, spec_reqs = min(
-        (serve_pass(True) for _ in range(2)), key=lambda r: r[0])
-    for r0, r1 in zip(plain_reqs, spec_reqs):
-        assert r0.generated == r1.generated, \
-            "spec decode diverged from plain greedy"
+    reps = max(3, int(os.environ.get("BENCH_SERVE_REPS", "3")))
+    plain_runs, spec_runs = _interleaved_reps(
+        lambda: serve_pass(False), lambda: serve_pass(True), reps)
+    plain_dt, _reqs = _median_run(plain_runs, key=lambda r: r[0])
+    spec_dt, _reqs = _median_run(spec_runs, key=lambda r: r[0])
+    # EVERY rep must match the recorded greedy outputs, not just the
+    # median one — a divergent-but-fast pass must fail, not hide
+    for _dt_i, reqs_i in plain_runs + spec_runs:
+        for r0, r1 in zip(plain_reqs, reqs_i):
+            assert r0.generated == r1.generated, \
+                "spec decode diverged from plain greedy"
     return plain_dt / spec_dt, engine.spec_stats()["accept_rate"]
 
 
-def _bench_rollout_shed(cfg, params):
-    """Zero-shed rolling upgrade: an in-process 2-replica fleet serves a
-    mixed trace concurrently with rolling_reload; returns the fleet's
-    shed counter delta (gate: 0)."""
-    import http.client
-    import json as json_mod
+def _inproc_fleet(params, cfg, replicas=2):
+    """An in-process ServingFleet: each 'replica' is a SlotEngine behind
+    a real ServingServer on loopback, wrapped in a Popen-shaped shim so
+    the fleet supervisor drives the REAL health/failover/reload paths
+    without subprocess spawn cost. Shared by the rolling-upgrade shed
+    gate and the online weight-push gate."""
     import threading
-
-    import numpy as np
 
     from metaflow_tpu.elastic.policy import BackoffPolicy
     from metaflow_tpu.serving import (
@@ -881,8 +906,22 @@ def _bench_rollout_shed(cfg, params):
         redispatch_max=3, spawn_timeout_s=120.0,
         backoff=BackoffPolicy(base_s=0.05, cap_s=0.1, jitter=0.0,
                               seed=0))
-    fleet = ServingFleet(spawner, 2, config=config)
+    fleet = ServingFleet(spawner, replicas, config=config)
     fleet.start()
+    return fleet
+
+
+def _bench_rollout_shed(cfg, params):
+    """Zero-shed rolling upgrade: an in-process 2-replica fleet serves a
+    mixed trace concurrently with rolling_reload; returns the fleet's
+    shed counter delta (gate: 0)."""
+    import http.client
+    import json as json_mod
+    import threading
+
+    import numpy as np
+
+    fleet = _inproc_fleet(params, cfg)
     try:
         rng = np.random.default_rng(7)
         trace = [rng.integers(1, cfg.vocab_size, 12).tolist()
@@ -924,6 +963,248 @@ def _bench_rollout_shed(cfg, params):
         return int(fleet.shed_count - shed0)
     finally:
         fleet.close()
+
+
+def _bench_online_push_shed(cfg, params):
+    """The online loop's weight-push path under load: an in-process
+    2-replica fleet decodes an ActorPool rollout batch WHILE
+    make_fleet_push rolls it onto the next generation. Returns the
+    fleet's shed delta (gate: 0 — a push must never cost rollouts)
+    after asserting every rollout completed and the pool observed the
+    bumped generation."""
+    import threading
+
+    import numpy as np
+
+    from metaflow_tpu.online import ActorPool, make_fleet_push
+
+    fleet = _inproc_fleet(params, cfg)
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(1, cfg.vocab_size, 8).tolist()
+                   for _ in range(12)]
+        actor = ActorPool(fleet=fleet, max_new_tokens=4,
+                          request_timeout_s=120.0, http_workers=4)
+        push = make_fleet_push(fleet)
+        holder = {}
+
+        def roll():
+            try:
+                holder["rollouts"] = actor.rollout_batch(prompts,
+                                                         round_index=0)
+            except Exception as exc:  # rejoined below
+                holder["error"] = exc
+
+        shed0 = fleet.shed_count
+        thread = threading.Thread(target=roll)
+        thread.start()
+        info = push(None, 0)
+        thread.join()
+        if "error" in holder:
+            raise holder["error"]
+        rollouts = holder["rollouts"]
+        assert len(rollouts) == len(prompts), len(rollouts)
+        assert all(len(r.completion) == 4 for r in rollouts), \
+            "rollout lost tokens across the reload"
+        assert actor.generation == 1, actor.generation
+        assert info["shed_requests"] == 0, info
+        return int(fleet.shed_count - shed0)
+    finally:
+        fleet.close()
+
+
+def bench_online():
+    """BENCH_MODE=online: loop goodput of the Podracer online loop —
+    learner tokens/s with the actor collecting CONCURRENTLY vs the
+    serial generate-then-train baseline, same model/rounds/steps
+    (gate: >= 1.3x).
+
+    CPU by design, and on a 1-core box compute cannot overlap compute —
+    so the actor is PACED: every rollout batch is padded to a
+    wall-clock floor with a GIL-releasing sleep, emulating the
+    round-trip latency of a REMOTE serving fleet (whose decode burns no
+    learner-host cycles). The gate therefore measures the loop's
+    overlap MACHINERY — prefetch thread, generation handoff, replay
+    append/read, idempotent publish — not host parallelism the box
+    doesn't have. The floor is calibrated to one measured UNPACED
+    serial round (decode + train + replay overhead) — the wall a real
+    remote round-trip must cover for the learner to hide it — so the
+    ceiling is ~2x and anything under
+    1.3x means the loop serialized somewhere. Interleaved median-of-
+    reps like every other serving gate.
+
+    Submetric: online_push_shed_requests — the fleet-backed weight push
+    (rolling_reload through make_fleet_push) under a live rollout
+    batch; gate == 0."""
+    import math
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from metaflow_tpu.datastore import FlowDataStore, LocalStorage
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.online import (
+        ActorPool,
+        OnlineLoop,
+        PromptSampler,
+        ReplayReader,
+        ReplayWriter,
+    )
+    from metaflow_tpu.serving import Scheduler, SlotEngine
+    from metaflow_tpu.spmd import MeshSpec, create_mesh
+    from metaflow_tpu.training import (
+        default_optimizer,
+        make_trainer,
+        shard_batch,
+    )
+
+    rounds = int(os.environ.get("BENCH_ONLINE_ROUNDS", "6"))
+    reps = max(3, int(os.environ.get("BENCH_ONLINE_REPS", "3")))
+    rollouts, batch_size = 8, 8
+    prompt_len, max_new = 8, 8
+    seq_len = 16  # window = 17 tokens; 8 rollouts/round -> 8 windows
+    cfg = llama.LlamaConfig.tiny(vocab_size=256)
+    mesh = create_mesh(MeshSpec.dp())
+
+    def snapshot(st):
+        # the jitted step donates its state: the actor serves COPIES
+        return jax.tree_util.tree_map(np.asarray,
+                                      jax.device_get(st["params"]))
+
+    # ONE trainer and ONE engine serve every rep: a fresh make_trainer/
+    # SlotEngine per run would recompile all jitted programs and the
+    # rep would time XLA compilation, not the loop
+    state0, step_fn, _sh = make_trainer(
+        jax.random.PRNGKey(0), cfg, mesh, llama,
+        optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                    total_steps=1000))
+    state_np = jax.tree_util.tree_map(np.asarray,
+                                      jax.device_get(state0))
+    params0 = state_np["params"]
+
+    def fresh_state():
+        # re-materialize device buffers (each run's steps donate them)
+        return jax.tree_util.tree_map(jax.device_put, state_np)
+
+    def learner_step(st, tokens):
+        batch = shard_batch({"tokens": tokens}, mesh)
+        with mesh:
+            st, metrics = step_fn(st, batch)
+        return st, float(metrics["loss"])
+
+    class _PacedActor(ActorPool):
+        floor_s = 0.0
+
+        def rollout_batch(self, prompts, round_index=0):
+            t0 = time.perf_counter()
+            out = super(_PacedActor, self).rollout_batch(
+                prompts, round_index=round_index)
+            left = self.floor_s - (time.perf_counter() - t0)
+            if left > 0:
+                time.sleep(left)  # the emulated remote round-trip
+            return out
+
+    engine = SlotEngine(dict(params0), cfg, max_slots=rollouts,
+                        max_seq_len=prompt_len + max_new + 8)
+    scheduler = Scheduler(engine)
+    sampler = PromptSampler(cfg.vocab_size, prompt_len, seed=0)
+
+    # ---- calibrate: warm-measure one train step and one (unpaced)
+    # rollout batch so the round shape tracks THIS host's speeds ----
+    tokens = np.ones((batch_size, seq_len + 1), np.int32)
+    step_dts, decode_dts = [], []
+    state = fresh_state()
+    for _ in range(2):  # compile + settle (first warm step still pays
+        state, _ = learner_step(state, tokens)  # one-time XLA costs)
+    for _ in range(5):
+        t0 = time.perf_counter()
+        state, _ = learner_step(state, tokens)
+        step_dts.append(time.perf_counter() - t0)
+    step_s = _median_run(step_dts)
+    cal_actor = _PacedActor(scheduler=scheduler, max_new_tokens=max_new)
+    cal_actor.rollout_batch(sampler.batch(0, rollouts))  # compile
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cal_actor.rollout_batch(sampler.batch(0, rollouts))
+        decode_dts.append(time.perf_counter() - t0)
+    decode_s = _median_run(decode_dts)
+    # a round's learner work: long enough that sleep dominates host
+    # jitter AND decode's non-overlappable compute stays well under it
+    steps_per_round = max(2, int(math.ceil(2.0 * decode_s / step_s)),
+                          int(math.ceil(0.3 / step_s)))
+
+    run_counter = [0]
+
+    def run_loop(concurrent, troot, floor_s):
+        run_counter[0] += 1
+        tag = "replay-%d" % run_counter[0]
+        fds = FlowDataStore("OnlineBench", LocalStorage, ds_root=troot)
+        engine.params = dict(params0)  # every rep starts identical
+        actor = _PacedActor(scheduler=scheduler,
+                            max_new_tokens=max_new)
+        actor.floor_s = floor_s
+        writer = ReplayWriter(fds, tag, seq_len,
+                              windows_per_shard=batch_size)
+        reader = ReplayReader(fds, tag, batch_size, seq_len, seed=0)
+        loop = OnlineLoop(actor, writer, reader, sampler, learner_step,
+                          fresh_state(), snapshot, rounds=rounds,
+                          rollouts=rollouts,
+                          steps_per_round=steps_per_round,
+                          push_every=1, max_lag=2,
+                          concurrent=concurrent)
+        t0 = time.perf_counter()
+        summary = loop.run()
+        dt = time.perf_counter() - t0
+        assert summary["dropped_stale"] == 0, summary
+        assert summary["shed_requests"] == 0, summary
+        assert summary["generation"] == rounds, summary
+        return summary["steps"] * batch_size * seq_len / dt, dt
+
+    with tempfile.TemporaryDirectory() as troot:
+        # the warm pass (floor 0) doubles as the floor calibration: one
+        # UNPACED serial round = decode + train + replay epoch overhead,
+        # which is exactly the wall a remote fleet's rollout round-trip
+        # must cover for the learner to hide it — so pace to that
+        _tps, warm_dt = run_loop(False, troot, 0.0)
+        floor_s = warm_dt / rounds
+        serial_runs, overlap_runs = _interleaved_reps(
+            lambda: run_loop(False, troot, floor_s),
+            lambda: run_loop(True, troot, floor_s), reps)
+    serial_tps = _median_run(serial_runs, key=lambda r: r[0])[0]
+    overlap_tps = _median_run(overlap_runs, key=lambda r: r[0])[0]
+    ratio = overlap_tps / serial_tps
+
+    params = snapshot(state)
+    return {
+        "metric": "online_loop_goodput_x",
+        "value": round(ratio, 2),
+        "unit": "learner tokens/s, concurrent actor vs serial baseline "
+                "(paced actor emulates remote fleet latency; median of "
+                "%d interleaved reps; gate: >= 1.3)" % reps,
+        "vs_baseline": _vs_baseline(ratio),
+        "extra": {
+            "backend": jax.default_backend(),
+            "rounds": rounds,
+            "rollouts_per_round": rollouts,
+            "steps_per_round": steps_per_round,
+            "batch": batch_size,
+            "seq_len": seq_len,
+            "pace_floor_ms": round(floor_s * 1000, 1),
+            "train_step_ms": round(step_s * 1000, 1),
+            "decode_batch_ms": round(decode_s * 1000, 1),
+            "serial_tokens_per_s": round(serial_tps, 1),
+            "concurrent_tokens_per_s": round(overlap_tps, 1),
+        },
+        "submetrics": [
+            _submetric(lambda: {
+                "metric": "online_push_shed_requests",
+                "value": _bench_online_push_shed(cfg, params),
+                "unit": "rollouts shed by a weight push under load "
+                        "(rolling_reload via make_fleet_push; "
+                        "gate: == 0)"}),
+        ],
+    }
 
 
 def bench_step_launch():
@@ -2455,6 +2736,15 @@ if __name__ == "__main__":
                        os.environ.get("PYTHONPATH", "").split(os.pathsep))):
             _rerun_on_cpu(degraded=False)
         result = bench_mpmd_overlap()
+    elif mode == "online":
+        # loop-goodput metric: a paced in-process actor emulating remote
+        # fleet latency BY DESIGN (see bench_online) — no chip involved,
+        # pin CPU before jax initializes
+        if (os.environ.get("JAX_PLATFORMS") != "cpu"
+                or any("axon_site" in p for p in
+                       os.environ.get("PYTHONPATH", "").split(os.pathsep))):
+            _rerun_on_cpu(degraded=False)
+        result = bench_online()
     elif mode == "hlo_estimate":
         # no chip needed BY DESIGN (abstract lowering + cost model): pin
         # to CPU before jax initializes — this mode must never touch the

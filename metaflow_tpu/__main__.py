@@ -671,7 +671,9 @@ def _dataset_cmd(fn, *args, **kwargs):
 
 @dataset.command(name="build",
                  help="Pack a token file (.npy, or raw binary with "
-                      "--dtype) into shards + manifest.")
+                      "--dtype) into shards + manifest; --append grows "
+                      "an existing corpus instead (new shards + "
+                      "manifest revision bump, old readers unaffected).")
 @click.argument("flow_name")
 @click.argument("name")
 @click.option("--input", "input_path", required=True,
@@ -689,10 +691,30 @@ def _dataset_cmd(fn, *args, **kwargs):
               help="Datastore root override.")
 @click.option("--overwrite", is_flag=True,
               help="Rebuild over an existing dataset of this name.")
+@click.option("--append", "append_", is_flag=True,
+              help="Append to an EXISTING dataset (packed at its "
+                   "manifest's shard size; --shard-tokens ignored).")
+@click.option("--generation", default=None, type=int,
+              help="With --append: stamp the new shards with this "
+                   "weight generation (online replay freshness key).")
 def dataset_build(flow_name, name, input_path, shard_tokens, dtype,
-                  datastore, datastore_root, overwrite):
-    from .cmd.dataset import build_dataset
+                  datastore, datastore_root, overwrite, append_,
+                  generation):
+    from .cmd.dataset import append_dataset, build_dataset
 
+    if append_:
+        if overwrite:
+            raise click.ClickException(
+                "--append and --overwrite are mutually exclusive")
+        _dataset_cmd(append_dataset, flow_name, name, input_path,
+                     dtype=dtype, generation=generation,
+                     datastore=datastore, datastore_root=datastore_root,
+                     echo=click.echo)
+        return
+    if generation is not None:
+        raise click.ClickException(
+            "--generation only applies to --append (a fresh build's "
+            "shards are generation 0 by definition)")
     _dataset_cmd(build_dataset, flow_name, name, input_path, shard_tokens,
                  dtype=dtype, datastore=datastore,
                  datastore_root=datastore_root, overwrite=overwrite,
@@ -724,6 +746,82 @@ def dataset_list_cmd(flow_name, datastore, datastore_root):
 
     _dataset_cmd(dataset_list, flow_name, datastore=datastore,
                  datastore_root=datastore_root, echo=click.echo)
+
+
+@main.command(name="online",
+              help="Run the closed actor-learner loop: serve rollouts, "
+                   "score them, append to the replay corpus, train, "
+                   "push weights back (docs/online.md).")
+@click.argument("flow_name")
+@click.option("--dataset", default="replay", show_default=True,
+              help="Replay corpus name in the flow's datastore.")
+@click.option("--run-id", default="online", show_default=True,
+              help="Run id telemetry records under.")
+@click.option("--rounds", default=None, type=int,
+              help="Loop rounds (default: TPUFLOW_ONLINE_ROUNDS).")
+@click.option("--rollouts", default=None, type=int,
+              help="Rollouts per round (TPUFLOW_ONLINE_ROLLOUTS).")
+@click.option("--steps-per-round", default=None, type=int,
+              help="Learner steps per round "
+                   "(TPUFLOW_ONLINE_STEPS_PER_ROUND).")
+@click.option("--push-every", default=None, type=int,
+              help="Weight-push cadence in rounds "
+                   "(TPUFLOW_ONLINE_PUSH_EVERY).")
+@click.option("--max-lag", default=None, type=int,
+              help="Off-policy guard in generations "
+                   "(TPUFLOW_ONLINE_MAX_LAG).")
+@click.option("--max-new-tokens", default=None, type=int,
+              help="Decode budget per rollout "
+                   "(TPUFLOW_ONLINE_MAX_NEW_TOKENS).")
+@click.option("--seq-len", default=32, show_default=True, type=int)
+@click.option("--batch-size", default=4, show_default=True, type=int)
+@click.option("--prompt-len", default=8, show_default=True, type=int)
+@click.option("--seed", default=0, show_default=True, type=int)
+@click.option("--vocab-size", default=128, show_default=True, type=int)
+@click.option("--dim", default=32, show_default=True, type=int)
+@click.option("--n-layers", default=1, show_default=True, type=int)
+@click.option("--n-heads", default=2, show_default=True, type=int)
+@click.option("--fresh-generations", default=None, type=int,
+              help="Replay freshness window "
+                   "(TPUFLOW_ONLINE_FRESH_GENERATIONS; 0 = no filter).")
+@click.option("--concurrent/--serial", default=False,
+              help="Prefetch the next round's rollouts while the "
+                   "learner trains (one-round Sebulba pipeline).")
+@click.option("--checkpoint-name", default="online", show_default=True,
+              help="AsyncCheckpointManager name (resume key).")
+@click.option("--reward", default="length", show_default=True,
+              type=click.Choice(["length", "diversity", "logprob"]),
+              help="Rollout scoring function.")
+@click.option("--datastore", default=None,
+              type=click.Choice(["local", "gs"]))
+@click.option("--datastore-root", default=None)
+@click.option("--json-out", default=None, type=click.Path(),
+              help="Write the run summary JSON here (harness hook).")
+def online_cmd(flow_name, dataset, run_id, rounds, rollouts,
+               steps_per_round, push_every, max_lag, max_new_tokens,
+               seq_len, batch_size, prompt_len, seed, vocab_size, dim,
+               n_layers, n_heads, fresh_generations, concurrent,
+               checkpoint_name, reward, datastore, datastore_root,
+               json_out):
+    from .cmd.online import run_online
+    from .exception import TpuFlowException
+
+    try:
+        run_online(flow_name, dataset=dataset, run_id=run_id,
+                   rounds=rounds, rollouts=rollouts,
+                   steps_per_round=steps_per_round,
+                   push_every=push_every, max_lag=max_lag,
+                   max_new_tokens=max_new_tokens, seq_len=seq_len,
+                   batch_size=batch_size, prompt_len=prompt_len,
+                   seed=seed, vocab_size=vocab_size, dim=dim,
+                   n_layers=n_layers, n_heads=n_heads,
+                   fresh_generations=fresh_generations,
+                   concurrent=concurrent,
+                   checkpoint_name=checkpoint_name, reward=reward,
+                   datastore=datastore, datastore_root=datastore_root,
+                   json_out=json_out, echo=click.echo)
+    except TpuFlowException as ex:
+        raise click.ClickException(str(ex))
 
 
 @main.group(help="Local full-stack dev harness: fake GCS + metadata "

@@ -18,9 +18,11 @@ import numpy as np
 
 from ..data.shards import (
     DatasetError,
+    append_corpus,
     build_corpus,
     list_datasets,
     load_manifest,
+    manifest_revision,
 )
 
 
@@ -64,6 +66,29 @@ def build_dataset(flow_name, name, input_path, shard_tokens, dtype=None,
             manifest["n_shards"], manifest["shard_tokens"],
             manifest["dtype"],
             sum(s["bytes"] for s in manifest["shards"]) / 2**20))
+    return manifest
+
+
+def append_dataset(flow_name, name, input_path, dtype=None,
+                   generation=None, datastore=None, datastore_root=None,
+                   echo=print):
+    """`tpuflow dataset build --append`: append a token file's contents
+    to an EXISTING corpus as new shards (packed at the manifest's own
+    shard_tokens) and bump the manifest's append revision. Readers
+    holding the old manifest stream exactly the token order they started
+    with; reloading readers see the growth at their next epoch boundary.
+    --generation stamps the new shards for the online replay freshness
+    window."""
+    fds = open_flow_datastore(flow_name, datastore, datastore_root)
+    tokens = load_tokens(input_path, dtype=dtype)
+    manifest = append_corpus(fds, name, tokens, generation=generation,
+                             dtype=dtype)
+    echo("appended %d tokens to dataset %s/%s: now %d tokens in %d "
+         "shard(s), revision %d%s"
+         % (tokens.size, flow_name, name, manifest["total_tokens"],
+            manifest["n_shards"], manifest_revision(manifest),
+            "" if generation is None
+            else ", generation %d" % int(generation)))
     return manifest
 
 

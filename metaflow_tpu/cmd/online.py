@@ -1,0 +1,124 @@
+"""`tpuflow online FLOW`: run the closed actor-learner loop at any
+scale — by default a self-contained test-scale run: a tiny Llama actor
+behind the continuous-batching scheduler, a seeded prompt sampler, the
+flow's datastore as the replay corpus, and a learner gang of one.
+
+Every leg is the production path (SlotEngine, StreamingTokenBatches,
+AsyncCheckpointManager, the chaos hooks), so a seeded run of this
+command is the end-to-end generate->score->pack->train->re-serve proof,
+and — because every stage is deterministic or idempotent — a SIGKILLed
+run re-invoked with the same arguments resumes with an exact loss
+trajectory and a byte-identical replay corpus. See docs/online.md.
+
+    python -m metaflow_tpu online OnlineFlow --rounds 4 --seed 0
+    TPUFLOW_CHAOS=step:0 python -m metaflow_tpu online OnlineFlow ...
+"""
+
+import json
+import os
+
+from .. import knobs, telemetry
+from ..exception import TpuFlowException
+
+
+def run_online(flow_name, dataset="replay", run_id="online",
+               rounds=None, rollouts=None, steps_per_round=None,
+               push_every=None, max_lag=None, max_new_tokens=None,
+               seq_len=32, batch_size=4, prompt_len=8, seed=0,
+               vocab_size=128, dim=32, n_layers=1, n_heads=2,
+               fresh_generations=None, concurrent=False,
+               checkpoint_name="online", reward="length",
+               datastore=None, datastore_root=None, json_out=None,
+               echo=print):
+    """Wire actor + replay + learner and run the loop; returns the
+    loop's summary dict (also written to --json-out for harnesses)."""
+    import jax
+    import numpy as np
+
+    from ..models import llama
+    from ..online import (ActorPool, LogProbScorer, OnlineLoop,
+                          PromptSampler, ReplayReader, ReplayWriter,
+                          diversity_reward, length_reward)
+    from ..serving import Scheduler, SlotEngine
+    from ..spmd import MeshSpec, create_mesh
+    from ..training import default_optimizer, make_trainer, shard_batch
+    from ..training.checkpoint import AsyncCheckpointManager
+    from .dataset import open_flow_datastore
+
+    fds = open_flow_datastore(flow_name, datastore, datastore_root)
+    rec = None
+    if telemetry.enabled():
+        rec = telemetry.init_recorder(fds, run_id, "_online",
+                                      "loop-%d" % os.getpid())
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=int(vocab_size),
+                                 dim=int(dim), n_layers=int(n_layers),
+                                 n_heads=int(n_heads))
+    mesh = create_mesh(MeshSpec.dp())
+    ckpt = AsyncCheckpointManager(fds, name=checkpoint_name)
+    state, step_fn, _shardings = make_trainer(
+        jax.random.PRNGKey(int(seed)), cfg, mesh, llama,
+        optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                    total_steps=1000),
+        checkpoint=ckpt)
+
+    # the actor serves COPIES of the learner weights: the jitted train
+    # step donates its state, so handing the engine the live buffers
+    # would leave it decoding from deleted arrays after the first step
+    def snapshot_params(st):
+        return jax.tree_util.tree_map(np.asarray,
+                                      jax.device_get(st["params"]))
+
+    max_new = (knobs.get_int("TPUFLOW_ONLINE_MAX_NEW_TOKENS")
+               if max_new_tokens is None else int(max_new_tokens))
+    engine = SlotEngine(snapshot_params(state), cfg,
+                        max_slots=min(8, max(1, int(rollouts or 8))),
+                        max_seq_len=int(prompt_len) + max_new + 8)
+    scheduler = Scheduler(engine)
+    if reward == "length":
+        reward_fn = length_reward
+    elif reward == "diversity":
+        reward_fn = diversity_reward
+    elif reward == "logprob":
+        reward_fn = LogProbScorer(snapshot_params(state), cfg)
+    else:
+        raise TpuFlowException(
+            "unknown reward %r (want length, diversity or logprob)"
+            % (reward,))
+    actor = ActorPool(scheduler=scheduler, reward_fn=reward_fn,
+                      max_new_tokens=max_new)
+
+    writer = ReplayWriter(fds, dataset, int(seq_len),
+                          windows_per_shard=max(1, int(batch_size)))
+    reader = ReplayReader(fds, dataset, int(batch_size), int(seq_len),
+                          seed=int(seed),
+                          fresh_generations=fresh_generations)
+    sampler = PromptSampler(cfg.vocab_size, int(prompt_len),
+                            seed=int(seed))
+
+    def learner_step(st, tokens):
+        batch = shard_batch({"tokens": tokens}, mesh)
+        with mesh:
+            st, metrics = step_fn(st, batch)
+        return st, float(metrics["loss"])
+
+    loop = OnlineLoop(actor, writer, reader, sampler, learner_step,
+                      state, snapshot_params, checkpoint=ckpt,
+                      rounds=rounds, rollouts=rollouts,
+                      steps_per_round=steps_per_round,
+                      push_every=push_every, max_lag=max_lag,
+                      concurrent=concurrent, echo=echo)
+    try:
+        summary = loop.run()
+    finally:
+        if rec is not None:
+            telemetry.close_recorder()
+    echo("online: done — %d step(s), generation %d, %d rollout(s) "
+         "kept, %d stale, %d shed"
+         % (summary["steps"], summary["generation"],
+            summary["kept_rollouts"], summary["dropped_stale"],
+            summary["shed_requests"]))
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(summary, f, sort_keys=True)
+    return summary

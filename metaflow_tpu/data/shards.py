@@ -122,6 +122,86 @@ def build_corpus(flow_datastore, name, tokens,
     return manifest
 
 
+def append_corpus(flow_datastore, name, tokens, generation=None,
+                  dtype=None):
+    """Append a 1-D token array to an EXISTING corpus as new shard blobs
+    plus a manifest rewrite; returns the updated manifest dict.
+
+    This is the replay-buffer write path (metaflow_tpu/online/replay.py)
+    and `tpuflow dataset build --append`. The manifest stays v1 but
+    gains/bumps an integer `revision` (absent == 0 for manifests written
+    before appends existed), so a writer's publish is observable:
+    readers that hold the OLD manifest dict keep streaming exactly the
+    token order they started with (shard entries are append-only and
+    existing blobs are immutable CAS objects), while readers that reload
+    the manifest see the growth and pick it up at their next epoch
+    boundary.
+
+    `generation` optionally stamps every appended shard entry with the
+    weight generation that produced its tokens — the freshness key the
+    online ReplayReader's max-staleness window filters on. Shards from
+    the original build (or generation-less appends) count as
+    generation 0.
+
+    The append's trailing shard may be short (shard_tokens is the pack
+    size, not a guarantee): StreamingTokenBatches windows are sliced
+    per-shard, so appended text never straddles a shard boundary, and a
+    mid-corpus short shard simply contributes fewer windows. Writers
+    that must not lose tokens to partial windows (the replay path) keep
+    both shard_tokens and each append a multiple of their window size.
+    """
+    manifest = load_manifest(flow_datastore, name)
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise DatasetError("tokens must be 1-D, got shape %s"
+                           % (tokens.shape,))
+    if tokens.size == 0:
+        raise DatasetError("refusing to append zero tokens to %r" % name)
+    want = np.dtype(manifest["dtype"])
+    if dtype is not None and np.dtype(dtype).newbyteorder("<") != want:
+        raise DatasetError(
+            "dataset %r stores %s tokens; cannot append as %s"
+            % (name, manifest["dtype"], np.dtype(dtype).str))
+    shard_tokens = int(manifest["shard_tokens"])
+    bounds = [(start, min(start + shard_tokens, tokens.size))
+              for start in range(0, tokens.size, shard_tokens)]
+
+    def blob_iter():
+        for start, stop in bounds:
+            yield np.ascontiguousarray(
+                tokens[start:stop], dtype=want).tobytes()
+
+    results = flow_datastore.save_data(blob_iter())
+    for (_uri, key), (start, stop) in zip(results, bounds):
+        shard = {"key": key, "tokens": int(stop - start),
+                 "bytes": int((stop - start) * want.itemsize),
+                 "sha256": key}
+        if generation is not None:
+            shard["generation"] = int(generation)
+        manifest["shards"].append(shard)
+    manifest["n_shards"] = len(manifest["shards"])
+    manifest["total_tokens"] = int(manifest["total_tokens"]
+                                   + tokens.size)
+    manifest["revision"] = int(manifest.get("revision", 0)) + 1
+    flow_datastore.storage.save_bytes(
+        [(_manifest_path(flow_datastore, name),
+          json.dumps(manifest, sort_keys=True).encode("utf-8"))],
+        overwrite=True,
+    )
+    return manifest
+
+
+def manifest_revision(manifest):
+    """The append revision of a manifest dict (0 = never appended)."""
+    return int(manifest.get("revision", 0))
+
+
+def shard_generation(shard):
+    """The weight generation stamped on a shard entry (0 = unstamped:
+    original build or a generation-less append)."""
+    return int(shard.get("generation", 0))
+
+
 def load_manifest(flow_datastore, name, missing_ok=False):
     """The manifest dict of a built dataset, or None (missing_ok)."""
     _check_name(name)

@@ -27,6 +27,9 @@ Taxonomy (pinned in tests/schema_validate.py::GOODPUT_CATEGORIES):
     serve_decode        serving: batched decode device work
     serve_idle          serving: scheduler span not covered by device
                         work (empty queue, admission gaps)
+    actor_rollout       online loop: remote-fleet rollout batches (the
+                        actor's chip-seconds; local-engine rollouts
+                        already ride serve_prefill/serve_decode)
     unattributed        observed chip-time no category explains — an
                         explicit bucket, never silently dropped
 
@@ -73,18 +76,19 @@ CAPACITY_WAIT = "capacity_wait"
 SERVE_PREFILL = "serve_prefill"
 SERVE_DECODE = "serve_decode"
 SERVE_IDLE = "serve_idle"
+ACTOR_ROLLOUT = "actor_rollout"
 UNATTRIBUTED = "unattributed"
 
 CATEGORIES = (
     PRODUCTIVE_STEP, COMPILE, INPUT_STALL, TRANSFER_STALL, UPDATE,
     CHECKPOINT_BLOCKED, RESTORE_REPLAY, CAPACITY_WAIT,
-    SERVE_PREFILL, SERVE_DECODE, SERVE_IDLE,
+    SERVE_PREFILL, SERVE_DECODE, SERVE_IDLE, ACTOR_ROLLOUT,
 )
 
 # chip-time spent doing the work the run exists for; everything else
 # (incl. unattributed) is a loss category the verdict can name
 PRODUCTIVE_CATEGORIES = (
-    PRODUCTIVE_STEP, UPDATE, SERVE_PREFILL, SERVE_DECODE)
+    PRODUCTIVE_STEP, UPDATE, SERVE_PREFILL, SERVE_DECODE, ACTOR_ROLLOUT)
 
 
 def _is_step_timer(rec):
@@ -229,6 +233,17 @@ def derive_ledger(records, run_id=None, tolerance=RECONCILE_TOLERANCE):
             lane.work(ts, seconds)
             lane.kinds.add("train")
             lane.add(RESTORE_REPLAY, seconds)
+        elif name == "online.rollout":
+            # the online ActorPool's remote-fleet batches: the fleet's
+            # chip-seconds viewed from the supervisor lane (the actor
+            # emits it ONLY on the remote path — a local engine's
+            # rollouts already land in serve_* via the serve timers in
+            # the same-process lane, and double-counting would break
+            # reconciliation)
+            lane = lanes.setdefault(_lane_key(rec), _Lane())
+            lane.work(ts, seconds)
+            lane.kinds.add("actor")
+            lane.add(ACTOR_ROLLOUT, seconds)
         # any other timer (task.user_code, persist.*, distributed.*) is
         # host bookkeeping, not chip work: it extends neither the span
         # nor any category

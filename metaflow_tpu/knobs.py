@@ -45,7 +45,7 @@ _FALSEY = ("0", "false", "no", "off")
 SUBSYSTEM_ORDER = (
     "config", "runtime", "datastore", "data", "training", "ops", "spmd",
     "progress", "elastic", "serving", "fleet", "slo", "telemetry",
-    "analysis", "tpu", "conda", "chaos", "internal",
+    "analysis", "tpu", "conda", "chaos", "internal", "online",
 )
 
 
@@ -417,6 +417,23 @@ _k("TPUFLOW_REPLICA_TELEMETRY_FLOW", "str", None, "", "internal",
    "flight-recorder flow name injected into serve replicas")
 _k("TPUFLOW_REPLICA_TELEMETRY_RUN", "str", None, "", "internal",
    "flight-recorder run id injected into serve replicas")
+
+# --- online (metaflow_tpu/online/: actor-learner loop) ---------------------
+_k("TPUFLOW_ONLINE_ROUNDS", "int", 4, "count", "online",
+   "rollout->append->train->push rounds per `tpuflow online` run")
+_k("TPUFLOW_ONLINE_ROLLOUTS", "int", 8, "count", "online",
+   "rollouts the actor generates per round")
+_k("TPUFLOW_ONLINE_STEPS_PER_ROUND", "int", 2, "steps", "online",
+   "learner train steps per round")
+_k("TPUFLOW_ONLINE_PUSH_EVERY", "int", 1, "rounds", "online",
+   "push learner weights to the actor every N rounds")
+_k("TPUFLOW_ONLINE_MAX_NEW_TOKENS", "int", 16, "tokens", "online",
+   "decode budget per rollout")
+_k("TPUFLOW_ONLINE_MAX_LAG", "int", 2, "generations", "online",
+   "off-policy guard: drop rollouts older than this many weight "
+   "generations")
+_k("TPUFLOW_ONLINE_FRESH_GENERATIONS", "int", 0, "generations", "online",
+   "ReplayReader freshness window in generations (0 = no filter)")
 
 
 # ---------------------------------------------------------------------------

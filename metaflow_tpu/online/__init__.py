@@ -1,0 +1,16 @@
+"""Online actor-learner loop (Podracer/Sebulba split over existing
+subsystems): the serving fleet as rollout actor, the streaming dataset
+as replay buffer, the training gang as learner, zero-shed rolling
+reloads as the weight-push path. See docs/online.md.
+"""
+
+from .actor import (ActorPool, LogProbScorer, OnlineError, PromptSampler,
+                    Rollout, diversity_reward, length_reward)
+from .loop import OnlineLoop, make_fleet_push
+from .replay import WATERMARK_KEYS, ReplayReader, ReplayWriter
+
+__all__ = [
+    "ActorPool", "LogProbScorer", "OnlineError", "PromptSampler",
+    "Rollout", "diversity_reward", "length_reward", "OnlineLoop",
+    "make_fleet_push", "ReplayReader", "ReplayWriter", "WATERMARK_KEYS",
+]

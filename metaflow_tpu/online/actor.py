@@ -1,0 +1,311 @@
+"""ActorPool: the serving tier as the online loop's rollout actor.
+
+One pool, two backends. `scheduler=` drives an in-process
+SlotEngine/PagedEngine through the continuous-batching Scheduler —
+weight pushes swap `engine.params` directly (the engine passes params
+per jitted call, so a swap needs no recompile). `fleet=` (a running
+ServingFleet) or `fleet_addr=` (host, port of one) POSTs
+/v1/generate to the failover router — weight pushes ride the fleet's
+zero-shed `rolling_reload`, and the pool reads the authoritative
+`fleet_generation` from the router.
+
+Every completed rollout is stamped with the weight GENERATION the
+backend reported when the batch was dispatched — the freshness key the
+off-policy guard and the replay freshness window both filter on — and
+scored through a pluggable `reward_fn(prompt, completion) -> float`.
+Determinism contract: with a seeded PromptSampler and greedy decode
+(temperature 0), `rollout_batch(prompts, round_index)` is a pure
+function of (weights, prompts) — replica failover re-decodes
+token-identically, and a resumed loop re-generates byte-identical
+rollouts, which is what makes the replay writer's idempotent publish
+(and the zero-dup kill guarantee) hold.
+
+Telemetry: one pinned `online.rollout.scored` event per rollout; the
+`online.rollout` timer wraps REMOTE batches only — it feeds the
+goodput ledger's `actor_rollout` lane, and a local engine's chip time
+already lands in serve_prefill/serve_decode via the scheduler's own
+timers in the same-process lane (emitting both would double-count).
+"""
+
+import json
+import threading
+import time
+from http.client import HTTPConnection
+
+import numpy as np
+
+from .. import knobs, telemetry
+from ..exception import TpuFlowException
+
+
+class OnlineError(TpuFlowException):
+    headline = "Online loop error"
+
+
+class PromptSampler(object):
+    """Seeded prompt source: `batch(round_index, n)` is a pure function
+    of (seed, round_index), so a resumed loop re-draws the exact prompts
+    of the round it re-enters."""
+
+    def __init__(self, vocab_size, prompt_len, seed=0):
+        self._vocab = int(vocab_size)
+        self._prompt_len = int(prompt_len)
+        self._seed = int(seed)
+
+    def batch(self, round_index, n):
+        rng = np.random.default_rng([self._seed, int(round_index)])
+        draws = rng.integers(1, self._vocab, size=(int(n),
+                                                   self._prompt_len))
+        return [[int(t) for t in row] for row in draws]
+
+
+class Rollout(object):
+    """One scored rollout, stamped with the generation that decoded it."""
+
+    __slots__ = ("request_id", "prompt", "completion", "generation",
+                 "reward")
+
+    def __init__(self, request_id, prompt, completion, generation,
+                 reward):
+        self.request_id = str(request_id)
+        self.prompt = list(prompt)
+        self.completion = list(completion)
+        self.generation = int(generation)
+        self.reward = float(reward)
+
+    @property
+    def tokens(self):
+        return self.prompt + self.completion
+
+
+# ---------------------------------------------------------------------------
+# reward functions: reward_fn(prompt, completion) -> float
+# ---------------------------------------------------------------------------
+
+
+def length_reward(prompt, completion):
+    """Programmatic reward: tokens actually generated."""
+    return float(len(completion))
+
+
+def diversity_reward(prompt, completion):
+    """Programmatic reward: fraction of distinct tokens in the
+    completion (degenerate repetition scores near zero)."""
+    if not completion:
+        return 0.0
+    return float(len(set(completion))) / float(len(completion))
+
+
+class LogProbScorer(object):
+    """Model-based scorer: mean log-probability of the completion under
+    a (possibly different) scoring model — the distillation-style reward
+    head. Holds its own params/cfg so the scorer can lag or differ from
+    the actor's weights."""
+
+    def __init__(self, params, cfg, mesh=None):
+        self._params = params
+        self._cfg = cfg
+        self._mesh = mesh
+
+    def __call__(self, prompt, completion):
+        if not completion:
+            return 0.0
+        import jax
+        import jax.numpy as jnp
+
+        from ..models import llama
+
+        toks = jnp.asarray([list(prompt) + list(completion)],
+                           dtype=jnp.int32)
+        logits = llama.forward(self._params, toks[:, :-1], self._cfg,
+                               mesh=self._mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        # positions len(prompt)-1 .. end predict the completion tokens
+        start = len(prompt) - 1
+        idx = jnp.arange(start, start + len(completion))
+        picked = logp[0, idx, toks[0, idx + 1]]
+        return float(jnp.mean(picked))
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+
+class ActorPool(object):
+    def __init__(self, scheduler=None, fleet=None, fleet_addr=None,
+                 reward_fn=None, max_new_tokens=None, temperature=0.0,
+                 generation=0, request_timeout_s=60.0, http_workers=4):
+        backends = sum(x is not None
+                       for x in (scheduler, fleet, fleet_addr))
+        if backends != 1:
+            raise OnlineError(
+                "ActorPool needs exactly one backend: scheduler= (local "
+                "engine), fleet= (in-process ServingFleet) or "
+                "fleet_addr= ((host, port) of a running fleet router)")
+        self._scheduler = scheduler
+        self._fleet = fleet
+        self._addr = (tuple(fleet_addr) if fleet_addr is not None
+                      else ((fleet.host, fleet.port)
+                            if fleet is not None else None))
+        self.reward_fn = reward_fn or length_reward
+        self.max_new_tokens = (
+            knobs.get_int("TPUFLOW_ONLINE_MAX_NEW_TOKENS")
+            if max_new_tokens is None else int(max_new_tokens))
+        self.temperature = float(temperature)
+        self._generation = int(generation)
+        self._timeout_s = float(request_timeout_s)
+        self._http_workers = int(http_workers)
+
+    # ---------- generation ----------
+
+    @property
+    def generation(self):
+        """The weight generation the backend currently serves."""
+        if self._fleet is not None:
+            return int(self._fleet.fleet_generation)
+        if self._addr is not None:
+            return int(self._healthz().get("fleet_generation", 0))
+        return self._generation
+
+    def set_generation(self, generation):
+        """Re-pin the LOCAL backend's generation counter (resume path:
+        the counter is loop state, not engine state). Remote backends
+        own their counter — the router's fleet_generation survives the
+        loop process, so there is nothing to re-pin."""
+        if self._scheduler is not None:
+            self._generation = int(generation)
+
+    def update_weights(self, params, generation=None):
+        """Swap the local engine's weights and bump the generation —
+        the in-process analogue of a fleet rolling_reload (no recompile:
+        params are a per-call argument of the jitted step). Remote
+        backends push via the fleet's own rolling_reload (loop.py wires
+        that path) — calling this on one is an error, not a silent
+        no-op."""
+        if self._scheduler is None:
+            raise OnlineError(
+                "update_weights() swaps a LOCAL engine's params; a "
+                "fleet-backed pool pushes weights via rolling_reload")
+        self._scheduler.engine.params = params
+        self._generation = (self._generation + 1 if generation is None
+                            else int(generation))
+        return self._generation
+
+    # ---------- rollouts ----------
+
+    def rollout_batch(self, prompts, round_index=0):
+        """Decode + score one batch of prompts; returns [Rollout].
+        Every rollout is stamped with the generation observed at
+        dispatch — if a reload lands mid-batch, the stamp is the
+        conservative (older) one, so the staleness guard can only
+        over-drop, never under-drop."""
+        gen = self.generation
+        if self._scheduler is not None:
+            raw = self._rollout_local(prompts, round_index)
+        else:
+            t0 = time.perf_counter()
+            raw = self._rollout_fleet(prompts, round_index)
+            telemetry.emit("timer", "online.rollout",
+                           ms=(time.perf_counter() - t0) * 1000.0,
+                           ok=True)
+        rollouts = []
+        for request_id, prompt, completion in raw:
+            reward = float(self.reward_fn(prompt, completion))
+            ro = Rollout(request_id, prompt, completion, gen, reward)
+            telemetry.event("online.rollout.scored", data={
+                "request_id": ro.request_id,
+                "generation": ro.generation,
+                "prompt_tokens": len(ro.prompt),
+                "new_tokens": len(ro.completion),
+                "reward": ro.reward})
+            rollouts.append(ro)
+        return rollouts
+
+    @staticmethod
+    def request_id(round_index, i):
+        """Stable id for rollout i of a round — identical across a
+        resumed re-generation, so replay accounting can dedup by id."""
+        return "round%d-%d" % (int(round_index), int(i))
+
+    def _rollout_local(self, prompts, round_index):
+        from ..serving import Request
+
+        reqs = []
+        for i, prompt in enumerate(prompts):
+            req = Request([int(t) for t in prompt],
+                          max_new_tokens=self.max_new_tokens,
+                          temperature=self.temperature, rng=i,
+                          request_id=self.request_id(round_index, i))
+            self._scheduler.submit(req)
+            reqs.append((req, prompt))
+        self._scheduler.run_until_idle()
+        return [(req.id, list(prompt), [int(t) for t in req.generated])
+                for req, prompt in reqs]
+
+    def _rollout_fleet(self, prompts, round_index):
+        results = [None] * len(prompts)
+        errors = []
+        lock = threading.Lock()
+        pending = list(enumerate(prompts))
+
+        def worker():
+            while True:
+                with lock:
+                    if not pending:
+                        return
+                    i, prompt = pending.pop(0)
+                try:
+                    body = self._post_generate(prompt, round_index, i)
+                    results[i] = (body["id"], list(prompt),
+                                  [int(t) for t in body["new_tokens"]])
+                except Exception as exc:  # surfaced below, with index
+                    with lock:
+                        errors.append((i, exc))
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, min(self._http_workers,
+                                             len(prompts))))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            i, exc = errors[0]
+            raise OnlineError(
+                "rollout %d of round batch failed against fleet %s:%d: "
+                "%s" % (i, self._addr[0], self._addr[1], exc))
+        return results
+
+    def _post_generate(self, prompt, round_index, i):
+        conn = HTTPConnection(self._addr[0], self._addr[1],
+                              timeout=self._timeout_s)
+        try:
+            payload = {
+                "tokens": [int(t) for t in prompt],
+                "max_new_tokens": self.max_new_tokens,
+                "temperature": self.temperature,
+                "seed": i,
+                "request_id": self.request_id(round_index, i),
+            }
+            conn.request("POST", "/v1/generate",
+                         body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read().decode() or "{}")
+            if resp.status != 200:
+                raise OnlineError("fleet returned %d: %s"
+                                  % (resp.status, body))
+            return body
+        finally:
+            conn.close()
+
+    def _healthz(self):
+        conn = HTTPConnection(self._addr[0], self._addr[1],
+                              timeout=self._timeout_s)
+        try:
+            conn.request("GET", "/healthz")
+            return json.loads(conn.getresponse().read().decode() or "{}")
+        finally:
+            conn.close()

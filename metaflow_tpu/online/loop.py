@@ -1,0 +1,260 @@
+"""OnlineLoop: the Podracer-style supervisor closing
+generate -> score -> pack -> train -> re-serve.
+
+Sebulba split, in one supervisor: the ACTOR (an ActorPool over the
+serving tier) decodes prompt batches; scored rollouts pass the
+off-policy guard and land in the replay corpus (ReplayWriter); the
+LEARNER pulls batches back out through the ReplayReader and steps; every
+`push_every` rounds the learner's weights go back to the actor — an
+in-process param swap, or (via `push_fn`) an AsyncCheckpointManager
+upload followed by the fleet's zero-shed `rolling_reload` — and the
+GENERATION counter advances.
+
+Generation/staleness semantics: a generation is one completed weight
+push. Rollouts carry the generation that decoded them; the guard drops
+any whose lag (learner generation - rollout generation) exceeds
+`TPUFLOW_ONLINE_MAX_LAG`. In `concurrent` mode the next round's
+rollouts prefetch on a background thread while the learner trains — a
+one-round pipeline, so rollouts are at most one push stale, inside any
+max_lag >= 1.
+
+Crash/resume contract (the reason every stage is deterministic or
+idempotent): prompts are a pure function of (seed, round); decode is
+greedy; `publish(target_revision=base+round+1)` dedups a re-run append;
+the reader stamp in the checkpoint `extra` resumes the exact token
+order; chaos kills re-arm through the once-only ledger. A SIGKILL at
+ANY point inside a round therefore resumes into a byte-identical
+replay corpus and an exact loss trajectory.
+"""
+
+import threading
+import time
+
+from .. import knobs, telemetry
+from ..data.ordering import STATE_KEY
+from ..devtools import chaos as chaos_mod
+from .actor import OnlineError
+
+
+def make_fleet_push(fleet, args_update=None, timeout_s=120.0):
+    """A push_fn for a fleet-backed loop: roll the fleet onto the new
+    weights via the zero-shed rolling reload. `args_update` (dict or
+    callable(step) -> dict) retargets the replica argv — typically at
+    the checkpoint step the AsyncCheckpointManager just uploaded."""
+
+    def push(params, step):
+        update = args_update(step) if callable(args_update) \
+            else args_update
+        rollout = fleet.rolling_reload(args_update=update,
+                                       timeout_s=timeout_s)
+        return {"shed_requests": int(rollout["shed_requests"]),
+                "ms": float(rollout["ms"]),
+                "mechanism": "rolling_reload"}
+
+    return push
+
+
+class OnlineLoop(object):
+    """Co-schedule actor and learner over the shared replay corpus.
+
+    The learner side is injected as plain callables so the loop itself
+    stays framework-free:
+      step_fn(state, tokens[B, seq_len+1]) -> (state, loss)
+      params_fn(state) -> params pytree the actor can serve
+    `checkpoint` (AsyncCheckpointManager) makes the loop resumable: a
+    restore that happened through make_trainer(checkpoint=...) is picked
+    up from `checkpoint.last_restored`.
+    """
+
+    def __init__(self, actor, writer, reader, sampler, step_fn, state,
+                 params_fn, *, checkpoint=None, rounds=None,
+                 rollouts=None, steps_per_round=None, push_every=None,
+                 max_lag=None, push_fn=None, concurrent=False,
+                 echo=None):
+        self.actor = actor
+        self.writer = writer
+        self.reader = reader
+        self.sampler = sampler
+        self._step_fn = step_fn
+        self._state = state
+        self._params_fn = params_fn
+        self._checkpoint = checkpoint
+        self.rounds = (knobs.get_int("TPUFLOW_ONLINE_ROUNDS")
+                       if rounds is None else int(rounds))
+        self.rollouts = (knobs.get_int("TPUFLOW_ONLINE_ROLLOUTS")
+                         if rollouts is None else int(rollouts))
+        self.steps_per_round = (
+            knobs.get_int("TPUFLOW_ONLINE_STEPS_PER_ROUND")
+            if steps_per_round is None else int(steps_per_round))
+        self.push_every = (knobs.get_int("TPUFLOW_ONLINE_PUSH_EVERY")
+                           if push_every is None else int(push_every))
+        self.max_lag = (knobs.get_int("TPUFLOW_ONLINE_MAX_LAG")
+                        if max_lag is None else int(max_lag))
+        self._push_fn = push_fn
+        self.concurrent = bool(concurrent)
+        self._echo = echo or (lambda *a, **k: None)
+        self._prefetch = None  # (thread, holder) for the next round
+
+    # ---------- stages ----------
+
+    def _collect(self, round_index):
+        prompts = self.sampler.batch(round_index, self.rollouts)
+        return self.actor.rollout_batch(prompts,
+                                        round_index=round_index)
+
+    def _collect_async(self, round_index):
+        holder = {}
+
+        def work():
+            try:
+                holder["rollouts"] = self._collect(round_index)
+            except BaseException as exc:  # rejoined on the main thread
+                holder["error"] = exc
+
+        thread = threading.Thread(target=work, daemon=True,
+                                  name="online-prefetch-%d" % round_index)
+        thread.start()
+        self._prefetch = (thread, holder)
+
+    def _take_rollouts(self, round_index):
+        if self._prefetch is not None:
+            thread, holder = self._prefetch
+            self._prefetch = None
+            thread.join()
+            if "error" in holder:
+                raise holder["error"]
+            return holder["rollouts"]
+        return self._collect(round_index)
+
+    def _guard(self, rollouts, generation):
+        """Off-policy guard: drop rollouts staler than max_lag
+        generations; gauges the round's worst observed lag."""
+        kept, dropped = [], 0
+        worst = 0
+        for ro in rollouts:
+            lag = int(generation) - int(ro.generation)
+            worst = max(worst, lag)
+            if lag > self.max_lag:
+                dropped += 1
+                telemetry.event("online.rollout.stale", data={
+                    "request_id": ro.request_id,
+                    "generation": ro.generation,
+                    "learner_generation": int(generation),
+                    "lag": lag})
+            else:
+                kept.append(ro)
+        telemetry.gauge("online.lag", worst)
+        return kept, dropped
+
+    def _push(self, step, generation):
+        params = self._params_fn(self._state)
+        t0 = time.perf_counter()
+        if self._push_fn is not None:
+            info = dict(self._push_fn(params, step))
+        else:
+            self.actor.update_weights(params,
+                                      generation=generation + 1)
+            info = {"shed_requests": 0,
+                    "ms": (time.perf_counter() - t0) * 1000.0,
+                    "mechanism": "swap"}
+        new_gen = generation + 1
+        telemetry.event("online.weights.pushed", data={
+            "step": int(step), "generation": int(new_gen),
+            "shed_requests": int(info.get("shed_requests", 0)),
+            "ms": float(info.get("ms", 0.0)),
+            "mechanism": info.get("mechanism", "swap")})
+        return new_gen, info
+
+    # ---------- the loop ----------
+
+    def run(self):
+        start_round, global_step, generation = 0, 0, 0
+        base_revision = None
+        restored = (self._checkpoint.last_restored
+                    if self._checkpoint is not None else None)
+        if restored is not None:
+            extra = restored.extra or {}
+            start_round = int(extra.get("round", 0))
+            generation = int(extra.get("generation", 0))
+            global_step = int(restored.step)
+            base_revision = extra.get("base_revision")
+            if extra.get("data_state"):
+                self.reader.restore(extra["data_state"])
+            self.actor.set_generation(generation)
+            self._echo("online: resuming at round %d (step %d, "
+                       "generation %d)" % (start_round, global_step,
+                                           generation))
+        if base_revision is None:
+            base_revision = self.writer.revision()
+        self.reader.generation = generation
+
+        losses, stamp = [], None
+        total_kept = total_dropped = total_shed = pushes = 0
+        batches = iter(self.reader)
+        for r in range(start_round, self.rounds):
+            # 1. rollouts (prefetched during the previous round's
+            # training in concurrent mode)
+            rollouts = self._take_rollouts(r)
+            kept, dropped = self._guard(rollouts, generation)
+            total_kept += len(kept)
+            total_dropped += dropped
+            if not kept:
+                raise OnlineError(
+                    "round %d: every rollout exceeded max_lag=%d — the "
+                    "actor is running away from the learner; push more "
+                    "often or raise TPUFLOW_ONLINE_MAX_LAG"
+                    % (r, self.max_lag))
+            # 2. append to the replay corpus (idempotent across resume)
+            for ro in kept:
+                self.writer.add(ro.tokens)
+            self.writer.publish(kept[0].generation,
+                                target_revision=base_revision + r + 1)
+            # 3. prefetch the NEXT round's rollouts while training —
+            # the Sebulba overlap; they decode under the current
+            # generation, one push stale by the time they train
+            if self.concurrent and r + 1 < self.rounds:
+                self._collect_async(r + 1)
+            # 4. learner steps
+            for _ in range(self.steps_per_round):
+                batch = next(batches)
+                chaos_mod.maybe_chaos_step(global_step)
+                self._state, loss = self._step_fn(self._state,
+                                                  batch["tokens"])
+                losses.append(float(loss))
+                stamp = batch[STATE_KEY]
+                global_step += 1
+            # 5. weight push -> generation bump
+            if (r + 1) % self.push_every == 0:
+                generation, info = self._push(global_step, generation)
+                total_shed += int(info.get("shed_requests", 0))
+                pushes += 1
+                self.reader.generation = generation
+            # 6. checkpoint the round boundary (stamp + loop cursor)
+            if self._checkpoint is not None:
+                self._checkpoint.save(self._state, global_step, extra={
+                    "round": r + 1,
+                    "generation": generation,
+                    "data_state": stamp,
+                    "base_revision": int(base_revision)})
+            self._echo("online: round %d/%d  loss %.4f  gen %d  "
+                       "kept %d/%d" % (r + 1, self.rounds,
+                                       losses[-1] if losses else 0.0,
+                                       generation, len(kept),
+                                       len(rollouts)))
+        if self._checkpoint is not None:
+            self._checkpoint.wait()
+        return {
+            "rounds": self.rounds,
+            "start_round": start_round,
+            "steps": global_step,
+            "generation": generation,
+            "losses": losses,
+            "kept_rollouts": total_kept,
+            "dropped_stale": total_dropped,
+            "pushes": pushes,
+            "shed_requests": total_shed,
+        }
+
+    @property
+    def state(self):
+        return self._state

@@ -1,0 +1,285 @@
+"""Replay buffer over the sharded corpus format: scored rollouts in,
+resumable token batches out.
+
+The online loop's replay store IS a `tpuflow dataset` corpus — no new
+storage format. The writer packs rollouts into (seq_len+1)-token windows
+(data/packing.py) and publishes them through `append_corpus`
+(data/shards.py): each publish appends immutable CAS shard blobs, stamps
+them with the weight GENERATION that produced the tokens, and bumps the
+manifest's append `revision`. The reader layers a replay policy on
+StreamingTokenBatches: each epoch streams a FROZEN VIEW of the corpus —
+the shard prefix that existed at the epoch boundary, optionally filtered
+to shards within a freshness window of the learner's current generation
+— and picks up growth at the next boundary.
+
+Exact resume: the reader extends the loader's flat resume stamp with the
+replay WATERMARK (`replay_prefix`, `replay_min_gen`, `replay_revision`).
+Because shard entries are append-only and blobs immutable, a (prefix,
+min_gen) pair reconstructs the exact epoch view no matter how far the
+corpus has grown since — `restore(stamp)` yields the exact next batch
+the interrupted stream would have produced, then rejoins corpus growth
+at the following epoch boundary, precisely where the uninterrupted
+stream would have.
+
+Idempotent publish: `ReplayWriter.publish(target_revision=N)` is a no-op
+when the manifest already reached revision N. Rollout generation is
+deterministic (seeded prompts, greedy decode), so a learner killed
+between append and checkpoint re-generates the same rollouts on resume
+and the revision guard drops the duplicate append — zero duplicated,
+zero lost rollouts in the corpus.
+"""
+
+import numpy as np
+
+from .. import knobs, telemetry
+from ..data.loader import StreamingTokenBatches
+from ..data.ordering import STATE_KEY
+from ..data.packing import pack_documents
+from ..data.shards import (DatasetError, append_corpus, build_corpus,
+                           load_manifest, manifest_revision,
+                           shard_generation)
+
+#: stamp keys the reader adds on top of the loader's flat resume state
+WATERMARK_KEYS = ("replay_prefix", "replay_min_gen", "replay_revision")
+
+
+class ReplayWriter(object):
+    """Buffer rollout token docs; publish them as generation-stamped
+    corpus shards through the dataset manifest path."""
+
+    def __init__(self, flow_datastore, dataset, seq_len, *, pad_id=0,
+                 dtype="<i4", windows_per_shard=64):
+        self._fds = flow_datastore
+        self._dataset = dataset
+        self._seq_len = int(seq_len)
+        self._window = self._seq_len + 1
+        self._pad_id = int(pad_id)
+        self._dtype = np.dtype(dtype)
+        # shard_tokens a multiple of the window so windows never straddle
+        # shards and no token is lost to a partial trailing window
+        self._shard_tokens = self._window * int(windows_per_shard)
+        self._docs = []
+
+    @property
+    def dataset(self):
+        return self._dataset
+
+    @property
+    def pending(self):
+        """Buffered docs not yet published."""
+        return len(self._docs)
+
+    def revision(self):
+        """The corpus's current append revision (0 when the corpus does
+        not exist yet — the first publish creates it)."""
+        manifest = load_manifest(self._fds, self._dataset, missing_ok=True)
+        return 0 if manifest is None else manifest_revision(manifest)
+
+    def add(self, tokens):
+        """Buffer one rollout's token sequence (prompt + completion)."""
+        doc = np.asarray(tokens, dtype=self._dtype).ravel()
+        if doc.size == 0:
+            raise DatasetError("refusing to buffer an empty rollout")
+        self._docs.append(doc)
+
+    def publish(self, generation, target_revision=None):
+        """Pack the buffer and append it to the corpus, stamped with
+        `generation`; returns (manifest, appended_tokens).
+
+        With `target_revision`, the publish is idempotent: when the
+        manifest already reached that revision this buffer's tokens
+        landed before a crash, so the buffer is dropped and nothing is
+        appended (appended_tokens == 0). Either way the buffer is empty
+        afterwards.
+        """
+        manifest = load_manifest(self._fds, self._dataset, missing_ok=True)
+        have = 0 if manifest is None else manifest_revision(manifest)
+        if target_revision is not None and have >= int(target_revision):
+            self._docs = []
+            telemetry.event("online.replay.append", data={
+                "dataset": self._dataset, "shards": 0, "tokens": 0,
+                "revision": int(have), "generation": int(generation),
+                "skipped": True})
+            return manifest, 0
+        if not self._docs:
+            raise DatasetError(
+                "nothing to publish: the rollout buffer is empty")
+        windows = [t for t, _segs in pack_documents(
+            self._docs, self._seq_len, pad_id=self._pad_id,
+            dtype=self._dtype)]
+        tokens = np.concatenate(windows)
+        before = 0 if manifest is None else len(manifest["shards"])
+        if manifest is None:
+            # first publish bootstraps the corpus, then stamps the fresh
+            # shards + revision so it is indistinguishable from an append
+            manifest = build_corpus(self._fds, self._dataset, tokens,
+                                    shard_tokens=self._shard_tokens)
+            manifest = _stamp_build(self._fds, manifest, generation)
+        else:
+            manifest = append_corpus(self._fds, self._dataset, tokens,
+                                     generation=int(generation))
+        self._docs = []
+        telemetry.event("online.replay.append", data={
+            "dataset": self._dataset,
+            "shards": int(len(manifest["shards"]) - before),
+            "tokens": int(tokens.size),
+            "revision": manifest_revision(manifest),
+            "generation": int(generation)})
+        return manifest, int(tokens.size)
+
+
+def _stamp_build(flow_datastore, manifest, generation):
+    """Stamp a freshly built corpus's shards with `generation` and set
+    revision 1 — the bootstrap publish counts as the first append."""
+    import json
+
+    from ..data.shards import _manifest_path
+
+    for shard in manifest["shards"]:
+        shard["generation"] = int(generation)
+    manifest["revision"] = 1
+    flow_datastore.storage.save_bytes(
+        [(_manifest_path(flow_datastore, manifest["name"]),
+          json.dumps(manifest, sort_keys=True).encode("utf-8"))],
+        overwrite=True,
+    )
+    return manifest
+
+
+class ReplayReader(object):
+    """StreamingTokenBatches with a replay policy: per-epoch frozen
+    views of a growing corpus, a max-staleness freshness filter, and
+    watermark-extended exact-resume stamps.
+
+    Yields the loader's {'tokens': [B, seq_len+1], STATE_KEY: {...}}
+    batches; the stamp under STATE_KEY carries the extra WATERMARK_KEYS
+    and round-trips through `restore()`. Set `.generation` to the
+    learner's current weight generation — the freshness filter keeps
+    shards with `generation >= current - fresh_generations`
+    (fresh_generations <= 0 disables the filter; a filter that leaves
+    fewer windows than one batch falls back to the unfiltered view so
+    the stream never starves deterministically).
+    """
+
+    def __init__(self, flow_datastore, dataset, batch_size, seq_len, *,
+                 seed=0, fresh_generations=None, generation=0,
+                 drop_last=True, host_index=None, n_hosts=None,
+                 verify=True, max_workers=None):
+        self._fds = flow_datastore
+        self._dataset = dataset
+        self._batch_size = int(batch_size)
+        self._seq_len = int(seq_len)
+        self._window = self._seq_len + 1
+        self._seed = seed
+        self._drop_last = bool(drop_last)
+        self._host_index = host_index
+        self._n_hosts = n_hosts
+        self._verify = verify
+        self._max_workers = max_workers
+        self._fresh = (knobs.get_int("TPUFLOW_ONLINE_FRESH_GENERATIONS")
+                       if fresh_generations is None
+                       else int(fresh_generations))
+        self.generation = int(generation)
+        self._epoch = 0
+        self._pending = None  # (inner_state, prefix, min_gen) to restore
+
+    # ---------- view construction (pure given manifest + watermark) ----
+
+    def _min_generation(self):
+        if self._fresh <= 0:
+            return -1  # no filter
+        return max(0, int(self.generation) - self._fresh)
+
+    def _build_view(self, manifest, prefix, min_gen):
+        shards = manifest["shards"][:prefix]
+        kept = shards
+        if min_gen >= 0:
+            fresh = [s for s in shards if shard_generation(s) >= min_gen]
+            windows = sum(s["tokens"] // self._window for s in fresh)
+            need = self._batch_size if self._drop_last else 1
+            # deterministic fallback: a freshness window that cannot
+            # fill one batch reads the whole prefix instead of starving
+            if windows >= need:
+                kept = fresh
+        view = dict(manifest)
+        view["shards"] = kept
+        view["n_shards"] = len(kept)
+        view["total_tokens"] = int(sum(s["tokens"] for s in kept))
+        return view
+
+    # ---------- resume contract ----------
+
+    def restore(self, stamp):
+        """Position the stream just after the batch that carried
+        `stamp` (a watermark-extended stamp this reader yielded)."""
+        stamp = dict(stamp)
+        try:
+            prefix = int(stamp.pop("replay_prefix"))
+            min_gen = int(stamp.pop("replay_min_gen"))
+        except KeyError:
+            raise ValueError(
+                "not a replay stamp: missing %s keys (was this stamp "
+                "produced by a plain StreamingTokenBatches?)"
+                % (WATERMARK_KEYS,))
+        stamp.pop("replay_revision", None)
+        self._epoch = int(stamp["epoch"])
+        self._pending = (stamp, prefix, min_gen)
+        return self
+
+    # ---------- iteration ----------
+
+    def __iter__(self):
+        while True:
+            manifest = load_manifest(self._fds, self._dataset)
+            restoring = self._pending is not None
+            if restoring:
+                inner_state, prefix, min_gen = self._pending
+                self._pending = None
+                if len(manifest["shards"]) < prefix:
+                    raise DatasetError(
+                        "replay watermark names %d shard(s) but corpus "
+                        "%r only holds %d — shard entries are append-"
+                        "only, so this stamp belongs to a different "
+                        "corpus" % (prefix, self._dataset,
+                                    len(manifest["shards"])))
+            else:
+                inner_state = None
+                prefix = len(manifest["shards"])
+                min_gen = self._min_generation()
+            view = self._build_view(manifest, prefix, min_gen)
+            revision = manifest_revision(manifest)
+            inner = StreamingTokenBatches(
+                self._fds, view, self._batch_size, self._seq_len,
+                seed=self._seed, epochs=self._epoch + 1,
+                drop_last=self._drop_last, host_index=self._host_index,
+                n_hosts=self._n_hosts, verify=self._verify,
+                max_workers=self._max_workers)
+            if inner_state is not None:
+                inner.restore(inner_state)
+            elif self._epoch:
+                # start the fresh view directly at the current global
+                # epoch (the epoch number keys the shuffle orders)
+                state0 = inner.state()
+                state0["epoch"] = self._epoch
+                inner.restore(state0)
+            yielded = False
+            for batch in inner:
+                stamp = dict(batch[STATE_KEY])
+                stamp["replay_prefix"] = int(prefix)
+                stamp["replay_min_gen"] = int(min_gen)
+                stamp["replay_revision"] = int(revision)
+                batch[STATE_KEY] = stamp
+                yield batch
+                yielded = True
+            if not yielded and not restoring:
+                # a full epoch from its start produced nothing: the
+                # corpus cannot fill one batch and an unbounded stream
+                # would spin forever (a restored stamp at/near the epoch
+                # end legitimately drains without a yield)
+                raise DatasetError(
+                    "replay corpus %r holds too few windows for one "
+                    "batch of %d in epoch %d (view: %d shard(s), "
+                    "min_gen=%d) — grow the corpus or shrink batch_size"
+                    % (self._dataset, self._batch_size, self._epoch,
+                       view["n_shards"], min_gen))
+            self._epoch += 1

@@ -470,6 +470,10 @@ _SHARD = _obj(
         "tokens": _INT,
         "bytes": _INT,
         "sha256": {"type": "string", "pattern": "^[0-9a-f]{64}$"},
+        # weight generation that produced this shard's tokens (replay
+        # appends only; absent == generation 0) — the freshness key the
+        # online ReplayReader's max-staleness window filters on
+        "generation": _INT,
     },
     required=("key", "tokens", "bytes", "sha256"),
 )
@@ -485,6 +489,11 @@ DATASET_MANIFEST_SCHEMA = _obj(
         "shard_tokens": _INT,
         "n_shards": _INT,
         "shards": _arr(_SHARD),
+        # append revision: bumped by every append_corpus publish (absent
+        # == 0, a manifest from before appends existed). Still v1 —
+        # shard entries are append-only and old blobs immutable, so a
+        # reader holding an older manifest copy keeps its exact stream.
+        "revision": _INT,
     },
     required=("v", "name", "dtype", "total_tokens", "shard_tokens",
               "n_shards", "shards"),
@@ -967,6 +976,85 @@ def validate_fleet_record(record):
 
 
 # ---------------------------------------------------------------------------
+# Online loop subsystem (metaflow_tpu/online/): the actor/replay/learner
+# supervisor's pinned telemetry surface. Generation arithmetic
+# (rollout.scored generation stamps, the weights.pushed bump, the
+# staleness guard's lag) is the loop's correctness story — dashboards and
+# tests key on these payloads, so they must not drift.
+# ---------------------------------------------------------------------------
+
+ONLINE_EVENT_DATA_SCHEMAS = {
+    # one per completed+scored rollout, stamped with the weight
+    # generation the actor served it under
+    "online.rollout.scored": _obj(
+        {"request_id": _STR, "generation": _INT, "prompt_tokens": _INT,
+         "new_tokens": _INT, "reward": _NUM},
+        required=("request_id", "generation", "prompt_tokens",
+                  "new_tokens", "reward"),
+    ),
+    # off-policy guard verdict: the rollout was older than
+    # TPUFLOW_ONLINE_MAX_LAG generations and was dropped
+    "online.rollout.stale": _obj(
+        {"request_id": _STR, "generation": _INT,
+         "learner_generation": _INT, "lag": _INT},
+        required=("request_id", "generation", "learner_generation",
+                  "lag"),
+    ),
+    # one per ReplayWriter publish; `skipped` marks an idempotent no-op
+    # (the revision this round would create already exists — the append
+    # landed before a mid-round kill)
+    "online.replay.append": _obj(
+        {"dataset": _STR, "shards": _INT, "tokens": _INT,
+         "revision": _INT, "generation": _INT, "skipped": _BOOL},
+        required=("dataset", "shards", "tokens", "revision",
+                  "generation"),
+    ),
+    # learner weights landed on the actor: engine param swap or fleet
+    # rolling_reload (the PR 13 zero-shed path); shed_requests must stay
+    # 0 for the rolling path
+    "online.weights.pushed": _obj(
+        {"step": _INT, "generation": _INT, "shed_requests": _INT,
+         "ms": _NUM, "mechanism": {"enum": ["swap", "rolling_reload"]}},
+        required=("step", "generation", "shed_requests", "ms"),
+    ),
+}
+
+ONLINE_METRIC_NAMES = {
+    # learner_generation - min(rollout generation) per round
+    "online.lag": "gauge",
+    # wall time of one remote-fleet rollout batch: the actor
+    # chip-seconds lane (local-engine batches already account their
+    # chip time via serve.prefill_chunk/serve.decode_step)
+    "online.rollout": "timer",
+}
+
+
+def validate_online_record(record):
+    """Validate one online.* flight-recorder record: base v1 record
+    shape, a pinned name, and the pinned data payload."""
+    validate_telemetry_record(record)
+    name = record.get("name", "")
+    if name in ONLINE_EVENT_DATA_SCHEMAS:
+        if record.get("type") != "event":
+            raise jsonschema.ValidationError(
+                "%s must be an event record, got %r"
+                % (name, record.get("type")))
+        jsonschema.validate(record.get("data", {}),
+                            ONLINE_EVENT_DATA_SCHEMAS[name],
+                            cls=jsonschema.Draft202012Validator)
+    elif name in ONLINE_METRIC_NAMES:
+        if record.get("type") != ONLINE_METRIC_NAMES[name]:
+            raise jsonschema.ValidationError(
+                "%s must be a %s record, got %r"
+                % (name, ONLINE_METRIC_NAMES[name], record.get("type")))
+    else:
+        raise jsonschema.ValidationError(
+            "unknown online record name %r (pinned: %s)"
+            % (name, sorted(ONLINE_EVENT_DATA_SCHEMAS)
+               + sorted(ONLINE_METRIC_NAMES)))
+
+
+# ---------------------------------------------------------------------------
 # core task/scheduler lifecycle records (task.py, runtime.py, and the
 # runtime-adjacent emitters). The contracts analyzer (metaflow_tpu/
 # analysis/contracts.py) cross-checks every literal telemetry emit in the
@@ -1412,7 +1500,7 @@ def validate_manifest(manifest):
 GOODPUT_CATEGORIES = (
     "productive_step", "compile", "input_stall", "transfer_stall",
     "update", "checkpoint_blocked", "restore_replay", "capacity_wait",
-    "serve_prefill", "serve_decode", "serve_idle",
+    "serve_prefill", "serve_decode", "serve_idle", "actor_rollout",
 )
 
 GOODPUT_ALL_BUCKETS = GOODPUT_CATEGORIES + ("unattributed",)
@@ -1442,7 +1530,7 @@ _LEDGER_LANE = _obj(
         "task_id": _STR,
         "attempt": _INT,
         "rank": _INT,
-        "kind": {"enum": ["train", "serve", "mixed"]},
+        "kind": {"enum": ["train", "serve", "actor", "mixed"]},
         "span_s": _NUM,
         "observed_s": _NUM,
         "unattributed_s": _NUM,
@@ -1478,7 +1566,8 @@ GOODPUT_LEDGER_SCHEMA = _obj(
                       {"enum": [c for c in GOODPUT_ALL_BUCKETS
                                 if c not in ("productive_step", "update",
                                              "serve_prefill",
-                                             "serve_decode")]}],
+                                             "serve_decode",
+                                             "actor_rollout")]}],
         },
         "dominant_loss_s": _NUM,
         "parked": _arr(_LEDGER_PARKED),

@@ -1,0 +1,542 @@
+"""Online actor-learner loop (metaflow_tpu/online/): replay writer
+packing + append-versioned manifests, replay reader growth/freshness/
+exact-resume, actor generation stamping (a weight push changes what the
+next batch decodes), the end-to-end generate->score->pack->train->
+re-serve loop with its pinned telemetry, mid-loop kill/resume with an
+exact loss trajectory and a byte-identical replay corpus, and replica
+failover mid-rollout with zero duplicated or lost rollouts."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+import jsonschema  # noqa: E402
+from schema_validate import (  # noqa: E402
+    validate_dataset_manifest,
+    validate_online_record,
+)
+
+from metaflow_tpu import telemetry  # noqa: E402
+from metaflow_tpu.data import StreamingTokenBatches  # noqa: E402
+from metaflow_tpu.data.ordering import STATE_KEY  # noqa: E402
+from metaflow_tpu.data.shards import (  # noqa: E402
+    load_manifest,
+    manifest_revision,
+    shard_generation,
+)
+from metaflow_tpu.datastore import FlowDataStore  # noqa: E402
+from metaflow_tpu.datastore.storage import LocalStorage  # noqa: E402
+from metaflow_tpu.online import (  # noqa: E402
+    ActorPool,
+    LogProbScorer,
+    OnlineError,
+    OnlineLoop,
+    PromptSampler,
+    ReplayReader,
+    ReplayWriter,
+    Rollout,
+    diversity_reward,
+    length_reward,
+)
+
+SEQ = 15          # window = 16 tokens
+PROMPT_LEN = 8
+MAX_NEW = 4       # one rollout = 12 tokens -> 3 windows per 4 rollouts
+
+
+@pytest.fixture()
+def fds(tmp_path):
+    return FlowDataStore("OnlineFlow", LocalStorage,
+                         ds_root=str(tmp_path / "ds"), blob_cache=False)
+
+
+@pytest.fixture(scope="module")
+def actor_stack():
+    """ONE tiny engine + scheduler for every actor test: SlotEngine
+    compiles three jitted programs, and each rebuild would recompile."""
+    import jax
+
+    from metaflow_tpu.models import llama
+    from metaflow_tpu.serving import Scheduler, SlotEngine
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=64, dim=32, n_layers=1,
+                                 n_heads=2, n_kv_heads=2, ffn_dim=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    params2 = llama.init_params(jax.random.PRNGKey(9), cfg)
+    engine = SlotEngine(params, cfg, max_slots=4, max_seq_len=32,
+                        prefill_chunk=16)
+    return cfg, params, params2, engine, Scheduler(engine)
+
+
+def _docs(n, value, length=PROMPT_LEN + MAX_NEW):
+    return [[int(value)] * length for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# replay writer: packing, append versioning, idempotent publish
+# ---------------------------------------------------------------------------
+
+
+class TestReplayWriter:
+    def test_publish_packs_validates_and_stamps(self, fds):
+        writer = ReplayWriter(fds, "replay", SEQ, windows_per_shard=2)
+        for doc in _docs(4, 5):
+            writer.add(doc)
+        manifest, appended = writer.publish(0)
+        validate_dataset_manifest(manifest)
+        assert manifest_revision(manifest) == 1
+        assert appended % (SEQ + 1) == 0, \
+            "publish must append whole windows"
+        assert all(shard_generation(s) == 0
+                   for s in manifest["shards"])
+        assert writer.pending == 0
+
+    def test_append_bumps_revision_and_keeps_prefix(self, fds):
+        writer = ReplayWriter(fds, "replay", SEQ, windows_per_shard=2)
+        for doc in _docs(4, 5):
+            writer.add(doc)
+        first, _ = writer.publish(0)
+        for doc in _docs(4, 9):
+            writer.add(doc)
+        second, _ = writer.publish(1)
+        validate_dataset_manifest(second)
+        assert manifest_revision(second) == 2
+        # append-only: the old reader's shard prefix is byte-identical,
+        # so a stream started before the append keeps its token order
+        assert second["shards"][:len(first["shards"])] == \
+            first["shards"]
+        assert {shard_generation(s) for s in second["shards"]} == {0, 1}
+
+    def test_publish_idempotent_across_resume(self, fds):
+        writer = ReplayWriter(fds, "replay", SEQ, windows_per_shard=2)
+        for doc in _docs(4, 5):
+            writer.add(doc)
+        manifest, appended = writer.publish(0, target_revision=1)
+        assert appended > 0
+        # a resumed round re-generates the same rollouts and re-publishes
+        # the same target revision: the corpus must not grow
+        for doc in _docs(4, 5):
+            writer.add(doc)
+        again, appended2 = writer.publish(0, target_revision=1)
+        assert appended2 == 0
+        assert writer.pending == 0, "skipped publish must drop buffer"
+        assert again["shards"] == manifest["shards"]
+        assert manifest_revision(again) == 1
+
+
+# ---------------------------------------------------------------------------
+# replay reader: growth, freshness window, exact resume
+# ---------------------------------------------------------------------------
+
+
+def _publish(fds, docs, generation, target=None):
+    writer = ReplayWriter(fds, "replay", SEQ, windows_per_shard=2)
+    for doc in docs:
+        writer.add(doc)
+    return writer.publish(generation, target_revision=target)
+
+
+class TestReplayReader:
+    def test_sees_growth_at_epoch_boundary(self, fds):
+        _publish(fds, _docs(4, 5), 0)
+        reader = ReplayReader(fds, "replay", 1, SEQ, seed=0,
+                              fresh_generations=0)
+        it = iter(reader)
+        first_epoch = [next(it) for _ in range(3)]  # 3 windows
+        _publish(fds, _docs(4, 9), 1)
+        # the next epoch reloads the manifest and reads the new shards
+        seen = set()
+        for _ in range(6):
+            seen.update(np.unique(next(it)["tokens"]).tolist())
+        assert 9 in seen
+        assert all(5 in np.unique(b["tokens"]) for b in first_epoch)
+
+    def test_freshness_window_filters_stale_generations(self, fds):
+        _publish(fds, _docs(4, 5), 0)
+        _publish(fds, _docs(4, 9), 2)
+        fresh = ReplayReader(fds, "replay", 1, SEQ, seed=0,
+                             fresh_generations=1, generation=2)
+        it = iter(fresh)
+        toks = set()
+        for _ in range(3):
+            toks.update(np.unique(next(it)["tokens"]).tolist())
+        assert 9 in toks and 5 not in toks, toks
+        # no filter: both generations stream
+        stale_ok = ReplayReader(fds, "replay", 1, SEQ, seed=0,
+                                fresh_generations=0, generation=2)
+        toks = set()
+        it = iter(stale_ok)
+        for _ in range(6):
+            toks.update(np.unique(next(it)["tokens"]).tolist())
+        assert {5, 9} <= toks
+
+    def test_exact_resume_mid_stream(self, fds):
+        rng = np.random.default_rng(3)
+        docs = [rng.integers(1, 50, PROMPT_LEN + MAX_NEW).tolist()
+                for _ in range(8)]
+        _publish(fds, docs, 0)
+        control = iter(ReplayReader(fds, "replay", 2, SEQ, seed=7))
+        batches = [next(control) for _ in range(7)]
+        stamp = batches[2][STATE_KEY]
+        assert "replay_prefix" in stamp and "replay_revision" in stamp
+        resumed = ReplayReader(fds, "replay", 2, SEQ, seed=7)
+        resumed.restore(stamp)
+        it = iter(resumed)
+        for want in batches[3:]:
+            got = next(it)
+            np.testing.assert_array_equal(got["tokens"], want["tokens"])
+            assert got[STATE_KEY] == want[STATE_KEY]
+
+
+# ---------------------------------------------------------------------------
+# actor pool: determinism, generation stamping, rewards
+# ---------------------------------------------------------------------------
+
+
+class TestActorPool:
+    def test_backend_validation(self, actor_stack):
+        _cfg, _p, _p2, _eng, sched = actor_stack
+        with pytest.raises(OnlineError):
+            ActorPool()
+        with pytest.raises(OnlineError):
+            ActorPool(scheduler=sched, fleet_addr=("127.0.0.1", 1))
+
+    def test_greedy_rollouts_deterministic_and_stamped(self,
+                                                       actor_stack):
+        cfg, params, _p2, engine, sched = actor_stack
+        engine.params = params
+        actor = ActorPool(scheduler=sched, max_new_tokens=MAX_NEW)
+        prompts = PromptSampler(cfg.vocab_size, PROMPT_LEN,
+                                seed=0).batch(0, 4)
+        a = actor.rollout_batch(prompts, round_index=0)
+        b = actor.rollout_batch(prompts, round_index=0)
+        assert [r.completion for r in a] == [r.completion for r in b]
+        assert all(r.generation == 0 for r in a)
+        assert [r.request_id for r in a] == \
+            ["round0-%d" % i for i in range(4)]
+        assert all(len(r.completion) == MAX_NEW for r in a)
+        assert all(r.reward == float(MAX_NEW) for r in a)
+
+    def test_weight_push_changes_next_batch(self, actor_stack):
+        """The acceptance proof at unit scale: after update_weights the
+        SAME prompts decode under the NEW generation to DIFFERENT
+        tokens — the push actually re-serves the learner's weights."""
+        cfg, params, params2, engine, sched = actor_stack
+        engine.params = params
+        actor = ActorPool(scheduler=sched, max_new_tokens=MAX_NEW)
+        prompts = PromptSampler(cfg.vocab_size, PROMPT_LEN,
+                                seed=1).batch(0, 4)
+        before = actor.rollout_batch(prompts, round_index=0)
+        assert actor.update_weights(params2, generation=1) == 1
+        after = actor.rollout_batch(prompts, round_index=1)
+        assert all(r.generation == 1 for r in after)
+        assert [r.completion for r in before] != \
+            [r.completion for r in after], \
+            "new weights decoded identically to the old ones"
+
+    def test_rewards(self, actor_stack):
+        cfg, params, _p2, _eng, _sched = actor_stack
+        assert length_reward([1, 2], [3, 4, 5]) == 3.0
+        assert diversity_reward([1], [7, 7, 7, 7]) == 0.25
+        assert diversity_reward([1], []) == 0.0
+        score = LogProbScorer(params, cfg)([1, 2, 3], [4, 5])
+        assert np.isfinite(score) and score <= 0.0
+
+    def test_prompt_sampler_pure(self):
+        s = PromptSampler(64, PROMPT_LEN, seed=3)
+        assert s.batch(2, 4) == s.batch(2, 4)
+        assert s.batch(2, 4) != s.batch(3, 4)
+        assert all(0 < t < 64 for row in s.batch(0, 4) for t in row)
+
+    def test_guard_drops_stale_keeps_fresh(self):
+        loop = OnlineLoop.__new__(OnlineLoop)
+        loop.max_lag = 2
+        rollouts = [Rollout("a", [1], [2], 0, 1.0),
+                    Rollout("b", [1], [2], 4, 1.0)]
+        kept, dropped = loop._guard(rollouts, 5)
+        assert [r.request_id for r in kept] == ["b"]
+        assert dropped == 1
+
+
+# ---------------------------------------------------------------------------
+# the closed loop, in process: generate -> score -> pack -> train ->
+# re-serve, with the pinned online.* telemetry surface
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineLoopE2E:
+    def test_loop_end_to_end(self, fds, tmp_path, monkeypatch):
+        import jax
+
+        from metaflow_tpu.models import llama
+        from metaflow_tpu.serving import Scheduler, SlotEngine
+        from metaflow_tpu.spmd import MeshSpec, create_mesh
+        from metaflow_tpu.training import (
+            default_optimizer,
+            make_trainer,
+            shard_batch,
+        )
+
+        monkeypatch.setenv("TPUFLOW_TELEMETRY", "1")
+        # conftest forces 8 host devices: the learner batch must be
+        # divisible by 8; seq_len 11 makes each 12-token rollout exactly
+        # one packed window, so 8 rollouts fill one 8-window batch
+        seq_len, batch, rollouts = 11, 8, 8
+        cfg = llama.LlamaConfig.tiny(vocab_size=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=2,
+                                     ffn_dim=64)
+        mesh = create_mesh(MeshSpec.dp())
+        state, step_fn, _sh = make_trainer(
+            jax.random.PRNGKey(0), cfg, mesh, llama,
+            optimizer=default_optimizer(lr=1e-2, warmup_steps=1,
+                                        total_steps=100))
+
+        def snapshot(st):
+            return jax.tree_util.tree_map(
+                np.asarray, jax.device_get(st["params"]))
+
+        engine = SlotEngine(snapshot(state), cfg, max_slots=4,
+                            max_seq_len=32, prefill_chunk=16)
+        actor = ActorPool(scheduler=Scheduler(engine),
+                          max_new_tokens=MAX_NEW)
+        writer = ReplayWriter(fds, "replay", seq_len,
+                              windows_per_shard=batch)
+        reader = ReplayReader(fds, "replay", batch, seq_len, seed=0)
+        sampler = PromptSampler(cfg.vocab_size, PROMPT_LEN, seed=0)
+
+        def learner_step(st, tokens):
+            batch = shard_batch({"tokens": tokens}, mesh)
+            with mesh:
+                st, metrics = step_fn(st, batch)
+            return st, float(metrics["loss"])
+
+        telemetry.init_recorder(fds, "run1", "_online", "loop-0")
+        try:
+            loop = OnlineLoop(actor, writer, reader, sampler,
+                              learner_step, state, snapshot, rounds=2,
+                              rollouts=rollouts, steps_per_round=2,
+                              push_every=1, max_lag=2)
+            summary = loop.run()
+        finally:
+            telemetry.close_recorder()
+
+        assert summary["generation"] == 2
+        assert summary["steps"] == 4
+        assert len(summary["losses"]) == 4
+        assert summary["kept_rollouts"] == 16
+        assert summary["dropped_stale"] == 0
+        assert summary["shed_requests"] == 0
+        manifest = load_manifest(fds, "replay")
+        validate_dataset_manifest(manifest)
+        assert manifest_revision(manifest) == 2
+
+        online = [r for r in telemetry.read_run_records(fds, "run1")
+                  if r["name"].startswith("online.")]
+        for rec in online:
+            validate_online_record(rec)
+        by_name = {}
+        for rec in online:
+            by_name.setdefault(rec["name"], []).append(rec)
+        scored = by_name["online.rollout.scored"]
+        assert len(scored) == 16
+        # the re-serve proof end to end: round 2's rollouts decoded
+        # under the generation round 1's push installed
+        assert {r["data"]["generation"] for r in scored} == {0, 1}
+        pushed = by_name["online.weights.pushed"]
+        assert [r["data"]["generation"] for r in pushed] == [1, 2]
+        assert all(r["data"]["shed_requests"] == 0 for r in pushed)
+        assert all(r["data"]["mechanism"] == "swap" for r in pushed)
+        assert by_name["online.lag"], "lag gauge missing"
+
+    def test_validate_online_record_rejects_unknown(self):
+        with pytest.raises(jsonschema.ValidationError):
+            validate_online_record({
+                "v": 1, "run_id": "r", "step_name": "s", "task_id": "t",
+                "ts": 1.0, "type": "event", "name": "online.bogus",
+                "data": {}})
+
+
+# ---------------------------------------------------------------------------
+# mid-loop kills: learner SIGKILL/resume, actor replica failover
+# ---------------------------------------------------------------------------
+
+
+def _online_cmd(root, extra=()):
+    # batch 8 (the forced 8-device host mesh), seq_len 11 so each
+    # 12-token rollout packs to exactly one window: 8 rollouts/round
+    # fill one learner batch per epoch view
+    return [sys.executable, "-m", "metaflow_tpu", "online",
+            "OnlineKillFlow", "--rounds", "3", "--rollouts", "8",
+            "--steps-per-round", "2", "--batch-size", "8",
+            "--seq-len", "11", "--prompt-len", str(PROMPT_LEN),
+            "--max-new-tokens", str(MAX_NEW), "--vocab-size", "64",
+            "--dim", "32", "--n-layers", "1", "--n-heads", "2",
+            "--seed", "0", "--datastore", "local",
+            "--datastore-root", root] + list(extra)
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(HERE)] +
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+    env.update(extra or {})
+    return env
+
+
+class TestOnlineKillResume:
+    def test_learner_kill_resumes_exact(self, tmp_path):
+        """Chaos-kill the learner at global step 2 (mid round 2), then
+        re-run the SAME command: the resumed run must replay the exact
+        loss trajectory of an uninterrupted control run and converge on
+        a byte-identical replay corpus — no rollout duplicated (the
+        idempotent publish dedups the re-generated round) and none lost
+        (the CAS shard keys match the control's exactly)."""
+        control_root = str(tmp_path / "control")
+        out = str(tmp_path / "control.json")
+        proc = subprocess.run(
+            _online_cmd(control_root, ["--json-out", out]),
+            env=_env(), capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stderr
+        control = json.load(open(out))
+        assert control["steps"] == 6 and control["start_round"] == 0
+
+        chaos_root = str(tmp_path / "chaos")
+        chaos_env = {"TPUFLOW_CHAOS": "2:0",
+                     "TPUFLOW_CHAOS_DIR": str(tmp_path / "ledger")}
+        proc = subprocess.run(
+            _online_cmd(chaos_root), env=_env(chaos_env),
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode != 0, \
+            "chaos kill did not fire: %s" % proc.stdout
+
+        out = str(tmp_path / "resumed.json")
+        proc = subprocess.run(
+            _online_cmd(chaos_root, ["--json-out", out]),
+            env=_env(chaos_env), capture_output=True, text=True,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr
+        resumed = json.load(open(out))
+        assert resumed["start_round"] > 0, \
+            "run restarted from scratch instead of resuming"
+        # exact loss trajectory: the resumed run's losses are the
+        # control's suffix, bit-for-bit
+        k = len(resumed["losses"])
+        assert 0 < k < len(control["losses"])
+        assert resumed["losses"] == control["losses"][-k:]
+        assert resumed["generation"] == control["generation"]
+
+        fds_c = FlowDataStore("OnlineKillFlow", LocalStorage,
+                              ds_root=control_root, blob_cache=False)
+        fds_k = FlowDataStore("OnlineKillFlow", LocalStorage,
+                              ds_root=chaos_root, blob_cache=False)
+        m_c = load_manifest(fds_c, "replay")
+        m_k = load_manifest(fds_k, "replay")
+        # zero duplicated, zero lost: identical CAS shard keys means a
+        # byte-identical corpus in identical order
+        assert [s["sha256"] for s in m_k["shards"]] == \
+            [s["sha256"] for s in m_c["shards"]]
+        assert manifest_revision(m_k) == manifest_revision(m_c)
+
+    def test_actor_replica_kill_failover(self, actor_stack, tmp_path,
+                                         monkeypatch):
+        """SIGKILL an actor replica mid-rollout through the fleet chaos
+        injector (TPUFLOW_CHAOS_FLEET): the router's failover must
+        redispatch the victim's in-flight rollouts so the batch
+        completes with every rollout present exactly once and
+        token-identical to an undisturbed batch."""
+        from metaflow_tpu.devtools import chaos
+        from metaflow_tpu.elastic.policy import BackoffPolicy
+        from metaflow_tpu.serving import (
+            FleetConfig,
+            Scheduler,
+            ServingFleet,
+            ServingServer,
+            SlotEngine,
+        )
+
+        cfg, params, _p2, _eng, _sched = actor_stack
+
+        class _Proc(object):
+            def __init__(self, server):
+                self.server, self.pid = server, os.getpid()
+                self._rc = None
+
+            def poll(self):
+                return self._rc
+
+            def kill(self):
+                if self._rc is None:
+                    self._rc = -9
+                    self.server.close()
+
+            terminate = kill
+
+            def wait(self, timeout=None):
+                return self._rc
+
+        build_lock = threading.Lock()
+
+        def spawner(index, generation):
+            with build_lock:
+                eng = SlotEngine(params, cfg, max_slots=4,
+                                 max_seq_len=32, prefill_chunk=16)
+                srv = ServingServer(Scheduler(eng), port=0).start()
+            return _Proc(srv), "127.0.0.1", srv.port
+
+        def make_fleet(injector):
+            config = FleetConfig(
+                failover=True, restart=False, health_interval_s=0.2,
+                wait_s=5.0, redispatch_max=3, spawn_timeout_s=120.0,
+                backoff=BackoffPolicy(base_s=0.05, cap_s=0.1,
+                                      jitter=0.0, seed=0))
+            fleet = ServingFleet(spawner, 2, config=config,
+                                 chaos=injector)
+            fleet.start()
+            return fleet
+
+        prompts = PromptSampler(cfg.vocab_size, PROMPT_LEN,
+                                seed=2).batch(0, 6)
+
+        fleet = make_fleet(None)
+        try:
+            actor = ActorPool(fleet=fleet, max_new_tokens=MAX_NEW,
+                              request_timeout_s=120.0)
+            control = actor.rollout_batch(prompts, round_index=0)
+        finally:
+            fleet.close()
+
+        monkeypatch.setenv(chaos.FLEET_ENV, "3:1")
+        monkeypatch.setenv(chaos.DIR_ENV, str(tmp_path / "fleet-ledger"))
+        injector = chaos.fleet_from_env(2)
+        assert injector is not None
+        fleet = make_fleet(injector)
+        try:
+            actor = ActorPool(fleet=fleet, max_new_tokens=MAX_NEW,
+                              request_timeout_s=120.0)
+            survived = actor.rollout_batch(prompts, round_index=0)
+        finally:
+            fleet.close()
+
+        assert len(survived) == len(prompts), "rollout lost in failover"
+        assert [r.request_id for r in survived] == \
+            [r.request_id for r in control], "rollout duplicated/reordered"
+        assert [r.completion for r in survived] == \
+            [r.completion for r in control], \
+            "failover re-decode diverged from the undisturbed batch"
+        ledger = os.listdir(str(tmp_path / "fleet-ledger"))
+        assert any(f.startswith("fleetkill-") for f in ledger), \
+            "chaos kill never fired"
