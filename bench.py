@@ -2020,6 +2020,188 @@ def bench_fleet_goodput():
     }
 
 
+def bench_route():
+    """BENCH_MODE=route: cache-aware multi-tenant routing, CPU by
+    design (same subprocess-replica shape as the fleet bench — the
+    metric is a ROUTER POLICY comparison, no chip involved).
+
+    A multi-tenant trace — 6 tenants, each with its own disjoint
+    96-token system prompt, arriving as one concurrent burst per
+    tenant — is pushed through the SAME 3-replica prefix-cached fleet
+    twice per rep: cache-aware dispatch ON (TPUFLOW_CACHE_ROUTE=1, the
+    default) vs pure least-loaded (=0). A concurrent burst is exactly
+    where least-loaded is pessimal: the in-flight counter spreads the
+    burst across every replica, so each replica pays the tenant's cold
+    prefill, while cache-aware dispatch sends the whole burst to the
+    replica whose radix tree already holds the prefix. The metric is
+    the ratio of aggregate prefill FLOPs skipped (sum of
+    replica-reported prefix-cache hit tokens — prefill cost is linear
+    in tokens at fixed model size), gated >= 1.5x, with responses
+    token-identical across the two policies (routing changes WHERE
+    prefill runs, never what it computes). Reps interleave ON/OFF so
+    both sides see the same slice of host drift."""
+    import contextlib
+    import http.client
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from metaflow_tpu.elastic.policy import BackoffPolicy
+    from metaflow_tpu.serving import (FleetConfig, ServingFleet,
+                                      SubprocessReplicaSpawner)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    synth = {"vocab_size": 256, "dim": 64, "n_layers": 1, "n_heads": 4,
+             "n_kv_heads": 2, "ffn_dim": 128, "max_seq_len": 160,
+             "rope_llama3_scaling": False, "dtype": "float32"}
+    n_replicas = 3
+    slots = int(os.environ.get("BENCH_ROUTE_SLOTS", "2"))
+    n_tenants = int(os.environ.get("BENCH_ROUTE_TENANTS", "6"))
+    per_tenant = int(os.environ.get("BENCH_ROUTE_REQUESTS", "4"))
+    reps = int(os.environ.get("BENCH_ROUTE_REPS", "3"))
+    step_delay_ms = float(os.environ.get("BENCH_ROUTE_STEP_DELAY_MS",
+                                         "25"))
+    sys_tokens = 96   # 6 route-digest blocks at the default block=16
+    max_new = 8
+    env = _fleet_replica_env(here)
+    cache_root = tempfile.mkdtemp(prefix="bench-route-jit-")
+    env["JAX_COMPILATION_CACHE_DIR"] = cache_root
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    replica_args = [
+        "--synthetic-config", json.dumps(synth), "--synthetic-seed", "7",
+        "--slots", str(slots), "--max-seq-len", "144",
+        "--prefill-chunk", "16", "--max-queue", "256",
+        "--step-delay-ms", str(step_delay_ms),
+        "--prefix-cache-mb", "16",
+    ]
+    # disjoint per-tenant system prompts: tenant t owns token ids
+    # [2 + t*sys_tokens, 2 + (t+1)*sys_tokens) — no shared blocks, so
+    # a warm score is evidence of THIS tenant's prefix, never a
+    # coincidental cross-tenant overlap
+    prompts = [list(range(2 + t * sys_tokens,
+                          2 + (t + 1) * sys_tokens))
+               for t in range(n_tenants)]
+    # the trace: one burst of per_tenant concurrent requests per
+    # tenant, each with a distinct 4-token tail (same requests both
+    # passes — identity is compared request-by-request)
+    bursts = [[(t, prompts[t] + [200 + t, 210 + i, 220 + i, 230 + i],
+                t * per_tenant + i) for i in range(per_tenant)]
+              for t in range(n_tenants)]
+
+    def ask(port, tenant, tokens, seed):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        try:
+            conn.request(
+                "POST", "/v1/generate",
+                json.dumps({"tokens": tokens, "max_new_tokens": max_new,
+                            "seed": seed, "tenant": "tenant%d" % tenant}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 200, (resp.status, body)
+            return body["new_tokens"]
+        finally:
+            conn.close()
+
+    def replica_hit_tokens(fleet):
+        total = 0
+        for h in fleet.handles:
+            conn = http.client.HTTPConnection("127.0.0.1", h.port,
+                                              timeout=30)
+            try:
+                conn.request("GET", "/v1/stats")
+                stats = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            total += int(stats["prefix_cache"]["hit_tokens"])
+        return total
+
+    def run_pass(cache_route):
+        """Boot a fresh fleet with the routing policy under test, seed
+        each tenant's prefix once (sequential, identical in both
+        policies: an idle fleet routes every seed the same way), let
+        the health poller pick up the published digests, then push one
+        concurrent burst per tenant. Returns (skipped_tokens, outputs,
+        stats)."""
+        os.environ["TPUFLOW_CACHE_ROUTE"] = "1" if cache_route else "0"
+        try:
+            with contextlib.ExitStack() as stack:
+                tmp = stack.enter_context(
+                    tempfile.TemporaryDirectory(prefix="bench-route-"))
+                config = FleetConfig(
+                    failover=True, restart=True, spawn_timeout_s=600.0,
+                    wait_s=60.0, health_interval_s=0.5,
+                    backoff=BackoffPolicy(base_s=0.2, cap_s=0.5,
+                                          jitter=0.0, seed=0))
+                fleet = ServingFleet(
+                    SubprocessReplicaSpawner(replica_args, workdir=tmp,
+                                             env=env,
+                                             spawn_timeout_s=600.0),
+                    n_replicas, config=config)
+                fleet.start()
+                stack.callback(fleet.close)
+                for t in range(n_tenants):
+                    ask(fleet.port, t, prompts[t] + [240, 241, 242, 243],
+                        seed=1000 + t)
+                time.sleep(3 * config.health_interval_s)
+                outs = []
+                with ThreadPoolExecutor(max_workers=per_tenant) as pool:
+                    for burst in bursts:
+                        # pool.map drains the burst before the next
+                        # tenant's begins: concurrency WITHIN a tenant,
+                        # isolation between tenants
+                        outs.extend(pool.map(
+                            lambda r: ask(fleet.port, r[0], r[1], r[2]),
+                            burst))
+                return replica_hit_tokens(fleet), outs, fleet.stats()
+        finally:
+            os.environ.pop("TPUFLOW_CACHE_ROUTE", None)
+
+    on_runs, off_runs = _interleaved_reps(
+        lambda: run_pass(True), lambda: run_pass(False), reps)
+    for (_s, on_outs, _st), (_s2, off_outs, _st2) in zip(on_runs,
+                                                         off_runs):
+        assert on_outs == off_outs, \
+            "routing policy changed response tokens"
+    on_med = _median_run(on_runs, key=lambda r: r[0])
+    off_med = _median_run(off_runs, key=lambda r: r[0])
+    on_skipped, off_skipped = on_med[0], off_med[0]
+    ratio = on_skipped / max(1, off_skipped)
+    route_stats = on_med[2]["cache_route"]
+
+    return {
+        "metric": "route_prefill_skip_ratio",
+        "value": round(ratio, 2),
+        "unit": "x aggregate prefill tokens skipped, cache-aware vs "
+                "least-loaded (same multi-tenant trace)",
+        "vs_baseline": _vs_baseline(ratio),
+        "extra": {
+            "replicas": n_replicas,
+            "slots_per_replica": slots,
+            "tenants": n_tenants,
+            "requests_per_tenant": per_tenant,
+            "system_prompt_tokens": sys_tokens,
+            "max_new_tokens": max_new,
+            "step_delay_ms": step_delay_ms,
+            "reps": reps,
+            "cache_aware_skipped_tokens": on_skipped,
+            "least_loaded_skipped_tokens": off_skipped,
+            "cache_route_hits": route_stats["hits"],
+            "cache_route_misses": route_stats["misses"],
+            "token_identical": True,
+            "gate": 1.5,
+        },
+        "submetrics": [
+            {"metric": "route_cache_aware_skipped_tokens",
+             "value": on_skipped,
+             "unit": "prefill tokens served from cache (routing on)"},
+            {"metric": "route_least_loaded_skipped_tokens",
+             "value": off_skipped,
+             "unit": "prefill tokens served from cache (routing off)"},
+        ],
+    }
+
+
 def bench_telemetry_overhead():
     """Instrumented-vs-disabled train-step overhead of the flight
     recorder (training.metrics.instrument_train_step emitting per-step
@@ -2706,6 +2888,15 @@ if __name__ == "__main__":
                        os.environ.get("PYTHONPATH", "").split(os.pathsep))):
             _rerun_on_cpu(degraded=False)
         result = bench_fleet_goodput()
+    elif mode == "route":
+        # routing-policy metric: subprocess replicas on the CPU
+        # device-emulation delay by design — same shape as the fleet
+        # bench, no chip involved
+        if (os.environ.get("JAX_PLATFORMS") != "cpu"
+                or any("axon_site" in p for p in
+                       os.environ.get("PYTHONPATH", "").split(os.pathsep))):
+            _rerun_on_cpu(degraded=False)
+        result = bench_route()
     elif mode == "persist":
         # artifact persist pipeline + async checkpoint overlap: pure
         # host/IO metrics, no chip needed

@@ -535,8 +535,11 @@ def watch(flow_run, run_id, datastore, datastore_root, once, check,
          "continuous-batching engine: `serve FLOW/RUN_ID` (or `serve "
          "FLOW` for the newest successful run). Slot-based KV cache, "
          "per-request admission/eviction, streamed token output, "
-         "graceful SIGTERM drain — docs/serving.md.")
-@click.argument("flow_run")
+         "graceful SIGTERM drain — docs/serving.md. With --federate "
+         "URL,URL no checkpoint is loaded: a thin front router spreads "
+         "tenants across the listed running fleets behind one API "
+         "(docs/serving.md#federation).")
+@click.argument("flow_run", required=False)
 @click.argument("run_id", required=False)
 @click.option("--step-name", default=None,
               help="The @checkpoint step (auto-detected when unique).")
@@ -603,14 +606,22 @@ def watch(flow_run, run_id, datastore, datastore_root, once, check,
                    "onto the RUNNING fleet at --host/--port via a "
                    "zero-shed rolling upgrade "
                    "(docs/serving.md#rollouts).")
+@click.option("--federate", default=None, metavar="URLS",
+              help="Don't load a checkpoint: run the federation front "
+                   "tier over these comma-separated RUNNING fleet "
+                   "URLs, spreading tenants across them behind one "
+                   "API (docs/serving.md#federation).")
 def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
           model, host, port, replicas, slots, max_seq_len, prefill_chunk,
           max_queue, mesh_spec, attn_impl, prefill_workers,
           prefix_cache_mb, paged, page_tokens, spec_k,
-          reload_checkpoint):
+          reload_checkpoint, federate):
     from .cmd.serve import serve as serve_impl
     from .exception import TpuFlowException
 
+    if not flow_run and not federate:
+        raise click.ClickException(
+            "FLOW_RUN is required (or pass --federate URL,URL)")
     try:
         serve_impl(flow_run, run_id=run_id, step_name=step_name,
                    ckpt_step=ckpt_step, params_key=params_key,
@@ -623,7 +634,7 @@ def serve(flow_run, run_id, step_name, ckpt_step, params_key, config_json,
                    prefix_cache_mb=prefix_cache_mb,
                    paged=paged, page_tokens=page_tokens, spec_k=spec_k,
                    reload_checkpoint=reload_checkpoint,
-                   echo=click.echo)
+                   federate=federate, echo=click.echo)
     except TpuFlowException as ex:
         raise click.ClickException(str(ex))
 

@@ -63,12 +63,23 @@ def aggregate(records, profiles=None):
           "pages_freed": 0, "page_shares": 0, "pages_shared": 0,
           "shared_tokens": 0, "exhausted": 0}
     kv_gauges = {}  # last-seen occupancy / cow_pages / spec accept rate
+    # serve.tenant.* admission events (serving/scheduler.py) plus
+    # tenant-tagged request lifecycle events, keyed by tenant id
+    tenant_stats = {}
+    # fleet.cache_route.* cache-aware dispatch events (serving/fleet.py)
+    cache_route = {"hits": 0, "misses": 0, "matched_tokens": 0,
+                   "prompt_tokens": 0}
     # MPMD per-stage pipeline gangs (spmd/mpmd.py + mpmd_trainer.py):
     # each rank runs ONE stage, so per-stage series key on the stage id
     # in the timer name, never averaged across ranks
     mpmd_stages = {}
     mpmd_transfer = {}
     mpmd_plan = {}
+
+    def _tenant(tid):
+        return tenant_stats.setdefault(str(tid), {
+            "admitted": 0, "throttled": 0, "throttles": {}, "shed": 0,
+            "prompt_tokens": 0, "generated_tokens": 0, "_ttft": []})
 
     for rec in records:
         name = rec.get("name", "")
@@ -190,6 +201,45 @@ def aggregate(records, profiles=None):
                     kv["shared_tokens"] += int(data.get("tokens", 0))
                 elif name == "serve.kv.exhausted":
                     kv["exhausted"] += 1
+            if name.startswith("serve.tenant."):
+                data = rec.get("data") or {}
+                t = _tenant(data.get("tenant") or "default")
+                if name == "serve.tenant.admitted":
+                    t["admitted"] += 1
+                    t["prompt_tokens"] += int(
+                        data.get("prompt_tokens", 0))
+                elif name == "serve.tenant.throttled":
+                    t["throttled"] += 1
+                    reason = str(data.get("reason", "unknown"))
+                    t["throttles"][reason] = \
+                        t["throttles"].get(reason, 0) + 1
+                elif name == "serve.tenant.shed":
+                    t["shed"] += 1
+            if name in ("serve.request.first_token",
+                        "serve.request.finished"):
+                # tenant-tagged request lifecycle: per-tenant TTFT
+                # distribution + generated-token attribution
+                data = rec.get("data") or {}
+                if data.get("tenant"):
+                    t = _tenant(data["tenant"])
+                    if (name == "serve.request.first_token"
+                            and "ttft_ms" in data):
+                        t["_ttft"].append(float(data["ttft_ms"]))
+                    elif name == "serve.request.finished":
+                        t["generated_tokens"] += int(
+                            data.get("new_tokens", 0))
+            if name.startswith("fleet.cache_route."):
+                data = rec.get("data") or {}
+                if name == "fleet.cache_route.hit":
+                    cache_route["hits"] += 1
+                    cache_route["matched_tokens"] += int(
+                        data.get("matched_tokens", 0))
+                    cache_route["prompt_tokens"] += int(
+                        data.get("prompt_tokens", 0))
+                elif name == "fleet.cache_route.miss":
+                    cache_route["misses"] += 1
+                    cache_route["prompt_tokens"] += int(
+                        data.get("prompt_tokens", 0))
             if name == "mpmd.transfer":
                 data = rec.get("data") or {}
                 t = mpmd_transfer.setdefault(
@@ -241,6 +291,10 @@ def aggregate(records, profiles=None):
                 elif name == "fleet.request.shed":
                     reason = str(data.get("reason", "unknown"))
                     fleet_shed[reason] = fleet_shed.get(reason, 0) + 1
+                    if data.get("tenant"):
+                        # router-level denial charged to the tenant it
+                        # was scoped to (budget / priority headroom)
+                        _tenant(data["tenant"])["shed"] += 1
                 elif name == "fleet.replica.restart":
                     fleet_restarts.append({
                         "ts": rec.get("ts"),
@@ -463,6 +517,30 @@ def aggregate(records, profiles=None):
         kv_pages["pages_outstanding"] = (kv["pages_allocated"]
                                          - kv["pages_freed"])
 
+    tenants = {}
+    for tid in sorted(tenant_stats):
+        t = tenant_stats[tid]
+        samples = sorted(t.pop("_ttft"))
+        row = dict(t)
+        if samples:
+            row["ttft_p50_ms"] = round(
+                samples[len(samples) // 2], 3)
+            # nearest-rank p99 — same estimator the fleet SLO loop uses
+            row["ttft_p99_ms"] = round(
+                samples[min(len(samples) - 1,
+                            int(0.99 * (len(samples) - 1) + 0.5))], 3)
+        tenants[tid] = row
+
+    routing = {}
+    routed = cache_route["hits"] + cache_route["misses"]
+    if routed:
+        routing = dict(cache_route)
+        routing["warm_rate"] = round(cache_route["hits"] / routed, 4)
+        # prefill FLOPs the router steered onto an already-warm replica
+        routing["routed_tokens_frac"] = round(
+            cache_route["matched_tokens"]
+            / max(1, cache_route["prompt_tokens"]), 4)
+
     task_rows = sorted(
         tasks.values(),
         key=lambda t: (t["step"], str(t["task_id"])))
@@ -478,6 +556,8 @@ def aggregate(records, profiles=None):
         "train": train,
         "mpmd": mpmd,
         "fleet": fleet,
+        "tenants": tenants,
+        "cache_route": routing,
         "hangs": hangs,
         "prefix_cache": prefix_cache,
         "kv_pages": kv_pages,
@@ -652,6 +732,35 @@ def render_summary(run_id, agg, echo=print):
                 echo("    replica %s attempt %s: wait %ss"
                      % (r.get("replica"), r.get("attempt"),
                         r.get("delay_s")))
+    routing = agg.get("cache_route") or {}
+    if routing:
+        echo("")
+        echo("cache-aware routing (prefix-affinity dispatch):")
+        echo("  %d warm / %d cold dispatch(es) (%.0f%% warm), %d of %d "
+             "prompt tokens already cached on the chosen replica "
+             "(%.0f%%)"
+             % (routing["hits"], routing["misses"],
+                routing["warm_rate"] * 100, routing["matched_tokens"],
+                routing["prompt_tokens"],
+                routing["routed_tokens_frac"] * 100))
+    tenants = agg.get("tenants") or {}
+    if tenants:
+        echo("")
+        echo("tenants (multi-tenant admission):")
+        echo("  %-16s %8s %9s %5s %10s %10s %9s %9s"
+             % ("tenant", "admitted", "throttled", "shed",
+                "prompt_tok", "gen_tok", "ttft p50", "ttft p99"))
+        for tid, t in tenants.items():
+            echo("  %-16s %8d %9d %5d %10d %10d %9s %9s"
+                 % (tid, t["admitted"], t["throttled"], t["shed"],
+                    t["prompt_tokens"], t["generated_tokens"],
+                    _fmt_ms(t.get("ttft_p50_ms")),
+                    _fmt_ms(t.get("ttft_p99_ms"))))
+            if t["throttles"]:
+                echo("  %-16s throttled by reason: %s"
+                     % ("", ", ".join(
+                         "%s=%d" % (k, v) for k, v
+                         in sorted(t["throttles"].items()))))
     hangs = agg.get("hangs") or {}
     if hangs:
         echo("")

@@ -302,14 +302,43 @@ def reload_fleet(flow_run, run_id=None, step_name=None, ckpt_step=None,
                            % timeout_s)
 
 
+def serve_federate(fleet_urls, host="127.0.0.1", port=8000, echo=print,
+                   block=True):
+    """`tpuflow serve --federate URL,URL`: run the thin federation
+    front tier over already-running fleets. No checkpoint is loaded
+    here — the front only forwards, polls fleet /healthz for capacity
+    rollups, and spreads tenants across fleets
+    (docs/serving.md#federation)."""
+    from ..serving import FederationRouter
+
+    urls = [u.strip() for u in fleet_urls.split(",") if u.strip()]
+    if not urls:
+        raise TpuFlowException("--federate needs at least one fleet URL")
+    router = FederationRouter(urls, host=host, port=port)
+    router.start()
+    echo("federating %d fleet(s) on http://%s:%d" % (len(urls),
+                                                     router.host,
+                                                     router.port))
+    for i, url in enumerate(urls):
+        echo("  fleet %d: %s" % (i, url))
+    echo("  POST /v1/generate  {\"tokens\": [...], \"tenant\": \"...\"}")
+    if not block:
+        return router
+    try:
+        router._stop.wait()
+    except KeyboardInterrupt:
+        pass
+    router.close()
+
+
 def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
           params_key="params", config_json=None, model="llama",
           host="127.0.0.1", port=8000, replicas=1, slots=8,
           max_seq_len=None, prefill_chunk=64, max_queue=64,
           mesh_spec=None, attn_impl="auto", prefill_workers=0,
           prefix_cache_mb=None, paged=False, page_tokens=None,
-          spec_k=None, reload_checkpoint=False, echo=print,
-          block=True):
+          spec_k=None, reload_checkpoint=False, federate=None,
+          echo=print, block=True):
     """Load FLOW/RUN's checkpoint and serve it. Returns the running
     ServingServer when block=False (tests); otherwise serves until
     SIGTERM/SIGINT, draining in-flight requests before exit. With
@@ -321,6 +350,10 @@ def serve(flow_run, run_id=None, step_name=None, ckpt_step=None,
     from .. import telemetry
     from ..inference import load_run_checkpoint
     from ..serving import Scheduler, ServingServer
+
+    if federate:
+        return serve_federate(federate, host=host, port=port, echo=echo,
+                              block=block)
 
     if reload_checkpoint:
         return reload_fleet(flow_run, run_id=run_id,

@@ -71,6 +71,10 @@ class WatchState(object):
         self.kv_shares = 0
         self.kv_exhausted = 0
         self.spec_accept_rate = None
+        # multi-tenant admission (serve.tenant.* + tenant-tagged
+        # request events): tenant id -> rolling counters + TTFT window
+        self._tenants = {}
+        self._tenant_window = window
         # fleet
         self.replicas_ready = None
         self.replica_flaps = 0
@@ -84,6 +88,15 @@ class WatchState(object):
         self.hang_count = 0
         self.last_hang = None         # latest hang.detected event data
         self.breach_events = []       # persisted slo.breach records
+
+    def _tenant(self, tid):
+        t = self._tenants.get(tid)
+        if t is None:
+            t = self._tenants[tid] = {
+                "admitted": 0, "throttled": 0, "shed": 0,
+                "queue_depth": None,
+                "_ttft": deque(maxlen=self._tenant_window)}
+        return t
 
     def ingest(self, records):
         for rec in records:
@@ -122,10 +135,17 @@ class WatchState(object):
                     self.kv_cow_pages = rec.get("value")
                 elif name == "serve.spec.accept_rate":
                     self.spec_accept_rate = rec.get("value")
+                elif name == "serve.tenant.queue_depth":
+                    self._tenant(
+                        data.get("tenant")
+                        or "default")["queue_depth"] = rec.get("value")
             elif rtype == "event":
                 if name == "serve.request.first_token":
                     if data.get("ttft_ms") is not None:
                         self._ttft_ms.append(data["ttft_ms"])
+                        if data.get("tenant"):
+                            self._tenant(data["tenant"])["_ttft"].append(
+                                data["ttft_ms"])
                 elif name == "serve.request.finished":
                     new = data.get("new_tokens") or 0
                     self._served.append((ts, new))
@@ -145,6 +165,20 @@ class WatchState(object):
                         data.get("prompt_tokens") or 0
                 elif name == "serve.prefix.evict":
                     self.prefix_evictions += data.get("nodes") or 0
+                elif name == "serve.tenant.admitted":
+                    self._tenant(
+                        data.get("tenant") or "default")["admitted"] += 1
+                elif name == "serve.tenant.throttled":
+                    self._tenant(
+                        data.get("tenant") or "default")["throttled"] += 1
+                elif name == "serve.tenant.shed":
+                    self._tenant(
+                        data.get("tenant") or "default")["shed"] += 1
+                elif name == "fleet.request.shed":
+                    # only tenant-scoped router denials attribute here;
+                    # anonymous capacity sheds stay fleet-level
+                    if data.get("tenant"):
+                        self._tenant(data["tenant"])["shed"] += 1
                 elif name == "serve.kv.page_shared":
                     self.kv_shares += 1
                 elif name == "serve.kv.exhausted":
@@ -222,7 +256,25 @@ class WatchState(object):
             m["kv_page_occupancy"] = round(float(self.kv_occupancy), 4)
         if self.spec_accept_rate is not None:
             m["spec_accept_rate"] = round(float(self.spec_accept_rate), 4)
+        # per-tenant TTFT percentiles use the SAME metric names the
+        # fleet SLO loop exposes, so slo.tenant_rules() applies the
+        # TPUFLOW_SLO_TENANT_P99_TTFT_MS bound to watch --check too
+        for tid, t in self._tenants.items():
+            if t["_ttft"]:
+                m["tenant.%s.p50_ttft_ms" % tid] = round(
+                    _pctl(t["_ttft"], 0.50), 3)
+                m["tenant.%s.p99_ttft_ms" % tid] = round(
+                    _pctl(t["_ttft"], 0.99), 3)
         return m
+
+    def tenant_rollup(self):
+        """Per-tenant admission counters for the snapshot/frame."""
+        return {
+            tid: {"admitted": t["admitted"],
+                  "throttled": t["throttled"],
+                  "shed": t["shed"],
+                  "queue_depth": t["queue_depth"]}
+            for tid, t in sorted(self._tenants.items())}
 
     def snapshot(self, run_id, breaches=()):
         """One machine-readable frame: the same data render_frame
@@ -239,6 +291,7 @@ class WatchState(object):
                 "queue_depth": self.queue_depth,
                 "occupancy": self.occupancy,
             },
+            "tenants": self.tenant_rollup(),
             "prefix": {
                 "hits": self.prefix_hits,
                 "misses": self.prefix_misses,
@@ -300,6 +353,16 @@ def render_frame(state, run_id, breaches=(), echo=print):
                 m["p50_itl_ms"], m["p99_itl_ms"])
         if "serve_tokens_per_sec" in m:
             line += "  %.0f tok/s" % m["serve_tokens_per_sec"]
+        echo(line)
+    for tid, t in state.tenant_rollup().items():
+        line = "  tenant %s: admitted %d  throttled %d  shed %d" % (
+            tid, t["admitted"], t["throttled"], t["shed"])
+        if t["queue_depth"] is not None:
+            line += "  queue %s" % t["queue_depth"]
+        p50 = m.get("tenant.%s.p50_ttft_ms" % tid)
+        p99 = m.get("tenant.%s.p99_ttft_ms" % tid)
+        if p50 is not None and p99 is not None:
+            line += "  ttft p50/p99 %.1f/%.1f ms" % (p50, p99)
         echo(line)
     if "prefix_hit_rate" in m or state.prefix_evictions:
         echo("  prefix: hit rate %.0f%%  prefill skipped %.0f%%  "
@@ -372,7 +435,11 @@ def watch(flow_datastore, run_id, once=False, check=False, interval=2.0,
     breaches = []
     while True:
         state.ingest(tail.poll())
-        breaches = slo_rules_mod.evaluate(rules, state.metrics())
+        metrics = state.metrics()
+        # per-tenant SLO bounds are synthesized from the live tenant
+        # population each poll (tenants appear as traffic arrives)
+        breaches = slo_rules_mod.evaluate(
+            rules + slo_rules_mod.tenant_rules(metrics), metrics)
         if as_json:
             echo(json.dumps(state.snapshot(run_id, breaches),
                             sort_keys=True))

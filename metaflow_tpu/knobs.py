@@ -45,7 +45,7 @@ _FALSEY = ("0", "false", "no", "off")
 SUBSYSTEM_ORDER = (
     "config", "runtime", "datastore", "data", "training", "ops", "spmd",
     "progress", "elastic", "serving", "fleet", "slo", "telemetry",
-    "analysis", "tpu", "conda", "chaos", "internal", "online",
+    "analysis", "tpu", "conda", "chaos", "internal", "online", "tenancy",
 )
 
 
@@ -434,6 +434,35 @@ _k("TPUFLOW_ONLINE_MAX_LAG", "int", 2, "generations", "online",
    "generations")
 _k("TPUFLOW_ONLINE_FRESH_GENERATIONS", "int", 0, "generations", "online",
    "ReplayReader freshness window in generations (0 = no filter)")
+
+# --- tenancy (serving/tenancy.py + cache_router.py: multi-tenant tier) -----
+_k("TPUFLOW_TENANT_WEIGHTS", "str", "", "", "tenancy",
+   "per-tenant DRR weights, 'gold=4,free=1' ('' = single-tenant)")
+_k("TPUFLOW_TENANT_PRIORITIES", "str", "", "", "tenancy",
+   "per-tenant priority classes, 'gold=high,free=low'")
+_k("TPUFLOW_TENANT_BUDGETS", "str", "", "", "tenancy",
+   "per-tenant token budgets per rolling window, 'free=4096'")
+_k("TPUFLOW_TENANT_BUDGET_WINDOW_S", "float", 10.0, "s", "tenancy",
+   "rolling window the tenant token budgets apply over")
+_k("TPUFLOW_TENANT_DEFAULT", "str", "default", "", "tenancy",
+   "bucket name for requests that carry no tenant id")
+_k("TPUFLOW_TENANT_QUANTUM", "int", 256, "tokens", "tenancy",
+   "DRR credit quantum per round (scaled by each tenant's weight)")
+_k("TPUFLOW_TENANT_FLEET_MAP", "str", "", "", "tenancy",
+   "federation tenant->fleet pins, 'gold=0,free=1' (else hash spread)")
+_k("TPUFLOW_CACHE_ROUTE", "bool", True, "", "tenancy",
+   "cache-aware dispatch: route to the replica with the longest "
+   "cached prompt prefix")
+_k("TPUFLOW_CACHE_ROUTE_BLOCK", "int", 16, "tokens", "tenancy",
+   "digest block size for radix-cache replicas (paged replicas "
+   "publish at their page size)")
+_k("TPUFLOW_CACHE_ROUTE_DIGESTS", "int", 512, "count", "tenancy",
+   "max prefix digests a replica publishes through /healthz")
+_k("TPUFLOW_CACHE_ROUTE_MIN_TOKENS", "int", 32, "tokens", "tenancy",
+   "cached-prefix score below this is treated as cold (load wins)")
+_k("TPUFLOW_SLO_TENANT_P99_TTFT_MS", "float", None, "ms", "tenancy",
+   "per-tenant upper bound on p99 time-to-first-token (one rule per "
+   "live tenant)")
 
 
 # ---------------------------------------------------------------------------

@@ -3,6 +3,7 @@ engine with speculative decoding, request scheduler, HTTP API, radix
 prefix cache, prefill/decode disaggregation, and the fault-tolerant
 autoscaling replica fleet. See docs/serving.md."""
 
+from .cache_router import CacheRouter, PromptChains
 from .disagg import decode_handoff, encode_handoff
 from .engine import SlotEngine, request_step_keys, sample_slots
 from .fleet import (
@@ -22,6 +23,7 @@ from .prefix_cache import (
     PagedPrefixIndex,
     PrefixHandle,
     RadixPrefixCache,
+    route_digest_chain,
 )
 from .scheduler import (
     CapacityError,
@@ -29,8 +31,15 @@ from .scheduler import (
     QueueFullError,
     Request,
     Scheduler,
+    TenantThrottledError,
 )
 from .server import ServingServer, retry_after_hint
+from .tenancy import (
+    FederationRouter,
+    TenancyConfig,
+    TenantQueues,
+    TokenBudgets,
+)
 
 __all__ = [
     "SlotEngine",
@@ -57,4 +66,12 @@ __all__ = [
     "encode_handoff",
     "decode_handoff",
     "retry_after_hint",
+    "route_digest_chain",
+    "CacheRouter",
+    "PromptChains",
+    "TenancyConfig",
+    "TenantQueues",
+    "TokenBudgets",
+    "TenantThrottledError",
+    "FederationRouter",
 ]

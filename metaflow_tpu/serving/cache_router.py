@@ -1,0 +1,104 @@
+"""Cache-aware dispatch: score replicas by longest cached prefix.
+
+At fleet scale the scarce resource is not slots, it is the per-replica
+prefix cache: least-loaded dispatch scatters a tenant's shared system
+prompt across every replica, so each one pays the cold prefill and the
+94%-FLOPs-skipped hit rate a single warm replica achieves (PR 13)
+collapses fleet-wide. This module makes the cache FLEET-GLOBAL without
+any new wire protocol:
+
+  * replicas publish compact prefix-digest summaries of their radix /
+    paged prefix trees inside the /healthz `prefix_cache` block the
+    fleet's health loop ALREADY polls (prefix_cache.route_digests);
+  * at dispatch the router computes the request prompt's rolling
+    block-digest chain (prefix_cache.route_digest_chain — the same
+    sha1 chain the paged index keys pages by) and scores each ready
+    replica by the number of leading chain digests present in its
+    published digest set: score == cached prefix length in tokens;
+  * the highest score wins, ties (including the all-cold case) fall
+    back to exactly the old least-loaded order, so an empty fleet
+    behaves bit-identically to pre-routing dispatch.
+
+Digest sets are refreshed at health-probe cadence, so scores can be a
+probe interval stale: a stale HIT still lands on a warm replica (the
+cache keeps entries until eviction), a stale MISS merely falls back to
+least-loaded — both safe, neither affects response tokens, because
+prefix reuse is bitwise-identity-preserving by construction.
+"""
+
+from .prefix_cache import route_digest_chain
+from .. import knobs
+
+
+class PromptChains(object):
+    """The per-request digest-chain memo: replicas may publish digests
+    at different block sizes (a paged replica's block IS its page size),
+    so the chain is computed lazily once per distinct block."""
+
+    __slots__ = ("tokens", "_by_block")
+
+    def __init__(self, tokens):
+        self.tokens = tokens
+        self._by_block = {}
+
+    def chain(self, block):
+        block = int(block)
+        if block <= 0:
+            return []
+        got = self._by_block.get(block)
+        if got is None:
+            try:
+                got = route_digest_chain(self.tokens, block)
+            except (TypeError, ValueError):
+                got = []   # malformed prompt: let the replica 400 it
+            self._by_block[block] = got
+        return got
+
+
+class CacheRouter(object):
+    """Scores dispatch candidates by longest-cached-prefix; the fleet
+    router consults it inside _pick. Stateless beyond config — replica
+    cache state arrives through the healthz stats the caller passes."""
+
+    def __init__(self, enabled=None, block=None, min_score_tokens=None):
+        self.enabled = (knobs.get_bool("TPUFLOW_CACHE_ROUTE")
+                        if enabled is None else bool(enabled))
+        self.block = (knobs.get_int("TPUFLOW_CACHE_ROUTE_BLOCK")
+                      if block is None else int(block))
+        # scores below this many tokens are treated as cold: a 1-block
+        # accidental overlap should not override load balancing
+        self.min_score_tokens = (
+            knobs.get_int("TPUFLOW_CACHE_ROUTE_MIN_TOKENS")
+            if min_score_tokens is None else int(min_score_tokens))
+
+    @classmethod
+    def from_env(cls):
+        return cls()
+
+    def chains(self, tokens):
+        """The memoized prompt-chain helper for one request."""
+        return PromptChains(tokens)
+
+    def score(self, chains, stats):
+        """Cached-prefix length (tokens) of `chains`' prompt on a
+        replica whose last healthz stats are `stats`; 0 when the
+        replica publishes no digests (cold, disabled, or never
+        probed)."""
+        if not self.enabled or chains is None:
+            return 0
+        pc = (stats or {}).get("prefix_cache") or {}
+        digests = pc.get("digests")
+        if not digests:
+            return 0
+        block = int(pc.get("route_block") or self.block or 0)
+        if block <= 0:
+            return 0
+        published = set(digests)
+        matched = 0
+        for digest in chains.chain(block):
+            if digest not in published:
+                break
+            matched += block
+        if matched < self.min_score_tokens:
+            return 0
+        return matched

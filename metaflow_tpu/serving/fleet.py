@@ -44,6 +44,10 @@ Env knobs (all optional, read by FleetConfig.from_env):
     TPUFLOW_FLEET_REDISPATCH_MAX    failovers per request (default 3)
     TPUFLOW_FLEET_WAIT_S            max wait for a ready replica before
                                     503 (default 15)
+    TPUFLOW_CACHE_ROUTE=0           disable cache-aware dispatch
+                                    (docs/serving.md#cache-aware-routing)
+    TPUFLOW_TENANT_*                per-tenant weights / priorities /
+                                    budgets (docs/serving.md#multi-tenancy)
 
 Restart delays come from the shared elastic.policy.BackoffPolicy
 (TPUFLOW_RETRY_BACKOFF_*), so a seeded chaos run replays the exact
@@ -61,6 +65,7 @@ import sys
 import tempfile
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import knobs
@@ -68,7 +73,20 @@ from .. import slo as slo_rules_mod
 from .. import telemetry
 from .. import tracing
 from ..elastic.policy import BackoffPolicy
+from .cache_router import CacheRouter
 from .server import retry_after_hint
+from .tenancy import PRIORITY_CLASSES, TenancyConfig, TokenBudgets
+
+
+def _pctl(values, q):
+    """Nearest-rank percentile of an unsorted sequence; 0.0 when empty
+    (mirrors scheduler._pctl without importing the engine stack into
+    the router process)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+    return round(float(ordered[idx]), 3)
 
 
 class FleetConfig(object):
@@ -409,6 +427,20 @@ class ServingFleet(object):
         self.slo_rules = slo_rules_mod.load_rules()
         self._slo_breaches = {}       # rule name -> latest breach dict
         self._restart_times = []      # monotonic stamps (under _lock)
+        # multi-tenancy + cache-aware dispatch: per-tenant budgets and
+        # headroom caps at admission, prefix-digest scoring at dispatch
+        self.tenancy = TenancyConfig.from_env()
+        self._tenant_budgets = TokenBudgets(self.tenancy)
+        self.cache_router = CacheRouter.from_env()
+        self.cache_route_hits = 0     # (under _lock)
+        self.cache_route_misses = 0   # (under _lock)
+        self._tenant_inflight = {}    # tenant -> in-flight (under _lock)
+        self._tenant_counts = {}      # tenant -> counters (under _lock)
+        self._tenant_ttft = {}        # tenant -> TTFT ms (under _lock)
+        # while a high-priority tenant is in SLO breach the router
+        # halves the low-priority headroom share (sheds background
+        # traffic first) until this deadline passes
+        self._tenant_pressure_until = 0.0
         self._httpd = ThreadingHTTPServer((host, port), _FleetHandler)
         self._httpd.daemon_threads = True
         self._httpd.fleet = self
@@ -566,6 +598,8 @@ class ServingFleet(object):
         now = time.monotonic()
         with self._lock:
             restarts = [t for t in self._restart_times if now - t <= 60.0]
+            tenant_ttft = {t: list(w)
+                           for t, w in self._tenant_ttft.items() if w}
         metrics = {"replica_restart_rate_per_min": float(len(restarts))}
         for key in ("p99_ttft_ms", "p99_itl_ms", "p50_ttft_ms",
                     "p50_itl_ms"):
@@ -574,13 +608,18 @@ class ServingFleet(object):
                     if isinstance(v, (int, float)) and v > 0]
             if vals:
                 metrics[key] = max(vals)
+        # per-tenant tail, measured AT THE ROUTER (first client-visible
+        # token): the vocabulary TPUFLOW_SLO_TENANT_* rules bind to
+        for t, vals in tenant_ttft.items():
+            metrics["tenant.%s.p99_ttft_ms" % t] = _pctl(vals, 0.99)
         return metrics
 
     def _check_slo(self):
-        if not self.slo_rules:
+        metrics = self.slo_metrics()
+        rules = self.slo_rules + slo_rules_mod.tenant_rules(metrics)
+        if not rules:
             return
-        breaches = slo_rules_mod.evaluate(self.slo_rules,
-                                          self.slo_metrics())
+        breaches = slo_rules_mod.evaluate(rules, metrics)
         current = {b["rule"]: b for b in breaches}
         for name, breach in current.items():
             if name not in self._slo_breaches:
@@ -591,7 +630,27 @@ class ServingFleet(object):
                 self.echo("fleet: SLO breach: %s %s=%s > %s"
                           % (breach["rule"], breach["metric"],
                              breach["value"], breach["threshold"]))
+                self._on_tenant_breach(breach)
         self._slo_breaches = current
+
+    def _on_tenant_breach(self, breach):
+        """Per-tenant SLO enforcement: a HIGH-priority tenant in breach
+        means background traffic is crowding it out. Open a pressure
+        window (admission halves the low-priority headroom share, so
+        low-priority work is shed first) and ask for another replica —
+        the two levers the ISSUE's 'scale_out / shed low-priority
+        first' contract names."""
+        metric = breach.get("metric", "")
+        if not metric.startswith("tenant."):
+            return
+        tenant = metric[len("tenant."):].rsplit(".", 1)[0]
+        if self.tenancy.priority(tenant) != PRIORITY_CLASSES["high"]:
+            return
+        self._tenant_pressure_until = time.monotonic() + max(
+            5.0, 5.0 * self.config.health_interval_s)
+        self.echo("fleet: high-priority tenant %s in SLO breach: "
+                  "shedding low-priority traffic first" % tenant)
+        self.scale_out(queue_per_replica=0.0)
 
     def _health_loop(self):
         while not self._stopped:
@@ -975,7 +1034,7 @@ class ServingFleet(object):
             return h.role == "prefill"
         return h.role in ("decode", "unified")
 
-    def _pick(self, session, exclude, role="decode"):
+    def _pick(self, session, exclude, role="decode", chains=None):
         with self._lock:
             ready = [h for h in self.handles
                      if h.state == "ready" and h not in exclude
@@ -987,13 +1046,53 @@ class ServingFleet(object):
                 if pinned is not None and pinned in ready:
                     pinned.inflight += 1
                     return pinned
-            h = min(ready, key=lambda r: (
-                r.inflight, r.last_stats.get("queue_depth") or 0,
-                r.index))
+
+            def load_key(r):
+                return (r.inflight,
+                        r.last_stats.get("queue_depth") or 0, r.index)
+
+            h = None
+            if chains is not None and self.cache_router.enabled:
+                # cache-aware dispatch: the warmest prefix cache wins
+                # (score == cached prompt-prefix tokens, from the digest
+                # sets replicas publish through /healthz); ties — and
+                # the all-cold case — fall back to exactly the old
+                # least-loaded order
+                scores = {r.index: self.cache_router.score(
+                    chains, r.last_stats) for r in ready}
+                if max(scores.values()) > 0:
+                    h = min(ready, key=lambda r:
+                            (-scores[r.index],) + load_key(r))
+            if h is None:
+                h = min(ready, key=load_key)
             if session is not None:
                 self._sessions[session] = h
             h.inflight += 1
             return h
+
+    def _emit_route(self, request_id, h, chains):
+        """Telemetry for one routing decision: how many prompt-prefix
+        tokens the chosen replica already holds."""
+        matched = self.cache_router.score(chains, h.last_stats)
+        telemetry.gauge("fleet.cache_route.score", matched,
+                        data={"replica": h.index})
+        if matched > 0:
+            with self._lock:
+                self.cache_route_hits += 1
+                candidates = sum(
+                    1 for hh in self.handles if hh.state == "ready"
+                    and self._eligible(hh, "decode"))
+            telemetry.event("fleet.cache_route.hit", data={
+                "request_id": str(request_id), "replica": h.index,
+                "matched_tokens": matched,
+                "prompt_tokens": len(chains.tokens),
+                "candidates": candidates})
+        else:
+            with self._lock:
+                self.cache_route_misses += 1
+            telemetry.event("fleet.cache_route.miss", data={
+                "request_id": str(request_id), "replica": h.index,
+                "prompt_tokens": len(chains.tokens)})
 
     def _wait_for_ready(self, deadline_s, exclude, role="decode"):
         """Block (bounded) for a ready replica: a fleet mid-restart
@@ -1024,19 +1123,85 @@ class ServingFleet(object):
                         if h.state == "ready" and h.role != "prefill")
         return retry_after_hint(max(1, inflight), max(1, slots))
 
-    def _shed(self, handler, request_id, reason, code, message):
+    def _tenant_counts_locked(self, tenant):
+        got = self._tenant_counts.get(tenant)
+        if got is None:
+            got = self._tenant_counts[tenant] = {
+                "forwarded": 0, "shed": 0}
+        return got
+
+    def _shed(self, handler, request_id, reason, code, message,
+              tenant=None, retry_after_s=None):
         with self._lock:
             self.shed_count += 1
-        telemetry.event("fleet.request.shed", data={
-            "request_id": str(request_id), "reason": reason})
-        handler._json(code, {"error": message, "reason": reason},
-                      headers={"Retry-After": str(self._retry_after())})
+            if tenant is not None:
+                self._tenant_counts_locked(tenant)["shed"] += 1
+        data = {"request_id": str(request_id), "reason": reason}
+        body = {"error": message, "reason": reason}
+        if tenant is not None:
+            # every shed echoes the tenant so a federated front (or the
+            # client) can attribute the refusal without parsing `error`
+            data["tenant"] = tenant
+            body["tenant"] = tenant
+        telemetry.event("fleet.request.shed", data=data)
+        if retry_after_s is None:
+            hint = self._retry_after()
+        else:
+            # tenant-scoped hint: a throttled tenant's wait is its OWN
+            # budget window / queue share, never the fleet-wide
+            # capacity estimate (wrong in both directions for it)
+            hint = max(1, int(retry_after_s + 0.999))
+        handler._json(code, body,
+                      headers={"Retry-After": str(hint)})
+
+    def _admit_tenant(self, handler, request_id, tenant, payload):
+        """Per-tenant admission; False == already shed. Token budget
+        first (429 with the tenant's own window-reset Retry-After),
+        then the low-priority headroom cap: non-high tenants may only
+        fill their collective weight share of the in-flight budget when
+        a high-priority tenant is configured — halved while one is in
+        SLO breach — so a saturating background tenant always leaves
+        headroom for interactive traffic."""
+        try:
+            cost = len(payload.get("tokens") or ()) \
+                + int(payload.get("max_new_tokens") or 1)
+        except (TypeError, ValueError):
+            cost = 1
+        wait = self._tenant_budgets.charge(tenant, cost)
+        if wait:
+            self._shed(handler, request_id, "tenant_budget", 429,
+                       "tenant %s over its token budget" % tenant,
+                       tenant=tenant, retry_after_s=wait)
+            return False
+        if self.tenancy.priority(tenant) > PRIORITY_CLASSES["high"]:
+            capacity = int(self.config.max_inflight or 0)
+            cap = self.tenancy.low_priority_share(capacity)
+            if capacity and cap < capacity:
+                if time.monotonic() < self._tenant_pressure_until:
+                    cap = max(1, cap // 2)
+                with self._lock:
+                    low = sum(
+                        n for t, n in self._tenant_inflight.items()
+                        if self.tenancy.priority(t)
+                        > PRIORITY_CLASSES["high"])
+                if low >= cap:
+                    self._shed(
+                        handler, request_id, "priority", 429,
+                        "low-priority headroom exhausted "
+                        "(tenant %s)" % tenant,
+                        tenant=tenant,
+                        retry_after_s=retry_after_hint(
+                            max(1, low), max(1, cap)))
+                    return False
+        return True
 
     def handle_generate(self, handler, payload):
         request_id = payload.get("request_id") or \
             "fleet-%d" % (id(payload) & 0xFFFFFF)
         session = payload.get("session")
         stream = bool(payload.get("stream", False))
+        tenant = payload.get("tenant")
+        tenant = str(tenant) if tenant else None
         # the router is where a request's trace begins: mint the root
         # traceparent here (or adopt the client's) so every dispatch
         # attempt — including failover re-dispatch — carries a child
@@ -1045,7 +1210,6 @@ class ServingFleet(object):
         if root_tp is None and tracing.trace_requests_enabled():
             root_tp = tracing.request_traceparent(str(request_id))
         trace_id, root_span = tracing.traceparent_ids(root_tp)
-        attempt_span = ""
         deadline = None
         if payload.get("deadline_ms") is not None:
             try:
@@ -1057,11 +1221,11 @@ class ServingFleet(object):
         # ---- admission: shed before any replica spends prefill ----
         if self._draining or self._stopped:
             self._shed(handler, request_id, "draining", 503,
-                       "fleet is draining")
+                       "fleet is draining", tenant=tenant)
             return
         if deadline is not None and deadline <= time.monotonic():
             self._shed(handler, request_id, "deadline", 429,
-                       "deadline already expired")
+                       "deadline already expired", tenant=tenant)
             return
         with self._lock:
             total_inflight = sum(h.inflight for h in self.handles)
@@ -1072,7 +1236,7 @@ class ServingFleet(object):
                 full = False
         if full:
             self._shed(handler, request_id, "queue_full", 429,
-                       "fleet in-flight budget exhausted")
+                       "fleet in-flight budget exhausted", tenant=tenant)
             return
         # never-fits capacity check: a request whose prompt+max_new
         # exceeds every ready replica's reported max_context_tokens
@@ -1088,8 +1252,59 @@ class ServingFleet(object):
             if need > cap:
                 self._shed(handler, request_id, "capacity", 413,
                            "prompt + max_new_tokens (%d) exceeds fleet "
-                           "max context (%d tokens)" % (need, cap))
+                           "max context (%d tokens)" % (need, cap),
+                           tenant=tenant)
                 return
+        # ---- per-tenant admission (budget, low-priority headroom) ----
+        tenancy_on = tenant is not None and self.tenancy.enabled()
+        if tenancy_on:
+            if not self._admit_tenant(handler, request_id, tenant,
+                                      payload):
+                return
+            with self._lock:
+                self._tenant_inflight[tenant] = \
+                    self._tenant_inflight.get(tenant, 0) + 1
+                self._tenant_counts_locked(tenant)["forwarded"] += 1
+        tokens = payload.get("tokens")
+        chains = None
+        if self.cache_router.enabled and isinstance(tokens, list) \
+                and tokens:
+            chains = self.cache_router.chains(tokens)
+        try:
+            self._dispatch(handler, payload, request_id, session,
+                           stream, deadline, root_tp, trace_id,
+                           root_span, chains, tenant)
+        finally:
+            if tenancy_on:
+                with self._lock:
+                    self._tenant_inflight[tenant] = max(
+                        0, self._tenant_inflight.get(tenant, 1) - 1)
+
+    def _dispatch(self, handler, payload, request_id, session, stream,
+                  deadline, root_tp, trace_id, root_span, chains,
+                  tenant):
+        """The dispatch/failover loop behind handle_generate's
+        admission gates: prefill hop, cache-aware pick, relay with
+        re-dispatch on replica loss."""
+        attempt_span = ""
+        on_first = None
+        if tenant is not None and self.tenancy.enabled():
+            t0 = time.monotonic()
+            fired = []
+
+            def on_first():
+                # first client-visible token: the router-side TTFT the
+                # per-tenant SLO rules bind to
+                if fired:
+                    return
+                fired.append(True)
+                ms = (time.monotonic() - t0) * 1000.0
+                with self._lock:
+                    w = self._tenant_ttft.get(tenant)
+                    if w is None:
+                        w = self._tenant_ttft[tenant] = \
+                            deque(maxlen=256)
+                    w.append(ms)
 
         # ---- disaggregation: prefill hop first when workers exist ----
         # the returned frame (KV + first token + original payload) is
@@ -1097,19 +1312,23 @@ class ServingFleet(object):
         # of re-paying prefill
         decode_body = None
         if self.prefill_workers:
-            decode_body = self._prefill_hop(payload, request_id, root_tp)
+            decode_body = self._prefill_hop(payload, request_id,
+                                            root_tp, chains=chains)
         delivered = 0      # tokens already streamed to the client
         started = False    # status line sent (streaming path)
         attempts = 0
         tried_busy = set()
         exclude = set()
+        route_scored = False
         while True:
             if deadline is not None and deadline <= time.monotonic() \
                     and delivered == 0:
                 self._shed(handler, request_id, "deadline", 429,
-                           "deadline expired before dispatch")
+                           "deadline expired before dispatch",
+                           tenant=tenant)
                 return
-            h = self._pick(session, exclude | tried_busy)
+            h = self._pick(session, exclude | tried_busy,
+                           chains=chains)
             if h is None:
                 wait = self.config.wait_s
                 if deadline is not None:
@@ -1121,8 +1340,13 @@ class ServingFleet(object):
                     handler.close_connection = True
                     return
                 self._shed(handler, request_id, "no_replica", 503,
-                           "no ready replica")
+                           "no ready replica", tenant=tenant)
                 return
+            if chains is not None and not route_scored:
+                # score the FIRST pick only: failover re-dispatch is a
+                # correctness path, not a routing decision
+                route_scored = True
+                self._emit_route(request_id, h, chains)
             with self._lock:
                 self.dispatch_count += 1
                 n_dispatch = self.dispatch_count
@@ -1152,7 +1376,7 @@ class ServingFleet(object):
                     traceparent=attempt_tp,
                     path=("/v1/decode" if decode_body is not None
                           else "/v1/generate"),
-                    body=decode_body)
+                    body=decode_body, on_first=on_first)
                 with self._lock:
                     h.inflight = max(0, h.inflight - 1)
                     if done:
@@ -1166,7 +1390,9 @@ class ServingFleet(object):
                               if self._eligible(hh, "decode")])
                 if len(tried_busy) >= pool_n:
                     self._shed(handler, request_id, "queue_full",
-                               ex.code, "every replica shed the request")
+                               ex.code,
+                               "every replica shed the request",
+                               tenant=tenant)
                     return
                 continue
             except _ReplicaBackendError as ex:
@@ -1180,7 +1406,7 @@ class ServingFleet(object):
                     else:
                         self._shed(handler, request_id, "replica_lost",
                                    502, "replica died (failover "
-                                   "disabled)")
+                                   "disabled)", tenant=tenant)
                     return
                 attempts += 1
                 if attempts > self.config.redispatch_max:
@@ -1189,7 +1415,8 @@ class ServingFleet(object):
                     else:
                         self._shed(handler, request_id,
                                    "failover_exhausted", 502,
-                                   "re-dispatch budget exhausted")
+                                   "re-dispatch budget exhausted",
+                                   tenant=tenant)
                     return
                 with self._lock:
                     self.failover_count += 1
@@ -1213,7 +1440,7 @@ class ServingFleet(object):
                 handler.close_connection = True
                 return
 
-    def _prefill_hop(self, payload, request_id, root_tp):
+    def _prefill_hop(self, payload, request_id, root_tp, chains=None):
         """Disaggregation phase 1: run chunked prefill on a dedicated
         worker and return the KV-handoff frame (bytes) to POST to a
         decode replica, or None to fall back to unified dispatch (no
@@ -1229,7 +1456,7 @@ class ServingFleet(object):
         trace_id, _ = tracing.traceparent_ids(root_tp)
         tried = set()
         while not self._draining and not self._stopped:
-            h = self._pick(None, tried, role="prefill")
+            h = self._pick(None, tried, role="prefill", chains=chains)
             if h is None:
                 break
             with self._lock:
@@ -1275,7 +1502,8 @@ class ServingFleet(object):
         return None
 
     def _relay(self, handler, h, payload, request_id, stream, delivered,
-               traceparent=None, path="/v1/generate", body=None):
+               traceparent=None, path="/v1/generate", body=None,
+               on_first=None):
         """Forward one dispatch attempt; returns (done, delivered,
         started). Raises _ReplicaBackendError (carrying progress) on
         replica death. With `body` set (a KV-handoff frame), the POST
@@ -1356,6 +1584,9 @@ class ServingFleet(object):
                     skip -= 1
                     continue
                 tokens.append(item["token"])
+                if on_first is not None and delivered == 0 \
+                        and len(tokens) == 1:
+                    on_first()
                 if stream:
                     if not started:
                         handler.send_response(200)
@@ -1474,6 +1705,30 @@ class ServingFleet(object):
                              for b in enabled),
         }
 
+    def tenant_rollup(self):
+        """Per-tenant router-side view for /healthz and /v1/stats: what
+        a federated front (and `tpuflow watch`) reads to attribute
+        forwarded / shed traffic and tail latency per tenant."""
+        with self._lock:
+            names = (set(self._tenant_counts)
+                     | set(self._tenant_inflight)
+                     | set(self._tenant_ttft)
+                     | set(self.tenancy.known_tenants()))
+            out = {}
+            for t in sorted(names):
+                window = list(self._tenant_ttft.get(t) or ())
+                counts = self._tenant_counts.get(t) or {}
+                out[t] = {
+                    "forwarded": int(counts.get("forwarded") or 0),
+                    "shed": int(counts.get("shed") or 0),
+                    "inflight": int(self._tenant_inflight.get(t) or 0),
+                    "priority": self.tenancy.priority_name(t),
+                    "weight": self.tenancy.weight(t),
+                    "p50_ttft_ms": _pctl(window, 0.50),
+                    "p99_ttft_ms": _pctl(window, 0.99),
+                }
+        return {"enabled": self.tenancy.enabled(), "tenants": out}
+
     def healthz(self):
         ready = sum(1 for h in self.handles if h.state == "ready")
         with self._lock:
@@ -1497,11 +1752,16 @@ class ServingFleet(object):
             # SLO breach state: what `tpuflow watch --check` and external
             # monitors gate on without reading telemetry
             "slo": {"breached": bool(breaches), "breaches": breaches},
+            "tenants": self.tenant_rollup(),
         }
 
     def stats(self):
+        tenants = self.tenant_rollup()
         with self._lock:
             return {
+                "tenancy": tenants,
+                "cache_route": {"hits": self.cache_route_hits,
+                                "misses": self.cache_route_misses},
                 "replicas": [h.describe() for h in self.handles],
                 "dispatched": self.dispatch_count,
                 "completed": self.completed,

@@ -56,6 +56,44 @@ def _common_prefix(a, b):
     return int(np.argmin(eq))
 
 
+# ---------------------------------------------------------------------------
+# Routing digests: the compact prefix summary replicas publish through
+# /healthz so the fleet router can score "who already holds this prompt's
+# longest prefix" without shipping token sequences over the wire. The
+# vocabulary is a rolling sha1 chain over BLOCK-aligned token blocks —
+# identical to the paged index's page-key chain, so for a paged replica
+# the published digests ARE its cached page keys. A digest identifies
+# both content and position (the chain folds in everything before it),
+# so set-membership of the request's chain against a replica's digest
+# set is exactly "this block-aligned prefix is cached there".
+# ---------------------------------------------------------------------------
+
+ROUTE_DIGEST_HEX = 16     # published hex chars per digest (64-bit)
+
+
+def _chain_key(prev_key, tokens):
+    h = hashlib.sha1(prev_key)
+    h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+def route_digest_chain(tokens, block):
+    """The rolling block-digest chain of a token sequence: one hex
+    digest per complete `block`-token prefix, in prefix order. The
+    router computes this for a request's prompt; replicas publish the
+    same chains for their cached prefixes."""
+    tokens = _as_tokens(tokens)
+    block = int(block)
+    if block <= 0:
+        return []
+    out = []
+    key = b"root"
+    for i in range(tokens.size // block):
+        key = _chain_key(key, tokens[i * block:(i + 1) * block])
+        out.append(key.hex()[:ROUTE_DIGEST_HEX])
+    return out
+
+
 class _Node(object):
     __slots__ = ("tokens", "k", "v", "children", "parent", "refs",
                  "last_use")
@@ -276,6 +314,37 @@ class RadixPrefixCache(object):
                 "evicted_tokens": self._evicted_tokens,
             }
 
+    def route_digests(self, block, limit=512):
+        """Block-digest summary of every cached prefix (newest-capped):
+        the compact routing vocabulary published through /healthz. A
+        radix edge can end mid-block; the partial remainder rides down
+        to the children, so only block-aligned prefixes produce
+        digests — the same alignment the router's request chain uses."""
+        block = int(block)
+        if block <= 0:
+            return []
+        out = []
+        with self._lock:
+            empty = np.zeros(0, np.int32)
+            stack = [(self._root, b"root", empty)]
+            while stack and len(out) < limit:
+                node, key, rem = stack.pop()
+                if node is self._root:
+                    toks = rem
+                else:
+                    toks = np.concatenate([rem, node.tokens])
+                n_full = toks.size // block
+                for i in range(n_full):
+                    key = _chain_key(key,
+                                     toks[i * block:(i + 1) * block])
+                    out.append(key.hex()[:ROUTE_DIGEST_HEX])
+                    if len(out) >= limit:
+                        break
+                rem = toks[n_full * block:]
+                for child in node.children.values():
+                    stack.append((child, key, rem))
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Page-granular prefix index (the paged engine's zero-copy counterpart)
@@ -368,9 +437,9 @@ class PagedPrefixIndex(object):
 
     @staticmethod
     def _chain(prev_key, tokens):
-        h = hashlib.sha1(prev_key)
-        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
-        return h.digest()
+        # shared with route_digest_chain: a paged replica's published
+        # routing digests are literally its cached page keys
+        return _chain_key(prev_key, tokens)
 
     # ---------- lookup ----------
 
@@ -538,3 +607,14 @@ class PagedPrefixIndex(object):
             "evictions": self._evictions,
             "evicted_pages": self._evicted_pages,
         }
+
+    def route_digests(self, block=None, limit=512):
+        """Routing summary for the fleet router: the cached full-page
+        chain keys, most-recently-used first. `block` is ignored — a
+        paged index's digest block IS its page size (publish
+        page_tokens as route_block alongside these)."""
+        with self._lock:
+            entries = sorted(self._full.values(),
+                             key=lambda e: e.last_use, reverse=True)
+        return [e.key.hex()[:ROUTE_DIGEST_HEX]
+                for e in entries[:int(limit)]]

@@ -36,6 +36,7 @@ from collections import deque
 from .. import knobs, telemetry
 from .. import tracing
 from .paged import PageExhaustedError
+from .tenancy import TenancyConfig, TenantQueues, TokenBudgets
 
 _request_ids = itertools.count(1)
 
@@ -64,6 +65,19 @@ class CapacityError(Exception):
     would only fail later, mid-decode or at admit."""
 
 
+class TenantThrottledError(Exception):
+    """Per-tenant admission control rejected the request (token budget
+    exhausted or queue share exceeded). Carries the TENANT-scoped
+    Retry-After — a throttled low-priority tenant must not inherit the
+    global capacity hint."""
+
+    def __init__(self, message, tenant, reason, retry_after_s):
+        super(TenantThrottledError, self).__init__(message)
+        self.tenant = tenant
+        self.reason = reason          # "budget" | "queue_share"
+        self.retry_after_s = float(retry_after_s)
+
+
 class Request(object):
     """One generation request: prompt tokens in, a stream of generated
     tokens out (thread-safe queue the HTTP layer consumes)."""
@@ -71,9 +85,12 @@ class Request(object):
     def __init__(self, tokens, max_new_tokens, temperature=0.0, top_k=None,
                  top_p=None, eos_id=None, rng=0, deadline=None,
                  request_id=None, traceparent=None, prefill_only=False,
-                 prefilled=None):
+                 prefilled=None, tenant=None):
         self.id = str(request_id) if request_id is not None \
             else "req-%d" % next(_request_ids)
+        # multi-tenancy: None == untagged (single-tenant traffic) — no
+        # per-tenant bookkeeping, no serve.tenant.* telemetry
+        self.tenant = str(tenant) if tenant else None
         # W3C trace context for this request (minted by the fleet router
         # or the HTTP server; None = untraced). Stamped into every
         # serve.request.* telemetry record.
@@ -143,9 +160,17 @@ class Request(object):
 
 class Scheduler(object):
     def __init__(self, engine, max_queue=64, prefill_budget=None,
-                 prefix_cache=None):
+                 prefix_cache=None, tenancy=None):
         self.engine = engine
         self.max_queue = int(max_queue)
+        # multi-tenancy: per-tenant DRR queues + budgets (tenancy.py).
+        # An empty config (the default) makes every surface below
+        # degrade to the exact single-FIFO behavior it replaced.
+        self.tenancy = (TenancyConfig.from_env() if tenancy is None
+                        else tenancy)
+        self._budgets = TokenBudgets(self.tenancy)
+        self._tenant_counts = {}     # tenant -> counts dict
+        self._tenant_ttft = {}       # tenant -> rolling TTFT window
         # optional RadixPrefixCache: admit seeds the longest cached
         # prefix into the slot, prefill resumes at the boundary, and a
         # finished prefill inserts the slot's KV back for the next hit
@@ -163,7 +188,7 @@ class Scheduler(object):
         if self.prefill_budget < 1:
             raise ValueError("prefill_budget must be >= 1, got %d"
                              % self.prefill_budget)
-        self._queue = deque()
+        self._queue = TenantQueues(self.tenancy)
         self._slots = {}          # slot index -> Request
         self._cond = threading.Condition()
         self._draining = False
@@ -211,24 +236,90 @@ class Scheduler(object):
                 "engine (max context %d tokens)"
                 % (len(request.tokens), request.max_new_tokens,
                    self.max_context_tokens()))
+        tenant = request.tenant
         with self._cond:
             if self._draining or self._stopped:
                 raise DrainingError("scheduler is draining")
+            if tenant is not None and self.tenancy.enabled():
+                self._tenant_admission_locked(request, tenant)
             if len(self._queue) >= self.max_queue:
-                raise QueueFullError(
-                    "queue full (%d requests)" % len(self._queue))
+                # a higher-priority tenant may evict the newest queued
+                # request of a lower tier instead of being turned away
+                if not self._priority_shed_locked(request):
+                    raise QueueFullError(
+                        "queue full (%d requests)" % len(self._queue))
             request.out = _q.Queue()
             request.state = "queued"
             request.t_submit = time.time()
             self._queue.append(request)
             depth = len(self._queue)
+            tdepth = (self._queue.tenant_depth(tenant)
+                      if tenant is not None else 0)
             self._cond.notify_all()
         telemetry.event("serve.request.queued", data=self._tdata(request, {
             "request_id": request.id, "queue_depth": depth,
             "prompt_tokens": len(request.tokens),
             "max_new_tokens": request.max_new_tokens}))
         telemetry.gauge("serve.queue_depth", depth)
+        if tenant is not None:
+            telemetry.gauge("serve.tenant.queue_depth", tdepth,
+                            data={"tenant": tenant})
         return request
+
+    # ---------- multi-tenant admission ----------
+
+    def _counts_for(self, tenant):
+        counts = self._tenant_counts.get(tenant)
+        if counts is None:
+            counts = self._tenant_counts[tenant] = {
+                "admitted": 0, "throttled": 0, "shed": 0,
+                "prompt_tokens": 0, "generated_tokens": 0}
+        return counts
+
+    def _tenant_admission_locked(self, request, tenant):
+        """Budget + queue-share checks; raises TenantThrottledError
+        with the tenant's OWN Retry-After."""
+        share = self.tenancy.share(tenant, self.max_queue)
+        if self._queue.tenant_depth(tenant) >= share:
+            # back off on the tenant's queue drain rate, not global
+            # pressure: its share of slots drains its share of queue
+            slots = max(1, self.tenancy.share(
+                tenant, self.engine.max_slots))
+            wait = min(60, max(1, -(-share // slots)))
+            self._throttle(request, tenant, "queue_share", wait)
+        cost = len(request.tokens) + request.max_new_tokens
+        wait = self._budgets.charge(tenant, cost)
+        if wait > 0:
+            self._throttle(request, tenant, "budget", wait)
+
+    def _throttle(self, request, tenant, reason, retry_after_s):
+        self._counts_for(tenant)["throttled"] += 1
+        telemetry.event("serve.tenant.throttled", data=self._tdata(
+            request, {"request_id": request.id, "tenant": tenant,
+                      "reason": reason,
+                      "retry_after_s": round(float(retry_after_s), 3)}))
+        raise TenantThrottledError(
+            "tenant %s throttled (%s); retry in %.1fs"
+            % (tenant, reason, retry_after_s),
+            tenant=tenant, reason=reason, retry_after_s=retry_after_s)
+
+    def _priority_shed_locked(self, request):
+        """Queue full: a strictly higher-priority submission evicts the
+        newest queued request of the worst lower tier. Returns True
+        when a slot was freed."""
+        if request.tenant is None or not self.tenancy.enabled():
+            return False
+        victim = self._queue.shed_lowest_priority(
+            below_tier=self.tenancy.priority(request.tenant))
+        if victim is None:
+            return False
+        vtenant = victim.tenant or self.tenancy.default_tenant
+        self._counts_for(vtenant)["shed"] += 1
+        telemetry.event("serve.tenant.shed", data=self._tdata(victim, {
+            "request_id": victim.id, "tenant": vtenant,
+            "reason": "priority"}))
+        self._finish(victim, "shed")
+        return True
 
     def cancel(self, request_id):
         """Flag a queued or in-flight request; the next iteration reaps
@@ -289,6 +380,8 @@ class Scheduler(object):
                 else "serve.request.cancelled")
         data = {"request_id": req.id, "reason": reason,
                 "new_tokens": len(req.generated)}
+        if req.tenant is not None:
+            data["tenant"] = req.tenant
         if req.slot is not None:
             data["slot"] = req.slot
         if req.t_first is not None and req.t_submit is not None:
@@ -300,6 +393,9 @@ class Scheduler(object):
             self.served += 1
         else:
             self.cancelled_count += 1
+        if req.tenant is not None and req.generated:
+            self._counts_for(req.tenant)["generated_tokens"] += len(
+                req.generated)
         req.out.put(None)
 
     def _deliver(self, req, token):
@@ -309,12 +405,20 @@ class Scheduler(object):
         req.token_times.append(now)
         if req.t_first is None:
             req.t_first = now
-            self._ttft_window.append((now - req.t_submit) * 1000)
+            ttft_ms = (now - req.t_submit) * 1000
+            self._ttft_window.append(ttft_ms)
+            if req.tenant is not None:
+                window = self._tenant_ttft.get(req.tenant)
+                if window is None:
+                    window = self._tenant_ttft[req.tenant] = deque(
+                        maxlen=self._ttft_window.maxlen)
+                window.append(ttft_ms)
+            data = {"request_id": req.id, "slot": req.slot,
+                    "ttft_ms": round(ttft_ms, 3)}
+            if req.tenant is not None:
+                data["tenant"] = req.tenant
             telemetry.event("serve.request.first_token",
-                            data=self._tdata(req, {
-                                "request_id": req.id, "slot": req.slot,
-                                "ttft_ms": round(
-                                    (now - req.t_submit) * 1000, 3)}))
+                            data=self._tdata(req, data))
         elif prev is not None:
             self._itl_window.append((now - prev) * 1000)
         req.out.put(token)
@@ -448,6 +552,22 @@ class Scheduler(object):
             telemetry.event("serve.request.prefill", data=self._tdata(req, {
                 "request_id": req.id, "slot": slot,
                 "queue_ms": round((req.t_admit - req.t_submit) * 1000, 3)}))
+            if req.tenant is not None:
+                counts = self._counts_for(req.tenant)
+                counts["admitted"] += 1
+                counts["prompt_tokens"] += len(req.tokens)
+                telemetry.event("serve.tenant.admitted",
+                                data=self._tdata(req, {
+                                    "request_id": req.id,
+                                    "tenant": req.tenant,
+                                    "prompt_tokens": len(req.tokens),
+                                    "queue_ms": round(
+                                        (req.t_admit - req.t_submit)
+                                        * 1000, 3)}))
+                telemetry.gauge(
+                    "serve.tenant.queue_depth",
+                    self._queue.tenant_depth(req.tenant),
+                    data={"tenant": req.tenant})
             if req.prefilled is not None:
                 # already past prefill: emit the first token now so the
                 # stream carries ALL tokens and eos/length still apply
@@ -686,7 +806,9 @@ class Scheduler(object):
         with self._cond:
             depth = len(self._queue)
             in_flight = len(self._slots)
+            tenant_depths = self._queue.depths()
         return {
+            "tenancy": self.tenant_stats(tenant_depths),
             "queue_depth": depth,
             "in_flight": in_flight,
             "slots": self.engine.max_slots,
@@ -712,6 +834,38 @@ class Scheduler(object):
                             else {"enabled": False}),
             "goodput": self.goodput_stats(),
         }
+
+    def tenant_stats(self, tenant_depths=None):
+        """Per-tenant admission/latency rollup for /v1/stats and the
+        `tpuflow metrics`/`watch` tenant sections."""
+        if tenant_depths is None:
+            with self._cond:
+                tenant_depths = self._queue.depths()
+        tenants = {}
+        # the default bucket holds UNTAGGED requests — it only shows up
+        # here if a tagged tenant actually uses that name
+        names = (set(self._tenant_counts)
+                 | set(self.tenancy.known_tenants())
+                 | (set(tenant_depths)
+                    - {self.tenancy.default_tenant}))
+        for t in sorted(names):
+            counts = self._tenant_counts.get(t) or {
+                "admitted": 0, "throttled": 0, "shed": 0,
+                "prompt_tokens": 0, "generated_tokens": 0}
+            window = list(self._tenant_ttft.get(t, ()))
+            tenants[t] = {
+                "queued": tenant_depths.get(t, 0),
+                "admitted": counts["admitted"],
+                "throttled": counts["throttled"],
+                "shed": counts["shed"],
+                "prompt_tokens": counts["prompt_tokens"],
+                "generated_tokens": counts["generated_tokens"],
+                "priority": self.tenancy.priority_name(t),
+                "weight": self.tenancy.weight(t),
+                "p50_ttft_ms": _pctl(window, 0.50),
+                "p99_ttft_ms": _pctl(window, 0.99),
+            }
+        return {"enabled": self.tenancy.enabled(), "tenants": tenants}
 
     def goodput_stats(self):
         """Chip-second split in the goodput taxonomy
@@ -756,4 +910,21 @@ class Scheduler(object):
         }
         if self.prefix_cache is not None:
             out.update(self.prefix_cache.stats())
+            # cache-aware routing summary: the compact digest set the
+            # fleet router scores dispatch against (cache_router.py).
+            # Rides the stats/healthz channel — no new wire protocol.
+            block = self.route_block()
+            out["route_block"] = block
+            out["digests"] = self.prefix_cache.route_digests(
+                block,
+                limit=knobs.get_int("TPUFLOW_CACHE_ROUTE_DIGESTS"))
         return out
+
+    def route_block(self):
+        """The digest block size this replica publishes: a paged index
+        digests at page granularity (its keys ARE page-chain digests),
+        the radix cache at the configured routing block."""
+        if self.prefix_cache is None:
+            return 0
+        return int(getattr(self.prefix_cache, "page_tokens", 0)
+                   or knobs.get_int("TPUFLOW_CACHE_ROUTE_BLOCK"))

@@ -37,7 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .. import tracing
 from .disagg import decode_handoff, encode_handoff
 from .scheduler import (CapacityError, DrainingError, QueueFullError,
-                        Request)
+                        Request, TenantThrottledError)
 
 STREAM_TIMEOUT_S = 300.0
 
@@ -64,6 +64,9 @@ def _request_from_payload(payload, prefill_only=False, prefilled=None):
         import time
 
         deadline = time.time() + float(payload["deadline_ms"]) / 1000.0
+    tenant = payload.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        raise ValueError("'tenant' must be a string")
     return Request(
         tokens,
         max_new_tokens=int(payload.get("max_new_tokens", 16)),
@@ -76,6 +79,7 @@ def _request_from_payload(payload, prefill_only=False, prefilled=None):
         request_id=payload.get("request_id"),
         prefill_only=prefill_only,
         prefilled=prefilled,
+        tenant=tenant or None,
     )
 
 
@@ -153,12 +157,16 @@ class _Handler(BaseHTTPRequestHandler):
                 "p99_ttft_ms": stats["p99_ttft_ms"],
                 "p50_itl_ms": stats["p50_itl_ms"],
                 "p99_itl_ms": stats["p99_itl_ms"],
-                # prefix-cache effectiveness (hit rate / bytes / evictions)
+                # prefix-cache effectiveness (hit rate / bytes /
+                # evictions) + the compact routing-digest summary the
+                # fleet's cache-aware dispatch scores against
                 "prefix_cache": {
                     "enabled": prefix["enabled"],
                     "hit_rate": prefix["hit_rate"],
                     "cached_bytes": prefix.get("cached_bytes", 0),
                     "evictions": prefix.get("evictions", 0),
+                    "route_block": prefix.get("route_block", 0),
+                    "digests": prefix.get("digests", []),
                 },
             })
             return
@@ -214,6 +222,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(413, {"error": str(ex)},
                        headers=self._shed_headers(draining=False))
             return False
+        except TenantThrottledError as ex:
+            # the TENANT's own backoff hint (budget-window remainder or
+            # its queue-share drain time), never the global capacity
+            # hint — and the tenant id rides the body for client-side
+            # per-tenant backoff state
+            self._json(429, {"error": str(ex), "reason": ex.reason,
+                             "tenant": ex.tenant},
+                       headers={"Retry-After": str(int(max(
+                           1, math.ceil(ex.retry_after_s))))})
+            return False
         except QueueFullError as ex:
             self._json(429, {"error": str(ex)},
                        headers=self._shed_headers(draining=False))
@@ -246,6 +264,15 @@ class _Handler(BaseHTTPRequestHandler):
             if req.reason == "rejected":
                 self._json(400, {"error": getattr(req, "error",
                                                   "rejected")})
+                return
+            if req.reason == "shed":
+                # evicted from the queue by a higher-priority tenant:
+                # backpressure (retryable), tenant echoed for client
+                # backoff bookkeeping
+                self._json(429, {"error": "shed by a higher-priority "
+                                          "tenant", "reason": "priority",
+                                 "tenant": req.tenant},
+                           headers=self._shed_headers(draining=False))
                 return
             self._json(200, {
                 "id": req.id,
@@ -348,6 +375,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if first is None and req.reason == "rejected":
             self._json(400, {"error": getattr(req, "error", "rejected")})
+            return
+        if first is None and req.reason == "shed":
+            self._json(429, {"error": "shed by a higher-priority tenant",
+                             "reason": "priority", "tenant": req.tenant},
+                       headers=self._shed_headers(draining=False))
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/jsonl")

@@ -22,6 +22,8 @@ Environment shorthands (value = threshold)::
     TPUFLOW_SLO_INPUT_STALL_FRAC       -> input_stall_frac
     TPUFLOW_SLO_RESTART_RATE_PER_MIN   -> replica_restart_rate_per_min
     TPUFLOW_SLO_DESYNC                 -> desync_count
+    TPUFLOW_SLO_TENANT_P99_TTFT_MS     -> tenant.<id>.p99_ttft_ms (every
+                                          tenant; see tenant_rules())
 
 A rule whose metric is absent from the metrics dict (or None) is not
 evaluated — an idle fleet with no latency samples yet is not in breach.
@@ -43,6 +45,13 @@ ENV_RULES = (
 )
 
 SLO_FILE_VAR = "TPUFLOW_SLO_FILE"
+
+# per-tenant shorthands: the threshold applies to EVERY tenant's metric
+# (tenant.<id>.<metric>), synthesized against the live metric set by
+# tenant_rules() because the tenant population is dynamic
+TENANT_ENV_RULES = (
+    ("TPUFLOW_SLO_TENANT_P99_TTFT_MS", "p99_ttft_ms"),
+)
 
 
 class SLORule(object):
@@ -88,6 +97,29 @@ def load_rules(path=None, env=None):
             rules.append(SLORule(metric, metric, float(raw)))
         except ValueError:
             raise ValueError("%s=%r is not a number" % (var, raw))
+    return rules
+
+
+def tenant_rules(metrics, env=None):
+    """Per-tenant rules from TPUFLOW_SLO_TENANT_* shorthands: one rule
+    per ``tenant.<id>.<metric>`` key present in `metrics`. Returns []
+    when no shorthand is set — the common path stays allocation-free.
+    Evaluated fresh each health tick so tenants that appear (or idle
+    out) after startup are covered without a restart."""
+    env = os.environ if env is None else env
+    rules = []
+    for var, metric in TENANT_ENV_RULES:
+        raw = env.get(var)
+        if raw in (None, ""):
+            continue
+        try:
+            bound = float(raw)
+        except ValueError:
+            raise ValueError("%s=%r is not a number" % (var, raw))
+        suffix = "." + metric
+        for name in sorted(metrics):
+            if name.startswith("tenant.") and name.endswith(suffix):
+                rules.append(SLORule(name, name, bound))
     return rules
 
 

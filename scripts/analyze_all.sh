@@ -88,7 +88,7 @@ if [ "$#" -eq 0 ]; then
                     zero_train_flow.py prefix_serve_flow.py \
                     hang_chaos_flow.py mpmd_pipeline_flow.py \
                     paged_serve_flow.py goodput_demo_flow.py \
-                    online_loop_flow.py; do
+                    online_loop_flow.py tenant_serve_flow.py; do
         if [ ! -f "$ROOT/tests/flows/$required" ]; then
             echo "analyze_all: required flow missing from sweep: $required" >&2
             fail=1

@@ -1,0 +1,162 @@
+"""Multi-tenant cache-aware fleet serving end to end: a run checkpoints
+trained weights, then a 2-replica fleet with radix prefix caches serves
+two tenants — interactive `api` (priority high) and background `batch`
+(priority low, token-budgeted) — each with its own disjoint system
+prompt. The cache-aware router (serving/cache_router.py) steers repeat
+prompts onto the replica that already holds their prefix KV, answers
+stay token-identical across warm routing, and a `batch` flood past its
+budget is refused with a tenant-scoped Retry-After while `api` keeps
+being served — the priority-inversion attempt fails."""
+
+import metaflow_tpu
+from metaflow_tpu import FlowSpec, current, step
+
+
+class TenantServeFlow(FlowSpec):
+    @metaflow_tpu.checkpoint
+    @step
+    def start(self):
+        import dataclasses
+
+        import jax
+
+        from metaflow_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(jax.random.PRNGKey(17), cfg)
+        current.checkpoint.save(
+            {"params": params, "cfg": dataclasses.asdict(cfg)}, step=0)
+        self.next(self.serve)
+
+    @step
+    def serve(self):
+        import http.client
+        import json
+        import os
+        import time
+
+        from metaflow_tpu.elastic.policy import BackoffPolicy
+        from metaflow_tpu.serving import (
+            FleetConfig,
+            ServingFleet,
+            SubprocessReplicaSpawner,
+        )
+
+        # tenancy contract for this fleet AND its replica subprocesses
+        # (they inherit the environment): api is interactive/high,
+        # batch is background/low with a 150-token budget per minute
+        os.environ["TPUFLOW_TENANT_PRIORITIES"] = "api=high,batch=low"
+        os.environ["TPUFLOW_TENANT_WEIGHTS"] = "api=4,batch=1"
+        os.environ["TPUFLOW_TENANT_BUDGETS"] = "batch=150"
+        os.environ["TPUFLOW_TENANT_BUDGET_WINDOW_S"] = "60"
+        os.environ["TPUFLOW_CACHE_ROUTE"] = "1"
+
+        replica_args = [
+            "--flow", current.flow_name, "--run-id", str(current.run_id),
+            "--step-name", "start", "--slots", "2",
+            "--max-seq-len", "64", "--prefill-chunk", "16",
+            "--prefix-cache-mb", "16",
+        ]
+        config = FleetConfig(
+            failover=True, restart=True, spawn_timeout_s=300.0,
+            wait_s=60.0, health_interval_s=0.5,
+            backoff=BackoffPolicy(base_s=0.2, cap_s=0.5, jitter=0.0,
+                                  seed=0))
+        fleet = ServingFleet(
+            SubprocessReplicaSpawner(replica_args,
+                                     spawn_timeout_s=300.0),
+            2, config=config, echo=print)
+        fleet.start()
+
+        # disjoint 32-token system prompts: exactly two route-digest
+        # blocks each, so a repeat scores past the warm threshold
+        api_sys = list(range(2, 34))
+        batch_sys = list(range(100, 132))
+
+        def ask(tenant, tokens, seed):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", fleet.port, timeout=300)
+            try:
+                conn.request(
+                    "POST", "/v1/generate",
+                    json.dumps({"tokens": tokens, "max_new_tokens": 4,
+                                "seed": seed, "tenant": tenant}),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                return resp.status, dict(resp.getheaders()), body
+            finally:
+                conn.close()
+
+        try:
+            api_first = []
+            batch_first = []
+            for i in range(3):
+                status, _h, body = ask("api", api_sys + [60 + i, 61, 62],
+                                       seed=i)
+                assert status == 200, body
+                api_first.append(body["new_tokens"])
+                status, _h, body = ask(
+                    "batch", batch_sys + [80 + i, 81, 82], seed=i)
+                assert status == 200, body
+                batch_first.append(body["new_tokens"])
+
+            # let the health poller pick up the replicas' published
+            # prefix digests, then repeat: warm-routed answers must be
+            # token-identical — routing changes WHERE prefill runs,
+            # never what it computes
+            time.sleep(3 * config.health_interval_s)
+            status, _h, body = ask("api", api_sys + [60, 61, 62], seed=0)
+            assert status == 200 and body["new_tokens"] == api_first[0], \
+                (body, api_first[0])
+            status, _h, body = ask("batch", batch_sys + [80, 81, 82],
+                                   seed=0)
+            assert status == 200 \
+                and body["new_tokens"] == batch_first[0], \
+                (body, batch_first[0])
+
+            # the priority-inversion attempt: batch has spent 156 of
+            # its 150-token budget (admit-then-charge lets the last
+            # request overshoot); the next one must be refused with a
+            # Retry-After scoped to ITS budget window, and api must
+            # keep being served at full priority
+            status, headers, body = ask(
+                "batch", batch_sys + [90, 91, 92], seed=9)
+            assert status == 429, (status, body)
+            assert body.get("tenant") == "batch", body
+            assert body.get("reason") == "tenant_budget", body
+            retry_after = int(headers["Retry-After"])
+            assert 1 <= retry_after <= 61, headers
+            status, _h, body = ask("api", api_sys + [63, 64, 65], seed=3)
+            assert status == 200, body
+
+            self.stats = fleet.stats()
+        finally:
+            # graceful drain (not close()): SIGTERM lets each replica
+            # flush its flight recorder, so `tpuflow metrics <run>`
+            # shows the per-tenant admission rows replica-side
+            fleet.shutdown(timeout=30.0)
+        self.next(self.end)
+
+    @step
+    def end(self):
+        tenants = self.stats["tenancy"]["tenants"]
+        assert self.stats["tenancy"]["enabled"], self.stats["tenancy"]
+        assert tenants["api"]["priority"] == "high", tenants
+        assert tenants["api"]["forwarded"] >= 4, tenants
+        assert tenants["api"]["shed"] == 0, tenants
+        assert tenants["batch"]["priority"] == "low", tenants
+        assert tenants["batch"]["shed"] >= 1, tenants
+        route = self.stats["cache_route"]
+        assert route["hits"] + route["misses"] >= 8, route
+        assert route["hits"] >= 1, route
+        print("tenants: api forwarded %d (p99 ttft %s ms), batch "
+              "forwarded %d shed %d; cache routing %d warm / %d cold"
+              % (tenants["api"]["forwarded"],
+                 tenants["api"]["p99_ttft_ms"],
+                 tenants["batch"]["forwarded"], tenants["batch"]["shed"],
+                 route["hits"], route["misses"]))
+
+
+if __name__ == "__main__":
+    TenantServeFlow()

@@ -354,6 +354,7 @@ SERVING_EVENT_DATA_SCHEMAS = {
     ),
     "serve.request.first_token": _obj(
         {"request_id": _STR, "slot": _INT, "ttft_ms": _NUM,
+         "tenant": _STR,
          "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "slot", "ttft_ms"),
     ),
@@ -364,6 +365,7 @@ SERVING_EVENT_DATA_SCHEMAS = {
          # to a decode replica (serving/disagg.py)
          "reason": {"enum": ["eos", "length", "prefilled"]},
          "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM,
+         "tenant": _STR,
          "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason", "new_tokens"),
     ),
@@ -385,11 +387,35 @@ SERVING_EVENT_DATA_SCHEMAS = {
     ),
     "serve.request.cancelled": _obj(
         {"request_id": _STR, "slot": _INT,
+         # "shed": evicted from the queue by a higher-priority tenant
+         # (scheduler._priority_shed_locked)
          "reason": {"enum": ["cancelled", "deadline", "shutdown",
-                             "rejected"]},
+                             "rejected", "shed"]},
          "new_tokens": _INT, "ttft_ms": _NUM, "total_ms": _NUM,
+         "tenant": _STR,
          "trace": _TRACE_HEX, "span": _SPAN_HEX},
         required=("request_id", "reason"),
+    ),
+    # multi-tenant admission (serving/tenancy.py + scheduler): one
+    # admitted per prefill of a tagged request, throttled per budget /
+    # queue-share refusal (the 429 carries the tenant-scoped
+    # Retry-After), shed per priority eviction victim
+    "serve.tenant.admitted": _obj(
+        {"request_id": _STR, "tenant": _STR, "prompt_tokens": _INT,
+         "queue_ms": _NUM, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "tenant", "prompt_tokens", "queue_ms"),
+    ),
+    "serve.tenant.throttled": _obj(
+        {"request_id": _STR, "tenant": _STR,
+         "reason": {"enum": ["budget", "queue_share"]},
+         "retry_after_s": _NUM, "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "tenant", "reason", "retry_after_s"),
+    ),
+    "serve.tenant.shed": _obj(
+        {"request_id": _STR, "tenant": _STR,
+         "reason": {"enum": ["priority"]},
+         "trace": _TRACE_HEX, "span": _SPAN_HEX},
+        required=("request_id", "tenant", "reason"),
     ),
     # paged-KV pool (serving/paged.py + scheduler): page reservation per
     # admit, release per terminal path, zero-copy prefix attach, and the
@@ -428,6 +454,8 @@ SERVING_METRIC_NAMES = {
     "serve.kv.page_occupancy": "gauge",
     "serve.kv.cow_pages": "gauge",
     "serve.spec.accept_rate": "gauge",
+    # per-tenant queue depth, labeled with data={"tenant": ...}
+    "serve.tenant.queue_depth": "gauge",
 }
 
 
@@ -882,7 +910,11 @@ def validate_elastic_record(record):
 # ---------------------------------------------------------------------------
 
 FLEET_SHED_REASONS = ["queue_full", "deadline", "draining", "no_replica",
-                      "replica_lost", "failover_exhausted", "capacity"]
+                      "replica_lost", "failover_exhausted", "capacity",
+                      # multi-tenant admission: over token budget /
+                      # low-priority headroom exhausted (fleet.py
+                      # _admit_tenant — tenant-scoped Retry-After)
+                      "tenant_budget", "priority"]
 
 FLEET_EVENT_DATA_SCHEMAS = {
     "fleet.replica.spawn": _obj(
@@ -916,8 +948,24 @@ FLEET_EVENT_DATA_SCHEMAS = {
         required=("request_id", "from_replica", "attempt", "delivered"),
     ),
     "fleet.request.shed": _obj(
-        {"request_id": _STR, "reason": {"enum": FLEET_SHED_REASONS}},
+        {"request_id": _STR, "reason": {"enum": FLEET_SHED_REASONS},
+         # echoed on every shed of a tagged request so refusals are
+         # attributable per tenant without parsing the error body
+         "tenant": _STR},
         required=("request_id", "reason"),
+    ),
+    # cache-aware dispatch (serving/cache_router.py): one hit/miss per
+    # routed request, scored at the FIRST pick (failover re-dispatch is
+    # a correctness path, not a routing decision)
+    "fleet.cache_route.hit": _obj(
+        {"request_id": _STR, "replica": _INT, "matched_tokens": _INT,
+         "prompt_tokens": _INT, "candidates": _INT},
+        required=("request_id", "replica", "matched_tokens",
+                  "prompt_tokens", "candidates"),
+    ),
+    "fleet.cache_route.miss": _obj(
+        {"request_id": _STR, "replica": _INT, "prompt_tokens": _INT},
+        required=("request_id", "replica", "prompt_tokens"),
     ),
     "chaos.replica_kill": _obj(
         {"dispatch": _INT, "replica": _INT, "replicas": _INT},
@@ -947,6 +995,9 @@ FLEET_EVENT_DATA_SCHEMAS = {
 
 FLEET_METRIC_NAMES = {
     "fleet.replicas_ready": "gauge",
+    # cached-prefix tokens of the replica each routed request landed
+    # on, labeled with data={"replica": ...}
+    "fleet.cache_route.score": "gauge",
 }
 
 
@@ -1200,6 +1251,12 @@ PREFIX_CACHE_HEALTH_SCHEMA = _obj(
         "hit_rate": _NUM,
         "cached_bytes": _INT,
         "evictions": _INT,
+        # cache-aware routing: the digest block size and the compact
+        # prefix-digest summary the fleet router scores dispatch
+        # candidates by (replica healthz only; absent from the fleet
+        # rollup — digests are per-replica state)
+        "route_block": _INT,
+        "digests": _arr(_STR),
     },
     required=("enabled", "hit_rate", "cached_bytes", "evictions"),
 )
@@ -1295,6 +1352,33 @@ _FLEET_POOL = _obj(
     required=("replicas", "ready", "inflight", "occupancy"),
 )
 
+# per-tenant router-side rollup (fleet.tenant_rollup): what a federated
+# front tier and `tpuflow watch` attribute traffic/tail latency by
+_TENANT_ROLLUP_ENTRY = _obj(
+    {
+        "forwarded": _INT,
+        "shed": _INT,
+        "inflight": _INT,
+        "priority": {"enum": ["high", "normal", "low"]},
+        "weight": _NUM,
+        "p50_ttft_ms": _NUM,
+        "p99_ttft_ms": _NUM,
+    },
+    required=("forwarded", "shed", "inflight", "priority", "weight",
+              "p50_ttft_ms", "p99_ttft_ms"),
+)
+
+FLEET_TENANTS_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "enabled": _BOOL,
+        "tenants": {"type": "object",
+                    "additionalProperties": _TENANT_ROLLUP_ENTRY},
+    },
+    "required": ["enabled", "tenants"],
+    "additionalProperties": False,
+}
+
 FLEET_HEALTHZ_SCHEMA = _obj(
     {
         "ok": _BOOL,
@@ -1320,11 +1404,14 @@ FLEET_HEALTHZ_SCHEMA = _obj(
             {"breached": _BOOL, "breaches": _arr(SLO_BREACH_SCHEMA)},
             required=("breached", "breaches"),
         ),
+        # multi-tenant rollup: {"enabled": False, "tenants": {}} on an
+        # unconfigured fleet so the schema stays total either way
+        "tenants": FLEET_TENANTS_SCHEMA,
     },
     required=("ok", "draining", "replicas", "ready", "inflight",
               "fleet_generation", "pools", "prefix_cache", "kv_pages",
               "max_context_tokens",
-              "p99_ttft_ms", "p99_itl_ms", "slo"),
+              "p99_ttft_ms", "p99_itl_ms", "slo", "tenants"),
 )
 
 
@@ -1642,8 +1729,21 @@ _WATCH_METRICS = _obj(
     required=("records", "replica_flaps", "desync_count",
               "flush_failures", "hang_count"),
 )
+# per-tenant latency metrics carry the tenant id inside the key
+# (tenant.<id>.p50_ttft_ms — the slo.tenant_rules() vocabulary), so
+# they are pinned by pattern rather than enumerated
+_WATCH_METRICS["patternProperties"] = {
+    r"^tenant\..+\.p(50|99)_ttft_ms$": _NUM}
 
 _NULL_NUM = {"type": ["number", "null"]}
+
+# per-tenant admission rollup in a watch frame (tenant ids are data,
+# so the map is keyed by additionalProperties)
+_WATCH_TENANT_ENTRY = _obj(
+    {"admitted": _INT, "throttled": _INT, "shed": _INT,
+     "queue_depth": _NULL_NUM},
+    required=("admitted", "throttled", "shed", "queue_depth"),
+)
 
 WATCH_SNAPSHOT_SCHEMA = _obj(
     {
@@ -1657,6 +1757,8 @@ WATCH_SNAPSHOT_SCHEMA = _obj(
             {"queue_depth": _NULL_NUM, "occupancy": _NULL_NUM},
             required=("queue_depth", "occupancy"),
         ),
+        "tenants": {"type": "object",
+                    "additionalProperties": _WATCH_TENANT_ENTRY},
         "prefix": _obj(
             {"hits": _INT, "misses": _INT, "evictions": _INT},
             required=("hits", "misses", "evictions"),
@@ -1684,8 +1786,8 @@ WATCH_SNAPSHOT_SCHEMA = _obj(
         "breach_events": _arr(SLO_BREACH_SCHEMA),
     },
     required=("v", "run_id", "records", "last_ts", "last_step_num",
-              "metrics", "serve", "prefix", "kv", "fleet", "incidents",
-              "breaches", "breach_events"),
+              "metrics", "serve", "tenants", "prefix", "kv", "fleet",
+              "incidents", "breaches", "breach_events"),
 )
 
 
